@@ -1,0 +1,93 @@
+"""Headline benchmark: training throughput on the available chip(s).
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+
+Round-1 metric: GPT-2 125M training tokens/sec/chip (driver config #1).
+``vs_baseline`` reports measured MFU / 0.45 — the north-star is >=45% MFU
+(BASELINE.md); >1.0 means the target is beaten on this metric.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def peak_flops_per_chip(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    # bf16 peak matmul flops
+    table = {
+        "v5e": 197e12, "v5 lite": 197e12, "v5litepod": 197e12,
+        "v5p": 459e12, "v4": 275e12, "v3": 123e12, "v6e": 918e12,
+    }
+    for k, v in table.items():
+        if k in kind:
+            return v
+    if "tpu" in kind:
+        return 197e12
+    return 1e12  # CPU-sim: nominal
+
+
+def main():
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    n_chips = len(jax.devices())
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = gpt2.GPT2Config.gpt2_125m()
+        cfg.remat = True  # recompute blocks in bwd: O(L) residuals, not O(L) attn maps
+        micro_bs, seq, steps = 8, 1024, 20
+    else:  # CPU smoke mode
+        cfg = gpt2.GPT2Config(vocab_size=2048, max_seq_len=256, num_layers=4,
+                              num_heads=8, hidden_size=256)
+        micro_bs, seq, steps = 2, 128, 5
+    cfg.max_seq_len = max(cfg.max_seq_len, seq)
+
+    config = {
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1 if n_chips > 1 else 0},
+    }
+    model = gpt2.build(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return {"input_ids": rng.integers(
+            0, cfg.vocab_size, size=(engine.train_batch_size(), seq + 1)
+        ).astype(np.int32)}
+
+    # warmup / compile
+    for _ in range(2):
+        _, m = engine.train_batch(batch())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        _, m = engine.train_batch(batch())
+    jax.block_until_ready(engine.state["params"])
+    dt = time.perf_counter() - t0
+
+    tokens = engine.train_batch_size() * seq * steps
+    tok_per_sec_per_chip = tokens / dt / n_chips
+    flops_per_token = 6.0 * cfg.num_params() + 12 * cfg.num_layers * \
+        cfg.hidden_size * seq  # attention term
+    mfu = tok_per_sec_per_chip * flops_per_token / peak_flops_per_chip(
+        jax.devices()[0])
+    print(json.dumps({
+        "metric": "gpt2_125m_train_tokens_per_sec_per_chip" if on_tpu else
+                  "gpt2_smoke_train_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec_per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""deepspeed_tpu — a TPU-native distributed training & inference framework.
+
+Public API mirrors the reference DeepSpeed surface (``deepspeed/__init__.py``):
+``initialize`` (:52), ``init_inference`` (:233), ``add_config_arguments`` (:210),
+``comm``, ``zero`` — implemented TPU-first on JAX/XLA/pjit/Pallas.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from . import comm
+from . import models
+from . import ops
+from .runtime import lr_schedules
+from .runtime.config import DeepSpeedConfig
+from .runtime.engine import DeepSpeedEngine
+from .runtime.model import ModelSpec, from_flax, from_functions
+from .parallel.topology import (MeshTopology, PipeModelDataParallelTopology,
+                                ProcessTopology, topology_from_config)
+from .utils.logging import log_dist, logger
+
+__version__ = "0.1.0"
+__git_hash__ = None
+__git_branch__ = None
+
+
+def initialize(args=None,
+               model: Optional[ModelSpec] = None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mpu=None,
+               dist_init_required: Optional[bool] = None,
+               collate_fn=None,
+               config: Optional[Union[str, dict]] = None,
+               config_params=None):
+    """Initialize the engine (reference ``deepspeed.initialize``, __init__.py:52).
+
+    Returns the same 4-tuple: ``(engine, optimizer, training_dataloader,
+    lr_scheduler)``.  ``model`` is a :class:`ModelSpec` (pure init/loss functions
+    over a param pytree) rather than an ``nn.Module``; ``optimizer`` (optional) is
+    an optax ``GradientTransformation``; everything else is config-driven.
+    """
+    log_dist(f"deepspeed_tpu info: version={__version__}", ranks=[0])
+    config = config if config is not None else config_params
+    if args is not None and hasattr(args, "deepspeed_config") and \
+            args.deepspeed_config is not None:
+        assert config is None, \
+            "Not sure how to proceed, we were given both a deepspeed_config and config"
+        config = args.deepspeed_config
+
+    engine = DeepSpeedEngine(args=args,
+                             model=model,
+                             optimizer=optimizer,
+                             model_parameters=model_parameters,
+                             training_data=training_data,
+                             lr_scheduler=lr_scheduler,
+                             mpu=mpu,
+                             dist_init_required=dist_init_required,
+                             collate_fn=collate_fn,
+                             config=config)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def add_config_arguments(parser):
+    """Argparse plumbing (reference __init__.py:210)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag for user code, no "
+                       "impact on DeepSpeed backend)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="DeepSpeed json configuration file.")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help="Deprecated enable DeepSpeed (helper flag for user "
+                       "code, no impact on DeepSpeed backend)")
+    group.add_argument("--deepscale_config", default=None, type=str,
+                       help="Deprecated DeepSpeed json configuration file.")
+    return parser
+
+
+def add_tuning_arguments(parser):
+    return lr_schedules.add_tuning_arguments(parser)
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Inference engine entry (reference __init__.py:233)."""
+    from .inference.engine import InferenceEngine
+    from .inference.config import DeepSpeedInferenceConfig
+
+    if isinstance(config, dict):
+        config = DeepSpeedInferenceConfig(**config)
+    elif config is None:
+        config = DeepSpeedInferenceConfig(**kwargs)
+    return InferenceEngine(model, config)

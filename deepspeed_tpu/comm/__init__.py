@@ -1,0 +1,8 @@
+from .comm import (ReduceOp, all_gather, all_gather_host, all_reduce,
+                   all_to_all_single, axis_index, barrier, broadcast,
+                   broadcast_in_graph, comms_logger, configure, get_local_rank,
+                   get_mesh, get_process_rank, get_process_world_size, get_rank,
+                   get_topology, get_world_size, get_data_parallel_world_size,
+                   get_expert_parallel_world_size, get_model_parallel_world_size,
+                   init_distributed, is_initialized, log_summary, pmean, ppermute,
+                   reduce_scatter, reset_topology, set_topology)

@@ -1,0 +1,308 @@
+"""Communication facade.
+
+TPU-native analog of reference ``deepspeed/comm/comm.py``: a thin, stable,
+module-level API over the actual transport.  Where the reference routes every
+collective through ``torch.distributed``/NCCL, here there are two transports:
+
+ - **in-graph** collectives (the hot path): ``jax.lax`` primitives compiled by XLA
+   onto ICI/DCN.  Used from inside ``jit``/``shard_map`` with a mesh axis name.
+   These are the equivalents of the NCCL calls the reference issues eagerly.
+ - **host-level** coordination (checkpointing, elasticity, logging): implemented
+   with ``jax.experimental.multihost_utils`` over the JAX distributed KV store.
+
+Every op feeds the comms logger like the reference's ``timed_op`` decorator
+(``comm/comm.py:112``).  In-graph ops are recorded at trace time (message sizes
+only — XLA owns the clock); host ops are wall-timed.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from enum import Enum
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils import comms_logging
+from ..utils.logging import logger
+from ..parallel.topology import (DATA_AXES, MESH_AXES, MeshTopology,
+                                 topology_from_config)
+
+
+class ReduceOp(Enum):
+    SUM = 0
+    PRODUCT = 1
+    MIN = 2
+    MAX = 3
+    BAND = 4
+    BOR = 5
+    BXOR = 6
+    AVG = 7
+    UNUSED = 8
+
+
+# ---------------------------------------------------------------------------
+# Global state (set once by init_distributed / configure)
+# ---------------------------------------------------------------------------
+cdb_initialized = False
+_topology: Optional[MeshTopology] = None
+comms_logger = comms_logging.CommsLogger()
+
+
+def init_distributed(dist_backend: str = "xla",
+                     auto_mpi_discovery: bool = True,
+                     distributed_port: int = 29500,
+                     verbose: bool = True,
+                     timeout=None,
+                     init_method: Optional[str] = None,
+                     dist_init_required: Optional[bool] = None,
+                     config: Optional[dict] = None,
+                     rank: int = -1,
+                     world_size: int = -1) -> None:
+    """Bootstrap multi-process JAX if needed (idempotent).
+
+    Reference analog: ``comm/comm.py:599``.  On TPU pods each host runs one
+    process and ``jax.distributed.initialize`` performs the rendezvous the
+    reference does with env-var/TCP-store init.  Single-process (including
+    CPU-sim meshes) needs no rendezvous and this is a no-op.
+    """
+    global cdb_initialized
+    if cdb_initialized:
+        return
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS") or init_method
+    nproc = int(os.environ.get("JAX_NUM_PROCESSES", world_size if world_size > 0 else 1))
+    if coord and nproc > 1:
+        pid = int(os.environ.get("JAX_PROCESS_ID", rank if rank >= 0 else 0))
+        if verbose:
+            logger.info(f"jax.distributed.initialize coordinator={coord} "
+                        f"process={pid}/{nproc}")
+        jax.distributed.initialize(coordinator_address=coord, num_processes=nproc,
+                                   process_id=pid)
+    cdb_initialized = True
+
+
+def is_initialized() -> bool:
+    return cdb_initialized
+
+
+def configure(config=None, topology: Optional[MeshTopology] = None) -> None:
+    """Install the active mesh topology and comms-logger config."""
+    global _topology
+    if topology is not None:
+        _topology = topology
+    if config is not None and hasattr(config, "comms_logger_enabled"):
+        comms_logger.configure(config)
+
+
+def set_topology(topology: MeshTopology) -> None:
+    configure(topology=topology)
+
+
+def get_topology() -> MeshTopology:
+    global _topology
+    if _topology is None:
+        _topology = MeshTopology()  # all devices on the dp axis
+    return _topology
+
+
+def get_mesh():
+    return get_topology().mesh
+
+
+def reset_topology() -> None:
+    """Testing hook: forget the module-level mesh."""
+    global _topology
+    _topology = None
+
+
+# ---------------------------------------------------------------------------
+# Rank / size queries. "Rank" follows the reference convention of one rank per
+# accelerator; process-level queries are exposed separately.
+# ---------------------------------------------------------------------------
+def get_world_size(group: Optional[Union[str, Sequence[str]]] = None) -> int:
+    """Devices in ``group`` (a mesh axis name / tuple), or the whole world."""
+    topo = get_topology()
+    if group is None:
+        return topo.world_size
+    if isinstance(group, str):
+        group = (group,)
+    size = 1
+    for ax in group:
+        size *= topo.get_dim(ax)
+    return size
+
+def get_rank() -> int:
+    """Rank of the first local device (process_index-scoped, like local rank 0)."""
+    return jax.process_index() * jax.local_device_count()
+
+
+def get_local_rank() -> int:
+    return 0  # one controller process drives all local devices under SPMD
+
+
+def get_process_rank() -> int:
+    return jax.process_index()
+
+
+def get_process_world_size() -> int:
+    return jax.process_count()
+
+
+def get_data_parallel_world_size() -> int:
+    return get_topology().data_parallel_size
+
+
+def get_model_parallel_world_size() -> int:
+    return get_topology().model_parallel_size
+
+
+def get_expert_parallel_world_size() -> int:
+    return get_topology().expert_parallel_size
+
+
+# ---------------------------------------------------------------------------
+# Logging helper
+# ---------------------------------------------------------------------------
+def _nbytes(x) -> int:
+    try:
+        leaves = jax.tree_util.tree_leaves(x)
+        return int(sum(v.size * jnp.dtype(v.dtype).itemsize for v in leaves))
+    except Exception:
+        return 0
+
+
+def _record(op_name: str, tensor, axis, latency: Optional[float] = None,
+            log_name: Optional[str] = None) -> None:
+    if not (comms_logger.enabled and
+            (comms_logger.prof_all or (log_name or op_name) in comms_logger.prof_ops)):
+        return
+    n = get_world_size(axis) if axis is not None else get_world_size()
+    comms_logger.append(op_name, log_name or op_name, latency, _nbytes(tensor), n,
+                        traced=latency is None)
+
+
+# ---------------------------------------------------------------------------
+# In-graph collectives (call from inside jit/shard_map with a mesh axis name).
+# These are 1:1 with the reference API rows in SURVEY §2.3.
+# ---------------------------------------------------------------------------
+_REDUCE_FNS = {
+    ReduceOp.SUM: lax.psum,
+    ReduceOp.MIN: lax.pmin,
+    ReduceOp.MAX: lax.pmax,
+}
+
+
+def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group=DATA_AXES,
+               log_name: str = "all_reduce"):
+    """In-graph all-reduce over mesh axis/axes ``group`` (reference comm.py:522)."""
+    _record("all_reduce", tensor, group, log_name=log_name)
+    if op == ReduceOp.AVG:
+        return lax.pmean(tensor, group)
+    if op == ReduceOp.PRODUCT:
+        logs = lax.psum(jnp.log(jnp.abs(tensor)), group)
+        sign = lax.psum(jnp.where(tensor < 0, 1.0, 0.0), group)
+        return jnp.exp(logs) * jnp.where(sign % 2 == 1, -1.0, 1.0)
+    fn = _REDUCE_FNS.get(op)
+    if fn is None:
+        raise NotImplementedError(f"ReduceOp {op} not supported in-graph")
+    return fn(tensor, group)
+
+
+def all_gather(tensor, group=DATA_AXES, axis: int = 0, tiled: bool = True,
+               log_name: str = "all_gather"):
+    """Concatenating all-gather along tensor dim ``axis`` (reference comm.py:236)."""
+    _record("all_gather", tensor, group, log_name=log_name)
+    return lax.all_gather(tensor, group, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(tensor, group=DATA_AXES, scatter_dimension: int = 0,
+                   tiled: bool = True, log_name: str = "reduce_scatter"):
+    """Reduce-scatter (reference comm.py:293 reduce_scatter_base)."""
+    _record("reduce_scatter", tensor, group, log_name=log_name)
+    return lax.psum_scatter(tensor, group, scatter_dimension=scatter_dimension,
+                            tiled=tiled)
+
+
+def all_to_all_single(tensor, group, split_axis: int, concat_axis: int,
+                      tiled: bool = True, log_name: str = "all_to_all_single"):
+    """All-to-all: scatter ``split_axis``, gather ``concat_axis`` (comm.py:361)."""
+    _record("all_to_all_single", tensor, group, log_name=log_name)
+    return lax.all_to_all(tensor, group, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=tiled)
+
+
+def ppermute(tensor, group, perm, log_name: str = "ppermute"):
+    """Point-to-point ring permute — the TPU analog of pipeline send/recv pairs."""
+    _record("ppermute", tensor, group, log_name=log_name)
+    return lax.ppermute(tensor, group, perm=perm)
+
+
+def axis_index(group):
+    return lax.axis_index(group)
+
+
+def pmean(tensor, group=DATA_AXES, log_name: str = "pmean"):
+    _record("all_reduce", tensor, group, log_name=log_name)
+    return lax.pmean(tensor, group)
+
+
+def broadcast_in_graph(tensor, src_index: int, group, log_name: str = "broadcast"):
+    """In-graph broadcast from ``src_index`` along ``group`` (reference comm.py:224)."""
+    _record("broadcast", tensor, group, log_name=log_name)
+    idx = lax.axis_index(group)
+    src_val = lax.all_gather(tensor, group, axis=0)[src_index]
+    del idx
+    return src_val
+
+
+# ---------------------------------------------------------------------------
+# Host-level coordination ops (outside jit; cross-process)
+# ---------------------------------------------------------------------------
+def barrier(log_name: str = "barrier") -> None:
+    """Cross-process sync point (reference comm.py:462)."""
+    t0 = time.perf_counter()
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(log_name)
+    _record("barrier", jnp.zeros(()), None,
+            latency=(time.perf_counter() - t0) * 1000.0, log_name=log_name)
+
+
+def broadcast(tensor, src: int = 0, log_name: str = "broadcast"):
+    """Host-level broadcast of a pytree from process ``src`` (process 0 only for now)."""
+    t0 = time.perf_counter()
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        assert src == 0, "host-level broadcast only supports src process 0"
+        tensor = multihost_utils.broadcast_one_to_all(tensor)
+    _record("broadcast", tensor, None,
+            latency=(time.perf_counter() - t0) * 1000.0, log_name=log_name)
+    return tensor
+
+
+def all_gather_host(tensor, log_name: str = "all_gather_host"):
+    """Host-level allgather across processes (returns stacked pytree)."""
+    t0 = time.perf_counter()
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        out = multihost_utils.process_allgather(tensor)
+    else:
+        out = jax.tree_util.tree_map(lambda x: jnp.asarray(x)[None], tensor)
+    _record("all_gather", out, None,
+            latency=(time.perf_counter() - t0) * 1000.0, log_name=log_name)
+    return out
+
+
+def log_summary(show_straggler: bool = False):
+    """Print the aggregated comms table (reference comm.py:483)."""
+    return comms_logger.log_all(print_log=True, show_straggler=show_straggler)
+
+
+def get_all_ranks_from_group(group=None):
+    return list(range(get_world_size(group)))

@@ -1,0 +1,76 @@
+"""Inference config (reference ``deepspeed/inference/config.py``).
+
+Same user-facing keys; TPU notes:
+ - ``enable_cuda_graph`` is accepted and ignored — ``jit`` *is* the graph capture
+   (reference captures CUDA graphs at ``inference/engine.py:479``).
+ - ``tensor_parallel.tp_size`` maps to the ``tp`` mesh axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from pydantic import Field
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    """Reference ``inference/config.py:44``."""
+    enabled: bool = True
+    tp_size: int = 1
+    mpu: Optional[Any] = None
+    tp_group: Optional[Any] = None
+
+
+class DeepSpeedMoEConfig(DeepSpeedConfigModel):
+    """Reference ``inference/config.py:62``."""
+    enabled: bool = True
+    ep_size: int = 1
+    moe_experts: list = Field(default_factory=lambda: [1])
+    type: str = "standard"
+
+
+class QuantizationConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    group_size: int = 64
+    num_bits: int = 8
+
+
+class InferenceCheckpointConfig(DeepSpeedConfigModel):
+    checkpoint_dir: Optional[str] = None
+    save_mp_checkpoint_path: Optional[str] = None
+    base_dir: Optional[str] = None
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    """Reference ``inference/config.py:123`` key set."""
+    kernel_inject: bool = Field(False, alias="replace_with_kernel_inject")
+    dtype: str = "bfloat16"
+    tensor_parallel: DeepSpeedTPConfig = Field(
+        default_factory=DeepSpeedTPConfig, alias="tp")
+    enable_cuda_graph: bool = False
+    zero: Dict[str, Any] = Field(default_factory=dict)
+    triangular_masking: bool = True
+    moe: DeepSpeedMoEConfig = Field(default_factory=DeepSpeedMoEConfig)
+    quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
+    checkpoint: Optional[Any] = None
+    base_dir: str = ""
+    max_tokens: int = Field(1024, alias="max_out_tokens")
+    min_out_tokens: int = Field(1, alias="min_tokens")
+    replace_method: str = "auto"
+    injection_policy: Optional[Dict] = Field(None, alias="injection_dict")
+    return_tuple: bool = True
+    training_mp_size: int = 1
+    max_batch_size: int = Field(1, alias="max_out_batch")
+
+    @property
+    def jnp_dtype(self):
+        import jax.numpy as jnp
+
+        return {
+            "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+            "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+            "float32": jnp.float32, "fp32": jnp.float32, "float": jnp.float32,
+            "int8": jnp.int8,
+        }[str(self.dtype).replace("torch.", "")]

@@ -1,0 +1,125 @@
+"""Inference engine.
+
+Analog of reference ``deepspeed/inference/engine.py:35`` (``InferenceEngine``).
+Wraps a :class:`ModelSpec`, shards its params over a ``tp`` mesh axis (the
+auto-TP analog: our models carry Megatron-style PartitionSpecs in ``tp_rules``,
+so "injection" is a sharding annotation instead of a module swap), casts to the
+inference dtype, and compiles the forward.  ``jit`` replaces CUDA-graph
+capture/replay (reference :479/:498).
+
+Round-1 decode is full-recompute greedy generation with fixed shapes (one jitted
+``fori_loop`` over the token budget).  The KV-cache decode-attention Pallas path
+(reference ``softmax_context`` kernels) lands in ``ops/decode_attention.py`` and
+will replace the inner step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import comm as dist
+from ..parallel.topology import MeshTopology
+from ..runtime.engine import _cast_floating
+from ..runtime.model import ModelSpec
+from ..utils.logging import log_dist
+from .config import DeepSpeedInferenceConfig
+
+
+class InferenceEngine:
+
+    def __init__(self, model: ModelSpec, config: DeepSpeedInferenceConfig,
+                 params=None):
+        assert isinstance(model, ModelSpec), (
+            "init_inference expects a deepspeed_tpu ModelSpec")
+        assert model.apply_fn is not None, "ModelSpec.apply_fn required for inference"
+        self.module = model
+        self._config = config
+
+        tp = config.tensor_parallel.tp_size if config.tensor_parallel.enabled else 1
+        dist.init_distributed()
+        n = len(jax.devices())
+        assert n % max(tp, 1) == 0, f"tp_size {tp} does not divide {n} devices"
+        self.topology = MeshTopology(tp=tp, dp=n // max(tp, 1))
+        dist.configure(topology=self.topology)
+        self.mesh = self.topology.mesh
+
+        if params is None:
+            params = model.init(jax.random.PRNGKey(0))
+        params = _cast_floating(params, config.jnp_dtype)
+        tp_specs = model.tp_rules(jax.eval_shape(lambda: params)) \
+            if model.tp_rules else None
+        rep = NamedSharding(self.mesh, P())
+        if tp_specs is not None:
+            shardings = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(self.mesh, spec), tp_specs,
+                is_leaf=lambda x: isinstance(x, P))
+        else:
+            shardings = jax.tree_util.tree_map(lambda _: rep, params)
+        self.params = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s), params, shardings)
+
+        self._forward_fn = jax.jit(
+            lambda p, batch: model.apply_fn(p, batch, None))
+        self._generate_fns: Dict[Any, Any] = {}
+        log_dist(f"InferenceEngine: mesh={self.topology}, dtype={config.dtype}",
+                 ranks=[0])
+
+    # ------------------------------------------------------------------ forward
+    def forward(self, batch):
+        """Logits for a batch (reference ``inference/engine.py:541``)."""
+        batch = self._put_batch(batch)
+        return self._forward_fn(self.params, batch)
+
+    __call__ = forward
+
+    def _put_batch(self, batch):
+        dp_total = self.topology.data_parallel_size
+
+        def put(x):
+            x = jnp.asarray(x)
+            spec = P(("dp", "ep")) if (x.ndim > 0 and x.shape[0] % dp_total == 0) \
+                else P()  # small batches replicate rather than fail
+            return jax.device_put(x, NamedSharding(self.mesh, spec))
+
+        return jax.tree_util.tree_map(put, batch)
+
+    # ----------------------------------------------------------------- generate
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None):
+        """Greedy decode with static shapes (reference ``_generate`` :571;
+        beam search is likewise rejected there)."""
+        input_ids = np.asarray(input_ids)
+        b, prompt_len = input_ids.shape
+        total = prompt_len + max_new_tokens
+        key = (b, prompt_len, max_new_tokens)
+        if key not in self._generate_fns:
+            apply_fn = self.module.apply_fn
+
+            def gen(params, ids):
+                buf = jnp.zeros((b, total), jnp.int32)
+                buf = buf.at[:, :prompt_len].set(ids)
+
+                def body(i, buf):
+                    logits = apply_fn(params, {"input_ids": buf}, None)
+                    next_tok = jnp.argmax(logits[:, i - 1, :], axis=-1)
+                    return buf.at[:, i].set(next_tok.astype(jnp.int32))
+
+                return jax.lax.fori_loop(prompt_len, total, body, buf)
+
+            self._generate_fns[key] = jax.jit(gen)
+        out = self._generate_fns[key](self.params, jnp.asarray(input_ids))
+        out = np.array(out)  # writable host copy (np.asarray view is read-only)
+        if eos_token_id is not None:
+            for row in range(b):
+                hits = np.where(out[row, prompt_len:] == eos_token_id)[0]
+                if hits.size:
+                    out[row, prompt_len + hits[0] + 1:] = eos_token_id
+        return out
+
+    def profile_model_time(self, use_cuda_events: bool = True):
+        pass  # jax.profiler traces replace per-module CUDA-event hooks

@@ -1,0 +1,10 @@
+"""Model zoo: TPU-native implementations of the reference's supported families."""
+
+from . import gpt2
+
+
+def get_model(name: str, **kwargs):
+    name = name.lower().replace("-", "").replace("_", "")
+    if name in ("gpt2", "gpt2125m"):
+        return gpt2.build(**kwargs)
+    raise ValueError(f"unknown model {name!r}")

@@ -1,0 +1,226 @@
+"""GPT-2 family, TPU-native.
+
+Decoder-only transformer written as pure functions over a param pytree, designed
+for the sharding engine rather than ported from torch modules:
+
+ - **Layers are stacked** ``[L, ...]`` and executed with ``lax.scan`` — one
+   compiled block body regardless of depth, and under ZeRO-3 the per-layer weight
+   slice is all-gathered exactly one scan step before use (XLA pipelines the
+   gather with the previous layer's compute), reproducing the reference's
+   ``PartitionedParameterCoordinator`` prefetch semantics without hooks.
+ - ``remat=True`` wraps the block in ``jax.checkpoint`` — the analog of the
+   reference's activation checkpointing (``activation_checkpointing/checkpointing.py``).
+ - ``tp_rules`` emits Megatron-style column/row parallel PartitionSpecs for the
+   attention and MLP weights over the ``tp`` mesh axis.
+
+This is driver config #1's model (GPT-2 125M, reference BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.topology import TP_AXIS
+from ..runtime.model import ModelSpec
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class GPT2Config:
+    vocab_size: int = 50257
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    hidden_size: int = 768
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    remat: bool = False
+    tie_embeddings: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        assert self.hidden_size % self.num_heads == 0
+        return self.hidden_size // self.num_heads
+
+    @property
+    def ffn_size(self) -> int:
+        return self.hidden_size * self.mlp_ratio
+
+    @staticmethod
+    def gpt2_125m() -> "GPT2Config":
+        return GPT2Config(num_layers=12, num_heads=12, hidden_size=768)
+
+    @staticmethod
+    def tiny(vocab_size: int = 512, max_seq_len: int = 64) -> "GPT2Config":
+        return GPT2Config(vocab_size=vocab_size, max_seq_len=max_seq_len,
+                          num_layers=2, num_heads=4, hidden_size=64)
+
+    def num_params(self) -> int:
+        d, l, v, s = self.hidden_size, self.num_layers, self.vocab_size, \
+            self.max_seq_len
+        per_layer = (3 * d * d + 3 * d) + (d * d + d) + \
+            2 * self.mlp_ratio * d * d + (self.mlp_ratio + 1) * d + 4 * d
+        return v * d + s * d + l * per_layer + 2 * d
+
+
+def init_params(cfg: GPT2Config, rng) -> PyTree:
+    d, l = cfg.hidden_size, cfg.num_layers
+    f = cfg.ffn_size
+    keys = jax.random.split(rng, 8)
+    std = 0.02
+    # residual-path projections get the GPT-2 1/sqrt(2L) scaled init
+    res_std = std / math.sqrt(2 * l)
+
+    def normal(key, shape, s=std):
+        return (jax.random.normal(key, shape) * s).astype(jnp.float32)
+
+    return {
+        "wte": normal(keys[0], (cfg.vocab_size, d)),
+        "wpe": normal(keys[1], (cfg.max_seq_len, d), 0.01),
+        "blocks": {
+            "ln1_scale": jnp.ones((l, d)),
+            "ln1_bias": jnp.zeros((l, d)),
+            "qkv_w": normal(keys[2], (l, d, 3 * d)),
+            "qkv_b": jnp.zeros((l, 3 * d)),
+            "o_w": normal(keys[3], (l, d, d), res_std),
+            "o_b": jnp.zeros((l, d)),
+            "ln2_scale": jnp.ones((l, d)),
+            "ln2_bias": jnp.zeros((l, d)),
+            "fc_w": normal(keys[4], (l, d, f)),
+            "fc_b": jnp.zeros((l, f)),
+            "proj_w": normal(keys[5], (l, f, d), res_std),
+            "proj_b": jnp.zeros((l, d)),
+        },
+        "lnf_scale": jnp.ones((d,)),
+        "lnf_bias": jnp.zeros((d,)),
+    }
+
+
+def _layer_norm(x, scale, bias, eps: float = 1e-5):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _block(cfg: GPT2Config, x, layer, mask, rng, dropout: float):
+    """One transformer block. x: [B, S, D]; layer: per-layer param slice."""
+    b, s, d = x.shape
+    h, hd = cfg.num_heads, cfg.head_dim
+
+    y = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+    qkv = y @ layer["qkv_w"].astype(y.dtype) + layer["qkv_b"].astype(y.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+    scores = jnp.where(mask, scores.astype(jnp.float32), -1e9)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    if dropout > 0.0 and rng is not None:
+        keep = jax.random.bernoulli(rng, 1.0 - dropout, probs.shape)
+        probs = probs * keep / (1.0 - dropout)
+    attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
+    x = x + attn @ layer["o_w"].astype(x.dtype) + layer["o_b"].astype(x.dtype)
+
+    y = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+    hid = jax.nn.gelu(y @ layer["fc_w"].astype(y.dtype) +
+                      layer["fc_b"].astype(y.dtype))
+    x = x + hid @ layer["proj_w"].astype(x.dtype) + layer["proj_b"].astype(x.dtype)
+    return x
+
+
+def forward(cfg: GPT2Config, params: PyTree, input_ids, rng=None,
+            train: bool = True):
+    """Token logits. input_ids: [B, S] int32."""
+    b, s = input_ids.shape
+    compute_dtype = params["wte"].dtype
+    x = params["wte"][input_ids] + params["wpe"][:s]
+    x = x.astype(compute_dtype)
+    mask = jnp.tril(jnp.ones((s, s), bool))[None, None, :, :]
+    dropout = cfg.dropout if train else 0.0
+
+    def body(carry, xs):
+        x, idx = carry
+        layer, = xs
+        r = (jax.random.fold_in(rng, idx) if (rng is not None and dropout > 0.0)
+             else None)
+        block_fn = _block
+        if cfg.remat:
+            block_fn = jax.checkpoint(_block, static_argnums=(0, 5))
+        x = block_fn(cfg, x, layer, mask, r, dropout)
+        return (x, idx + 1), None
+
+    (x, _), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.int32)),
+                             (params["blocks"],))
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    logits = x @ params["wte"].T.astype(x.dtype)
+    return logits
+
+
+def loss_from_batch(cfg: GPT2Config, params, batch, rng=None, train: bool = True):
+    """Next-token cross entropy. batch: {"input_ids": [B, S]} (targets = shift)
+    or {"input_ids", "labels"}; label -100 entries are masked (HF convention)."""
+    if isinstance(batch, (tuple, list)):
+        input_ids, labels = batch
+    else:
+        input_ids = batch["input_ids"]
+        labels = batch.get("labels")
+    if labels is None:
+        labels = input_ids[:, 1:]
+        input_ids = input_ids[:, :-1]
+    logits = forward(cfg, params, input_ids, rng=rng, train=train)
+    logits = logits.astype(jnp.float32)
+    valid = labels >= 0
+    safe_labels = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, nll, 0.0)
+    return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def tp_rules(cfg: GPT2Config, abstract_params: PyTree) -> PyTree:
+    """Megatron-style TP: qkv/fc column-parallel, o/proj row-parallel
+    (reference module_inject sharding directions, ``replace_module.py:25``)."""
+    specs = {
+        "wte": P(TP_AXIS, None),
+        "wpe": P(),
+        "blocks": {
+            "ln1_scale": P(), "ln1_bias": P(),
+            "qkv_w": P(None, None, TP_AXIS), "qkv_b": P(None, TP_AXIS),
+            "o_w": P(None, TP_AXIS, None), "o_b": P(),
+            "ln2_scale": P(), "ln2_bias": P(),
+            "fc_w": P(None, None, TP_AXIS), "fc_b": P(None, TP_AXIS),
+            "proj_w": P(None, TP_AXIS, None), "proj_b": P(),
+        },
+        "lnf_scale": P(), "lnf_bias": P(),
+    }
+    return specs
+
+
+def build(cfg: Optional[GPT2Config] = None, **overrides) -> ModelSpec:
+    cfg = cfg or GPT2Config(**overrides)
+
+    def init_fn(rng):
+        return init_params(cfg, rng)
+
+    def loss_fn(params, batch, rng=None, train=True):
+        return loss_from_batch(cfg, params, batch, rng=rng, train=train)
+
+    def apply_fn(params, batch, rng=None):
+        input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        return forward(cfg, params, input_ids, rng=rng, train=False)
+
+    return ModelSpec(init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
+                     tp_rules=lambda ap: tp_rules(cfg, ap),
+                     flops_per_token=6.0 * cfg.num_params(),
+                     name=f"gpt2-{cfg.num_layers}l-{cfg.hidden_size}d")

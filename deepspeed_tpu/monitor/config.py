@@ -1,0 +1,45 @@
+"""Monitor config (reference ``deepspeed/monitor/config.py:22``)."""
+
+from __future__ import annotations
+
+from pydantic import model_validator
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+
+class TensorBoardConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class WandbConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    group: str = None
+    team: str = None
+    project: str = "deepspeed"
+
+
+class CSVConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class DeepSpeedMonitorConfig(DeepSpeedConfigModel):
+    tensorboard: TensorBoardConfig = TensorBoardConfig()
+    wandb: WandbConfig = WandbConfig()
+    csv_monitor: CSVConfig = CSVConfig()
+
+    @property
+    def enabled(self) -> bool:
+        return (self.tensorboard.enabled or self.wandb.enabled
+                or self.csv_monitor.enabled)
+
+
+def get_monitor_config(param_dict: dict) -> DeepSpeedMonitorConfig:
+    monitor_dict = {
+        key: param_dict.get(key, {})
+        for key in ("tensorboard", "wandb", "csv_monitor")
+    }
+    return DeepSpeedMonitorConfig(**monitor_dict)

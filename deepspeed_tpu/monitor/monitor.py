@@ -1,0 +1,112 @@
+"""Monitoring backends.
+
+Analog of reference ``deepspeed/monitor/monitor.py`` (``MonitorMaster`` :25):
+fans out ``(name, value, step)`` events to TensorBoard / W&B / CSV.  Only process
+0 writes (reference checks rank 0 the same way).
+"""
+
+from __future__ import annotations
+
+import os
+from abc import ABC, abstractmethod
+from typing import List, Tuple
+
+from ..utils.logging import logger
+
+
+class Monitor(ABC):
+
+    def __init__(self, monitor_config):
+        self.monitor_config = monitor_config
+
+    @abstractmethod
+    def write_events(self, event_list: List[Tuple]) -> None:
+        ...
+
+
+class TensorBoardMonitor(Monitor):
+
+    def __init__(self, tensorboard_config):
+        super().__init__(tensorboard_config)
+        self.summary_writer = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            log_dir = os.path.join(tensorboard_config.output_path or ".",
+                                   tensorboard_config.job_name)
+            self.summary_writer = SummaryWriter(log_dir=log_dir)
+        except Exception as e:  # tensorboard optional
+            logger.warning(f"tensorboard unavailable: {e}")
+
+    def write_events(self, event_list, flush: bool = True) -> None:
+        if self.summary_writer is None:
+            return
+        for name, value, step in event_list:
+            self.summary_writer.add_scalar(name, value, step)
+        if flush:
+            self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+
+    def __init__(self, wandb_config):
+        super().__init__(wandb_config)
+        self._wandb = None
+        try:
+            import wandb
+
+            wandb.init(project=wandb_config.project, group=wandb_config.group,
+                       entity=wandb_config.team)
+            self._wandb = wandb
+        except Exception as e:
+            logger.warning(f"wandb unavailable: {e}")
+
+    def write_events(self, event_list) -> None:
+        if self._wandb is None:
+            return
+        for name, value, step in event_list:
+            self._wandb.log({name: value}, step=step)
+
+
+class csvMonitor(Monitor):
+
+    def __init__(self, csv_config):
+        super().__init__(csv_config)
+        self.filenames = {}
+        self.log_dir = os.path.join(csv_config.output_path or ".",
+                                    csv_config.job_name)
+        os.makedirs(self.log_dir, exist_ok=True)
+
+    def write_events(self, event_list) -> None:
+        import csv
+
+        for name, value, step in event_list:
+            fname = os.path.join(self.log_dir,
+                                 name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", name])
+                w.writerow([step, float(value)])
+
+
+class MonitorMaster(Monitor):
+
+    def __init__(self, monitor_config):
+        super().__init__(monitor_config)
+        import jax
+
+        self.monitors: List[Monitor] = []
+        self.enabled = monitor_config.enabled
+        if jax.process_index() == 0:
+            if monitor_config.tensorboard.enabled:
+                self.monitors.append(TensorBoardMonitor(monitor_config.tensorboard))
+            if monitor_config.wandb.enabled:
+                self.monitors.append(WandbMonitor(monitor_config.wandb))
+            if monitor_config.csv_monitor.enabled:
+                self.monitors.append(csvMonitor(monitor_config.csv_monitor))
+
+    def write_events(self, event_list) -> None:
+        for m in self.monitors:
+            m.write_events(event_list)

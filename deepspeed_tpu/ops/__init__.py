@@ -1,0 +1,2 @@
+from . import optimizers
+from .optimizers import adagrad, fused_adam, fused_lamb, get_optimizer, lion, sgd

@@ -1,0 +1,4 @@
+from .topology import (DATA_AXES, DP_AXIS, EP_AXIS, MESH_AXES, PP_AXIS, SP_AXIS,
+                       TP_AXIS, ZERO_AXES, MeshTopology, PipeDataParallelTopology,
+                       PipeModelDataParallelTopology, ProcessTopology,
+                       topology_from_config)

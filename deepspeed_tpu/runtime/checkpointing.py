@@ -1,0 +1,182 @@
+"""Checkpoint save/load.
+
+Analog of reference ``runtime/engine.py:3068 save_checkpoint`` /
+``:2708 load_checkpoint`` + the pluggable ``CheckpointEngine``
+(``runtime/checkpoint_engine/checkpoint_engine.py``).  TPU-native storage is
+orbax: sharded arrays are written by all hosts cooperatively (the analog of each
+rank writing its ``zero_pp_rank_*`` partition file) and restored with *current*
+shardings — which gives elastic / universal-checkpoint resharding (reference
+``checkpoint/deepspeed_checkpoint.py``) for free: save under one mesh, load under
+another, orbax + XLA redistribute.
+
+Layout (per the reference's tag-directory protocol)::
+
+    <save_dir>/
+      latest                # text file containing the newest tag (engine.py:3105)
+      <tag>/
+        state/              # orbax pytree: params, opt_state, scaler, step
+        ds_meta.json        # counters, config snapshot, client_state
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from abc import ABC, abstractmethod
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+
+
+class CheckpointEngine(ABC):
+    """Pluggable storage backend (reference ``checkpoint_engine.py``)."""
+
+    def __init__(self, config_params=None):
+        pass
+
+    @abstractmethod
+    def save(self, state_tree, path: str) -> None:
+        ...
+
+    @abstractmethod
+    def load(self, path: str, abstract_target=None):
+        ...
+
+    def makedirs(self, path: str, exist_ok: bool = True) -> None:
+        os.makedirs(path, exist_ok=exist_ok)
+
+    def commit(self, tag: str) -> bool:
+        return True
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    """Async-capable sharded-array storage via orbax (the Nebula-engine analog —
+    reference ``nebula_checkpoint_engine.py`` — is subsumed: orbax is already
+    async + multi-host)."""
+
+    def __init__(self, config_params=None, use_async: bool = False):
+        super().__init__(config_params)
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self._ckptr = ocp.StandardCheckpointer()
+
+    def save(self, state_tree, path: str) -> None:
+        path = os.path.abspath(path)
+        self._ckptr.save(path, state_tree, force=True)
+        self._ckptr.wait_until_finished()
+
+    def load(self, path: str, abstract_target=None):
+        path = os.path.abspath(path)
+        if abstract_target is not None:
+            return self._ckptr.restore(path, target=abstract_target)
+        return self._ckptr.restore(path)
+
+
+class CheckpointManager:
+    """Engine-facing checkpoint orchestration with the reference's tag protocol."""
+
+    def __init__(self, engine, checkpoint_engine: Optional[CheckpointEngine] = None):
+        self.engine = engine
+        self.checkpoint_engine = checkpoint_engine or OrbaxCheckpointEngine()
+
+    # -- tag handling (reference engine.py:3050 _checkpoint_tag_validation) ----
+    def _validate_tag(self, tag: str) -> None:
+        mode = self.engine._config.checkpoint_config.tag_validation.lower()
+        if mode == "ignore" or jax.process_count() == 1:
+            return
+        from .. import comm as dist
+
+        import hashlib
+
+        digest = hashlib.sha256(str(tag).encode()).digest()[:8]
+        h = np.frombuffer(digest, dtype=np.int64)
+        gathered = dist.all_gather_host(jax.numpy.asarray(h))
+        valid = bool(np.all(np.asarray(gathered) == np.asarray(gathered)[0]))
+        if not valid:
+            msg = f"checkpoint tag '{tag}' is not consistent across processes"
+            if mode == "fail":
+                raise RuntimeError(msg)
+            logger.warning(msg)
+
+    def save(self, save_dir: str, tag: Optional[str] = None,
+             client_state: Optional[Dict[str, Any]] = None,
+             save_latest: bool = True) -> str:
+        engine = self.engine
+        if tag is None:
+            tag = f"global_step{engine.global_steps}"
+        self._validate_tag(tag)
+        ckpt_dir = os.path.join(save_dir, str(tag))
+        self.checkpoint_engine.makedirs(ckpt_dir)
+
+        self.checkpoint_engine.save(engine.state, os.path.join(ckpt_dir, "state"))
+        meta = {
+            "tag": str(tag),
+            "global_steps": engine.global_steps,
+            "global_samples": engine.global_samples,
+            "micro_steps": engine.micro_steps,
+            "skipped_steps": engine.skipped_steps,
+            "dp_world_size": engine.topology.data_parallel_size,
+            "mesh": engine.topology.axis_sizes,
+            "zero_stage": engine.zero_stage,
+            "dtype": engine._config.precision_dtype,
+            "lr_scheduler": (engine.lr_scheduler.state_dict()
+                             if engine.lr_scheduler else None),
+            "client_state": client_state or {},
+        }
+        if jax.process_index() == 0:
+            with open(os.path.join(ckpt_dir, "ds_meta.json"), "w") as f:
+                json.dump(meta, f, indent=2)
+            if save_latest:
+                with open(os.path.join(save_dir, "latest"), "w") as f:
+                    f.write(str(tag))
+        from .. import comm as dist
+
+        dist.barrier("checkpoint_save")
+        log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
+        return ckpt_dir
+
+    def load(self, load_dir: str, tag: Optional[str] = None,
+             load_optimizer_states: bool = True, load_module_only: bool = False):
+        engine = self.engine
+        if tag is None:
+            latest_path = os.path.join(load_dir, "latest")
+            if not os.path.isfile(latest_path):
+                logger.warning(f"no 'latest' file at {load_dir}; nothing loaded")
+                return None, {}
+            with open(latest_path) as f:
+                tag = f.read().strip()
+        ckpt_dir = os.path.join(load_dir, str(tag))
+        meta_path = os.path.join(ckpt_dir, "ds_meta.json")
+        meta: Dict[str, Any] = {}
+        if os.path.isfile(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+
+        # abstract target carries *current* shardings -> orbax reshards on read
+        abstract = jax.tree_util.tree_map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            engine.state, engine.state_shardings)
+        if load_module_only or not load_optimizer_states:
+            loaded = self.checkpoint_engine.load(os.path.join(ckpt_dir, "state"),
+                                                 abstract_target=abstract)
+            engine.state["params"] = loaded["params"]
+            if not load_module_only:
+                engine.state["step"] = loaded["step"]
+                engine.state["scaler"] = loaded["scaler"]
+        else:
+            engine.state = self.checkpoint_engine.load(
+                os.path.join(ckpt_dir, "state"), abstract_target=abstract)
+
+        engine.global_steps = int(meta.get("global_steps", 0))
+        engine.global_samples = int(meta.get("global_samples", 0))
+        engine.micro_steps = int(meta.get("micro_steps", 0))
+        engine.skipped_steps = int(meta.get("skipped_steps", 0))
+        if engine.lr_scheduler is not None and meta.get("lr_scheduler"):
+            engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        log_dist(f"loaded checkpoint {ckpt_dir} at step {engine.global_steps}",
+                 ranks=[0])
+        return ckpt_dir, meta.get("client_state", {})

@@ -1,0 +1,281 @@
+"""Master JSON config.
+
+Analog of reference ``deepspeed/runtime/config.py`` (``DeepSpeedConfig`` :704).
+Same user-facing key names; one config dict drives every subsystem.  The batch
+triple (``train_batch_size`` = ``train_micro_batch_size_per_gpu`` ×
+``gradient_accumulation_steps`` × data-parallel world size) is derived/validated
+exactly as the reference does (``config.py:_configure_train_batch_size``), with
+"gpu" read as "chip".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Union
+
+from pydantic import Field
+
+from ..comm.config import DeepSpeedCommsConfig
+from ..monitor.config import DeepSpeedMonitorConfig, get_monitor_config
+from ..profiling.config import (DeepSpeedFlopsProfilerConfig,
+                                get_flops_profiler_config)
+from ..utils.logging import logger
+from . import constants as C
+from .config_utils import (DeepSpeedConfigModel, dict_raise_error_on_duplicate_keys,
+                           get_scalar_param)
+from .zero.config import DeepSpeedZeroConfig, ZeroStageEnum
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+class Fp16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+    fp16_master_weights_and_grads: bool = False
+
+
+class Bf16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    partition_activations: bool = False
+    contiguous_memory_optimization: bool = False
+    cpu_checkpointing: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+
+
+class CheckpointConfig(DeepSpeedConfigModel):
+    tag_validation: str = "Warn"
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: dict = Field(default_factory=dict)
+
+    def __init__(self, **data):
+        super().__init__(**data)
+        if self.tag_validation.capitalize() not in C.CHECKPOINT_TAG_VALIDATION_MODES:
+            raise DeepSpeedConfigError(
+                f"checkpoint.tag_validation must be one of "
+                f"{C.CHECKPOINT_TAG_VALIDATION_MODES}, got {self.tag_validation}")
+
+
+class DataTypesConfig(DeepSpeedConfigModel):
+    grad_accum_dtype: Optional[str] = None
+
+
+class OptimizerConfigBlock(DeepSpeedConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = Field(default_factory=dict)
+    legacy_fusion: bool = False
+
+
+class SchedulerConfigBlock(DeepSpeedConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class DeepSpeedConfig:
+    """Parsed + validated master config.
+
+    ``world_size`` here is the **data-parallel** world size (number of chips
+    divided by tp*pp*sp model axes), matching the reference where
+    ``dp_world_size = world_size // (mp * pp)``.
+    """
+
+    def __init__(self, config: Union[str, dict], world_size: Optional[int] = None,
+                 mesh_topology=None):
+        if isinstance(config, str):
+            if not os.path.exists(config):
+                raise DeepSpeedConfigError(f"config path does not exist: {config}")
+            with open(config) as f:
+                self._param_dict = json.load(
+                    f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        elif isinstance(config, dict):
+            self._param_dict = config
+        else:
+            raise DeepSpeedConfigError(
+                f"expected a dict or json path, got {type(config)}")
+
+        self.mesh_config: Dict[str, int] = dict(self._param_dict.get(C.MESH, {}))
+        if world_size is not None:
+            self.world_size = world_size
+        elif mesh_topology is not None:
+            self.world_size = mesh_topology.data_parallel_size
+        else:
+            self.world_size = 1
+        self._initialize_params(self._param_dict)
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
+    # -- parsing --------------------------------------------------------------
+    def _initialize_params(self, pd: dict) -> None:
+        self.train_batch_size = get_scalar_param(pd, C.TRAIN_BATCH_SIZE, None)
+        self.train_micro_batch_size_per_gpu = get_scalar_param(
+            pd, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU, None)
+        self.gradient_accumulation_steps = get_scalar_param(
+            pd, C.GRADIENT_ACCUMULATION_STEPS, None)
+        self.steps_per_print = get_scalar_param(pd, C.STEPS_PER_PRINT,
+                                                C.STEPS_PER_PRINT_DEFAULT)
+        self.dump_state = get_scalar_param(pd, C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
+        self.seed = get_scalar_param(pd, C.SEED, C.SEED_DEFAULT)
+
+        self.zero_config = DeepSpeedZeroConfig(**pd.get(C.ZERO_OPTIMIZATION, {}))
+        self.zero_optimization_stage = int(self.zero_config.stage)
+        self.zero_enabled = self.zero_optimization_stage > 0
+
+        self.fp16_config = Fp16Config(**pd.get(C.FP16, {}))
+        self.fp16_enabled = self.fp16_config.enabled
+        bf16_dict = pd.get(C.BFLOAT16, pd.get(C.BFLOAT16_OLD, {}))
+        self.bf16_config = Bf16Config(**bf16_dict)
+        self.bfloat16_enabled = self.bf16_config.enabled
+        if self.fp16_enabled and self.bfloat16_enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+        self.precision_dtype = ("float16" if self.fp16_enabled else
+                                "bfloat16" if self.bfloat16_enabled else "float32")
+        self.fp16_auto_cast = self.fp16_config.auto_cast
+        self.loss_scale = self.fp16_config.loss_scale
+        self.initial_dynamic_scale = 2**self.fp16_config.initial_scale_power
+        self.dynamic_loss_scale_args = {
+            "init_scale": 2**self.fp16_config.initial_scale_power,
+            "scale_window": self.fp16_config.loss_scale_window,
+            "min_scale": self.fp16_config.min_loss_scale,
+            "delayed_shift": self.fp16_config.hysteresis,
+        }
+
+        self.gradient_clipping = get_scalar_param(pd, C.GRADIENT_CLIPPING,
+                                                  C.GRADIENT_CLIPPING_DEFAULT)
+        self.prescale_gradients = get_scalar_param(pd, C.PRESCALE_GRADIENTS,
+                                                   C.PRESCALE_GRADIENTS_DEFAULT)
+        self.gradient_predivide_factor = get_scalar_param(
+            pd, C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+        self.sparse_gradients_enabled = get_scalar_param(pd, C.SPARSE_GRADIENTS,
+                                                         C.SPARSE_GRADIENTS_DEFAULT)
+        self.communication_data_type = get_scalar_param(
+            pd, C.COMMUNICATION_DATA_TYPE, C.COMMUNICATION_DATA_TYPE_DEFAULT)
+        self.disable_allgather = get_scalar_param(pd, C.DISABLE_ALLGATHER,
+                                                  C.DISABLE_ALLGATHER_DEFAULT)
+        self.dataloader_drop_last = get_scalar_param(pd, C.DATALOADER_DROP_LAST,
+                                                     C.DATALOADER_DROP_LAST_DEFAULT)
+
+        opt_block = pd.get(C.OPTIMIZER)
+        self.optimizer_config = OptimizerConfigBlock(**opt_block) if opt_block else None
+        self.optimizer_name = (self.optimizer_config.type.lower()
+                               if self.optimizer_config and self.optimizer_config.type
+                               else None)
+        self.optimizer_params = (self.optimizer_config.params
+                                 if self.optimizer_config else None)
+        self.optimizer_legacy_fusion = (self.optimizer_config.legacy_fusion
+                                        if self.optimizer_config else False)
+        self.zero_allow_untested_optimizer = get_scalar_param(
+            pd, C.ZERO_ALLOW_UNTESTED_OPTIMIZER,
+            C.ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT)
+
+        sched_block = pd.get(C.SCHEDULER)
+        self.scheduler_config = (SchedulerConfigBlock(**sched_block)
+                                 if sched_block else None)
+        self.scheduler_name = (self.scheduler_config.type
+                               if self.scheduler_config else None)
+        self.scheduler_params = (self.scheduler_config.params
+                                 if self.scheduler_config else None)
+
+        self.wall_clock_breakdown = get_scalar_param(pd, C.WALL_CLOCK_BREAKDOWN,
+                                                     C.WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.memory_breakdown = get_scalar_param(pd, C.MEMORY_BREAKDOWN,
+                                                 C.MEMORY_BREAKDOWN_DEFAULT)
+        self.monitor_config: DeepSpeedMonitorConfig = get_monitor_config(pd)
+        self.flops_profiler_config: DeepSpeedFlopsProfilerConfig = \
+            get_flops_profiler_config(pd)
+        self.comms_config = DeepSpeedCommsConfig(pd)
+        self.activation_checkpointing_config = ActivationCheckpointingConfig(
+            **pd.get(C.ACTIVATION_CHECKPOINTING, {}))
+        self.checkpoint_config = CheckpointConfig(**pd.get(C.CHECKPOINT, {}))
+        self.load_universal_checkpoint = self.checkpoint_config.load_universal
+        self.use_node_local_storage = self.checkpoint_config.use_node_local_storage
+        self.data_types_config = DataTypesConfig(**pd.get("data_types", {}))
+        self.grad_accum_dtype = self.data_types_config.grad_accum_dtype
+
+        self.elasticity_enabled = bool(pd.get(C.ELASTICITY, {}).get("enabled", False))
+        self.pipeline_config = dict(pd.get(C.PIPELINE, {}))
+        self.compression_config = dict(pd.get("compression_training", {}))
+        self.data_efficiency_config = dict(pd.get(C.DATA_EFFICIENCY, {}))
+        self.curriculum_enabled_legacy = bool(
+            pd.get(C.CURRICULUM_LEARNING_LEGACY, {}).get(
+                C.CURRICULUM_ENABLED_LEGACY, C.CURRICULUM_ENABLED_DEFAULT_LEGACY))
+        self.curriculum_params_legacy = pd.get(C.CURRICULUM_LEARNING_LEGACY, {})
+        self.aio_config = dict(pd.get("aio", {}))
+
+    # -- batch-size triple ----------------------------------------------------
+    def _configure_train_batch_size(self) -> None:
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+        ws = self.world_size
+
+        have = (train is not None, micro is not None, gas is not None)
+        if all(have):
+            pass
+        elif have == (True, True, False):
+            gas = train // micro
+            gas //= ws
+        elif have == (True, False, True):
+            micro = train // ws
+            micro //= gas
+        elif have == (False, True, True):
+            train = micro * gas * ws
+        elif have == (True, False, False):
+            gas = 1
+            micro = train // ws
+        elif have == (False, True, False):
+            gas = 1
+            train = micro * ws
+        elif have == (False, False, True):
+            micro = 1
+            train = gas * ws
+        else:
+            raise DeepSpeedConfigError(
+                "At least one of train_batch_size / train_micro_batch_size_per_gpu /"
+                " gradient_accumulation_steps must be set")
+        self.train_batch_size = train
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = gas
+
+    def _batch_assertion(self) -> None:
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+        assert train > 0, f"Train batch size: {train} has to be greater than 0"
+        assert micro > 0, f"Micro batch size per gpu: {micro} has to be greater than 0"
+        assert gas > 0, f"Gradient accumulation steps: {gas} has to be greater than 0"
+        assert train == micro * gas * self.world_size, (
+            f"Check batch related parameters. train_batch_size is not equal "
+            f"to micro_batch_per_gpu * gradient_acc_step * world_size "
+            f"{train} != {micro} * {gas} * {self.world_size}")
+
+    def _do_sanity_check(self) -> None:
+        self._batch_assertion()
+        if self.zero_enabled and self.zero_optimization_stage > ZeroStageEnum.max_stage:
+            raise DeepSpeedConfigError(
+                f"ZeRO stage {self.zero_optimization_stage} > max "
+                f"{int(ZeroStageEnum.max_stage)}")
+
+    def print_user_config(self) -> None:
+        logger.info("  json = {}".format(
+            json.dumps(self._param_dict, sort_keys=True, indent=4,
+                       separators=(",", ":"), default=repr)))
+
+    def print(self, name: str) -> None:
+        logger.info(f"{name}:")
+        for arg in sorted(vars(self)):
+            if arg != "_param_dict":
+                logger.info(f"  {arg} {'.' * (29 - len(arg))} {getattr(self, arg)}")
+        self.print_user_config()

@@ -1,0 +1,74 @@
+"""Config model base.
+
+Analog of reference ``deepspeed/runtime/config_utils.py`` (``DeepSpeedConfigModel``),
+on pydantic v2.  Supports the reference's ``"auto"`` sentinel on annotated fields and
+deprecated-field aliasing.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+from pydantic import BaseModel, ConfigDict
+
+from ..utils.logging import logger
+
+AUTO = "auto"
+
+
+class DeepSpeedConfigModel(BaseModel):
+    """Base for all config blocks: validate on assignment, warn on unknown keys."""
+
+    model_config = ConfigDict(extra="allow", validate_assignment=True,
+                              populate_by_name=True, arbitrary_types_allowed=True,
+                              protected_namespaces=())
+
+    def __init__(self, strict: bool = False, **data):
+        known = set(self.__class__.model_fields.keys())
+        aliases = {
+            f.alias
+            for f in self.__class__.model_fields.values() if f.alias is not None
+        }
+        unknown = {k for k in data if k not in known and k not in aliases}
+        if unknown:
+            msg = (f"{self.__class__.__name__}: unknown config keys {sorted(unknown)}")
+            if strict:
+                raise ValueError(msg)
+            logger.warning(msg)
+        super().__init__(**data)
+
+    def dict(self, **kwargs) -> Dict[str, Any]:  # pydantic v1 compat shim
+        return self.model_dump(**kwargs)
+
+
+def get_scalar_param(param_dict: dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_list_param(param_dict: dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict: dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """json object_pairs_hook that rejects duplicate keys (reference behavior)."""
+    d = dict((k, v) for k, v in ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = {}
+        for k, _ in ordered_pairs:
+            counter[k] = counter.get(k, 0) + 1
+        keys = [k for k, v in counter.items() if v > 1]
+        raise ValueError(f"Duplicate keys in DeepSpeed config: {keys}")
+    return d
+
+
+class ScientificNotationEncoder(json.JSONEncoder):
+
+    def iterencode(self, o, _one_shot=False):
+        if isinstance(o, float) and o >= 1e3:
+            return iter([f"{o:e}"])
+        return super().iterencode(o, _one_shot=_one_shot)

@@ -1,0 +1,100 @@
+"""Data loading.
+
+Analog of reference ``deepspeed/runtime/dataloader.py`` (``DeepSpeedDataLoader``
+:39, ``RepeatingLoader`` :16).  Single-controller JAX inverts the reference's
+per-rank loaders: one loader yields *global* micro-batches of size
+``micro_batch_per_chip × data_parallel_world``; the jitted step shards them over
+the mesh data axes.  Under multi-process (one controller per host) each process
+loads its slice — handled by ``process_shard`` offsets.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class RepeatingLoader:
+    """Wraps an iterator to restart on StopIteration (reference :16)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __next__(self):
+        try:
+            batch = next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            batch = next(self.data_iter)
+        return batch
+
+
+def default_collate(samples: Sequence[Any]):
+    """Stack a list of samples (dicts/tuples/arrays of numpy) into one batch."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: default_collate([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(default_collate([s[i] for s in samples])
+                           for i in range(len(first)))
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class DeepSpeedDataLoader:
+    """Deterministically shuffled, epoch-aware global micro-batch loader."""
+
+    def __init__(self, dataset, batch_size: int, collate_fn: Optional[Callable] = None,
+                 shuffle: bool = True, seed: int = 0, drop_last: bool = True,
+                 num_local_io_workers: Optional[int] = None,
+                 data_sampler=None, process_rank: int = 0, process_count: int = 1):
+        self.dataset = dataset
+        self.batch_size = batch_size  # global micro-batch size
+        self.collate_fn = collate_fn or default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.data_sampler = data_sampler
+        self.process_rank = process_rank
+        self.process_count = process_count
+        self.epoch = 0
+        if batch_size % max(process_count, 1) != 0:
+            raise ValueError(
+                f"global micro-batch {batch_size} must divide by process count "
+                f"{process_count}")
+        self._len = len(dataset) // batch_size if drop_last else \
+            -(-len(dataset) // batch_size)
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __iter__(self) -> Iterator[Any]:
+        n = len(self.dataset)
+        if self.data_sampler is not None:
+            order = list(iter(self.data_sampler))
+        elif self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            order = rng.permutation(n)
+        else:
+            order = np.arange(n)
+        usable = (n // self.batch_size) * self.batch_size if self.drop_last else n
+        per_proc = self.batch_size // self.process_count
+        for start in range(0, usable, self.batch_size):
+            idx = order[start:start + self.batch_size]
+            if len(idx) < self.batch_size and self.drop_last:
+                return
+            # each controller process materialises only its slice of the batch
+            lo = self.process_rank * per_proc
+            sub = idx[lo:lo + per_proc] if self.process_count > 1 else idx
+            yield self.collate_fn([self.dataset[int(i)] for i in sub])
+        self.epoch += 1

@@ -1,0 +1,94 @@
+"""Loss scaling for fp16 training — functional form.
+
+Analog of reference ``deepspeed/runtime/fp16/loss_scaler.py`` (``LossScaler`` :54,
+``DynamicLossScaler`` :77).  The reference mutates a scaler object between steps;
+under jit the scaler is a small state pytree updated inside the compiled step, so
+overflow handling costs no host sync:
+
+    state  = {cur_scale, cur_hysteresis, good_steps, skipped}
+    scaled_loss = loss * cur_scale
+    overflow    = any(!isfinite(grads))   (global: XLA reduces across the mesh)
+    on overflow: scale /= 2 (after hysteresis), skip update
+    else: after scale_window good steps, scale *= 2
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+CONSECUTIVE_HYSTERESIS = "consecutive_hysteresis"
+MIN_LOSS_SCALE = "min_scale"
+
+
+class LossScaleState(NamedTuple):
+    """All-array state so it passes through jit; whether scaling is *dynamic* is a
+    static property of the compiled step (see ``update_scale(dynamic=...)``)."""
+    cur_scale: jnp.ndarray      # f32 scalar
+    cur_hysteresis: jnp.ndarray  # i32 scalar
+    good_steps: jnp.ndarray     # i32 scalar
+    skipped: jnp.ndarray        # i32 scalar, total skipped steps (diagnostics)
+
+    @staticmethod
+    def create(init_scale: float = 2.0**16,
+               delayed_shift: int = 2) -> "LossScaleState":
+        return LossScaleState(
+            cur_scale=jnp.asarray(init_scale, jnp.float32),
+            cur_hysteresis=jnp.asarray(delayed_shift, jnp.int32),
+            good_steps=jnp.asarray(0, jnp.int32),
+            skipped=jnp.asarray(0, jnp.int32))
+
+
+def has_overflow(grads) -> jnp.ndarray:
+    """True iff any grad entry is NaN/Inf. In-graph global check — the analog of the
+    reference's cross-rank overflow all-reduce (``CheckOverflow``)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.asarray(False)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(g))) for g in leaves]
+    return jnp.any(jnp.stack(flags))
+
+
+def update_scale(state: LossScaleState, overflow: jnp.ndarray,
+                 scale_window: int = 1000, min_scale: float = 1.0,
+                 scale_factor: float = 2.0, delayed_shift: int = 2,
+                 consecutive_hysteresis: bool = False,
+                 dynamic: bool = True) -> LossScaleState:
+    """Post-step scale adjustment (reference ``DynamicLossScaler.update_scale``).
+
+    ``dynamic=False`` reproduces the static ``LossScaler`` (:54): the scale never
+    moves, but skipped steps are still counted.
+    """
+    if not dynamic:
+        return state._replace(skipped=state.skipped + overflow.astype(jnp.int32))
+
+    def on_overflow(s: LossScaleState) -> LossScaleState:
+        exhausted = s.cur_hysteresis <= 1
+        new_scale = jnp.where(
+            exhausted, jnp.maximum(s.cur_scale / scale_factor, min_scale),
+            s.cur_scale)
+        new_hyst = jnp.where(exhausted, s.cur_hysteresis, s.cur_hysteresis - 1)
+        return s._replace(cur_scale=new_scale, cur_hysteresis=new_hyst,
+                          good_steps=jnp.zeros_like(s.good_steps),
+                          skipped=s.skipped + 1)
+
+    def on_good(s: LossScaleState) -> LossScaleState:
+        window_hit = (s.good_steps + 1) % scale_window == 0
+        # reference: hysteresis refills every good step when
+        # consecutive_hysteresis, otherwise only at scale_window boundaries
+        if consecutive_hysteresis:
+            new_hyst = jnp.asarray(delayed_shift, jnp.int32)
+        else:
+            new_hyst = jnp.where(window_hit,
+                                 jnp.asarray(delayed_shift, jnp.int32),
+                                 s.cur_hysteresis)
+        return s._replace(
+            cur_scale=jnp.where(window_hit, s.cur_scale * scale_factor, s.cur_scale),
+            cur_hysteresis=new_hyst, good_steps=s.good_steps + 1)
+
+    return jax.lax.cond(overflow, on_overflow, on_good, state)

@@ -1,0 +1,203 @@
+"""Learning-rate schedules.
+
+Analog of reference ``deepspeed/runtime/lr_schedules.py`` (LRRangeTest :308,
+OneCycle :415, WarmupLR :704, WarmupDecayLR :800), with the same schedule names
+and parameter keys.  TPU-native form: each schedule is a pure ``step -> lr``
+callable (optax schedule), which the engine closes over inside the jitted train
+step — no mutable scheduler object needs checkpointing beyond the step counter.
+
+A thin ``LRScheduler`` wrapper preserves the reference's object API
+(``step()``/``get_lr()``/``state_dict()``) for user code that expects it.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Union
+
+LR_SCHEDULE = "lr_schedule"
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR]
+
+LR_RANGE_TEST_MIN_LR = "lr_range_test_min_lr"
+LR_RANGE_TEST_STEP_RATE = "lr_range_test_step_rate"
+LR_RANGE_TEST_STEP_SIZE = "lr_range_test_step_size"
+LR_RANGE_TEST_STAIRCASE = "lr_range_test_staircase"
+
+CYCLE_MIN_LR = "cycle_min_lr"
+CYCLE_MAX_LR = "cycle_max_lr"
+DECAY_LR_RATE = "decay_lr_rate"
+CYCLE_FIRST_STEP_SIZE = "cycle_first_step_size"
+CYCLE_SECOND_STEP_SIZE = "cycle_second_step_size"
+CYCLE_FIRST_STAIR_COUNT = "cycle_first_stair_count"
+CYCLE_SECOND_STAIR_COUNT = "cycle_second_stair_count"
+DECAY_STEP_SIZE = "decay_step_size"
+
+WARMUP_MIN_LR = "warmup_min_lr"
+WARMUP_MAX_LR = "warmup_max_lr"
+WARMUP_NUM_STEPS = "warmup_num_steps"
+WARMUP_TYPE = "warmup_type"
+WARMUP_LOG_RATE = "log"
+WARMUP_LINEAR_RATE = "linear"
+
+TOTAL_NUM_STEPS = "total_num_steps"
+
+Schedule = Callable[[Any], Any]
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3,
+                  lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0,
+                  lr_range_test_staircase: bool = False, **_) -> Schedule:
+    """Linearly/stepwise increasing LR probe (reference :308)."""
+    import jax.numpy as jnp
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        interval = (jnp.floor(step / lr_range_test_step_size)
+                    if lr_range_test_staircase else step / lr_range_test_step_size)
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+
+    return schedule
+
+
+def one_cycle(cycle_min_lr: float = 0.0, cycle_max_lr: float = 1e-3,
+              decay_lr_rate: float = 0.0, cycle_first_step_size: int = 2000,
+              cycle_second_step_size: Optional[int] = None,
+              cycle_first_stair_count: int = 0,
+              cycle_second_stair_count: Optional[int] = None,
+              decay_step_size: int = 0, **_) -> Schedule:
+    """1-cycle policy (reference :415); momentum cycling handled by optimizer hyperparams."""
+    import jax.numpy as jnp
+
+    second = (cycle_second_step_size
+              if cycle_second_step_size is not None else cycle_first_step_size)
+    total_cycle = cycle_first_step_size + second
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        up_frac = jnp.clip(step / cycle_first_step_size, 0.0, 1.0)
+        down_frac = jnp.clip((step - cycle_first_step_size) / second, 0.0, 1.0)
+        in_cycle_lr = jnp.where(
+            step <= cycle_first_step_size,
+            cycle_min_lr + (cycle_max_lr - cycle_min_lr) * up_frac,
+            cycle_max_lr - (cycle_max_lr - cycle_min_lr) * down_frac)
+        decay_steps = jnp.maximum(step - total_cycle, 0.0)
+        if decay_step_size > 0:
+            decay_epochs = jnp.floor(decay_steps / decay_step_size)
+        else:
+            decay_epochs = decay_steps
+        decayed = cycle_min_lr / (1.0 + decay_lr_rate * decay_epochs)
+        return jnp.where(step <= total_cycle, in_cycle_lr, decayed)
+
+    return schedule
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 1e-3,
+              warmup_num_steps: int = 1000, warmup_type: str = WARMUP_LOG_RATE,
+              **_) -> Schedule:
+    """Warm up then hold (reference :704)."""
+    import jax.numpy as jnp
+
+    warmup_num_steps = max(2, warmup_num_steps)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        frac = jnp.clip(step / warmup_num_steps, 0.0, 1.0)
+        if warmup_type == WARMUP_LOG_RATE:
+            gamma = jnp.log(jnp.maximum(step, 1.0)) / math.log(warmup_num_steps)
+            gamma = jnp.clip(gamma, 0.0, 1.0)
+        else:
+            gamma = frac
+        warm = warmup_min_lr + (warmup_max_lr - warmup_min_lr) * gamma
+        return jnp.where(step < warmup_num_steps, warm, warmup_max_lr)
+
+    return schedule
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 1e-3, warmup_num_steps: int = 1000,
+                    warmup_type: str = WARMUP_LOG_RATE, **_) -> Schedule:
+    """Warm up then linear decay to 0 (reference :800)."""
+    import jax.numpy as jnp
+
+    warm = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+    warmup_num_steps_c = max(2, warmup_num_steps)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        decay_frac = jnp.clip(
+            (total_num_steps - step) /
+            jnp.maximum(float(total_num_steps - warmup_num_steps_c), 1.0), 0.0, 1.0)
+        return jnp.where(step < warmup_num_steps_c, warm(step),
+                         warmup_max_lr * decay_frac)
+
+    return schedule
+
+
+_SCHEDULE_BUILDERS = {
+    LR_RANGE_TEST: lr_range_test,
+    ONE_CYCLE: one_cycle,
+    WARMUP_LR: warmup_lr,
+    WARMUP_DECAY_LR: warmup_decay_lr,
+}
+
+
+def get_lr_schedule(name: str, params: Dict[str, Any],
+                    base_lr: Optional[float] = None) -> Schedule:
+    if name not in _SCHEDULE_BUILDERS:
+        raise ValueError(f"unknown lr schedule {name!r}; valid: {VALID_LR_SCHEDULES}")
+    return _SCHEDULE_BUILDERS[name](**params)
+
+
+class LRScheduler:
+    """Object-style wrapper matching the reference scheduler API."""
+
+    def __init__(self, schedule: Schedule, last_batch_iteration: int = -1):
+        self.schedule = schedule
+        self.last_batch_iteration = last_batch_iteration
+
+    def step(self, last_batch_iteration: Optional[int] = None) -> None:
+        if last_batch_iteration is None:
+            last_batch_iteration = self.last_batch_iteration + 1
+        self.last_batch_iteration = last_batch_iteration
+
+    def get_lr(self) -> List[float]:
+        return [float(self.schedule(max(self.last_batch_iteration, 0)))]
+
+    def get_last_lr(self) -> List[float]:
+        return self.get_lr()
+
+    def state_dict(self) -> dict:
+        return {"last_batch_iteration": self.last_batch_iteration}
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.last_batch_iteration = sd["last_batch_iteration"]
+
+
+def add_tuning_arguments(parser):
+    """Reference ``lr_schedules.py:add_tuning_arguments`` (exported top-level)."""
+    group = parser.add_argument_group("Convergence Tuning",
+                                      "Convergence tuning configurations")
+    group.add_argument("--lr_schedule", type=str, default=None,
+                       help="LR schedule for training.")
+    group.add_argument("--lr_range_test_min_lr", type=float, default=0.001)
+    group.add_argument("--lr_range_test_step_rate", type=float, default=1.0)
+    group.add_argument("--lr_range_test_step_size", type=int, default=1000)
+    group.add_argument("--lr_range_test_staircase", type=bool, default=False)
+    group.add_argument("--cycle_first_step_size", type=int, default=1000)
+    group.add_argument("--cycle_first_stair_count", type=int, default=-1)
+    group.add_argument("--cycle_second_step_size", type=int, default=-1)
+    group.add_argument("--cycle_second_stair_count", type=int, default=-1)
+    group.add_argument("--decay_step_size", type=int, default=1000)
+    group.add_argument("--cycle_min_lr", type=float, default=0.01)
+    group.add_argument("--cycle_max_lr", type=float, default=0.1)
+    group.add_argument("--decay_lr_rate", type=float, default=0.0)
+    group.add_argument("--warmup_min_lr", type=float, default=0)
+    group.add_argument("--warmup_max_lr", type=float, default=0.001)
+    group.add_argument("--warmup_num_steps", type=int, default=1000)
+    group.add_argument("--warmup_type", type=str, default=WARMUP_LOG_RATE)
+    return parser

@@ -1,0 +1,75 @@
+"""Model contract between user code and the engine.
+
+The reference engine wraps a ``torch.nn.Module`` whose ``forward`` returns the
+loss (``runtime/engine.py:189,206``).  The TPU-native equivalent of a module is a
+pair of pure functions over a param pytree; :class:`ModelSpec` is that contract:
+
+ - ``init_fn(rng)``                       -> params pytree
+ - ``loss_fn(params, batch, rng, train)`` -> scalar loss (mean over the batch dim)
+ - ``apply_fn(params, batch, rng)``       -> model outputs (logits), for eval/inference
+ - ``tp_rules(abstract_params)``          -> pytree of ``PartitionSpec`` carrying
+   model-parallel (tp/ep/sp) placement, or None for replicated.  ZeRO sharding is
+   layered on top by the engine (``runtime/zero/sharding.py``).
+
+Anything exposing these four attributes works — our ``models/`` package, a wrapped
+flax module (:func:`from_flax`), or hand-written functions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    init_fn: Callable[..., PyTree]
+    loss_fn: Callable[..., Any]
+    apply_fn: Optional[Callable[..., Any]] = None
+    tp_rules: Optional[Callable[[PyTree], PyTree]] = None
+    #: optional: flops per token (fwd) for MFU reporting
+    flops_per_token: Optional[float] = None
+    name: str = "model"
+
+    def init(self, rng) -> PyTree:
+        return self.init_fn(rng)
+
+    def loss(self, params, batch, rng=None, train: bool = True):
+        return self.loss_fn(params, batch, rng, train)
+
+
+def from_functions(init_fn, loss_fn, apply_fn=None, tp_rules=None,
+                   name="model") -> ModelSpec:
+    return ModelSpec(init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
+                     tp_rules=tp_rules, name=name)
+
+
+def from_flax(module, loss_from_logits: Callable, sample_batch,
+              batch_to_inputs: Optional[Callable] = None,
+              name: str = "flax_model") -> ModelSpec:
+    """Adapt a ``flax.linen`` module.
+
+    ``batch_to_inputs(batch) -> (args, kwargs)`` extracts module inputs from a
+    batch; ``loss_from_logits(logits, batch) -> scalar``.
+    """
+    import jax
+
+    if batch_to_inputs is None:
+        batch_to_inputs = lambda batch: ((batch,), {})
+
+    def init_fn(rng):
+        args, kwargs = batch_to_inputs(sample_batch)
+        return module.init(rng, *args, **kwargs)
+
+    def apply_fn(params, batch, rng=None):
+        args, kwargs = batch_to_inputs(batch)
+        rngs = {"dropout": rng} if rng is not None else None
+        return module.apply(params, *args, rngs=rngs, **kwargs)
+
+    def loss_fn(params, batch, rng=None, train=True):
+        logits = apply_fn(params, batch, rng if train else None)
+        return loss_from_logits(logits, batch)
+
+    return ModelSpec(init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn, name=name)

@@ -1,0 +1,143 @@
+"""Communication logging.
+
+Analog of reference ``deepspeed/utils/comms_logging.py`` (``CommsLogger`` :58,
+``calc_bw_log`` :25).  Two kinds of records exist on TPU:
+
+ - *host ops* (checkpoint broadcast, barriers): wall-timed like the reference.
+ - *in-graph collectives* (psum/all_gather/... inside jit): these are compiled into
+   the XLA program, so per-call wall time is unobservable from Python.  We record
+   them at **trace time** with message sizes; combined with a profiler trace this
+   still gives the comm-volume table the reference's ``log_summary()`` prints.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+from .logging import log_dist
+
+
+def get_caller_func(frame: int = 3) -> str:
+    import sys
+
+    return sys._getframe(frame).f_code.co_name
+
+
+def convert_size(size_bytes: int) -> str:
+    if size_bytes == 0:
+        return "0B"
+    size_name = ("B", "KB", "MB", "GB", "TB", "PB")
+    i = int(math.floor(math.log(size_bytes, 1024)))
+    p = math.pow(1024, i)
+    s = round(size_bytes / p, 2)
+    return f"{s} {size_name[i]}"
+
+
+def calc_bw_log(comm_op: str, size: int, duration: float, n: int) -> tuple:
+    """Algorithmic and bus bandwidth in Gbps for a timed collective.
+
+    Same factors as the reference (``comms_logging.py:25``): allreduce busbw =
+    algbw * 2(n-1)/n; (all)gather/scatter family busbw = algbw * (n-1)/n.
+    """
+    duration = max(duration, 1e-9)
+    n = max(n, 1)
+    if comm_op in ("all_to_all", "all_to_all_single"):
+        tput = size / duration
+        busbw = (size / duration) * ((n - 1) / n)
+    elif comm_op in ("all_gather", "all_gather_base", "reduce_scatter",
+                     "reduce_scatter_base", "psum_scatter"):
+        size *= n
+        tput = size / duration
+        busbw = (size / duration) * ((n - 1) / n)
+    elif comm_op in ("all_reduce", "psum"):
+        tput = size * 2 / duration
+        busbw = (size / duration) * (2 * (n - 1) / n)
+    else:  # send/recv/broadcast/ppermute...
+        tput = size / duration
+        busbw = tput
+    # bytes/sec -> Gbits/sec
+    return tput * 8 / 1e9, busbw * 8 / 1e9
+
+
+class CommsLogger:
+    """Aggregates per-op communication records; prints a summary table."""
+
+    def __init__(self):
+        from ..runtime.constants import (COMMS_LOGGER_DEBUG_DEFAULT,
+                                         COMMS_LOGGER_ENABLED_DEFAULT,
+                                         COMMS_LOGGER_PROF_ALL_DEFAULT,
+                                         COMMS_LOGGER_PROF_OPS_DEFAULT,
+                                         COMMS_LOGGER_VERBOSE_DEFAULT)
+        self.comms_dict: Dict[str, Dict[int, list]] = {}
+        self.verbose = COMMS_LOGGER_VERBOSE_DEFAULT
+        self.debug = COMMS_LOGGER_DEBUG_DEFAULT
+        self.prof_ops = COMMS_LOGGER_PROF_OPS_DEFAULT
+        self.prof_all = COMMS_LOGGER_PROF_ALL_DEFAULT
+        self.enabled = COMMS_LOGGER_ENABLED_DEFAULT
+
+    def configure(self, comms_config) -> None:
+        self.enabled = comms_config.comms_logger_enabled
+        if self.enabled:
+            self.verbose = comms_config.comms_logger.verbose
+            self.debug = comms_config.comms_logger.debug
+            self.prof_ops = comms_config.comms_logger.prof_ops
+            self.prof_all = comms_config.comms_logger.prof_all
+
+    def start_profiling_comms(self):
+        self.prof_all = True
+
+    def stop_profiling_comms(self):
+        self.prof_all = False
+
+    def append(self, raw_name: str, record_name: str, latency: Optional[float],
+               msg_size: int, world_size: int, traced: bool = False) -> None:
+        """Add one record. ``latency`` is None for trace-time (in-graph) records."""
+        if latency is not None:
+            algbw, busbw = calc_bw_log(raw_name, msg_size, latency, world_size)
+        else:
+            algbw, busbw = 0.0, 0.0
+            latency = 0.0
+        if record_name in self.comms_dict:
+            if msg_size in self.comms_dict[record_name]:
+                entry = self.comms_dict[record_name][msg_size]
+                entry[0] += 1
+                entry[1].append(latency)
+                entry[2].append(algbw)
+                entry[3].append(busbw)
+            else:
+                self.comms_dict[record_name][msg_size] = [1, [latency], [algbw], [busbw]]
+        else:
+            self.comms_dict[record_name] = {msg_size: [1, [latency], [algbw], [busbw]]}
+        if self.verbose:
+            kind = "traced" if traced else f"{latency:.2f} ms"
+            log_dist(f"comm op: {record_name} | size: {convert_size(msg_size)} | {kind}",
+                     ranks=[0])
+
+    def log_all(self, print_log: bool = True, show_straggler: bool = False):
+        from copy import deepcopy
+
+        if print_log:
+            msg = f"{'Comm. Op': <20}{'Message Size': <20}{'Count': <20}" \
+                  f"{'Total Latency(ms)': <20}{'Avg Latency(ms)': <20}" \
+                  f"{'tput_avg (Gbps)': <20}{'busbw_avg (Gbps)': <20}"
+            log_dist(msg, ranks=[0])
+        out = deepcopy(self.comms_dict)
+        for record_name in out:
+            if print_log:
+                log_dist(record_name, ranks=[0])
+            for msg_size, vals in sorted(out[record_name].items()):
+                count, latencies, algbws, busbws = vals
+                total_lat = sum(latencies)
+                avg_lat = total_lat / max(count, 1)
+                avg_alg = sum(algbws) / max(count, 1)
+                avg_bus = sum(busbws) / max(count, 1)
+                if print_log:
+                    log_dist(
+                        f"{' ': <20}{convert_size(msg_size): <20}{count: <20}"
+                        f"{total_lat: <20.2f}{avg_lat: <20.2f}{avg_alg: <20.2f}"
+                        f"{avg_bus: <20.2f}", ranks=[0])
+        return out
+
+    def reset(self):
+        self.comms_dict = {}

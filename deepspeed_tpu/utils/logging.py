@@ -1,0 +1,81 @@
+"""Rank-aware logging.
+
+TPU-native analog of the reference's ``deepspeed/utils/logging.py`` (LoggerFactory at
+:16, ``log_dist`` at :56).  Rank filtering uses ``jax.process_index()`` instead of
+``torch.distributed`` ranks; inside a single-process mesh-simulated run the process
+index is always 0, which matches how the reference behaves under a single rank.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+
+log_levels = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class LoggerFactory:
+
+    @staticmethod
+    def create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> logging.Logger:
+        if name is None:
+            raise ValueError("name for logger cannot be None")
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d:%(funcName)s] %(message)s")
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            ch = logging.StreamHandler(stream=sys.stdout)
+            ch.setLevel(level)
+            ch.setFormatter(formatter)
+            logger_.addHandler(ch)
+        return logger_
+
+
+logger = LoggerFactory.create_logger(
+    level=log_levels.get(os.environ.get("DS_TPU_LOG_LEVEL", "info").lower(), logging.INFO))
+
+
+@functools.lru_cache(maxsize=None)
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # jax not initialised yet / no backend
+        return int(os.environ.get("JAX_PROCESS_INDEX", 0))
+
+
+def log_dist(message: str, ranks=None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given process ranks (``[-1]`` or None = all)."""
+    my_rank = _process_index()
+    if ranks is None or len(ranks) == 0 or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message: str, _seen=set()) -> None:
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)
+
+
+def print_json_dist(message, ranks=None, path=None) -> None:
+    """Print a json summary on the given ranks, optionally persisting it to ``path``."""
+    import json
+
+    my_rank = _process_index()
+    if ranks is None or len(ranks) == 0 or -1 in ranks or my_rank in ranks:
+        message["rank"] = my_rank
+        if path is not None:
+            with open(path, "w") as f:
+                json.dump(message, f)
+        logger.info(json.dumps(message))

@@ -1,0 +1,37 @@
+"""Test harness: 8-device CPU-sim mesh.
+
+The reference tests distributed behavior by spawning N processes over local GPUs
+(``tests/unit/common.py DistributedExec``).  On TPU/JAX the equivalent — and
+simpler — harness is a single process with 8 virtual CPU devices
+(``--xla_force_host_platform_device_count``): every collective and sharding path
+is exercised for real by XLA's CPU backend, no hardware needed (SURVEY §4).
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _reset_comm_state():
+    """Each test gets a fresh module-level topology."""
+    yield
+    from deepspeed_tpu import comm
+
+    comm.reset_topology()
+    comm.comms_logger.reset()
+
+
+@pytest.fixture
+def eight_devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 CPU-sim devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,124 @@
+"""Collective facade tests (model: reference tests/unit/comm/test_dist.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu import comm
+from deepspeed_tpu.parallel.topology import MeshTopology
+
+
+@pytest.fixture
+def mesh8(eight_devices):
+    topo = MeshTopology(dp=4, tp=2)
+    comm.set_topology(topo)
+    return topo.mesh
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         check_vma=False)
+
+
+def test_all_reduce(mesh8):
+    x = jnp.arange(8.0)
+
+    def f(x):
+        return comm.all_reduce(x, group="dp")
+
+    out = _shard_map(f, mesh8, P(("dp",)), P("dp"))(x)
+    # each dp shard of 2 elements summed across 4 dp ranks
+    expected = np.array([0 + 2 + 4 + 6, 1 + 3 + 5 + 7], dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(out)[:2], expected)
+
+
+def test_all_reduce_ops(mesh8):
+    x = jnp.arange(4.0)
+
+    def fmax(x):
+        return comm.all_reduce(x, op=comm.ReduceOp.MAX, group="dp")
+
+    out = _shard_map(fmax, mesh8, P("dp"), P("dp"))(x)
+    assert np.asarray(out)[0] == 3.0
+
+    def favg(x):
+        return comm.all_reduce(x, op=comm.ReduceOp.AVG, group="dp")
+
+    out = _shard_map(favg, mesh8, P("dp"), P("dp"))(x)
+    np.testing.assert_allclose(np.asarray(out)[0], 1.5)
+
+
+def test_all_gather(mesh8):
+    x = jnp.arange(4.0)
+
+    def f(x):
+        return comm.all_gather(x, group="dp")
+
+    out = _shard_map(f, mesh8, P("dp"), P())(x)
+    np.testing.assert_allclose(np.asarray(out), np.arange(4.0))
+
+
+def test_reduce_scatter(mesh8):
+    x = jnp.ones((8,))
+
+    def f(x):
+        return comm.reduce_scatter(x, group="dp")
+
+    out = _shard_map(f, mesh8, P(), P("dp"))(x)
+    np.testing.assert_allclose(np.asarray(out), 4.0 * np.ones(8))
+
+
+def test_all_to_all(mesh8):
+    x = jnp.arange(16.0).reshape(4, 4)
+
+    def f(x):
+        return comm.all_to_all_single(x, group="dp", split_axis=1, concat_axis=0)
+
+    out = _shard_map(f, mesh8, P("dp", None), P("dp", None))(x)
+    assert out.shape == (16, 1)
+
+
+def test_ppermute(mesh8):
+    x = jnp.arange(4.0)
+
+    def f(x):
+        perm = [(i, (i + 1) % 4) for i in range(4)]
+        return comm.ppermute(x, "dp", perm)
+
+    out = _shard_map(f, mesh8, P("dp"), P("dp"))(x)
+    np.testing.assert_allclose(np.asarray(out), np.array([3, 0, 1, 2.0]))
+
+
+def test_world_size_queries(mesh8):
+    assert comm.get_world_size() == 8
+    assert comm.get_world_size("dp") == 4
+    assert comm.get_world_size("tp") == 2
+    assert comm.get_world_size(("dp", "tp")) == 8
+    assert comm.get_data_parallel_world_size() == 4
+    assert comm.get_model_parallel_world_size() == 2
+
+
+def test_host_ops():
+    comm.barrier("test")
+    x = {"a": np.arange(3.0)}
+    out = comm.broadcast(x, src=0)
+    np.testing.assert_allclose(out["a"], x["a"])
+    gathered = comm.all_gather_host(np.arange(3.0))
+    assert np.asarray(gathered).shape == (1, 3)
+
+
+def test_comms_logger_records(mesh8):
+    comm.comms_logger.enabled = True
+    comm.comms_logger.prof_all = True
+    x = jnp.arange(8.0)
+
+    def f(x):
+        return comm.all_reduce(x, group="dp")
+
+    _shard_map(f, mesh8, P("dp"), P("dp"))(x)
+    assert "all_reduce" in comm.comms_logger.comms_dict
+    summary = comm.log_summary()
+    assert summary
+    comm.comms_logger.enabled = False

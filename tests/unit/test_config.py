@@ -1,0 +1,158 @@
+"""Config tests (model: reference tests/unit/runtime/test_ds_config_dict.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.runtime.zero.config import (DeepSpeedZeroConfig,
+                                               OffloadDeviceEnum, ZeroStageEnum)
+
+
+def test_batch_triple_full():
+    cfg = DeepSpeedConfig(
+        {
+            "train_batch_size": 32,
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 2
+        },
+        world_size=4)
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_triple_derive_gas():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4},
+        world_size=4)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_triple_derive_micro():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 32, "gradient_accumulation_steps": 2}, world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+def test_batch_triple_derive_train():
+    cfg = DeepSpeedConfig(
+        {"train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 2},
+        world_size=4)
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_triple_only_train():
+    cfg = DeepSpeedConfig({"train_batch_size": 8}, world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 2
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_triple_inconsistent():
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig(
+            {
+                "train_batch_size": 32,
+                "train_micro_batch_size_per_gpu": 4,
+                "gradient_accumulation_steps": 4
+            },
+            world_size=4)
+
+
+def test_batch_triple_none_given():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({}, world_size=4)
+
+
+def test_precision_flags():
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "bf16": {"enabled": True}},
+                          world_size=1)
+    assert cfg.bfloat16_enabled and not cfg.fp16_enabled
+    assert cfg.precision_dtype == "bfloat16"
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(
+            {
+                "train_batch_size": 8,
+                "bf16": {"enabled": True},
+                "fp16": {"enabled": True}
+            },
+            world_size=1)
+
+
+def test_fp16_scaler_args():
+    cfg = DeepSpeedConfig(
+        {
+            "train_batch_size": 8,
+            "fp16": {
+                "enabled": True,
+                "initial_scale_power": 8,
+                "loss_scale_window": 500,
+                "hysteresis": 4
+            }
+        },
+        world_size=1)
+    assert cfg.fp16_enabled
+    assert cfg.dynamic_loss_scale_args["init_scale"] == 256
+    assert cfg.dynamic_loss_scale_args["scale_window"] == 500
+    assert cfg.dynamic_loss_scale_args["delayed_shift"] == 4
+
+
+def test_zero_config_defaults():
+    z = DeepSpeedZeroConfig()
+    assert z.stage == ZeroStageEnum.disabled
+    assert z.overlap_comm is False
+    z3 = DeepSpeedZeroConfig(stage=3)
+    assert z3.overlap_comm is True
+
+
+def test_zero_config_aliases():
+    z = DeepSpeedZeroConfig(stage=3, stage3_max_live_parameters=1000,
+                            stage3_prefetch_bucket_size=500)
+    assert z.max_live_parameters == 1000
+    assert z.prefetch_bucket_size == 500
+
+
+def test_zero_offload_configs():
+    z = DeepSpeedZeroConfig(
+        stage=2, offload_optimizer={"device": "cpu", "pin_memory": True})
+    assert z.offload_optimizer.device == OffloadDeviceEnum.cpu
+    assert z.offload_optimizer.pin_memory
+
+
+def test_zero_deprecated_cpu_offload():
+    z = DeepSpeedZeroConfig(stage=2, cpu_offload=True)
+    assert z.offload_optimizer is not None
+    assert z.offload_optimizer.device == OffloadDeviceEnum.cpu
+
+
+def test_optimizer_scheduler_blocks():
+    cfg = DeepSpeedConfig(
+        {
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3, "betas": [0.9, 0.99]}},
+            "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}}
+        },
+        world_size=1)
+    assert cfg.optimizer_name == "adam"
+    assert cfg.optimizer_params["lr"] == 1e-3
+    assert cfg.scheduler_name == "WarmupLR"
+
+
+def test_checkpoint_tag_validation_modes():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 8, "checkpoint": {"tag_validation": "Fail"}},
+        world_size=1)
+    assert cfg.checkpoint_config.tag_validation == "Fail"
+    with pytest.raises(Exception):
+        DeepSpeedConfig(
+            {"train_batch_size": 8, "checkpoint": {"tag_validation": "bogus"}},
+            world_size=1)
+
+
+def test_duplicate_json_keys(tmp_path):
+    p = tmp_path / "ds.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(str(p), world_size=1)
+
+
+def test_gradient_clipping():
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "gradient_clipping": 1.0},
+                          world_size=1)
+    assert cfg.gradient_clipping == 1.0

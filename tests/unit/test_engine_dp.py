@@ -1,0 +1,184 @@
+"""End-to-end engine tests: tiny GPT-2 over the 8-device CPU-sim mesh.
+
+Model: reference tests/unit/runtime/zero/test_zero.py (stage-vs-baseline loss
+parity) and tests/unit/runtime/half_precision tests.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt2
+
+
+def tiny_model():
+    return gpt2.build(gpt2.GPT2Config.tiny())
+
+
+def make_batch(rng, n, seq=33, vocab=512):
+    return {"input_ids": rng.integers(0, vocab, size=(n, seq)).astype(np.int32)}
+
+
+def base_config(**over):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "mesh": {},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def run_steps(config, steps=5, seed=0):
+    deepspeed_tpu.comm.reset_topology()
+    engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config=config)
+    rng = np.random.default_rng(seed)
+    losses = []
+    for _ in range(steps):
+        batch = make_batch(rng, engine.train_batch_size())
+        _, metrics = engine.train_batch(batch)
+        losses.append(metrics["loss"])
+    return engine, losses
+
+
+def test_train_loss_decreases():
+    _, losses = run_steps(base_config(), steps=8)
+    assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_zero_stage_matches_baseline(stage):
+    _, base_losses = run_steps(base_config(), steps=4)
+    _, z_losses = run_steps(
+        base_config(zero_optimization={"stage": stage}), steps=4)
+    np.testing.assert_allclose(base_losses, z_losses, rtol=2e-4, atol=1e-5)
+
+
+def test_zero3_state_is_sharded(eight_devices):
+    deepspeed_tpu.comm.reset_topology()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=tiny_model(), config=base_config(zero_optimization={"stage": 3}))
+    qkv = engine.state["params"]["blocks"]["qkv_w"]
+    # 8-way dp: each device holds 1/8 of the tensor
+    shard_size = qkv.addressable_shards[0].data.size
+    assert shard_size == qkv.size // 8
+    m = engine.state["opt_state"]
+    leaves = [x for x in jax.tree_util.tree_leaves(m)
+              if x.ndim > 0 and x.size > 8]
+    assert leaves, "no optimizer moment buffers found"
+    for leaf in leaves:
+        assert leaf.addressable_shards[0].data.size < leaf.size
+
+
+def test_gradient_accumulation_equivalence():
+    # gas=2 with half micro-batch == gas=1 with full batch (same global batch)
+    _, l1 = run_steps(base_config(train_micro_batch_size_per_gpu=2,
+                                  gradient_accumulation_steps=1), steps=3)
+    _, l2 = run_steps(base_config(train_micro_batch_size_per_gpu=1,
+                                  gradient_accumulation_steps=2), steps=3)
+    np.testing.assert_allclose(l1, l2, rtol=2e-4, atol=1e-5)
+
+
+def test_micro_step_shims():
+    """The reference-style forward/backward/step loop trains equivalently."""
+    deepspeed_tpu.comm.reset_topology()
+    config = base_config(gradient_accumulation_steps=2)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config=config)
+    rng = np.random.default_rng(0)
+    for i in range(2):
+        for g in range(2):
+            batch = make_batch(rng, engine.micro_batch_global())
+            loss = engine.forward(batch)
+            engine.backward(loss)
+            if engine.is_gradient_accumulation_boundary():
+                engine.step()
+    assert engine.global_steps == 2
+    assert engine.micro_steps == 4
+
+
+def test_bf16_training():
+    _, losses = run_steps(base_config(bf16={"enabled": True}), steps=5)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_fp16_dynamic_loss_scale():
+    deepspeed_tpu.comm.reset_topology()
+    config = base_config(fp16={"enabled": True, "initial_scale_power": 8})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config=config)
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        _, metrics = engine.train_batch(make_batch(rng, engine.train_batch_size()))
+    assert metrics["loss_scale"] == 256.0
+    assert engine.loss_scale() == 256.0
+
+
+def test_tp_mesh_training(eight_devices):
+    """tp=2 x dp=4: model-parallel matmuls + data-parallel grads, same loss.
+
+    train_batch_size is pinned so both runs consume identical global batches
+    (micro-batch per chip derives to 1 vs 2)."""
+    _, base_losses = run_steps(base_config(train_batch_size=8,
+                                           train_micro_batch_size_per_gpu=None,
+                                           gradient_accumulation_steps=None), steps=3)
+    _, tp_losses = run_steps(base_config(train_batch_size=8,
+                                         train_micro_batch_size_per_gpu=None,
+                                         gradient_accumulation_steps=None,
+                                         mesh={"tp": 2}), steps=3)
+    np.testing.assert_allclose(base_losses, tp_losses, rtol=2e-4, atol=1e-5)
+
+
+def test_dataloader_path():
+    deepspeed_tpu.comm.reset_topology()
+    rng = np.random.default_rng(1)
+    data = [{"input_ids": rng.integers(0, 512, size=(33,)).astype(np.int32)}
+            for _ in range(64)]
+    engine, _, loader, _ = deepspeed_tpu.initialize(
+        model=tiny_model(), config=base_config(), training_data=data)
+    assert loader is not None
+    _, metrics = engine.train_batch()  # pulls from its own loader
+    assert np.isfinite(metrics["loss"])
+
+
+def test_checkpoint_save_load_resume(tmp_path):
+    deepspeed_tpu.comm.reset_topology()
+    config = base_config()
+    engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config=config)
+    rng = np.random.default_rng(0)
+    for _ in range(2):
+        engine.train_batch(make_batch(rng, engine.train_batch_size()))
+    engine.save_checkpoint(str(tmp_path), client_state={"note": "hi"})
+
+    deepspeed_tpu.comm.reset_topology()
+    engine2, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config=config)
+    path, client_state = engine2.load_checkpoint(str(tmp_path))
+    assert path is not None
+    assert client_state == {"note": "hi"}
+    assert engine2.global_steps == 2
+    # resumed state trains identically to continuing the original
+    batch = make_batch(np.random.default_rng(9), engine.train_batch_size())
+    _, m1 = engine.train_batch(batch)
+    _, m2 = engine2.train_batch(batch)
+    np.testing.assert_allclose(m1["loss"], m2["loss"], rtol=1e-5)
+
+
+def test_checkpoint_elastic_reshard(tmp_path):
+    """Save under zero-3 sharding, load under zero-0 (replicated) — the orbax
+    restore reshards: this is the universal-checkpoint capability (SURVEY §5.4)."""
+    deepspeed_tpu.comm.reset_topology()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=tiny_model(), config=base_config(zero_optimization={"stage": 3}))
+    rng = np.random.default_rng(0)
+    engine.train_batch(make_batch(rng, engine.train_batch_size()))
+    engine.save_checkpoint(str(tmp_path))
+
+    deepspeed_tpu.comm.reset_topology()
+    engine2, _, _, _ = deepspeed_tpu.initialize(
+        model=tiny_model(), config=base_config())
+    engine2.load_checkpoint(str(tmp_path))
+    batch = make_batch(np.random.default_rng(5), engine.train_batch_size())
+    _, m1 = engine.train_batch(batch)
+    _, m2 = engine2.train_batch(batch)
+    np.testing.assert_allclose(m1["loss"], m2["loss"], rtol=2e-4, atol=1e-5)

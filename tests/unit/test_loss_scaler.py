@@ -1,0 +1,49 @@
+"""Dynamic loss scaler tests (model: reference test_dynamic_loss_scale.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.fp16.loss_scaler import (LossScaleState, has_overflow,
+                                                    update_scale)
+
+
+def test_has_overflow():
+    good = {"a": jnp.ones(3), "b": jnp.zeros(2)}
+    assert not bool(has_overflow(good))
+    bad = {"a": jnp.array([1.0, jnp.nan]), "b": jnp.zeros(2)}
+    assert bool(has_overflow(bad))
+    inf = {"a": jnp.array([jnp.inf])}
+    assert bool(has_overflow(inf))
+
+
+def test_scale_halves_on_overflow_after_hysteresis():
+    s = LossScaleState.create(init_scale=256.0, delayed_shift=2)
+    # first overflow burns hysteresis, scale unchanged
+    s = update_scale(s, jnp.asarray(True), delayed_shift=2)
+    assert float(s.cur_scale) == 256.0
+    assert int(s.skipped) == 1
+    # second overflow halves
+    s = update_scale(s, jnp.asarray(True), delayed_shift=2)
+    assert float(s.cur_scale) == 128.0
+
+
+def test_scale_doubles_after_window():
+    s = LossScaleState.create(init_scale=4.0, delayed_shift=1)
+    for i in range(10):
+        s = update_scale(s, jnp.asarray(False), scale_window=10)
+    assert float(s.cur_scale) == 8.0
+    assert int(s.good_steps) == 10
+
+
+def test_min_scale_floor():
+    s = LossScaleState.create(init_scale=2.0, delayed_shift=1)
+    for _ in range(5):
+        s = update_scale(s, jnp.asarray(True), min_scale=1.0, delayed_shift=1)
+    assert float(s.cur_scale) == 1.0
+
+
+def test_static_mode():
+    s = LossScaleState.create(init_scale=64.0)
+    s2 = update_scale(s, jnp.asarray(True), dynamic=False)
+    assert float(s2.cur_scale) == 64.0
+    assert int(s2.skipped) == 1

@@ -1,0 +1,73 @@
+"""LR schedule tests (model: reference tests/unit/runtime/test_lr_schedulers.py)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (LRScheduler, get_lr_schedule,
+                                                lr_range_test, one_cycle,
+                                                warmup_decay_lr, warmup_lr)
+
+
+def test_warmup_lr_linear():
+    s = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=1.0, warmup_num_steps=10,
+                  warmup_type="linear")
+    assert float(s(0)) == 0.0
+    np.testing.assert_allclose(float(s(5)), 0.5)
+    assert float(s(10)) == 1.0
+    assert float(s(100)) == 1.0
+
+
+def test_warmup_lr_log():
+    s = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=1.0, warmup_num_steps=100,
+                  warmup_type="log")
+    assert float(s(1)) == 0.0
+    np.testing.assert_allclose(float(s(10)), 0.5, rtol=1e-5)
+    assert float(s(100)) == 1.0
+
+
+def test_warmup_decay():
+    s = warmup_decay_lr(total_num_steps=100, warmup_min_lr=0.0, warmup_max_lr=1.0,
+                        warmup_num_steps=10, warmup_type="linear")
+    np.testing.assert_allclose(float(s(5)), 0.5)
+    np.testing.assert_allclose(float(s(100)), 0.0, atol=1e-6)
+    mid = float(s(55))
+    assert 0.0 < mid < 1.0
+
+
+def test_lr_range_test():
+    s = lr_range_test(lr_range_test_min_lr=0.1, lr_range_test_step_size=10,
+                      lr_range_test_step_rate=1.0)
+    np.testing.assert_allclose(float(s(0)), 0.1)
+    np.testing.assert_allclose(float(s(10)), 0.2)
+    staircase = lr_range_test(lr_range_test_min_lr=0.1, lr_range_test_step_size=10,
+                              lr_range_test_step_rate=1.0,
+                              lr_range_test_staircase=True)
+    np.testing.assert_allclose(float(staircase(9)), 0.1)
+    np.testing.assert_allclose(float(staircase(10)), 0.2)
+
+
+def test_one_cycle():
+    s = one_cycle(cycle_min_lr=0.0, cycle_max_lr=1.0, cycle_first_step_size=10)
+    np.testing.assert_allclose(float(s(0)), 0.0)
+    np.testing.assert_allclose(float(s(10)), 1.0)
+    np.testing.assert_allclose(float(s(20)), 0.0, atol=1e-6)
+
+
+def test_get_lr_schedule_names():
+    s = get_lr_schedule("WarmupLR", {"warmup_max_lr": 0.5})
+    assert s is not None
+    with pytest.raises(ValueError):
+        get_lr_schedule("Bogus", {})
+
+
+def test_scheduler_object_api():
+    s = LRScheduler(warmup_lr(warmup_max_lr=1.0, warmup_num_steps=10,
+                              warmup_type="linear"))
+    s.step()
+    s.step()
+    lr = s.get_lr()[0]
+    assert 0 < lr < 1.0
+    sd = s.state_dict()
+    s2 = LRScheduler(warmup_lr())
+    s2.load_state_dict(sd)
+    assert s2.last_batch_iteration == s.last_batch_iteration

@@ -1,0 +1,95 @@
+"""Topology math tests (model: reference tests/unit/runtime/pipe/test_topology.py)."""
+
+import pytest
+
+from deepspeed_tpu.parallel.topology import (MeshTopology,
+                                             PipeModelDataParallelTopology,
+                                             ProcessTopology,
+                                             topology_from_config)
+
+
+def test_topology_2d():
+    topo = ProcessTopology(axes=["row", "col"], dims=[2, 2])
+    assert topo.world_size == 4
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+    assert topo.get_axis_names() == ["row", "col"]
+
+
+def test_topology_dims():
+    topo = ProcessTopology(axes=["a", "b", "c"], dims=[2, 3, 4])
+    assert topo.world_size == 24
+    assert topo.get_dim("a") == 2
+    assert topo.get_dim("b") == 3
+    assert topo.get_dim("c") == 4
+    assert topo.get_dim("missing") == 0
+
+
+def test_topology_comm_lists():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert topo.world_size == 8
+    pipe_lists = topo.get_axis_comm_lists("pipe")
+    for lst in pipe_lists:
+        assert len(lst) == 2
+    assert sorted(sum(pipe_lists, [])) == list(range(8))
+    model_lists = topo.get_axis_comm_lists("model")
+    # model axis is innermost: consecutive ranks
+    for lst in model_lists:
+        assert lst[1] == lst[0] + 1
+    assert topo.get_axis_comm_lists("missing") == []
+
+
+def test_topology_filter_match():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    ranks = topo.filter_match(pipe=0, data=1)
+    assert len(ranks) == 2
+    for r in ranks:
+        coord = topo.get_coord(r)
+        assert coord.pipe == 0 and coord.data == 1
+
+
+def test_topology_rank_repr():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    r = topo.get_rank_repr(rank=0)
+    assert "pipe_00" in r and "model_00" in r and "data" not in r
+
+
+def test_mesh_topology_infer_dp(eight_devices):
+    topo = MeshTopology(tp=2)
+    assert topo.axis_sizes["dp"] == 4
+    assert topo.data_parallel_size == 4
+    assert topo.model_parallel_size == 2
+    assert topo.world_size == 8
+
+
+def test_mesh_topology_explicit(eight_devices):
+    topo = MeshTopology(pp=2, dp=2, tp=2)
+    assert topo.world_size == 8
+    m = topo.mesh
+    assert m.shape["pp"] == 2 and m.shape["dp"] == 2 and m.shape["tp"] == 2
+    assert m.shape["ep"] == 1 and m.shape["sp"] == 1
+
+
+def test_mesh_topology_bad_sizes(eight_devices):
+    with pytest.raises(AssertionError):
+        MeshTopology(dp=3, tp=2)  # 6 != 8
+    with pytest.raises(AssertionError):
+        MeshTopology(tp=3)  # 8 % 3 != 0
+
+
+def test_topology_from_config(eight_devices):
+    topo = topology_from_config({"tensor_parallel_size": 2, "pp": 2})
+    assert topo.model_parallel_size == 2
+    assert topo.pipe_parallel_size == 2
+    assert topo.data_parallel_size == 2
+    with pytest.raises(ValueError):
+        topology_from_config({"bogus_axis": 2})
+
+
+def test_expert_data_split(eight_devices):
+    topo = MeshTopology(ep=4)
+    assert topo.expert_parallel_size == 4
+    assert topo.expert_data_parallel_size == 2
+    assert topo.data_parallel_size == 8  # dp * ep = full DP world
