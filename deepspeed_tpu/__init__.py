@@ -51,16 +51,31 @@ def initialize(args=None,
             "Not sure how to proceed, we were given both a deepspeed_config and config"
         config = args.deepspeed_config
 
-    engine = DeepSpeedEngine(args=args,
-                             model=model,
-                             optimizer=optimizer,
-                             model_parameters=model_parameters,
-                             training_data=training_data,
-                             lr_scheduler=lr_scheduler,
-                             mpu=mpu,
-                             dist_init_required=dist_init_required,
-                             collate_fn=collate_fn,
-                             config=config)
+    # pp > 1 selects the pipeline engine (reference picks PipelineEngine when
+    # the model is a PipelineModule, __init__.py:125)
+    cfg_dict = config
+    if isinstance(cfg_dict, str):
+        import json
+
+        with open(cfg_dict) as f:
+            cfg_dict = json.load(f)
+    pp_size = int((cfg_dict or {}).get("mesh", {}).get(
+        "pp", (cfg_dict or {}).get("mesh", {}).get("pipeline_parallel_size", 1)))
+    engine_cls = DeepSpeedEngine
+    if pp_size > 1:
+        from .runtime.pipe.engine import PipelineEngine
+
+        engine_cls = PipelineEngine
+    engine = engine_cls(args=args,
+                        model=model,
+                        optimizer=optimizer,
+                        model_parameters=model_parameters,
+                        training_data=training_data,
+                        lr_scheduler=lr_scheduler,
+                        mpu=mpu,
+                        dist_init_required=dist_init_required,
+                        collate_fn=collate_fn,
+                        config=config)
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
 

@@ -1,0 +1,73 @@
+"""Per-node launcher.
+
+Analog of reference ``deepspeed/launcher/launch.py:129 main()``: decode the
+base64 world info, derive this node's process id, export the rendezvous env, and
+spawn the training script.  TPU difference: ONE process per host (XLA drives all
+local chips), so there is no per-device subprocess fan-out; the reference's
+process-tree signal handling (:115 terminate_process_tree) is kept.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import signal
+import subprocess
+import sys
+
+from ..utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--world_info", type=str, required=True)
+    parser.add_argument("--node_rank", type=int, required=True)
+    parser.add_argument("--master_addr", type=str, required=True)
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def decode_world_info(world_info_b64: str) -> dict:
+    return json.loads(base64.urlsafe_b64decode(world_info_b64).decode())
+
+
+def build_env(world_info: dict, node_rank: int, master_addr: str,
+              master_port: int, base_env=None) -> dict:
+    env = dict(base_env if base_env is not None else os.environ)
+    hosts = list(world_info.keys())
+    env["JAX_COORDINATOR_ADDRESS"] = f"{master_addr}:{master_port}"
+    env["JAX_NUM_PROCESSES"] = str(len(hosts))
+    env["JAX_PROCESS_ID"] = str(node_rank)
+    # reference-compatible names some user scripts read
+    env["MASTER_ADDR"] = master_addr
+    env["MASTER_PORT"] = str(master_port)
+    env["WORLD_SIZE"] = str(sum(len(v) if v else 1 for v in world_info.values()))
+    env["RANK"] = str(node_rank)
+    env["LOCAL_RANK"] = "0"
+    return env
+
+
+def main(args=None):
+    args = parse_args(args)
+    world_info = decode_world_info(args.world_info)
+    env = build_env(world_info, args.node_rank, args.master_addr,
+                    args.master_port)
+    cmd = [sys.executable, "-u", args.user_script] + args.user_args
+    logger.info(f"node {args.node_rank}: launching {' '.join(cmd)}")
+    proc = subprocess.Popen(cmd, env=env)
+
+    def sig_handler(signum, frame):
+        proc.send_signal(signum)
+
+    signal.signal(signal.SIGTERM, sig_handler)
+    signal.signal(signal.SIGINT, sig_handler)
+    proc.wait()
+    sys.exit(proc.returncode)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,270 @@
+"""Cluster launcher CLI.
+
+Analog of reference ``deepspeed/launcher/runner.py:380 main()`` (the ``deepspeed``
+command): parse a hostfile with ``slots=N`` syntax (:184 fetch_hostfile), apply
+--include/--exclude filters (:245), pick a master, and dispatch per-node
+launchers over a backend (PDSH/OpenMPI/SLURM — ``multinode_runner.py``).
+
+TPU mapping: one *process per host* (not per chip — XLA drives all local chips),
+rendezvous via ``jax.distributed`` env vars (JAX_COORDINATOR_ADDRESS/
+JAX_NUM_PROCESSES/JAX_PROCESS_ID) instead of MASTER_ADDR/RANK.  ``slots`` counts
+chips per host, kept for capacity accounting and include/exclude filtering.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import collections
+import json
+import os
+import re
+import shlex
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+from ..utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ["PYTHON", "PATH", "LD_LIBRARY_PATH", "JAX_", "XLA_", "TPU_",
+               "LIBTPU_"]
+DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed_tpu launcher: run a training script over one or "
+        "more TPU hosts")
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="Hostfile path: lines of '<host> slots=<n>'")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help='Include filter, e.g. "worker-0@worker-1:0,2"')
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="Exclude filter, same syntax as --include")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--num_gpus", "--num_chips", dest="num_gpus", type=int,
+                        default=-1, help="chips per node to use")
+    parser.add_argument("--master_port", type=int,
+                        default=int(os.environ.get("DLTS_MASTER_PORT", 29500)))
+    parser.add_argument("--master_addr", type=str, default="")
+    parser.add_argument("--launcher", type=str, default="pdsh",
+                        choices=["pdsh", "openmpi", "slurm", "ssh"])
+    parser.add_argument("--launcher_args", type=str, default="")
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--detect_nvme", action="store_true")
+    parser.add_argument("user_script", type=str, help="training script")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path: str) -> Optional[Dict[str, int]]:
+    """Parse ``host slots=N`` lines (reference ``runner.py:184``)."""
+    if not os.path.isfile(hostfile_path):
+        logger.warning(f"Unable to find hostfile, will proceed with training "
+                       f"with local resources only: {hostfile_path}")
+        return None
+    resource_pool = collections.OrderedDict()
+    with open(hostfile_path) as fd:
+        for line in fd:
+            line = line.strip()
+            if line == "" or line.startswith("#"):
+                continue
+            try:
+                hostname, slots = line.split()
+                _, slot_count = slots.split("=")
+                slot_count = int(slot_count)
+            except ValueError as err:
+                logger.error(f"Hostfile is not formatted correctly, unable to "
+                             f"proceed with training: {line}")
+                raise err
+            if hostname in resource_pool:
+                logger.error(f"Hostfile contains duplicate hosts, unable to "
+                             f"proceed with training: {hostname}")
+                raise ValueError(f"host {hostname} is already defined")
+            resource_pool[hostname] = slot_count
+    return resource_pool
+
+
+def _parse_hostlist(string: str) -> Dict[str, List[int]]:
+    """'worker-0@worker-1:0,2' -> {worker-0: [], worker-1: [0, 2]}."""
+    result: Dict[str, List[int]] = {}
+    for node_config in string.split("@"):
+        if node_config == "":
+            continue
+        if ":" in node_config:
+            hostname, slots = node_config.split(":")
+            result[hostname] = [int(x) for x in slots.split(",")]
+        else:
+            result[node_config] = []
+    return result
+
+
+def parse_resource_filter(host_info: Dict[str, int], include_str: str = "",
+                          exclude_str: str = "") -> Dict[str, List[int]]:
+    """Apply include/exclude filters (reference ``runner.py:245``)."""
+    if include_str and exclude_str:
+        raise ValueError("include_str and exclude_str are mutually exclusive")
+    pool = {host: list(range(slots)) for host, slots in host_info.items()}
+    if include_str:
+        include = _parse_hostlist(include_str)
+        filtered = {}
+        for host, slots in include.items():
+            if host not in pool:
+                raise ValueError(f"include host {host} not in hostfile")
+            filtered[host] = slots if slots else pool[host]
+            for s in slots:
+                if s not in pool[host]:
+                    raise ValueError(f"include slot {host}:{s} does not exist")
+        return filtered
+    if exclude_str:
+        exclude = _parse_hostlist(exclude_str)
+        filtered = {}
+        for host, slots in pool.items():
+            if host in exclude:
+                bad = exclude[host]
+                if not bad:
+                    continue  # whole host excluded
+                keep = [s for s in slots if s not in bad]
+                if keep:
+                    filtered[host] = keep
+            else:
+                filtered[host] = slots
+        return filtered
+    return pool
+
+
+def encode_world_info(resource_pool: Dict[str, List[int]]) -> str:
+    world_info = json.dumps(resource_pool)
+    return base64.urlsafe_b64encode(world_info.encode()).decode()
+
+
+class MultiNodeRunner:
+    """Backend-pluggable remote command builder (reference
+    ``multinode_runner.py``); ``get_cmd`` is pure for testability."""
+
+    name = "base"
+
+    def __init__(self, args, world_info_base64: str):
+        self.args = args
+        self.world_info_base64 = world_info_base64
+        self.user_arguments = list(args.user_args)
+        self.user_script = args.user_script
+
+    def backend_exists(self) -> bool:
+        raise NotImplementedError()
+
+    def get_cmd(self, environment: Dict[str, str],
+                active_resources: Dict[str, List[int]]) -> List[str]:
+        raise NotImplementedError()
+
+    @property
+    def exports(self) -> Dict[str, str]:
+        env = {}
+        for var, val in os.environ.items():
+            if any(var == v or (v.endswith("_") and var.startswith(v))
+                   for v in EXPORT_ENVS):
+                env[var] = val
+        return env
+
+
+class PDSHRunner(MultiNodeRunner):
+    name = "pdsh"
+
+    def backend_exists(self) -> bool:
+        import shutil
+
+        return shutil.which("pdsh") is not None
+
+    def get_cmd(self, environment, active_resources):
+        # mutate the caller's env (it is what Popen receives) — pdsh must see this
+        environment["PDSH_RCMD_TYPE"] = "ssh"
+        active_workers = ",".join(active_resources.keys())
+        exports = "".join(f"export {k}={shlex.quote(v)}; "
+                          for k, v in self.exports.items())
+        launch = (f"cd {os.path.abspath('.')}; {exports}"
+                  f"{sys.executable} -m deepspeed_tpu.launcher.launch "
+                  f"--world_info={self.world_info_base64} "
+                  f"--master_addr={self.args.master_addr} "
+                  f"--master_port={self.args.master_port} "
+                  f"--node_rank=%n {self.user_script} "
+                  + " ".join(map(shlex.quote, self.user_arguments)))
+        return ["pdsh", "-S", "-f", "1024", "-w", active_workers, launch]
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    name = "openmpi"
+
+    def backend_exists(self) -> bool:
+        import shutil
+
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        total_procs = len(active_resources)  # one proc per host on TPU
+        hosts = ",".join(f"{h}:1" for h in active_resources)
+        cmd = ["mpirun", "-n", str(total_procs), "-host", hosts,
+               "--mca", "btl", "^openib", "--mca", "btl_tcp_if_include", "eth0"]
+        for k, v in self.exports.items():
+            cmd += ["-x", f"{k}={v}"]
+        cmd += [sys.executable, self.user_script] + self.user_arguments
+        return cmd
+
+
+class SlurmRunner(MultiNodeRunner):
+    name = "slurm"
+
+    def backend_exists(self) -> bool:
+        import shutil
+
+        return shutil.which("srun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        total_nodes = len(active_resources)
+        cmd = ["srun", "-N", str(total_nodes), "--ntasks-per-node=1"]
+        if getattr(self.args, "include", ""):
+            cmd += ["--include", self.args.include]
+        if self.args.launcher_args:
+            cmd += shlex.split(self.args.launcher_args)
+        exports = ",".join(f"{k}={v}" for k, v in self.exports.items())
+        if exports:
+            cmd += [f"--export=ALL,{exports}"]
+        cmd += [sys.executable, self.user_script] + self.user_arguments
+        return cmd
+
+
+def main(args=None):
+    args = parse_args(args)
+    resource_pool = fetch_hostfile(args.hostfile)
+
+    if not resource_pool:
+        # single-host path: exec the script locally, no rendezvous needed
+        env = os.environ.copy()
+        cmd = [sys.executable, args.user_script] + args.user_args
+        logger.info(f"cmd = {' '.join(cmd)}")
+        result = subprocess.Popen(cmd, env=env)
+        result.wait()
+        sys.exit(result.returncode)
+
+    active = parse_resource_filter(resource_pool, args.include, args.exclude)
+    if args.num_nodes > 0:
+        active = dict(list(active.items())[:args.num_nodes])
+    if not args.master_addr:
+        args.master_addr = list(active.keys())[0]
+
+    world_info = encode_world_info(active)
+    runner_cls = {"pdsh": PDSHRunner, "ssh": PDSHRunner,
+                  "openmpi": OpenMPIRunner, "slurm": SlurmRunner}[args.launcher]
+    runner = runner_cls(args, world_info)
+    if not runner.backend_exists():
+        raise RuntimeError(f"launcher backend {args.launcher} not installed")
+    env = os.environ.copy()
+    cmd = runner.get_cmd(env, active)
+    logger.info(f"cmd = {' '.join(cmd)}")
+    result = subprocess.Popen(cmd, env=env)
+    result.wait()
+    sys.exit(result.returncode)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,10 +1,31 @@
 """Model zoo: TPU-native implementations of the reference's supported families."""
 
-from . import gpt2
+import dataclasses
+
+from . import gpt2, llama, mixtral
+
+
+def _with(cfg, overrides):
+    """Apply kwargs onto a named config dataclass instead of dropping them."""
+    return dataclasses.replace(cfg, **overrides)
+
+
+_NAMED = {
+    "gpt2": lambda kw: gpt2.build(**kw),
+    "gpt2125m": lambda kw: gpt2.build(_with(gpt2.GPT2Config.gpt2_125m(), kw)),
+    "llama": lambda kw: llama.build(**kw),
+    "llama38b": lambda kw: llama.build(_with(llama.LlamaConfig.llama3_8b(), kw)),
+    "llama370b": lambda kw: llama.build(_with(llama.LlamaConfig.llama3_70b(), kw)),
+    "mixtral": lambda kw: mixtral.build(**kw),
+    "mixtral8x7b": lambda kw: mixtral.build(
+        _with(mixtral.MixtralConfig.mixtral_8x7b(), kw)),
+}
 
 
 def get_model(name: str, **kwargs):
-    name = name.lower().replace("-", "").replace("_", "")
-    if name in ("gpt2", "gpt2125m"):
-        return gpt2.build(**kwargs)
-    raise ValueError(f"unknown model {name!r}")
+    key = name.lower().replace("-", "").replace("_", "").replace(".", "")
+    if key not in _NAMED:
+        raise ValueError(
+            f"unknown model {name!r}; known: {sorted(_NAMED)} "
+            f"(or call models.<family>.build(config) directly)")
+    return _NAMED[key](kwargs)

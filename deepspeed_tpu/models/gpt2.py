@@ -43,6 +43,9 @@ class GPT2Config:
     dropout: float = 0.0
     remat: bool = False
     tie_embeddings: bool = True
+    #: None = auto (Pallas flash attention on TPU, einsum elsewhere);
+    #: flash path requires attention-dropout == 0
+    use_flash: Optional[bool] = None
 
     @property
     def head_dim(self) -> int:
@@ -122,13 +125,21 @@ def _block(cfg: GPT2Config, x, layer, mask, rng, dropout: float):
     q = q.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
     k = k.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
     v = v.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
-    scores = jnp.where(mask, scores.astype(jnp.float32), -1e9)
-    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    if dropout > 0.0 and rng is not None:
-        keep = jax.random.bernoulli(rng, 1.0 - dropout, probs.shape)
-        probs = probs * keep / (1.0 - dropout)
-    attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    use_flash = cfg.use_flash
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
+    if use_flash and dropout == 0.0:
+        from ..ops.flash_attention import flash_attention
+
+        attn = flash_attention(q, k, v, causal=True)
+    else:
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+        scores = jnp.where(mask, scores.astype(jnp.float32), -1e9)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        if dropout > 0.0 and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - dropout, probs.shape)
+            probs = probs * keep / (1.0 - dropout)
+        attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
     x = x + attn @ layer["o_w"].astype(x.dtype) + layer["o_b"].astype(x.dtype)
 
@@ -207,6 +218,20 @@ def tp_rules(cfg: GPT2Config, abstract_params: PyTree) -> PyTree:
     return specs
 
 
+def _embed(cfg: GPT2Config, params, input_ids):
+    s = input_ids.shape[1]
+    x = params["wte"][input_ids] + params["wpe"][:s]
+    return x.astype(params["wte"].dtype)
+
+
+def _head_loss(cfg: GPT2Config, params, x, targets):
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    logits = (x @ params["wte"].T.astype(x.dtype)).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
 def build(cfg: Optional[GPT2Config] = None, **overrides) -> ModelSpec:
     cfg = cfg or GPT2Config(**overrides)
 
@@ -220,7 +245,21 @@ def build(cfg: Optional[GPT2Config] = None, **overrides) -> ModelSpec:
         input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
         return forward(cfg, params, input_ids, rng=rng, train=False)
 
+    def block_fn(layer, x):
+        s = x.shape[1]
+        mask = jnp.tril(jnp.ones((s, s), bool))[None, None, :, :]
+        return _block(cfg, x, layer, mask, None, 0.0)
+
+    pipeline_hooks = {
+        "blocks_key": ("blocks",),
+        "embed_fn": lambda params, ids: _embed(cfg, params, ids),
+        "block_fn": block_fn,
+        "head_loss_fn": lambda params, x, tgt: _head_loss(cfg, params, x, tgt),
+        "dropout": cfg.dropout,
+    }
+
     return ModelSpec(init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
                      tp_rules=lambda ap: tp_rules(cfg, ap),
                      flops_per_token=6.0 * cfg.num_params(),
+                     pipeline_hooks=pipeline_hooks,
                      name=f"gpt2-{cfg.num_layers}l-{cfg.hidden_size}d")

@@ -1,0 +1,260 @@
+"""Llama family (Llama-2/3), TPU-native.
+
+Driver configs #2/#3 (BASELINE.json: Llama-3-8B ZeRO-3, Llama-3-70B 3D).
+Same structural choices as gpt2.py — stacked [L, ...] blocks + ``lax.scan``
+(ZeRO-3 gathers one layer ahead), optional remat, Megatron-style TP specs,
+pipeline hooks — with the Llama specifics: RMSNorm, rotary embeddings, grouped-
+query attention (GQA), SwiGLU MLP, no biases, untied LM head.
+
+The reference serves these archs through ``module_inject`` policy injection onto
+HF modules; here the model IS the TPU-optimised implementation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.topology import TP_AXIS
+from ..runtime.model import ModelSpec
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 128256
+    max_seq_len: int = 8192
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    hidden_size: int = 4096
+    ffn_size: int = 14336
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    remat: bool = True
+    use_flash: Optional[bool] = None
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def llama3_70b() -> "LlamaConfig":
+        return LlamaConfig(num_layers=80, num_heads=64, num_kv_heads=8,
+                           hidden_size=8192, ffn_size=28672)
+
+    @staticmethod
+    def tiny(vocab_size: int = 512, max_seq_len: int = 128) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=vocab_size, max_seq_len=max_seq_len,
+                           num_layers=2, num_heads=4, num_kv_heads=2,
+                           hidden_size=64, ffn_size=128, rope_theta=10000.0,
+                           remat=False)
+
+    def num_params(self) -> int:
+        d, f, l, v = self.hidden_size, self.ffn_size, self.num_layers, \
+            self.vocab_size
+        hd = self.head_dim
+        attn = d * self.num_heads * hd + 2 * d * self.num_kv_heads * hd + \
+            self.num_heads * hd * d
+        mlp = 3 * d * f
+        return v * d + l * (attn + mlp + 2 * d) + d + d * v
+
+
+def init_params(cfg: LlamaConfig, rng) -> PyTree:
+    d, f, l = cfg.hidden_size, cfg.ffn_size, cfg.num_layers
+    hq = cfg.num_heads * cfg.head_dim
+    hkv = cfg.num_kv_heads * cfg.head_dim
+    keys = jax.random.split(rng, 9)
+    std = 0.02
+
+    def normal(key, shape, s=std):
+        return (jax.random.normal(key, shape) * s).astype(jnp.float32)
+
+    return {
+        "embed": normal(keys[0], (cfg.vocab_size, d)),
+        "blocks": {
+            "attn_norm": jnp.ones((l, d)),
+            "q_w": normal(keys[1], (l, d, hq)),
+            "k_w": normal(keys[2], (l, d, hkv)),
+            "v_w": normal(keys[3], (l, d, hkv)),
+            "o_w": normal(keys[4], (l, hq, d), std / math.sqrt(2 * l)),
+            "mlp_norm": jnp.ones((l, d)),
+            "w1": normal(keys[5], (l, d, f)),
+            "w3": normal(keys[6], (l, d, f)),
+            "w2": normal(keys[7], (l, f, d), std / math.sqrt(2 * l)),
+        },
+        "final_norm": jnp.ones((d,)),
+        "lm_head": normal(keys[8], (d, cfg.vocab_size)),
+    }
+
+
+def rms_norm(x, scale, eps: float = 1e-5):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps) * scale).astype(x.dtype)
+
+
+def rope_angles(cfg: LlamaConfig, seq_len: int, offset: int = 0):
+    hd = cfg.head_dim
+    inv_freq = 1.0 / (cfg.rope_theta ** (jnp.arange(0, hd, 2,
+                                                    dtype=jnp.float32) / hd))
+    pos = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)
+    angles = pos[:, None] * inv_freq[None, :]          # [S, hd/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x, cos, sin):
+    """x: [B, H, S, hd]; rotate pairs (HF half-split convention)."""
+    hd = x.shape[-1]
+    x1, x2 = x[..., :hd // 2], x[..., hd // 2:]
+    c = cos[None, None, :, :]
+    s = sin[None, None, :, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def _attention(cfg: LlamaConfig, q, k, v):
+    use_flash = cfg.use_flash
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
+    if use_flash:
+        from ..ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    rep = cfg.num_heads // cfg.num_kv_heads
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    s_len = q.shape[2]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(cfg.head_dim)
+    mask = jnp.tril(jnp.ones((s_len, k.shape[2]), bool))
+    scores = jnp.where(mask[None, None], scores.astype(jnp.float32), -1e9)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def block_apply(cfg: LlamaConfig, layer: PyTree, x, cos, sin):
+    b, s, d = x.shape
+    h, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    y = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+    q = (y @ layer["q_w"].astype(y.dtype)).reshape(b, s, h, hd)
+    k = (y @ layer["k_w"].astype(y.dtype)).reshape(b, s, hkv, hd)
+    v = (y @ layer["v_w"].astype(y.dtype)).reshape(b, s, hkv, hd)
+    q = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)
+    k = apply_rope(k.transpose(0, 2, 1, 3), cos, sin)
+    v = v.transpose(0, 2, 1, 3)
+    attn = _attention(cfg, q, k, v)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
+    x = x + attn @ layer["o_w"].astype(x.dtype)
+
+    y = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
+    gate = jax.nn.silu(y @ layer["w1"].astype(y.dtype))
+    up = y @ layer["w3"].astype(y.dtype)
+    x = x + (gate * up) @ layer["w2"].astype(x.dtype)
+    return x
+
+
+def forward(cfg: LlamaConfig, params: PyTree, input_ids, rng=None,
+            train: bool = True):
+    del rng, train  # no dropout in llama pretraining config
+    b, s = input_ids.shape
+    x = params["embed"][input_ids].astype(params["embed"].dtype)
+    cos, sin = rope_angles(cfg, s)
+
+    def body(x, layer):
+        fn = block_apply
+        if cfg.remat:
+            fn = jax.checkpoint(block_apply, static_argnums=(0,))
+        return fn(cfg, layer, x, cos, sin), None
+
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    return x @ params["lm_head"].astype(x.dtype)
+
+
+def loss_from_batch(cfg: LlamaConfig, params, batch, rng=None,
+                    train: bool = True):
+    if isinstance(batch, (tuple, list)):
+        input_ids, labels = batch
+    else:
+        input_ids = batch["input_ids"]
+        labels = batch.get("labels")
+    if labels is None:
+        labels = input_ids[:, 1:]
+        input_ids = input_ids[:, :-1]
+    logits = forward(cfg, params, input_ids, rng=rng, train=train)
+    logits = logits.astype(jnp.float32)
+    valid = labels >= 0
+    safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return jnp.where(valid, nll, 0.0).sum() / jnp.maximum(valid.sum(), 1)
+
+
+def tp_rules(cfg: LlamaConfig, abstract_params: PyTree) -> PyTree:
+    return {
+        "embed": P(TP_AXIS, None),
+        "blocks": {
+            "attn_norm": P(),
+            "q_w": P(None, None, TP_AXIS),
+            "k_w": P(None, None, TP_AXIS),
+            "v_w": P(None, None, TP_AXIS),
+            "o_w": P(None, TP_AXIS, None),
+            "mlp_norm": P(),
+            "w1": P(None, None, TP_AXIS),
+            "w3": P(None, None, TP_AXIS),
+            "w2": P(None, TP_AXIS, None),
+        },
+        "final_norm": P(),
+        "lm_head": P(None, TP_AXIS),
+    }
+
+
+def build(cfg: Optional[LlamaConfig] = None, **overrides) -> ModelSpec:
+    cfg = cfg or LlamaConfig(**overrides)
+
+    def init_fn(rng):
+        return init_params(cfg, rng)
+
+    def loss_fn(params, batch, rng=None, train=True):
+        return loss_from_batch(cfg, params, batch, rng=rng, train=train)
+
+    def apply_fn(params, batch, rng=None):
+        ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        return forward(cfg, params, ids, rng=rng, train=False)
+
+    def pp_embed(params, ids):
+        return params["embed"][ids].astype(params["embed"].dtype)
+
+    def pp_block(layer, x):
+        cos, sin = rope_angles(cfg, x.shape[1])
+        return block_apply(cfg, layer, x, cos, sin)
+
+    def pp_head_loss(params, x, targets):
+        x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+        logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return nll.mean()
+
+    return ModelSpec(
+        init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
+        tp_rules=lambda ap: tp_rules(cfg, ap),
+        flops_per_token=6.0 * cfg.num_params(),
+        pipeline_hooks={
+            "blocks_key": ("blocks",),
+            "embed_fn": pp_embed,
+            "block_fn": pp_block,
+            "head_loss_fn": pp_head_loss,
+        },
+        name=f"llama-{cfg.num_layers}l-{cfg.hidden_size}d")

@@ -1,0 +1,179 @@
+"""Mixtral (sparse-MoE Llama), TPU-native.
+
+Driver config #4 (BASELINE.json: Mixtral 8x7B expert-parallel + ZeRO-2).
+Llama attention blocks with the FFN replaced by a top-2-gated MoE
+(``deepspeed_tpu.moe``): expert weights are stacked [L, E, ...] with the expert
+dim sharded over the ``ep`` mesh axis, so scan-over-layers + vmapped experts +
+all-to-all dispatch compose with ZeRO and TP.  Reference analog:
+``deepspeed/moe/layer.py`` MoE inserted per-block + MoE-aware ZeRO.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..moe.layer import MoEConfig, moe_apply
+from ..moe.sharded_moe import top2gating, top1gating, dispatch_tokens, combine_tokens
+from ..parallel.topology import EP_AXIS, TP_AXIS
+from ..runtime.model import ModelSpec
+from . import llama as L
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class MixtralConfig(L.LlamaConfig):
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_loss_coef: float = 0.02
+
+    @staticmethod
+    def mixtral_8x7b() -> "MixtralConfig":
+        return MixtralConfig(vocab_size=32000, num_layers=32, num_heads=32,
+                             num_kv_heads=8, hidden_size=4096, ffn_size=14336,
+                             rope_theta=1e6, num_experts=8, top_k=2)
+
+    @staticmethod
+    def tiny(vocab_size: int = 512) -> "MixtralConfig":
+        return MixtralConfig(vocab_size=vocab_size, max_seq_len=128,
+                             num_layers=2, num_heads=4, num_kv_heads=2,
+                             hidden_size=64, ffn_size=128, rope_theta=10000.0,
+                             num_experts=4, top_k=2, remat=False)
+
+    def num_params(self) -> int:
+        base = super().num_params()
+        d, f = self.hidden_size, self.ffn_size
+        # swap the dense MLP for E experts + router
+        per_layer_mlp = 3 * d * f
+        return base + self.num_layers * (
+            (self.num_experts - 1) * per_layer_mlp + d * self.num_experts)
+
+    def moe_cfg(self) -> MoEConfig:
+        return MoEConfig(hidden_size=self.hidden_size,
+                         ffn_hidden_size=self.ffn_size,
+                         num_experts=self.num_experts, k=self.top_k,
+                         capacity_factor=self.capacity_factor,
+                         activation="silu_glu")
+
+
+def init_params(cfg: MixtralConfig, rng) -> PyTree:
+    params = L.init_params(cfg, rng)
+    blocks = params["blocks"]
+    d, f, l, e = cfg.hidden_size, cfg.ffn_size, cfg.num_layers, cfg.num_experts
+    keys = jax.random.split(jax.random.fold_in(rng, 7), 4)
+    std = 0.02
+
+    def normal(key, shape, s=std):
+        return (jax.random.normal(key, shape) * s).astype(jnp.float32)
+
+    for k in ("w1", "w2", "w3"):
+        del blocks[k]
+    blocks["gate_w"] = normal(keys[0], (l, d, e))
+    blocks["experts_w1"] = normal(keys[1], (l, e, d, f))
+    blocks["experts_w3"] = normal(keys[2], (l, e, d, f))
+    blocks["experts_w2"] = normal(keys[3], (l, e, f, d))
+    return params
+
+
+def _moe_block(cfg: MixtralConfig, layer: PyTree, x, cos, sin, train: bool = True):
+    """Llama attention + MoE FFN; returns (x, aux_loss)."""
+    b, s, d = x.shape
+    y = L.rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+    h, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    q = (y @ layer["q_w"].astype(y.dtype)).reshape(b, s, h, hd)
+    k = (y @ layer["k_w"].astype(y.dtype)).reshape(b, s, hkv, hd)
+    v = (y @ layer["v_w"].astype(y.dtype)).reshape(b, s, hkv, hd)
+    q = L.apply_rope(q.transpose(0, 2, 1, 3), cos, sin)
+    k = L.apply_rope(k.transpose(0, 2, 1, 3), cos, sin)
+    attn = L._attention(cfg, q, k, v.transpose(0, 2, 1, 3))
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
+    x = x + attn @ layer["o_w"].astype(x.dtype)
+
+    y = L.rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
+    moe_params = {
+        "gate_w": layer["gate_w"],
+        "experts": {"w1": layer["experts_w1"], "w3": layer["experts_w3"],
+                    "w2": layer["experts_w2"]},
+    }
+    moe_out, aux = moe_apply(cfg.moe_cfg(), moe_params, y, train=train)
+    return x + moe_out, aux
+
+
+def forward_with_aux(cfg: MixtralConfig, params: PyTree, input_ids,
+                     train: bool = True):
+    b, s = input_ids.shape
+    x = params["embed"][input_ids].astype(params["embed"].dtype)
+    cos, sin = L.rope_angles(cfg, s)
+
+    def body(carry, layer):
+        x, aux_sum = carry
+        fn = _moe_block
+        if cfg.remat:
+            fn = jax.checkpoint(_moe_block, static_argnums=(0, 5))
+        x, aux = fn(cfg, layer, x, cos, sin, train)
+        return (x, aux_sum + aux), None
+
+    (x, aux_sum), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)),
+                                   params["blocks"])
+    x = L.rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = x @ params["lm_head"].astype(x.dtype)
+    return logits, aux_sum / cfg.num_layers
+
+
+def loss_from_batch(cfg: MixtralConfig, params, batch, rng=None,
+                    train: bool = True):
+    if isinstance(batch, (tuple, list)):
+        input_ids, labels = batch
+    else:
+        input_ids = batch["input_ids"]
+        labels = batch.get("labels")
+    if labels is None:
+        labels = input_ids[:, 1:]
+        input_ids = input_ids[:, :-1]
+    logits, aux = forward_with_aux(cfg, params, input_ids, train=train)
+    logits = logits.astype(jnp.float32)
+    valid = labels >= 0
+    safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    lm_loss = jnp.where(valid, nll, 0.0).sum() / jnp.maximum(valid.sum(), 1)
+    return lm_loss + cfg.router_aux_loss_coef * aux
+
+
+def tp_rules(cfg: MixtralConfig, abstract_params: PyTree) -> PyTree:
+    rules = L.tp_rules(cfg, abstract_params)
+    blocks = rules["blocks"]
+    for k in ("w1", "w2", "w3"):
+        del blocks[k]
+    blocks["gate_w"] = P()
+    blocks["experts_w1"] = P(None, EP_AXIS, None, TP_AXIS)
+    blocks["experts_w3"] = P(None, EP_AXIS, None, TP_AXIS)
+    blocks["experts_w2"] = P(None, EP_AXIS, TP_AXIS, None)
+    return rules
+
+
+def build(cfg: Optional[MixtralConfig] = None, **overrides) -> ModelSpec:
+    cfg = cfg or MixtralConfig(**overrides)
+
+    def init_fn(rng):
+        return init_params(cfg, rng)
+
+    def loss_fn(params, batch, rng=None, train=True):
+        return loss_from_batch(cfg, params, batch, rng=rng, train=train)
+
+    def apply_fn(params, batch, rng=None):
+        ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        return forward_with_aux(cfg, params, ids, train=False)[0]
+
+    return ModelSpec(
+        init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
+        tp_rules=lambda ap: tp_rules(cfg, ap),
+        flops_per_token=6.0 * (cfg.num_params() / cfg.num_experts *
+                               (cfg.top_k + 1)),
+        name=f"mixtral-{cfg.num_layers}l-{cfg.num_experts}e")

@@ -1,0 +1,2 @@
+from .layer import MoEConfig, init_moe_params, moe_apply, moe_tp_rules
+from .sharded_moe import top1gating, top2gating

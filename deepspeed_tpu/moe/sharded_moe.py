@@ -1,0 +1,136 @@
+"""Sharded MoE: gating + expert-parallel dispatch/combine.
+
+Analog of reference ``deepspeed/moe/sharded_moe.py`` — same gating math
+(``top1gating`` :177, ``top2gating`` :278, ``_capacity`` :155, load-balancing
+aux loss) — but dispatch is declarative: tokens are rearranged into per-expert
+capacity buckets with einsums, and the expert dimension is sharded over the
+``ep`` mesh axis, so XLA inserts the **all-to-all** the reference issues
+explicitly through its ``_AllToAll`` autograd function (:89).
+
+Shapes follow the grouped convention: tokens [G, S, D] (G = groups = sharded
+batch), gates [G, S, E], dispatch/combine [G, S, E, C].
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+uniform_map = {}
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+              min_capacity: int) -> int:
+    """Reference ``_capacity`` (sharded_moe.py:155): tokens-per-expert budget."""
+    capacity = int(num_tokens // num_experts * capacity_factor)
+    return max(capacity, min_capacity)
+
+
+def _one_hot(idx, num: int, dtype=jnp.float32):
+    return jax.nn.one_hot(idx, num, dtype=dtype)
+
+
+def _cumsum_exclusive(x, axis: int):
+    return jnp.cumsum(x, axis=axis) - x
+
+
+def top1gating(logits, capacity_factor: float = 1.0, min_capacity: int = 4,
+               noisy_gate_policy: Optional[str] = None, rng=None,
+               drop_tokens: bool = True, used_token_mask=None
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-1 (Switch) gating.  Returns (aux_loss, combine_weights, dispatch_mask,
+    exp_counts) like the reference (sharded_moe.py:177).
+
+    logits: [G, S, E].
+    """
+    g, s, e = logits.shape
+    capacity = _capacity(s, e, capacity_factor, min_capacity)
+
+    if noisy_gate_policy == "RSample" and rng is not None:
+        logits_for_sel = logits + jax.random.gumbel(rng, logits.shape)
+    else:
+        logits_for_sel = logits
+    gates = jax.nn.softmax(logits, axis=-1)                    # [G,S,E]
+    index1 = jnp.argmax(logits_for_sel, axis=-1)               # [G,S]
+    mask1 = _one_hot(index1, e)                                # [G,S,E]
+    if used_token_mask is not None:  # padding tokens don't route
+        mask1 = mask1 * used_token_mask[..., None]
+
+    # load-balancing loss (reference l_aux: E * mean(me * ce))
+    me = jnp.mean(gates, axis=1)                               # [G,E]
+    ce = jnp.mean(mask1, axis=1)                               # [G,E]
+    l_aux = jnp.mean(jnp.sum(me * ce, axis=-1)) * e
+
+    # position of each token within its expert's bucket
+    locations1 = _cumsum_exclusive(mask1, axis=1)              # [G,S,E]
+    pos1 = jnp.sum(locations1 * mask1, axis=-1)                # [G,S]
+    if not drop_tokens:
+        # the reference raises capacity to max(exp_counts) here
+        # (sharded_moe.py:214); that is a data-dependent shape, impossible
+        # under jit — reject rather than silently zeroing overflow tokens
+        raise NotImplementedError(
+            "drop_tokens=False needs dynamic capacity, which cannot compile "
+            "under jit; raise capacity_factor instead")
+    mask1 = mask1 * (locations1 < capacity).astype(mask1.dtype)
+
+    gates1 = jnp.sum(gates * mask1, axis=-1)                   # [G,S]
+    dispatch = mask1[..., None] * _one_hot(pos1, capacity)[:, :, None, :]
+    combine = gates1[..., None, None] * dispatch               # [G,S,E,C]
+    exp_counts = jnp.sum(mask1, axis=(0, 1))
+    return l_aux, combine, dispatch.astype(bool), exp_counts
+
+
+def top2gating(logits, capacity_factor: float = 1.0, min_capacity: int = 4
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-2 (GShard) gating (reference sharded_moe.py:278): second expert from
+    re-argmax with the first masked; weights renormalised over the chosen two."""
+    g, s, e = logits.shape
+    capacity = _capacity(s, e, 2 * capacity_factor, min_capacity)
+
+    gates = jax.nn.softmax(logits, axis=-1)
+    index1 = jnp.argmax(gates, axis=-1)
+    mask1 = _one_hot(index1, e)
+    logits_wo1 = jnp.where(mask1.astype(bool), -jnp.inf, logits)
+    index2 = jnp.argmax(logits_wo1, axis=-1)
+    mask2 = _one_hot(index2, e)
+
+    locations1 = _cumsum_exclusive(mask1, axis=1)
+    # expert-2 slots start after all expert-1 claims (reference offsets by
+    # sum(mask1) per expert)
+    locations2 = _cumsum_exclusive(mask2, axis=1) + \
+        jnp.sum(mask1, axis=1, keepdims=True)
+
+    me = jnp.mean(gates, axis=1)
+    ce = jnp.mean(mask1, axis=1)
+    l_aux = jnp.mean(jnp.sum(me * ce, axis=-1)) * e
+
+    mask1 = mask1 * (locations1 < capacity).astype(mask1.dtype)
+    mask2 = mask2 * (locations2 < capacity).astype(mask2.dtype)
+    pos1 = jnp.sum(locations1 * mask1, axis=-1)
+    pos2 = jnp.sum(locations2 * mask2, axis=-1)
+
+    gates1 = jnp.sum(gates * mask1, axis=-1)
+    gates2 = jnp.sum(gates * mask2, axis=-1)
+    denom = jnp.maximum(gates1 + gates2, jnp.finfo(gates.dtype).eps)
+    gates1, gates2 = gates1 / denom, gates2 / denom
+
+    disp1 = mask1[..., None] * _one_hot(pos1, capacity)[:, :, None, :]
+    disp2 = mask2[..., None] * _one_hot(pos2, capacity)[:, :, None, :]
+    combine = gates1[..., None, None] * disp1 + gates2[..., None, None] * disp2
+    dispatch = (disp1 + disp2).astype(bool)
+    exp_counts = jnp.sum(mask1 + mask2, axis=(0, 1))
+    return l_aux, combine, dispatch, exp_counts
+
+
+def dispatch_tokens(x, dispatch_mask):
+    """[G,S,D], [G,S,E,C] -> expert inputs [E, G, C, D] (reference einsum
+    ``sec,sm->ecm`` at MOELayer.forward, sharded_moe.py:439)."""
+    return jnp.einsum("gsec,gsd->egcd", dispatch_mask.astype(x.dtype), x)
+
+
+def combine_tokens(expert_out, combine_weights):
+    """[E,G,C,D], [G,S,E,C] -> [G,S,D]."""
+    return jnp.einsum("gsec,egcd->gsd",
+                      combine_weights.astype(expert_out.dtype), expert_out)

@@ -298,20 +298,13 @@ class DeepSpeedEngine:
 
         return micro_loss
 
-    def _build_step_fns(self) -> None:
-        gas = self.gradient_accumulation_steps()
+    def _make_apply_update(self):
+        """Build the shared optimizer-apply closure (overflow skip, scaler
+        update, metrics) — used by both the DP and pipeline step functions."""
         fp16 = self.fp16_enabled
         dynamic = self.dynamic_loss_scale
         scaler_args = self._config.dynamic_loss_scale_args
-        micro_loss = self._micro_loss_closure()
         tx = self.tx
-        grad_shardings = self.grad_shardings
-
-        def grads_of_micro(params, micro, rng, scale):
-            (scaled_loss, loss), grads = jax.value_and_grad(
-                micro_loss, has_aux=True)(params, micro, rng, scale)
-            del scaled_loss
-            return loss, grads
 
         def apply_update(state, grads, mean_loss):
             """grads: fp32, already averaged over the global batch & unscaled."""
@@ -354,6 +347,26 @@ class DeepSpeedEngine:
                 "skipped": new_scaler.skipped,
             }
             return new_state, metrics
+
+        return apply_update
+
+    def _metrics_shardings(self):
+        rep = NamedSharding(self.mesh, P())
+        return {k: rep for k in
+                ("loss", "grad_norm", "overflow", "loss_scale", "skipped")}
+
+    def _build_step_fns(self) -> None:
+        gas = self.gradient_accumulation_steps()
+        fp16 = self.fp16_enabled
+        micro_loss = self._micro_loss_closure()
+        grad_shardings = self.grad_shardings
+        apply_update = self._make_apply_update()
+
+        def grads_of_micro(params, micro, rng, scale):
+            (scaled_loss, loss), grads = jax.value_and_grad(
+                micro_loss, has_aux=True)(params, micro, rng, scale)
+            del scaled_loss
+            return loss, grads
 
         def train_step(state, batch, base_rng):
             """batch: pytree with leading dims [gas, micro_global, ...]."""
@@ -400,9 +413,7 @@ class DeepSpeedEngine:
             return self.model_spec.loss_fn(p, batch, base_rng, False)
 
         rep = NamedSharding(self.mesh, P())
-        metrics_shardings = {k: rep for k in
-                             ("loss", "grad_norm", "overflow", "loss_scale",
-                              "skipped")}
+        metrics_shardings = self._metrics_shardings()
         self._train_step_fn = jax.jit(
             train_step,
             out_shardings=(self.state_shardings, metrics_shardings),

@@ -32,6 +32,12 @@ class ModelSpec:
     #: optional: flops per token (fwd) for MFU reporting
     flops_per_token: Optional[float] = None
     name: str = "model"
+    #: Optional pipeline decomposition for pp>1 (see runtime/pipe/engine.py):
+    #:   blocks_key: tuple path of the [L, ...]-stacked block params
+    #:   embed_fn(params, input_ids) -> activations [B, S, D]
+    #:   block_fn(layer_params, x)   -> x  (one transformer block)
+    #:   head_loss_fn(params, x, targets) -> scalar mean loss
+    pipeline_hooks: Optional[dict] = None
 
     def init(self, rng) -> PyTree:
         return self.init_fn(rng)

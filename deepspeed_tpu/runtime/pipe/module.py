@@ -1,0 +1,170 @@
+"""Pipeline module description.
+
+Port of reference ``runtime/pipe/module.py`` (``LayerSpec`` :26, ``TiedLayerSpec``
+:74, ``PipelineModule`` :88) to the functional world: a ``PipelineModule`` is a
+*description* — an ordered list of layer builders plus a partitioning method —
+that the TPU pipeline engine compiles into stage-stacked parameter pytrees
+sharded over the ``pp`` mesh axis.  ``partition_method`` supports the reference's
+``uniform`` / ``parameters`` / ``type:regex`` modes (``module.py:367``).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ...utils.logging import logger
+
+
+class LayerSpec:
+    """Delayed layer builder (reference ``module.py:26``).
+
+    ``typename`` is any callable returning a layer description with
+    ``init(rng) -> params`` and ``apply(params, x, **kw) -> x`` — our functional
+    replacement for building an nn.Module.
+    """
+
+    def __init__(self, typename: Callable, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not callable(typename):
+            raise RuntimeError("LayerSpec requires a callable typename")
+
+    def build(self, log: bool = False):
+        if log:
+            logger.info(f"building {repr(self)}")
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    @property
+    def name(self) -> str:
+        return getattr(self.typename, "__name__", str(self.typename))
+
+    def __repr__(self):
+        return f"LayerSpec({self.name})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer whose params are shared across stages (reference ``module.py:74``).
+
+    On TPU, tied layers are *replicated over the pp axis* and their gradients
+    psum over ``pp`` automatically — the declarative form of the reference's
+    ``ReduceTiedGrads`` / tied-comm groups (``pipe/engine.py:233``).
+    """
+
+    def __init__(self, key: str, typename: Callable, *module_args,
+                 forward_fn: Optional[Callable] = None, tied_weight_attr="weight",
+                 **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    """Reference ``runtime/utils.py partition_uniform``: boundaries of equal
+    chunks (remainder spread over the first parts)."""
+    parts = [0] * (num_parts + 1)
+    chunk, rem = divmod(num_items, num_parts)
+    for p in range(num_parts):
+        parts[p + 1] = parts[p] + chunk + (1 if p < rem else 0)
+    return parts
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
+    """Reference ``runtime/utils.py partition_balanced``: boundaries minimising
+    the max part weight (binary search over the bottleneck)."""
+    weights = list(weights)
+    n = len(weights)
+    prefix = np.concatenate([[0], np.cumsum(weights)])
+
+    def parts_needed(limit: float) -> Optional[List[int]]:
+        bounds = [0]
+        start = 0
+        for _ in range(num_parts):
+            # furthest end such that sum(weights[start:end]) <= limit
+            hi = int(np.searchsorted(prefix, prefix[start] + limit, side="right")) - 1
+            if hi <= start and start < n:
+                hi = start + 1  # at least one item even if it exceeds limit
+            bounds.append(min(hi, n))
+            start = bounds[-1]
+            if start >= n:
+                break
+        while len(bounds) < num_parts + 1:
+            bounds.append(n)
+        return bounds if bounds[-1] >= n else None
+
+    lo, hi = max(weights, default=0.0), float(prefix[-1])
+    for _ in range(50):
+        mid = (lo + hi) / 2
+        if parts_needed(mid) is not None:
+            hi = mid
+        else:
+            lo = mid
+    return parts_needed(hi)
+
+
+class PipelineModule:
+    """Ordered layer list + partitioning (reference ``module.py:88``)."""
+
+    def __init__(self, layers: Sequence[LayerSpec],
+                 num_stages: Optional[int] = None,
+                 topology=None,
+                 loss_fn: Optional[Callable] = None,
+                 partition_method: str = "parameters",
+                 seed_layers: bool = False,
+                 activation_checkpoint_interval: int = 0):
+        self.layer_specs = list(layers)
+        self.num_stages = num_stages or (topology.pipe_parallel_size
+                                         if topology else 1)
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.seed_layers = seed_layers
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.parts = self._partition_layers()
+
+    def _count_layer_params(self) -> List[float]:
+        import jax
+
+        counts = []
+        for spec in self.layer_specs:
+            layer = spec.build()
+            if hasattr(layer, "num_params"):
+                counts.append(float(layer.num_params()))
+            elif hasattr(layer, "init"):
+                abstract = jax.eval_shape(layer.init, jax.random.PRNGKey(0))
+                counts.append(float(sum(
+                    np.prod(x.shape) for x in jax.tree_util.tree_leaves(abstract))))
+            else:
+                counts.append(0.0)
+        return counts
+
+    def _partition_layers(self) -> List[int]:
+        method = self.partition_method.lower()
+        n = len(self.layer_specs)
+        if method == "uniform":
+            parts = partition_uniform(n, self.num_stages)
+        elif method == "parameters":
+            weights = self._count_layer_params()
+            parts = partition_balanced(weights, self.num_stages)
+        elif method.startswith("type:"):
+            pattern = method.split(":", 1)[1]
+            binary = [1.0 if re.search(pattern, spec.name, re.IGNORECASE) else 0.0
+                      for spec in self.layer_specs]
+            parts = partition_balanced(binary, self.num_stages)
+        else:
+            raise NotImplementedError(f"partition_method {self.partition_method}")
+        assert len(parts) == self.num_stages + 1 and parts[-1] == n, \
+            f"bad partition {parts} for {n} layers over {self.num_stages} stages"
+        return parts
+
+    def stage_layer_indices(self, stage_id: int) -> range:
+        return range(self.parts[stage_id], self.parts[stage_id + 1])
+
+    def num_layers_per_stage(self) -> List[int]:
+        return [self.parts[i + 1] - self.parts[i] for i in range(self.num_stages)]
+
+    def __len__(self):
+        return len(self.layer_specs)

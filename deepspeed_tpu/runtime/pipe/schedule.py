@@ -1,0 +1,283 @@
+"""Pipeline schedules — pure instruction-stream math.
+
+Port of the reference's schedule semantics (``runtime/pipe/schedule.py``:
+``PipeSchedule`` base, ``InferenceSchedule`` :117, ``TrainSchedule`` :184 — the
+1F1B alternation) as device-free Python.  On TPU the *executed* schedule for the
+SPMD pipelined train step is the rotation loop in ``pipe/engine.py`` (GPipe-like,
+derived by XLA from shardings); these instruction streams drive the host-driven
+executor variant, tests, and bubble accounting, and keep parity with the
+reference's scheduling contract.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+
+class PipeInstruction:
+    """A single step directive (reference ``schedule.py:310``)."""
+
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for key, val in kwargs.items():
+            setattr(self, key, val)
+
+    def __repr__(self):
+        if not self.kwargs:
+            return self.name
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{self.name}({args})"
+
+    def __eq__(self, other):
+        return (type(self) is type(other)) and self.kwargs == other.kwargs
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class BufferOpInstruction(PipeInstruction):
+    def __init__(self, buffer_id: int, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    pass
+
+
+class ForwardPass(BufferOpInstruction):
+    pass
+
+
+class BackwardPass(BufferOpInstruction):
+    pass
+
+
+class SendActivation(BufferOpInstruction):
+    pass
+
+
+class RecvActivation(BufferOpInstruction):
+    pass
+
+
+class SendGrad(BufferOpInstruction):
+    pass
+
+
+class RecvGrad(BufferOpInstruction):
+    pass
+
+
+class PipeSchedule:
+    """Generates lists of instructions per step (reference ``schedule.py:7``)."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        assert 0 <= stage_id < stages
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = self.stage_id - 1
+        self.next_stage = self.stage_id + 1
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError()
+
+    def num_pipe_buffers(self) -> int:
+        return self.micro_batches
+
+    @property
+    def stage(self):
+        return self.stage_id
+
+    @property
+    def num_stages(self):
+        return self.stages
+
+    @property
+    def num_micro_batches(self):
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def _valid_micro_batch(self, micro_batch_id: int) -> bool:
+        return 0 <= micro_batch_id < self.micro_batches
+
+    def _valid_stage(self, stage_id: int) -> bool:
+        return 0 <= stage_id < self.num_stages
+
+    def _buffer_idx(self, micro_batch_id: int) -> int:
+        assert self._valid_micro_batch(micro_batch_id)
+        return micro_batch_id % self.num_pipe_buffers()
+
+    def __iter__(self):
+        self.it = None
+        return self
+
+    def __next__(self):
+        if self.it is None:
+            self.it = self.steps()
+        return next(self.it)
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only fill-drain (reference ``schedule.py:117``)."""
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            cmds = []
+            micro_batch_id = step_id - self.stage_id
+
+            # alternate send/recv buffers to overlap transfers
+            if _is_even(step_id) and _is_even(self.stage_id) or \
+                    _is_odd(step_id) and _is_odd(self.stage_id):
+                recv_buf, send_buf = step_id % 2, (step_id + 1) % 2
+            else:
+                recv_buf, send_buf = (step_id + 1) % 2, step_id % 2
+
+            if self.is_first_stage or self.is_last_stage:
+                if self._valid_micro_batch(micro_batch_id) and self.is_first_stage:
+                    cmds.append(LoadMicroBatch(recv_buf))
+            if _is_even(self.stage_id):
+                if self._valid_stage(self.next_stage) and \
+                        self._valid_micro_batch(micro_batch_id - 1):
+                    cmds.append(SendActivation(send_buf))
+                if self._valid_stage(self.prev_stage) and \
+                        self._valid_micro_batch(micro_batch_id):
+                    cmds.append(RecvActivation(recv_buf))
+            else:
+                if self._valid_stage(self.prev_stage) and \
+                        self._valid_micro_batch(micro_batch_id):
+                    cmds.append(RecvActivation(recv_buf))
+                if self._valid_stage(self.next_stage) and \
+                        self._valid_micro_batch(micro_batch_id - 1):
+                    cmds.append(SendActivation(send_buf))
+            if self._valid_micro_batch(micro_batch_id):
+                cmds.append(ForwardPass(recv_buf))
+            yield cmds
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B alternation (reference ``schedule.py:184``): even steps forward, odd
+    steps backward, offset per stage so steady state interleaves 1 fwd / 1 bwd."""
+
+    def steps(self):
+        prev_micro_batch_id = -1
+        total_steps = 2 * (self.micro_batches + self.stages - 1)
+        for step_id in range(total_steps):
+            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
+            cmds = []
+
+            # exchange activations/grads with neighbours
+            if self._valid_micro_batch(prev_micro_batch_id) and \
+                    self._valid_stage(self.next_stage):
+                if is_forward:
+                    cmds.append(RecvGrad(self._buffer_idx(prev_micro_batch_id)))
+                else:
+                    cmds.append(SendActivation(self._buffer_idx(prev_micro_batch_id)))
+            if self._valid_micro_batch(micro_batch_id) and \
+                    self._valid_stage(self.prev_stage):
+                if is_forward:
+                    cmds.append(RecvActivation(self._buffer_idx(micro_batch_id)))
+                else:
+                    cmds.append(SendGrad(self._buffer_idx(micro_batch_id)))
+
+            # first/last stage loads data
+            if self.stage_id == 0 or self.stage_id == self.stages - 1:
+                if is_forward and self._valid_micro_batch(micro_batch_id):
+                    cmds.append(LoadMicroBatch(self._buffer_idx(micro_batch_id)))
+
+            # compute
+            if self._valid_micro_batch(micro_batch_id):
+                if is_forward:
+                    cmds.append(ForwardPass(self._buffer_idx(micro_batch_id)))
+                else:
+                    cmds.append(BackwardPass(self._buffer_idx(micro_batch_id)))
+
+            # step at the very end
+            if step_id == total_steps - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+
+            prev_micro_batch_id = micro_batch_id
+            yield cmds
+
+    def num_pipe_buffers(self) -> int:
+        """Max buffers in flight (reference :290): shrinks for later stages."""
+        buffers = min(self.stages - self.stage_id, self.micro_batches)
+        return max(2, buffers)
+
+    def _step_to_micro_batch(self, step_id: int):
+        if _is_even(step_id) and _is_even(self.stage_id):
+            micro_batch_id = self._even_step_forward_id(step_id)
+            is_forward = True
+        elif _is_odd(step_id) and _is_odd(self.stage_id):
+            micro_batch_id = self._odd_step_forward_id(step_id)
+            is_forward = True
+        elif _is_even(step_id) and _is_odd(self.stage_id):
+            micro_batch_id = self._even_step_backward_id(step_id)
+            is_forward = False
+        elif _is_odd(step_id) and _is_even(self.stage_id):
+            micro_batch_id = self._odd_step_backward_id(step_id)
+            is_forward = False
+        else:
+            raise AssertionError()
+        return micro_batch_id, is_forward
+
+    def _even_step_forward_id(self, step_id: int) -> int:
+        base = step_id // 2
+        return int(base - self.stage_id // 2)
+
+    def _odd_step_forward_id(self, step_id: int) -> int:
+        base = (step_id - 1) // 2
+        return int(base - self.stage_id // 2)
+
+    def _even_step_backward_id(self, step_id: int) -> int:
+        base = step_id // 2
+        return int(base - self.stages + (self.stage_id + 1) // 2)
+
+    def _odd_step_backward_id(self, step_id: int) -> int:
+        base = ((step_id - 1) // 2) - self.stages + 1
+        return int(base + (self.stage_id + 1) // 2)
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule (reference ``schedule.py:465``)."""
+
+    def steps(self):
+        for step_id in range(self.micro_batches):
+            cmds = [LoadMicroBatch(0), ForwardPass(0), BackwardPass(0)]
+            if step_id == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds
+
+    def num_pipe_buffers(self) -> int:
+        return 1
+
+
+def _is_even(x: int) -> bool:
+    return x % 2 == 0
+
+
+def _is_odd(x: int) -> bool:
+    return x % 2 != 0

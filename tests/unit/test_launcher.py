@@ -1,0 +1,120 @@
+"""Launcher pure-unit tests (model: reference tests/unit/launcher/test_run.py
+and test_multinode_runner.py — no ssh, just parsing + command construction)."""
+
+import base64
+import json
+
+import pytest
+
+from deepspeed_tpu.launcher.launch import build_env, decode_world_info
+from deepspeed_tpu.launcher.runner import (OpenMPIRunner, PDSHRunner,
+                                           SlurmRunner, encode_world_info,
+                                           fetch_hostfile, parse_args,
+                                           parse_resource_filter)
+
+
+@pytest.fixture
+def hostfile(tmp_path):
+    p = tmp_path / "hostfile"
+    p.write_text("""
+worker-0 slots=4
+worker-1 slots=4
+# a comment
+worker-2 slots=8
+""")
+    return str(p)
+
+
+def test_fetch_hostfile(hostfile):
+    pool = fetch_hostfile(hostfile)
+    assert pool == {"worker-0": 4, "worker-1": 4, "worker-2": 8}
+
+
+def test_fetch_hostfile_missing(tmp_path):
+    assert fetch_hostfile(str(tmp_path / "nope")) is None
+
+
+def test_fetch_hostfile_bad_format(tmp_path):
+    p = tmp_path / "hf"
+    p.write_text("worker-0 slots=four\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(str(p))
+
+
+def test_fetch_hostfile_duplicate(tmp_path):
+    p = tmp_path / "hf"
+    p.write_text("w slots=2\nw slots=2\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(str(p))
+
+
+def test_resource_filter_include():
+    pool = {"worker-0": 4, "worker-1": 4}
+    active = parse_resource_filter(pool, include_str="worker-1:0,2")
+    assert active == {"worker-1": [0, 2]}
+    active = parse_resource_filter(pool, include_str="worker-0")
+    assert active == {"worker-0": [0, 1, 2, 3]}
+
+
+def test_resource_filter_exclude():
+    pool = {"worker-0": 4, "worker-1": 4}
+    active = parse_resource_filter(pool, exclude_str="worker-1")
+    assert list(active.keys()) == ["worker-0"]
+    active = parse_resource_filter(pool, exclude_str="worker-0:1,3")
+    assert active["worker-0"] == [0, 2]
+
+
+def test_resource_filter_conflicts():
+    with pytest.raises(ValueError):
+        parse_resource_filter({"w": 2}, include_str="w", exclude_str="w")
+    with pytest.raises(ValueError):
+        parse_resource_filter({"w": 2}, include_str="bogus-host")
+
+
+def test_world_info_roundtrip():
+    active = {"worker-0": [0, 1], "worker-1": [0]}
+    encoded = encode_world_info(active)
+    assert decode_world_info(encoded) == active
+
+
+def _args(extra=None):
+    return parse_args((extra or []) + ["train.py", "--foo", "bar"])
+
+
+def test_pdsh_cmd_construction():
+    args = _args(["--master_addr", "worker-0"])
+    runner = PDSHRunner(args, encode_world_info({"worker-0": [0], "worker-1": [0]}))
+    cmd = runner.get_cmd({}, {"worker-0": [0], "worker-1": [0]})
+    assert cmd[0] == "pdsh"
+    assert "worker-0,worker-1" in cmd
+    joined = " ".join(cmd)
+    assert "deepspeed_tpu.launcher.launch" in joined
+    assert "--master_addr=worker-0" in joined
+    assert "train.py" in joined and "--foo bar" in joined
+
+
+def test_openmpi_cmd_construction():
+    args = _args()
+    runner = OpenMPIRunner(args, "x")
+    cmd = runner.get_cmd({}, {"a": [0], "b": [0]})
+    assert cmd[0] == "mpirun"
+    assert "-n" in cmd and cmd[cmd.index("-n") + 1] == "2"
+    assert "train.py" in cmd
+
+
+def test_slurm_cmd_construction():
+    args = _args()
+    runner = SlurmRunner(args, "x")
+    cmd = runner.get_cmd({}, {"a": [0], "b": [0], "c": [0]})
+    assert cmd[0] == "srun"
+    assert cmd[cmd.index("-N") + 1] == "3"
+
+
+def test_build_env():
+    world = {"worker-0": [0, 1], "worker-1": [0, 1]}
+    env = build_env(world, node_rank=1, master_addr="worker-0",
+                    master_port=1234, base_env={})
+    assert env["JAX_COORDINATOR_ADDRESS"] == "worker-0:1234"
+    assert env["JAX_NUM_PROCESSES"] == "2"
+    assert env["JAX_PROCESS_ID"] == "1"
+    assert env["WORLD_SIZE"] == "4"

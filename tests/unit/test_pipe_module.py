@@ -1,0 +1,66 @@
+"""PipelineModule partitioning tests (model: reference tests/unit/pipe/test_pipe_module.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.pipe.module import (LayerSpec, PipelineModule,
+                                               partition_balanced,
+                                               partition_uniform)
+
+
+class FakeLayer:
+    def __init__(self, n=10):
+        self.n = n
+
+    def num_params(self):
+        return self.n
+
+
+def test_partition_uniform():
+    assert partition_uniform(8, 4) == [0, 2, 4, 6, 8]
+    assert partition_uniform(7, 2) == [0, 4, 7]
+    assert partition_uniform(3, 3) == [0, 1, 2, 3]
+
+
+def test_partition_balanced():
+    parts = partition_balanced([1, 1, 1, 1], 2)
+    assert parts == [0, 2, 4]
+    parts = partition_balanced([10, 1, 1, 1], 2)
+    assert parts[1] == 1  # heavy first layer gets its own stage
+    parts = partition_balanced([1, 1, 1, 10], 2)
+    assert parts == [0, 3, 4]
+
+
+def test_pipeline_module_uniform():
+    layers = [LayerSpec(FakeLayer) for _ in range(8)]
+    pm = PipelineModule(layers, num_stages=4, partition_method="uniform")
+    assert pm.num_layers_per_stage() == [2, 2, 2, 2]
+    assert list(pm.stage_layer_indices(1)) == [2, 3]
+
+
+def test_pipeline_module_parameters():
+    layers = [LayerSpec(FakeLayer, 100)] + \
+             [LayerSpec(FakeLayer, 1) for _ in range(7)]
+    pm = PipelineModule(layers, num_stages=2, partition_method="parameters")
+    assert pm.parts[1] == 1
+
+
+def test_pipeline_module_type_regex():
+    class TransformerLayer(FakeLayer):
+        pass
+
+    class EmbeddingLayer(FakeLayer):
+        pass
+
+    layers = [LayerSpec(EmbeddingLayer)] + \
+             [LayerSpec(TransformerLayer) for _ in range(4)] + \
+             [LayerSpec(EmbeddingLayer)]
+    pm = PipelineModule(layers, num_stages=2, partition_method="type:transformer")
+    counts = [sum(1 for i in pm.stage_layer_indices(s)
+                  if "Transformer" in layers[i].name) for s in range(2)]
+    assert counts == [2, 2]
+
+
+def test_bad_partition_method():
+    with pytest.raises(NotImplementedError):
+        PipelineModule([LayerSpec(FakeLayer)], num_stages=1,
+                       partition_method="bogus")

@@ -1,0 +1,72 @@
+"""Schedule tests (model: reference tests/unit/runtime/pipe/test_pipe_schedule.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.pipe.schedule import (BackwardPass, ForwardPass,
+                                                 InferenceSchedule,
+                                                 LoadMicroBatch, OptimizerStep,
+                                                 PipeSchedule, RecvActivation,
+                                                 SendActivation, TrainSchedule)
+
+
+def _flatten(sched):
+    return [cmd for step in sched for cmd in step]
+
+
+def test_pipe_schedule_bounds():
+    with pytest.raises(AssertionError):
+        TrainSchedule(micro_batches=1, stages=2, stage_id=2)
+
+
+def test_inference_schedule_firststage():
+    sched = InferenceSchedule(micro_batches=4, stages=3, stage_id=0)
+    assert sched.num_pipe_buffers() == 2
+    cmds = _flatten(sched)
+    assert sum(isinstance(c, ForwardPass) for c in cmds) == 4
+    assert sum(isinstance(c, LoadMicroBatch) for c in cmds) == 4
+    assert sum(isinstance(c, SendActivation) for c in cmds) == 4
+    assert not any(isinstance(c, RecvActivation) for c in cmds)
+
+
+def test_inference_schedule_laststage():
+    sched = InferenceSchedule(micro_batches=4, stages=3, stage_id=2)
+    cmds = _flatten(sched)
+    assert sum(isinstance(c, ForwardPass) for c in cmds) == 4
+    assert sum(isinstance(c, RecvActivation) for c in cmds) == 4
+    assert not any(isinstance(c, SendActivation) for c in cmds)
+
+
+@pytest.mark.parametrize("micro_batches,stages", [(4, 2), (8, 4), (3, 3)])
+def test_train_schedule_counts(micro_batches, stages):
+    for stage in range(stages):
+        sched = TrainSchedule(micro_batches=micro_batches, stages=stages,
+                              stage_id=stage)
+        cmds = _flatten(sched)
+        assert sum(isinstance(c, ForwardPass) for c in cmds) == micro_batches
+        assert sum(isinstance(c, BackwardPass) for c in cmds) == micro_batches
+        assert sum(isinstance(c, OptimizerStep) for c in cmds) == 1
+
+
+def test_train_schedule_ordering():
+    """Every microbatch's forward precedes its backward on each stage."""
+    sched = TrainSchedule(micro_batches=4, stages=2, stage_id=1)
+    seen_fwd = set()
+    for step in sched:
+        for cmd in step:
+            if isinstance(cmd, ForwardPass):
+                seen_fwd.add(cmd.buffer_id)
+            if isinstance(cmd, BackwardPass):
+                assert cmd.buffer_id in seen_fwd
+
+
+def test_train_schedule_buffer_counts():
+    # earlier stages need more in-flight buffers (1F1B property)
+    s0 = TrainSchedule(micro_batches=8, stages=4, stage_id=0)
+    s3 = TrainSchedule(micro_batches=8, stages=4, stage_id=3)
+    assert s0.num_pipe_buffers() == 4
+    assert s3.num_pipe_buffers() == 2
+
+
+def test_schedule_steps_total():
+    sched = TrainSchedule(micro_batches=4, stages=2, stage_id=0)
+    assert len(list(sched.steps())) == 2 * (4 + 2 - 1)
