@@ -59,10 +59,11 @@ def initialize(args=None,
 
         with open(cfg_dict) as f:
             cfg_dict = json.load(f)
-    pp_size = int((cfg_dict or {}).get("mesh", {}).get(
-        "pp", (cfg_dict or {}).get("mesh", {}).get("pipeline_parallel_size", 1)))
+    from .parallel.topology import normalize_mesh_config
+
+    mesh_norm = normalize_mesh_config((cfg_dict or {}).get("mesh"))
     engine_cls = DeepSpeedEngine
-    if pp_size > 1:
+    if int(mesh_norm.get("pp", 1)) > 1:
         from .runtime.pipe.engine import PipelineEngine
 
         engine_cls = PipelineEngine
@@ -75,7 +76,7 @@ def initialize(args=None,
                         mpu=mpu,
                         dist_init_required=dist_init_required,
                         collate_fn=collate_fn,
-                        config=config)
+                        config=cfg_dict)
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
 
@@ -99,8 +100,12 @@ def add_tuning_arguments(parser):
     return lr_schedules.add_tuning_arguments(parser)
 
 
-def init_inference(model=None, config=None, **kwargs):
-    """Inference engine entry (reference __init__.py:233)."""
+def init_inference(model=None, config=None, params=None, **kwargs):
+    """Inference engine entry (reference __init__.py:233).
+
+    ``params``: trained parameter pytree; without it the engine serves
+    freshly-initialized weights (useful only for tests/benchmarks).
+    """
     from .inference.engine import InferenceEngine
     from .inference.config import DeepSpeedInferenceConfig
 
@@ -108,4 +113,4 @@ def init_inference(model=None, config=None, **kwargs):
         config = DeepSpeedInferenceConfig(**config)
     elif config is None:
         config = DeepSpeedInferenceConfig(**kwargs)
-    return InferenceEngine(model, config)
+    return InferenceEngine(model, config, params=params)

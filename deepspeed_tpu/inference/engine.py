@@ -96,22 +96,20 @@ class InferenceEngine:
         input_ids = np.asarray(input_ids)
         b, prompt_len = input_ids.shape
         total = prompt_len + max_new_tokens
+        hooks = self.module.decode_hooks
+        max_ctx = (hooks or {}).get("max_seq_len")
+        if max_ctx is not None and total > max_ctx:
+            raise ValueError(
+                f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+                f"= {total} exceeds the model context length {max_ctx}")
         key = (b, prompt_len, max_new_tokens)
         if key not in self._generate_fns:
-            apply_fn = self.module.apply_fn
-
-            def gen(params, ids):
-                buf = jnp.zeros((b, total), jnp.int32)
-                buf = buf.at[:, :prompt_len].set(ids)
-
-                def body(i, buf):
-                    logits = apply_fn(params, {"input_ids": buf}, None)
-                    next_tok = jnp.argmax(logits[:, i - 1, :], axis=-1)
-                    return buf.at[:, i].set(next_tok.astype(jnp.int32))
-
-                return jax.lax.fori_loop(prompt_len, total, body, buf)
-
-            self._generate_fns[key] = jax.jit(gen)
+            if self.module.decode_hooks is not None:
+                self._generate_fns[key] = self._build_kv_cache_gen(
+                    b, prompt_len, total)
+            else:
+                self._generate_fns[key] = self._build_recompute_gen(
+                    b, prompt_len, total)
         out = self._generate_fns[key](self.params, jnp.asarray(input_ids))
         out = np.array(out)  # writable host copy (np.asarray view is read-only)
         if eos_token_id is not None:
@@ -120,6 +118,56 @@ class InferenceEngine:
                 if hits.size:
                     out[row, prompt_len + hits[0] + 1:] = eos_token_id
         return out
+
+    def _build_recompute_gen(self, b, prompt_len, total):
+        """Full-recompute fallback for models without decode hooks."""
+        apply_fn = self.module.apply_fn
+
+        def gen(params, ids):
+            buf = jnp.zeros((b, total), jnp.int32)
+            buf = buf.at[:, :prompt_len].set(ids)
+
+            def body(i, buf):
+                logits = apply_fn(params, {"input_ids": buf}, None)
+                next_tok = jnp.argmax(logits[:, i - 1, :], axis=-1)
+                return buf.at[:, i].set(next_tok.astype(jnp.int32))
+
+            return jax.lax.fori_loop(prompt_len, total, body, buf)
+
+        return jax.jit(gen)
+
+    def _build_kv_cache_gen(self, b, prompt_len, total):
+        """Prefill + single-token decode loop over a static KV cache
+        (reference ``softmax_context`` path; workspace sized like
+        ``inference_context.h`` by the token budget)."""
+        hooks = self.module.decode_hooks
+        init_cache, forward_cached = hooks["init_cache"], hooks["forward_cached"]
+        # round the workspace up so the Pallas kernel's block_k divides it
+        cache_len = -(-total // 128) * 128
+        cache_dtype = self._config.jnp_dtype
+
+        def gen(params, ids):
+            cache = init_cache(b, cache_len, cache_dtype)
+            buf = jnp.zeros((b, total), jnp.int32)
+            buf = buf.at[:, :prompt_len].set(ids)
+            logits, cache = forward_cached(params, ids, cache, 0)   # prefill
+            buf = buf.at[:, prompt_len].set(
+                jnp.argmax(logits, axis=-1).astype(jnp.int32))
+
+            def body(pos, carry):
+                buf, cache = carry
+                tok = jax.lax.dynamic_slice(buf, (0, pos), (b, 1))
+                logits, cache2 = forward_cached(params, tok, cache, pos)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                buf = jax.lax.dynamic_update_slice(buf, nxt[:, None],
+                                                   (0, pos + 1))
+                return buf, cache2
+
+            buf, _ = jax.lax.fori_loop(prompt_len, total - 1, body,
+                                       (buf, cache))
+            return buf
+
+        return jax.jit(gen)
 
     def profile_model_time(self, use_cuda_events: bool = True):
         pass  # jax.profiler traces replace per-module CUDA-event hooks

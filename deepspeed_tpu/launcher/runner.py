@@ -249,6 +249,8 @@ def main(args=None):
     active = parse_resource_filter(resource_pool, args.include, args.exclude)
     if args.num_nodes > 0:
         active = dict(list(active.items())[:args.num_nodes])
+    if args.num_gpus > 0:  # cap chips per node (reference runner.py:389-400)
+        active = {host: slots[:args.num_gpus] for host, slots in active.items()}
     if not args.master_addr:
         args.master_addr = list(active.keys())[0]
 

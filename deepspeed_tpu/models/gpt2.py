@@ -178,6 +178,60 @@ def forward(cfg: GPT2Config, params: PyTree, input_ids, rng=None,
     return logits
 
 
+def init_cache(cfg: GPT2Config, batch_size: int, max_len: int,
+               dtype=jnp.bfloat16):
+    """Static KV workspace (reference ``inference_context.h``): [L,B,H,S,hd]."""
+    shape = (cfg.num_layers, batch_size, cfg.num_heads, max_len, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _block_cached(cfg: GPT2Config, x, layer, ck, cv, pos):
+    """One block with KV-cache read/write.  x: [B, T, D]; ck/cv: [B, H, S, hd];
+    pos: traced global position of x[:, 0]."""
+    from ..ops.decode_attention import decode_attention
+
+    b, t, d = x.shape
+    h, hd = cfg.num_heads, cfg.head_dim
+
+    y = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+    qkv = y @ layer["qkv_w"].astype(y.dtype) + layer["qkv_b"].astype(y.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
+    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, pos, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, pos, 0))
+    attn = decode_attention(q, ck, cv, pos)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, t, d)
+    x = x + attn @ layer["o_w"].astype(x.dtype) + layer["o_b"].astype(x.dtype)
+
+    y = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+    hid = jax.nn.gelu(y @ layer["fc_w"].astype(y.dtype) +
+                      layer["fc_b"].astype(y.dtype))
+    x = x + hid @ layer["proj_w"].astype(x.dtype) + layer["proj_b"].astype(x.dtype)
+    return x, ck, cv
+
+
+def forward_cached(cfg: GPT2Config, params, input_ids, cache, pos):
+    """Incremental forward: logits for the LAST input position + updated cache."""
+    b, t = input_ids.shape
+    d = cfg.hidden_size
+    pos = jnp.asarray(pos, jnp.int32)
+    wpe = jax.lax.dynamic_slice(params["wpe"], (pos, 0), (t, d))
+    x = (params["wte"][input_ids] + wpe).astype(params["wte"].dtype)
+
+    def body(x, xs):
+        layer, ck, cv = xs
+        x, ck, cv = _block_cached(cfg, x, layer, ck, cv, pos)
+        return x, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"],
+                                         cache["v"]))
+    x = _layer_norm(x[:, -1], params["lnf_scale"], params["lnf_bias"])
+    logits = x @ params["wte"].T.astype(x.dtype)
+    return logits, {"k": ks, "v": vs}
+
+
 def loss_from_batch(cfg: GPT2Config, params, batch, rng=None, train: bool = True):
     """Next-token cross entropy. batch: {"input_ids": [B, S]} (targets = shift)
     or {"input_ids", "labels"}; label -100 entries are masked (HF convention)."""
@@ -228,8 +282,10 @@ def _head_loss(cfg: GPT2Config, params, x, targets):
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
     logits = (x @ params["wte"].T.astype(x.dtype)).astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    valid = targets >= 0  # -100 = ignore (HF convention, same as loss_from_batch)
+    safe = jnp.where(valid, targets, 0)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return jnp.where(valid, nll, 0.0).sum() / jnp.maximum(valid.sum(), 1)
 
 
 def build(cfg: Optional[GPT2Config] = None, **overrides) -> ModelSpec:
@@ -258,8 +314,19 @@ def build(cfg: Optional[GPT2Config] = None, **overrides) -> ModelSpec:
         "dropout": cfg.dropout,
     }
 
+    decode_hooks = {
+        "init_cache": lambda b, s, dtype=jnp.bfloat16: init_cache(cfg, b, s,
+                                                                  dtype),
+        "forward_cached": lambda params, ids, cache, pos: forward_cached(
+            cfg, params, ids, cache, pos),
+        # learned absolute positions: decoding past this silently clamps the
+        # wpe dynamic_slice, so the engine must reject it up front
+        "max_seq_len": cfg.max_seq_len,
+    }
+
     return ModelSpec(init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
                      tp_rules=lambda ap: tp_rules(cfg, ap),
                      flops_per_token=6.0 * cfg.num_params(),
                      pipeline_hooks=pipeline_hooks,
+                     decode_hooks=decode_hooks,
                      name=f"gpt2-{cfg.num_layers}l-{cfg.hidden_size}d")

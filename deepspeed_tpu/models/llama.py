@@ -182,6 +182,66 @@ def forward(cfg: LlamaConfig, params: PyTree, input_ids, rng=None,
     return x @ params["lm_head"].astype(x.dtype)
 
 
+def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int,
+               dtype=jnp.bfloat16):
+    """Static KV workspace: [L, B, HKV, S, hd] (GQA — KV heads only)."""
+    shape = (cfg.num_layers, batch_size, cfg.num_kv_heads, max_len,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _rope_cached(cfg: LlamaConfig, x, pos):
+    """Rotary embedding at traced offset ``pos``.  x: [B, H, T, hd]."""
+    hd = cfg.head_dim
+    inv_freq = 1.0 / (cfg.rope_theta ** (jnp.arange(0, hd, 2,
+                                                    dtype=jnp.float32) / hd))
+    p = pos + jnp.arange(x.shape[2], dtype=jnp.float32)
+    angles = p[:, None] * inv_freq[None, :]
+    return apply_rope(x, jnp.cos(angles), jnp.sin(angles))
+
+
+def _block_cached(cfg: LlamaConfig, x, layer, ck, cv, pos):
+    from ..ops.decode_attention import decode_attention
+
+    b, t, d = x.shape
+    h, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    y = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+    q = (y @ layer["q_w"].astype(y.dtype)).reshape(b, t, h, hd)
+    k = (y @ layer["k_w"].astype(y.dtype)).reshape(b, t, hkv, hd)
+    v = (y @ layer["v_w"].astype(y.dtype)).reshape(b, t, hkv, hd)
+    q = _rope_cached(cfg, q.transpose(0, 2, 1, 3), pos)
+    k = _rope_cached(cfg, k.transpose(0, 2, 1, 3), pos)
+    v = v.transpose(0, 2, 1, 3)
+    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, pos, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, pos, 0))
+    attn = decode_attention(q, ck, cv, pos)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, t, h * hd)
+    x = x + attn @ layer["o_w"].astype(x.dtype)
+
+    y = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
+    gate = jax.nn.silu(y @ layer["w1"].astype(y.dtype))
+    up = y @ layer["w3"].astype(y.dtype)
+    x = x + (gate * up) @ layer["w2"].astype(x.dtype)
+    return x, ck, cv
+
+
+def forward_cached(cfg: LlamaConfig, params, input_ids, cache, pos):
+    """Incremental forward: logits for the LAST input position + updated cache."""
+    pos = jnp.asarray(pos, jnp.int32)
+    x = params["embed"][input_ids].astype(params["embed"].dtype)
+
+    def body(x, xs):
+        layer, ck, cv = xs
+        x, ck, cv = _block_cached(cfg, x, layer, ck, cv, pos)
+        return x, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"],
+                                         cache["v"]))
+    x = rms_norm(x[:, -1], params["final_norm"], cfg.rms_eps)
+    return x @ params["lm_head"].astype(x.dtype), {"k": ks, "v": vs}
+
+
 def loss_from_batch(cfg: LlamaConfig, params, batch, rng=None,
                     train: bool = True):
     if isinstance(batch, (tuple, list)):
@@ -244,8 +304,10 @@ def build(cfg: Optional[LlamaConfig] = None, **overrides) -> ModelSpec:
         x = rms_norm(x, params["final_norm"], cfg.rms_eps)
         logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return nll.mean()
+        valid = targets >= 0  # -100 = ignore (HF convention)
+        safe = jnp.where(valid, targets, 0)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        return jnp.where(valid, nll, 0.0).sum() / jnp.maximum(valid.sum(), 1)
 
     return ModelSpec(
         init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
@@ -256,5 +318,11 @@ def build(cfg: Optional[LlamaConfig] = None, **overrides) -> ModelSpec:
             "embed_fn": pp_embed,
             "block_fn": pp_block,
             "head_loss_fn": pp_head_loss,
+        },
+        decode_hooks={
+            "init_cache": lambda b, s, dtype=jnp.bfloat16: init_cache(
+                cfg, b, s, dtype),
+            "forward_cached": lambda params, ids, cache, pos: forward_cached(
+                cfg, params, ids, cache, pos),
         },
         name=f"llama-{cfg.num_layers}l-{cfg.hidden_size}d")

@@ -18,7 +18,6 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-uniform_map = {}
 
 
 def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,

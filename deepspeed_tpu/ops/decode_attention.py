@@ -1,0 +1,169 @@
+"""Decode attention over a static KV cache — TPU replacement for the
+reference's fused ``softmax_context`` inference kernels
+(``csrc/transformer/inference/csrc/pt_binding.cpp`` attention variants, KV
+workspace ``csrc/transformer/inference/includes/inference_context.h``).
+
+The cache is a statically-shaped HBM buffer ``[B, HKV, S_max, D]`` sized by
+``max_out_tokens`` exactly like the reference's ``InferenceContext`` workspace;
+the valid prefix length is a traced scalar, so one compiled program serves every
+decode step (the reference gets the same effect from CUDA-graph replay; here it
+falls out of ``jit`` + static shapes).
+
+Two paths, one API:
+ - ``decode_attention_reference``: q of one or more new positions against the
+   cache, with position-aware causal masking (query at global position p sees
+   keys ``<= p``) and grouped-query (GQA) head sharing.  Pure XLA — used for
+   prefill and as the CPU/correctness path.
+ - ``decode_attention_pallas``: single-token kernel that streams the cache in
+   ``block_k`` chunks with an online softmax (f32 accumulation, no [S] score
+   materialisation).  Chunks past the valid prefix are skipped with ``pl.when``
+   so FLOPs scale with the *valid* length, not the workspace size.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+LANES = 128
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def decode_attention_reference(q, k_cache, v_cache, q_pos, *,
+                               sm_scale: Optional[float] = None):
+    """Masked attention of new queries against the KV cache (pure XLA).
+
+    q:        [B, H, T, D]  — T new query positions (T=1 for decode,
+                              T=prompt_len for prefill)
+    k_cache:  [B, HKV, S, D], v_cache: [B, HKV, S, D] — the *already updated*
+              cache (new keys written at q_pos .. q_pos+T-1)
+    q_pos:    scalar int32 — global position of q[:, :, 0]
+    """
+    b, h, t, d = q.shape
+    hkv, s = k_cache.shape[1], k_cache.shape[2]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    if h != hkv:
+        rep = h // hkv
+        k_cache = jnp.repeat(k_cache, rep, axis=1)
+        v_cache = jnp.repeat(v_cache, rep, axis=1)
+    scores = jnp.einsum("bhtd,bhsd->bhts", q, k_cache).astype(jnp.float32)
+    scores = scores * scale
+    key_idx = jnp.arange(s)[None, :]
+    query_idx = q_pos + jnp.arange(t)[:, None]
+    mask = key_idx <= query_idx                       # [T, S]
+    scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bhsd->bhtd", probs, v_cache)
+
+
+# ---------------------------------------------------------------------------
+# Pallas single-token decode kernel
+# ---------------------------------------------------------------------------
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                   *, sm_scale: float, block_k: int):
+    """Grid: (B, HKV, S // block_k), KV innermost so scratch carries across.
+
+    q_ref: [1, 1, rep, D] — the ``rep`` query heads sharing this KV head.
+    k_ref/v_ref: [1, 1, block_k, D] chunk of the cache.
+    """
+    kb = pl.program_id(2)
+    nk = pl.num_programs(2)
+    pos = pos_ref[0]
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    start = kb * block_k
+
+    @pl.when(start <= pos)  # skip chunks entirely past the valid prefix
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)           # [rep, D]
+        k = k_ref[0, 0].astype(jnp.float32)           # [bk, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale                              # [rep, bk]
+        idx = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(idx <= pos, s, NEG_INF)
+
+        m_prev = m_scr[...][:, :1]                    # [rep, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                        # [rep, bk]
+        l_new = l_scr[...][:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)           # [bk, D]
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(kb == nk - 1)
+    def _finish():
+        l = l_scr[...][:, :1]
+        o_ref[0, 0] = (acc_scr[...] /
+                       jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def decode_attention_pallas(q, k_cache, v_cache, q_pos, *,
+                            sm_scale: Optional[float] = None,
+                            block_k: int = 256,
+                            interpret: Optional[bool] = None):
+    """Single-token decode: q [B, H, 1, D] vs cache [B, HKV, S, D]."""
+    b, h, t, d = q.shape
+    assert t == 1, "pallas decode kernel is single-token; use the XLA path"
+    hkv, s = k_cache.shape[1], k_cache.shape[2]
+    rep = h // hkv
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    block_k = min(block_k, s)
+    while s % block_k:  # largest divisor of s not above the requested block
+        block_k -= 1
+    if interpret is None:
+        interpret = _use_interpret()
+
+    qg = q[:, :, 0, :].reshape(b, hkv, rep, d)        # [B, HKV, rep, D]
+    pos = jnp.asarray(q_pos, jnp.int32).reshape(1)
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, sm_scale=scale, block_k=block_k),
+        grid=(b, hkv, s // block_k),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, rep, d), lambda i, j, k: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda i, j, k: (i, j, k, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda i, j, k: (i, j, k, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, rep, d), lambda i, j, k: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rep, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((rep, LANES), jnp.float32),    # m
+            pltpu.VMEM((rep, LANES), jnp.float32),    # l
+            pltpu.VMEM((rep, d), jnp.float32),        # acc
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(pos, qg, k_cache, v_cache)
+    return out.reshape(b, h, 1, d)
+
+
+def decode_attention(q, k_cache, v_cache, q_pos, *,
+                     sm_scale: Optional[float] = None):
+    """Dispatch: Pallas kernel for single-token decode on TPU, XLA otherwise."""
+    if q.shape[2] == 1 and jax.default_backend() == "tpu":
+        return decode_attention_pallas(q, k_cache, v_cache, q_pos,
+                                       sm_scale=sm_scale)
+    return decode_attention_reference(q, k_cache, v_cache, q_pos,
+                                      sm_scale=sm_scale)

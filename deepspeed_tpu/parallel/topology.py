@@ -251,17 +251,22 @@ class MeshTopology:
         return f"MeshTopology({dims})"
 
 
-def topology_from_config(mesh_cfg: Optional[dict], devices=None) -> MeshTopology:
-    """Build a MeshTopology from the ``"mesh"`` block of the JSON config."""
-    mesh_cfg = dict(mesh_cfg or {})
+def normalize_mesh_config(mesh_cfg: Optional[dict]) -> dict:
+    """Canonicalize the ``"mesh"`` config block's axis aliases (single source
+    of truth — also used by ``deepspeed_tpu.initialize`` for engine selection)."""
     aliases = {"pipeline_parallel_size": "pp", "data_parallel_size": "dp",
                "expert_parallel_size": "ep", "sequence_parallel_size": "sp",
                "tensor_parallel_size": "tp", "model_parallel_size": "tp"}
     norm = {}
-    for k, v in mesh_cfg.items():
+    for k, v in dict(mesh_cfg or {}).items():
         norm[aliases.get(k, k)] = v
     allowed = set(MESH_AXES) | {"allow_split_physical_axes"}
     unknown = set(norm) - allowed
     if unknown:
         raise ValueError(f"unknown mesh axes {sorted(unknown)}; allowed: {sorted(allowed)}")
-    return MeshTopology(devices=devices, **norm)
+    return norm
+
+
+def topology_from_config(mesh_cfg: Optional[dict], devices=None) -> MeshTopology:
+    """Build a MeshTopology from the ``"mesh"`` block of the JSON config."""
+    return MeshTopology(devices=devices, **normalize_mesh_config(mesh_cfg))

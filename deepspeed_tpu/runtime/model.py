@@ -38,6 +38,13 @@ class ModelSpec:
     #:   block_fn(layer_params, x)   -> x  (one transformer block)
     #:   head_loss_fn(params, x, targets) -> scalar mean loss
     pipeline_hooks: Optional[dict] = None
+    #: Optional KV-cache decode path (see inference/engine.py generate):
+    #:   init_cache(batch_size, max_len, dtype) -> cache pytree
+    #:   forward_cached(params, input_ids, cache, pos) ->
+    #:       (last-position logits [B, V], updated cache)
+    #: ``pos`` is the (traced) global position of input_ids[:, 0]; the same
+    #: function serves prefill (T=prompt) and decode (T=1).
+    decode_hooks: Optional[dict] = None
 
     def init(self, rng) -> PyTree:
         return self.init_fn(rng)

@@ -155,10 +155,17 @@ class PipelineEngine(DeepSpeedEngine):
         stage_apply = jax.checkpoint(stage_apply)
 
         def pp_loss(params, batch, scale):
-            """batch: [M, mb, S+1] token ids; returns scaled mean loss."""
+            """batch: [M, mb, S+1] token ids, or {"input_ids": [M, mb, S],
+            "labels": [M, mb, S]} (labels may carry -100 ignore entries, masked
+            by the model's head_loss_fn); returns scaled mean loss."""
             p = _cast_floating(params, compute_dtype) if cast else params
-            inputs = batch[:, :, :-1]
-            targets = batch[:, :, 1:]
+            if isinstance(batch, dict) and batch.get("labels") is not None:
+                inputs = batch["input_ids"]
+                targets = batch["labels"]
+            else:
+                ids = batch["input_ids"] if isinstance(batch, dict) else batch
+                inputs = ids[:, :, :-1]
+                targets = ids[:, :, 1:]
             blocks = split_blocks(p)
             mb, s = inputs.shape[1], inputs.shape[2]
             T = M + pp - 1
@@ -220,8 +227,11 @@ class PipelineEngine(DeepSpeedEngine):
             first = jax.tree_util.tree_leaves(batch)[0]
             if first.ndim == 2:  # [B, S] -> [M, mb, S]
                 batch = self._reshape_global_batch(batch)
-        ids = batch["input_ids"] if isinstance(batch, dict) else batch
-        ids = self._shard_batch(ids, leading_gas_dim=True)
+        if isinstance(batch, dict) and batch.get("labels") is not None:
+            batch = {"input_ids": batch["input_ids"], "labels": batch["labels"]}
+        else:
+            batch = batch["input_ids"] if isinstance(batch, dict) else batch
+        ids = self._shard_batch(batch, leading_gas_dim=True)
 
         self.tput_timer.start()
         self.state, metrics = self._train_step_fn(self.state, ids,

@@ -87,3 +87,25 @@ def test_pp_blocks_sharded(eight_devices):
                                                config=config(pp=2))
     qkv = engine.state["params"]["blocks"]["qkv_w"]  # [2, d, 3d]
     assert qkv.addressable_shards[0].data.shape[0] == 1  # layer dim split 2-way
+
+
+def test_pp_labels_with_ignore_index_matches_dp(eight_devices):
+    """pp>1 must honor explicit labels incl. -100 masking, like the DP path."""
+    def run_labeled(cfg):
+        deepspeed_tpu.comm.reset_topology()
+        engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(),
+                                                   config=cfg)
+        rng = np.random.default_rng(7)
+        losses = []
+        for _ in range(2):
+            ids = rng.integers(0, 512,
+                               size=(engine.train_batch_size(), 32)).astype(np.int32)
+            labels = ids.copy()
+            labels[:, :5] = -100  # mask a prefix (HF ignore convention)
+            _, m = engine.train_batch({"input_ids": ids, "labels": labels})
+            losses.append(m["loss"])
+        return losses
+
+    base = run_labeled(config(pp=1))
+    pp = run_labeled(config(pp=2))
+    np.testing.assert_allclose(base, pp, rtol=2e-4, atol=1e-4)
