@@ -46,6 +46,8 @@ class GPT2Config:
     #: None = auto (Pallas flash attention on TPU, einsum elsewhere);
     #: flash path requires attention-dropout == 0
     use_flash: Optional[bool] = None
+    #: sequence-parallel attention impl when mesh sp>1: auto|ulysses|ring
+    sp_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -125,10 +127,21 @@ def _block(cfg: GPT2Config, x, layer, mask, rng, dropout: float):
     q = q.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
     k = k.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
     v = v.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    from ..parallel import sequence as seq_parallel
+
     use_flash = cfg.use_flash
     if use_flash is None:
         use_flash = jax.default_backend() == "tpu"
-    if use_flash and dropout == 0.0:
+    if seq_parallel.sp_size() > 1 and dropout > 0.0:
+        from ..utils.logging import logger
+
+        logger.warning("mesh sp>1 with attention dropout>0: sequence-parallel "
+                       "attention requires dropout=0; falling back to the "
+                       "dense path (quadratic in S)")
+    if seq_parallel.sp_size() > 1 and dropout == 0.0:
+        attn = seq_parallel.sequence_parallel_attention(
+            q, k, v, causal=True, impl=getattr(cfg, "sp_impl", "auto"))
+    elif use_flash and dropout == 0.0:
         from ..ops.flash_attention import flash_attention
 
         attn = flash_attention(q, k, v, causal=True)

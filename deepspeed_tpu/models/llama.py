@@ -39,6 +39,8 @@ class LlamaConfig:
     rms_eps: float = 1e-5
     remat: bool = True
     use_flash: Optional[bool] = None
+    #: sequence-parallel attention impl when mesh sp>1: auto|ulysses|ring
+    sp_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -123,6 +125,11 @@ def apply_rope(x, cos, sin):
 
 
 def _attention(cfg: LlamaConfig, q, k, v):
+    from ..parallel import sequence as seq_parallel
+
+    if seq_parallel.sp_size() > 1:
+        return seq_parallel.sequence_parallel_attention(
+            q, k, v, causal=True, impl=cfg.sp_impl)
     use_flash = cfg.use_flash
     if use_flash is None:
         use_flash = jax.default_backend() == "tpu"

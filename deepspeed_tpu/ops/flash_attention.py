@@ -211,19 +211,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         dv_ref[0, ...] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd(sm_scale, causal, block_q, block_k, interpret, true_kv_len,
-         residuals, g):
-    q, k, v, o, lse = residuals
-    do = g
+def _bwd_dq_call(q, k, v, do, lse_b, delta_b, *, sm_scale, causal, block_q,
+                 block_k, kv_len, interpret):
+    """dq for one (q-chunk, kv-chunk) pair given *global* lse/delta.
+
+    Exposed separately so ring attention (parallel/sequence.py) can reuse the
+    kernel per ring step with the globally-merged log-sum-exp.
+    """
     bh, q_len, d = q.shape
-    kv_len = true_kv_len
     nq = pl.cdiv(q_len, block_q)
     nk = pl.cdiv(kv_len, block_k)
-
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    lse_b = jnp.broadcast_to(lse[..., None], lse.shape + (LANES,))
-    delta_b = jnp.broadcast_to(delta[..., None], delta.shape + (LANES,))
-
     dq_kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
                                   causal=causal, block_q=block_q,
                                   block_k=block_k, kv_len=kv_len,
@@ -246,7 +243,15 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, true_kv_len,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse_b, delta_b)
+    return dq
 
+
+def _bwd_dkv_call(q, k, v, do, lse_b, delta_b, *, sm_scale, causal, block_q,
+                  block_k, kv_len, interpret):
+    """dk, dv for one (q-chunk, kv-chunk) pair given *global* lse/delta."""
+    bh, q_len, d = q.shape
+    nq = pl.cdiv(q_len, block_q)
+    nk = pl.cdiv(kv_len, block_k)
     dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
                                    causal=causal, block_q=block_q,
                                    block_k=block_k, kv_len=kv_len,
@@ -278,6 +283,23 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, true_kv_len,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse_b, delta_b)
+    return dk, dv
+
+
+def _bwd(sm_scale, causal, block_q, block_k, interpret, true_kv_len,
+         residuals, g):
+    q, k, v, o, lse = residuals
+    do = g
+    kv_len = true_kv_len
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    lse_b = jnp.broadcast_to(lse[..., None], lse.shape + (LANES,))
+    delta_b = jnp.broadcast_to(delta[..., None], delta.shape + (LANES,))
+
+    kw = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
+              block_k=block_k, kv_len=kv_len, interpret=interpret)
+    dq = _bwd_dq_call(q, k, v, do, lse_b, delta_b, **kw)
+    dk, dv = _bwd_dkv_call(q, k, v, do, lse_b, delta_b, **kw)
     return dq, dk, dv
 
 

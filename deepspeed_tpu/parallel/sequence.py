@@ -1,0 +1,367 @@
+"""Sequence/context parallelism: Ulysses all-to-all + ring attention.
+
+The reference at v0.8.2 predates DeepSpeed-Ulysses — its long-sequence story is
+block-sparse attention + curriculum seqlen + a reserved "slice parallel" axis on
+the topology (`pipe/topology.py:443`, SURVEY §5.7).  The TPU build makes SP a
+first-class mesh axis (``sp``) with two interchangeable attention strategies:
+
+ - **Ulysses** (`ulysses_attention`): all-to-all over the ``sp`` axis scatters
+   heads / gathers sequence around the attention op, so each device runs plain
+   flash attention on the *full* sequence for ``H/sp`` of the heads.  Two
+   all-to-alls per attention, rides ICI.  Requires local head count divisible
+   by sp.
+
+ - **Ring attention** (`ring_attention`): Q stays put; KV chunks rotate around
+   the ``sp`` ring via ``ppermute``.  Each step runs the flash-attention
+   forward kernel on a (local Q, visiting KV) pair and merges the partial
+   output into a running online-softmax state.  The backward pass is a second
+   ring: per-step dq/dk/dv from the flash backward kernels evaluated with the
+   *globally merged* log-sum-exp, with dk/dv accumulators rotating alongside
+   the KV chunks back to their owners.  Memory per device stays O(S/sp).
+
+Both run inside ``shard_map`` over the engine's global mesh, composing with
+``dp`` (batch) and ``tp`` (heads) sharding.  ``sequence_parallel_attention``
+picks Ulysses when head counts divide (cheaper: 2 all-to-alls vs sp ppermute
+rounds), else ring.
+
+Causal ring steps where the visiting KV chunk is strictly in the future are
+masked out at merge time (the kernel work is still issued — the classic ring
+load-imbalance; zigzag block reordering is a future optimization).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops import flash_attention as fa
+from .topology import DATA_AXES, SP_AXIS, TP_AXIS
+
+NEG_INF = -jnp.inf
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _repeat_kv(q, k, v):
+    h, hkv = q.shape[1], k.shape[1]
+    if hkv != h:
+        assert h % hkv == 0, f"GQA needs num_heads {h} % kv_heads {hkv} == 0"
+        rep = h // hkv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    return k, v
+
+
+# ---------------------------------------------------------------------------
+# ring attention core (runs per-shard inside shard_map)
+# ---------------------------------------------------------------------------
+def _flat(x):
+    b, h, c, d = x.shape
+    return x.reshape(b * h, c, d)
+
+
+def _rep_flat(kv, rep):
+    """[B, Hkv, C, D] -> repeated+flattened [B*Hkv*rep, C, D] matching q's
+    head order — GQA KV chunks rotate un-repeated so ring traffic stays
+    O(Hkv), and only the per-step kernel input is expanded."""
+    if rep == 1:
+        return _flat(kv)
+    b, hkv, c, d = kv.shape
+    out = jnp.broadcast_to(kv[:, :, None], (b, hkv, rep, c, d))
+    return out.reshape(b * hkv * rep, c, d)
+
+
+def _ring_fwd_impl(q, k, v, axis_name, sp, sm_scale, causal, block_q, block_k,
+                   interpret):
+    """q: [B, H, C, D]; k, v: [B, Hkv, C, D] local chunks (device i holds
+    sequence chunk i).  Returns (o [B, H, C, D], lse [B*H, C]).
+    """
+    b, h, c, d = q.shape
+    rep = h // k.shape[1]
+    bh = b * h
+    qf = _flat(q)
+    idx = jax.lax.axis_index(axis_name)
+
+    m = jnp.full((bh, c, 1), NEG_INF, jnp.float32)   # running max
+    s = jnp.zeros((bh, c, 1), jnp.float32)           # running sum-exp
+    acc = jnp.zeros((bh, c, d), jnp.float32)         # running weighted output
+    k_cur, v_cur = k, v
+    perm = [(r, (r + 1) % sp) for r in range(sp)]
+
+    for step in range(sp):
+        # after `step` rotations device idx holds KV chunk (idx - step) mod sp
+        o_j, lse_j = fa._fwd(qf, _rep_flat(k_cur, rep), _rep_flat(v_cur, rep),
+                             sm_scale, causal and step == 0, block_q, block_k,
+                             interpret, c)
+        lse_j = lse_j[..., None]                     # [bh, C, 1]
+        m_new = jnp.maximum(m, lse_j)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(lse_j - m_new)
+        s_new = s * alpha + beta
+        acc_new = acc * alpha + beta * o_j.astype(jnp.float32)
+        if causal and step > 0:
+            # visiting chunk j = idx - step (mod sp) is in the past iff
+            # idx >= step; future chunks contribute nothing
+            attend = idx >= step
+            m = jnp.where(attend, m_new, m)
+            s = jnp.where(attend, s_new, s)
+            acc = jnp.where(attend, acc_new, acc)
+        else:
+            m, s, acc = m_new, s_new, acc_new
+        if step < sp - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+
+    s_safe = jnp.where(s == 0.0, 1.0, s)
+    o = (acc / s_safe).astype(q.dtype).reshape(b, h, c, d)
+    lse = (m + jnp.log(s_safe))[..., 0]
+    return o, lse
+
+
+def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, sp, sm_scale, causal,
+                   block_q, block_k, interpret):
+    b, h, c, d = q.shape
+    hkv = k.shape[1]
+    rep = h // hkv
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(r, (r + 1) % sp) for r in range(sp)]
+
+    qf, of, dof = _flat(q), _flat(o), _flat(do)
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+    lse_b = jnp.broadcast_to(lse[..., None], lse.shape + (fa.LANES,))
+    delta_b = jnp.broadcast_to(delta[..., None], delta.shape + (fa.LANES,))
+
+    def fold_kv(g):
+        """Sum repeated-head grads back onto the Hkv KV heads."""
+        if rep == 1:
+            return g.reshape(b, hkv, c, d)
+        return g.reshape(b, hkv, rep, c, d).sum(axis=2)
+
+    dq = jnp.zeros((b * h, c, d), jnp.float32)
+    dk_cur = jnp.zeros((b, hkv, c, d), jnp.float32)
+    dv_cur = jnp.zeros((b, hkv, c, d), jnp.float32)
+    k_cur, v_cur = k, v
+
+    for step in range(sp):
+        kw = dict(sm_scale=sm_scale, causal=causal and step == 0,
+                  block_q=block_q, block_k=block_k, kv_len=c,
+                  interpret=interpret)
+        kf, vf = _rep_flat(k_cur, rep), _rep_flat(v_cur, rep)
+        dq_j = fa._bwd_dq_call(qf, kf, vf, dof, lse_b, delta_b, **kw)
+        dk_j, dv_j = fa._bwd_dkv_call(qf, kf, vf, dof, lse_b, delta_b, **kw)
+        dk_j = fold_kv(dk_j.astype(jnp.float32))
+        dv_j = fold_kv(dv_j.astype(jnp.float32))
+        if causal and step > 0:
+            # select, don't multiply: future-chunk kernels evaluate
+            # exp(s - lse) with an lse that doesn't bound s, so dq_j can be
+            # inf — 0*inf would poison the accumulator with NaN
+            attend = idx >= step
+            dq = jnp.where(attend, dq + dq_j.astype(jnp.float32), dq)
+            dk_cur = jnp.where(attend, dk_cur + dk_j, dk_cur)
+            dv_cur = jnp.where(attend, dv_cur + dv_j, dv_cur)
+        else:
+            dq = dq + dq_j.astype(jnp.float32)
+            dk_cur = dk_cur + dk_j
+            dv_cur = dv_cur + dv_j
+        # rotate the visiting KV chunk and its grad accumulators together;
+        # after sp rotations the accumulators are home at the chunk's owner
+        dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
+        if step < sp - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+
+    return (dq.astype(q.dtype).reshape(b, h, c, d), dk_cur.astype(k.dtype),
+            dv_cur.astype(v.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _ring_attn(q, k, v, axis_name, sp, sm_scale, causal, block_q, block_k,
+               interpret):
+    o, _ = _ring_fwd_impl(q, k, v, axis_name, sp, sm_scale, causal, block_q,
+                          block_k, interpret)
+    return o
+
+
+def _ring_attn_fwd(q, k, v, axis_name, sp, sm_scale, causal, block_q, block_k,
+                   interpret):
+    o, lse = _ring_fwd_impl(q, k, v, axis_name, sp, sm_scale, causal, block_q,
+                            block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_attn_bwd(axis_name, sp, sm_scale, causal, block_q, block_k,
+                   interpret, res, do):
+    q, k, v, o, lse = res
+    return _ring_bwd_impl(q, k, v, o, lse, do, axis_name, sp, sm_scale, causal,
+                          block_q, block_k, interpret)
+
+
+_ring_attn.defvjp(_ring_attn_fwd, _ring_attn_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public ops: global [B, H, S, D] -> [B, H, S, D] over the mesh
+# ---------------------------------------------------------------------------
+def _resolve_mesh(mesh):
+    if mesh is not None:
+        return mesh
+    from .. import comm
+
+    return comm.get_mesh()
+
+
+def sp_size() -> int:
+    """Size of the active sequence-parallel axis (trace-time python int)."""
+    from .. import comm
+
+    return comm.get_topology().sequence_parallel_size
+
+
+def _axis_size(mesh, axes) -> int:
+    if axes is None:
+        return 1
+    if isinstance(axes, str):
+        axes = (axes,)
+    size = 1
+    for a in axes:
+        size *= mesh.shape.get(a, 1)
+    return size
+
+
+def _qkvo_spec(mesh, q_shape, batch_axes, head_axis, sp_axis):
+    """Shard batch over dp/ep and heads over tp only when sizes divide —
+    otherwise keep those dims replicated (the seq dim must always divide sp)."""
+    b_axes = batch_axes if q_shape[0] % _axis_size(mesh, batch_axes) == 0 \
+        else None
+    h_axes = head_axis if q_shape[1] % _axis_size(mesh, head_axis) == 0 \
+        else None
+    return P(b_axes, h_axes, sp_axis, None)
+
+
+def ring_attention(q, k, v, causal: bool = True,
+                   sm_scale: Optional[float] = None, mesh=None,
+                   sp_axis: str = SP_AXIS, batch_axes=DATA_AXES,
+                   head_axis: str = TP_AXIS, block_q: int = 128,
+                   block_k: int = 128, interpret: Optional[bool] = None):
+    """Ring attention over the ``sp`` mesh axis.  q: [B, H, S, D] global.
+
+    S is chunked over sp; KV chunks rotate via ppermute.  k, v may have fewer
+    (GQA) heads — they are repeated to H first.
+    """
+    mesh = _resolve_mesh(mesh)
+    sp = mesh.shape[sp_axis]
+    h, hkv = q.shape[1], k.shape[1]
+    assert h % hkv == 0, f"GQA needs num_heads {h} % kv_heads {hkv} == 0"
+    if interpret is None:
+        interpret = _interpret_default()
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if sp == 1:
+        return fa.flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                                  block_q=block_q, block_k=block_k,
+                                  interpret=interpret)
+    s_len = q.shape[2]
+    assert s_len % sp == 0, f"seq len {s_len} must divide sp={sp}"
+    c = s_len // sp
+    bq = min(block_q, c)
+    bk = min(block_k, c)
+    assert c % bq == 0 and c % bk == 0, (
+        f"per-device chunk {c} must be a multiple of block sizes ({bq},{bk})")
+
+    def local(q, k, v):
+        return _ring_attn(q, k, v, sp_axis, sp, sm_scale, causal, bq, bk,
+                          interpret)
+
+    q_spec = _qkvo_spec(mesh, q.shape, batch_axes, head_axis, sp_axis)
+    kv_spec = _qkvo_spec(mesh, k.shape, batch_axes, head_axis, sp_axis)
+    if q_spec[1] != kv_spec[1]:
+        # GQA with kv heads not divisible by tp: per-shard q heads would fall
+        # below the kv head count — keep both head dims replicated instead
+        q_spec = P(q_spec[0], None, sp_axis, None)
+        kv_spec = P(kv_spec[0], None, sp_axis, None)
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec),
+                       out_specs=q_spec, check_vma=False)
+    return fn(q, k, v)
+
+
+def ulysses_attention(q, k, v, causal: bool = True,
+                      sm_scale: Optional[float] = None, mesh=None,
+                      sp_axis: str = SP_AXIS, batch_axes=DATA_AXES,
+                      head_axis: str = TP_AXIS, block_q: int = 128,
+                      block_k: int = 128, interpret: Optional[bool] = None):
+    """DeepSpeed-Ulysses-style attention: all-to-all scatters heads / gathers
+    sequence so each device runs full-sequence flash attention on H/sp heads.
+    """
+    mesh = _resolve_mesh(mesh)
+    sp = mesh.shape[sp_axis]
+    tp = mesh.shape[head_axis] if head_axis in mesh.shape else 1
+    k, v = _repeat_kv(q, k, v)
+    if interpret is None:
+        interpret = _interpret_default()
+    if sp == 1:
+        return fa.flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                                  block_q=block_q, block_k=block_k,
+                                  interpret=interpret)
+    h = q.shape[1]
+    assert h % tp == 0 and (h // tp) % sp == 0, (
+        f"ulysses needs heads/tp divisible by sp: H={h}, tp={tp}, sp={sp}")
+
+    def local(q, k, v):
+        # [b, h_loc, C, D] -> all-to-all -> [b, h_loc/sp, S, D]
+        q = jax.lax.all_to_all(q, sp_axis, split_axis=1, concat_axis=2,
+                               tiled=True)
+        k = jax.lax.all_to_all(k, sp_axis, split_axis=1, concat_axis=2,
+                               tiled=True)
+        v = jax.lax.all_to_all(v, sp_axis, split_axis=1, concat_axis=2,
+                               tiled=True)
+        o = fa.flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                               block_q=block_q, block_k=block_k,
+                               interpret=interpret)
+        return jax.lax.all_to_all(o, sp_axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    spec = _qkvo_spec(mesh, q.shape, batch_axes, head_axis, sp_axis)
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+def sequence_parallel_attention(q, k, v, causal: bool = True,
+                                sm_scale: Optional[float] = None,
+                                impl: str = "auto", mesh=None,
+                                sp_axis: str = SP_AXIS, batch_axes=DATA_AXES,
+                                head_axis: str = TP_AXIS,
+                                interpret: Optional[bool] = None, **kw):
+    """Dispatch to ulysses/ring based on config and divisibility.
+
+    ``impl``: "auto" | "ulysses" | "ring".  Auto prefers Ulysses (2 all-to-alls
+    beat sp ppermute rounds) when heads/tp divide by sp, else ring (which has
+    no head-count constraint and O(S/sp) memory for arbitrarily long S).
+    """
+    mesh = _resolve_mesh(mesh)
+    sp = mesh.shape[sp_axis]
+    if sp == 1 or q.shape[2] % sp != 0:
+        # no sp axis, or sequence doesn't chunk evenly: plain (replicated-seq)
+        # flash attention — XLA SPMD handles any input sharding correctly
+        k, v = _repeat_kv(q, k, v)
+        return fa.flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                                  interpret=interpret, **kw)
+    tp = mesh.shape[head_axis] if head_axis in mesh.shape else 1
+    h = q.shape[1]
+    ulysses_ok = h % tp == 0 and (h // tp) % sp == 0
+    if impl == "ulysses" or (impl == "auto" and ulysses_ok):
+        return ulysses_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                                 mesh=mesh, sp_axis=sp_axis,
+                                 batch_axes=batch_axes, head_axis=head_axis,
+                                 interpret=interpret, **kw)
+    return ring_attention(q, k, v, causal=causal, sm_scale=sm_scale, mesh=mesh,
+                          sp_axis=sp_axis, batch_axes=batch_axes,
+                          head_axis=head_axis, interpret=interpret, **kw)

@@ -40,7 +40,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import comm as dist
 from ..ops.optimizers import get_optimizer
-from ..parallel.topology import DATA_AXES, MeshTopology, topology_from_config
+from ..parallel.topology import (DATA_AXES, SP_AXIS, MeshTopology,
+                                 topology_from_config)
 from ..utils.logging import log_dist, logger
 from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER,
                            STEP_GLOBAL_TIMER, TRAIN_BATCH_TIMER,
@@ -431,20 +432,35 @@ class DeepSpeedEngine:
             donate_argnums=(0,))
 
     # ---------------------------------------------------------------- batching
-    def _batch_sharding(self, leading_gas_dim: bool):
-        spec = P(None, DATA_AXES) if leading_gas_dim else P(DATA_AXES)
-        return NamedSharding(self.mesh, spec)
+    def _batch_sharding(self, leading_gas_dim: bool, x=None):
+        """Batch dim over (dp, ep); if sp>1, the sequence dim over sp too
+        (when it divides — SP attention reshards internally otherwise)."""
+        dims = [None, DATA_AXES] if leading_gas_dim else [DATA_AXES]
+        if x is not None:
+            seq_dim = len(dims)
+            sp = self.topology.sequence_parallel_size
+            x_shape = getattr(x, "shape", ())
+            # multi-process: the dataloader shards only the batch dim per
+            # process, so seq-dim process-sharding would mis-assemble the
+            # global array; SP attention reshards in-graph instead
+            if (sp > 1 and jax.process_count() == 1
+                    and len(x_shape) > seq_dim
+                    and x_shape[seq_dim] % sp == 0):
+                dims.append(SP_AXIS)
+        return NamedSharding(self.mesh, P(*dims))
 
     def _shard_batch(self, batch, leading_gas_dim: bool = False):
-        sharding = self._batch_sharding(leading_gas_dim)
         if jax.process_count() > 1:
             # each controller holds only its slice of the global batch (see
             # DeepSpeedDataLoader process_shard); assemble the global array
             return jax.tree_util.tree_map(
                 lambda x: jax.make_array_from_process_local_data(
-                    sharding, np.asarray(x)), batch)
+                    self._batch_sharding(leading_gas_dim, x), np.asarray(x)),
+                batch)
         return jax.tree_util.tree_map(
-            lambda x: jax.device_put(jnp.asarray(x), sharding), batch)
+            lambda x: jax.device_put(
+                jnp.asarray(x), self._batch_sharding(leading_gas_dim, x)),
+            batch)
 
     def _stack_micros(self, micros) -> PyTree:
         return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *micros)

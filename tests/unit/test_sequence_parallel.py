@@ -1,0 +1,156 @@
+"""Sequence parallelism: ring + Ulysses attention vs plain attention.
+
+The reference has no SP at v0.8.2 (SURVEY §5.7) — this is the capability
+upgrade the TPU build adds; numerics are checked against the einsum reference
+on the 8-device CPU-sim mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu import comm
+from deepspeed_tpu.ops.flash_attention import mha_reference
+from deepspeed_tpu.parallel import sequence as seq
+from deepspeed_tpu.parallel.topology import MeshTopology
+
+
+def make_qkv(key, b=2, h=4, s=32, d=8, hkv=None):
+    hkv = hkv or h
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, h, s, d), jnp.float32)
+    k = jax.random.normal(kk, (b, hkv, s, d), jnp.float32)
+    v = jax.random.normal(kv, (b, hkv, s, d), jnp.float32)
+    return q, k, v
+
+
+def mesh_for(sp, tp=1):
+    topo = MeshTopology(sp=sp, tp=tp)
+    comm.set_topology(topo)
+    return topo.mesh
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("sp", [2, 4])
+def test_ring_attention_matches_reference(causal, sp):
+    mesh = mesh_for(sp)
+    q, k, v = make_qkv(jax.random.PRNGKey(0))
+    out = seq.ring_attention(q, k, v, causal=causal, mesh=mesh)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_gqa():
+    mesh = mesh_for(4)
+    q, k, v = make_qkv(jax.random.PRNGKey(1), h=4, hkv=2)
+    out = seq.ring_attention(q, k, v, causal=True, mesh=mesh)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+def test_ring_attention_grads(sp):
+    mesh = mesh_for(sp)
+    q, k, v = make_qkv(jax.random.PRNGKey(2), b=1, h=2, s=16, d=8)
+
+    def ring_loss(q, k, v):
+        o = seq.ring_attention(q, k, v, causal=True, mesh=mesh)
+        return jnp.sum(o * o)
+
+    def ref_loss(q, k, v):
+        o = mha_reference(q, k, v, causal=True)
+        return jnp.sum(o * o)
+
+    g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_reference(causal):
+    mesh = mesh_for(4)
+    q, k, v = make_qkv(jax.random.PRNGKey(3))
+    out = seq.ulysses_attention(q, k, v, causal=causal, mesh=mesh)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_grads():
+    mesh = mesh_for(2)
+    q, k, v = make_qkv(jax.random.PRNGKey(4), b=1, h=2, s=16, d=8)
+
+    def uly_loss(q, k, v):
+        o = seq.ulysses_attention(q, k, v, causal=True, mesh=mesh)
+        return jnp.sum(o * o)
+
+    def ref_loss(q, k, v):
+        o = mha_reference(q, k, v, causal=True)
+        return jnp.sum(o * o)
+
+    g = jax.grad(uly_loss, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-5)
+
+
+def test_dispatcher_picks_ulysses_then_ring():
+    mesh = mesh_for(4)
+    # h=4, tp=1 -> 4 % 4 == 0 -> ulysses ok; h=2 -> ring fallback
+    q, k, v = make_qkv(jax.random.PRNGKey(5), h=2, s=32)
+    out = seq.sequence_parallel_attention(q, k, v, causal=True, mesh=mesh)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_engine_sp_loss_matches_dp(impl):
+    """Tiny llama trained with mesh sp=2 matches the pure-DP loss curve."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama
+
+    def run(mesh_cfg):
+        deepspeed_tpu.comm.reset_topology()
+        cfg = llama.LlamaConfig.tiny()
+        cfg.sp_impl = impl
+        cfg.use_flash = False  # sp path overrides; dense path for baseline
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=llama.build(cfg),
+            config={
+                "train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "mesh": mesh_cfg,
+            })
+        rng = np.random.default_rng(0)
+        losses = []
+        for _ in range(3):
+            # seq 65 -> model sees 64 after the label shift: divisible by
+            # sp=2 so the SP attention path really runs (33 would fall back)
+            batch = {"input_ids": rng.integers(
+                0, 512, size=(engine.train_batch_size(), 65)).astype(np.int32)}
+            _, m = engine.train_batch(batch)
+            losses.append(m["loss"])
+        return losses
+
+    # same dp world (= same global batch/data) with the spare axis as tp vs sp
+    base = run({"dp": 4, "tp": 2})
+    sp = run({"dp": 4, "sp": 2})
+    np.testing.assert_allclose(base, sp, rtol=2e-4, atol=1e-5)
+
+
+def test_sp_with_tp_combined():
+    mesh = mesh_for(sp=2, tp=2)  # dp=2 absorbs the rest
+    q, k, v = make_qkv(jax.random.PRNGKey(6), b=2, h=4, s=32, d=8)
+    for impl in ("ring", "ulysses"):
+        out = seq.sequence_parallel_attention(q, k, v, causal=True, impl=impl,
+                                              mesh=mesh)
+        ref = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5, err_msg=impl)
