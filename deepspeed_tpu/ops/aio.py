@@ -1,0 +1,94 @@
+"""Async file I/O for the NVMe offload tier.
+
+Reference: ``csrc/aio/py_lib/deepspeed_py_aio_handle.cpp`` (libaio-backed
+``aio_handle`` with async_pread/async_pwrite/wait used by
+``runtime/swap_tensor/*``).  Here the backend is the worker-thread C++ library
+from ``op_builder.AsyncIOBuilder``; a synchronous numpy fallback keeps the API
+working without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from .op_builder import AsyncIOBuilder
+
+
+class AsyncIOHandle:
+    """Submit async reads/writes of numpy buffers against files; wait() joins.
+
+    Mirrors the reference aio_handle: ops are queued to worker threads at
+    submit time; ``wait()`` blocks until all submitted ops complete and
+    returns the number of failures since the last wait.
+    """
+
+    def __init__(self, num_threads: int = 8):
+        self._lib = AsyncIOBuilder.bind()
+        self._handle = None
+        self._inflight = []   # keep buffer refs alive until wait()
+        self._sync_failures = 0
+        if self._lib is not None:
+            self._handle = self._lib.ds_aio_handle_new(num_threads)
+
+    @property
+    def has_native(self) -> bool:
+        return self._handle is not None
+
+    def async_pread(self, buf: np.ndarray, path: str, offset: int = 0) -> None:
+        assert buf.flags.c_contiguous
+        if self._handle is not None:
+            self._inflight.append(buf)
+            self._lib.ds_aio_pread(self._handle, path.encode(),
+                                   buf.ctypes.data_as(ctypes.c_void_p),
+                                   buf.nbytes, offset)
+            return
+        try:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                data = f.read(buf.nbytes)
+            flat = buf.reshape(-1).view(np.uint8)
+            flat[:len(data)] = np.frombuffer(data, np.uint8)
+        except OSError:
+            self._sync_failures += 1
+
+    def async_pwrite(self, buf: np.ndarray, path: str, offset: int = 0) -> None:
+        assert buf.flags.c_contiguous
+        if self._handle is not None:
+            self._inflight.append(buf)
+            self._lib.ds_aio_pwrite(self._handle, path.encode(),
+                                    buf.ctypes.data_as(ctypes.c_void_p),
+                                    buf.nbytes, offset)
+            return
+        try:
+            mode = "r+b" if os.path.exists(path) else "wb"
+            with open(path, mode) as f:
+                f.seek(offset)
+                f.write(buf.tobytes())
+        except OSError:
+            self._sync_failures += 1
+
+    def wait(self) -> int:
+        """Block until all submitted ops finish; returns failure count."""
+        if self._handle is not None:
+            n = int(self._lib.ds_aio_wait(self._handle))
+            self._inflight.clear()
+            return n
+        n = self._sync_failures
+        self._sync_failures = 0
+        return n
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self.wait()
+            self._lib.ds_aio_handle_free(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,122 @@
+"""DeepSpeedCPUAdam — host-memory Adam for offloaded optimizer partitions.
+
+Reference: ``deepspeed/ops/adam/cpu_adam.py`` wrapping
+``csrc/adam/cpu_adam.cpp`` (AVX-vectorized host Adam used by ZeRO-Offload /
+ZeRO-Infinity to step optimizer state living in host DRAM).  Here the state is
+flat numpy fp32 buffers and the kernel is the OpenMP/SIMD C++ library built by
+``op_builder.CPUAdamBuilder`` (ctypes), with a numpy fallback when no
+toolchain is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from .op_builder import CPUAdamBuilder
+
+_F32P = ctypes.POINTER(ctypes.c_float)
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(_F32P)
+
+
+class DeepSpeedCPUAdam:
+    """Adam/AdamW over flat fp32 host buffers.
+
+    ``params``/``m``/``v`` are caller-owned contiguous fp32 numpy arrays (the
+    offloaded partition); ``step(grads)`` updates them in place.
+    """
+
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, bias_correction: bool = True,
+                 adamw_mode: bool = True):
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.bias_correction = bias_correction
+        self.adamw_mode = adamw_mode
+        self.step_count = 0
+        self._lib = CPUAdamBuilder.bind()
+
+    @property
+    def has_native(self) -> bool:
+        return self._lib is not None
+
+    def step(self, params: np.ndarray, grads: np.ndarray, m: np.ndarray,
+             v: np.ndarray, lr: Optional[float] = None) -> None:
+        assert params.dtype == np.float32 and params.flags.c_contiguous
+        self.step_count += 1
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        if self._lib is not None:
+            grads = np.ascontiguousarray(grads, np.float32)
+            self._lib.ds_adam_step(
+                _ptr(params), _ptr(grads), _ptr(m), _ptr(v), params.size,
+                lr, b1, b2, self.eps, self.weight_decay, self.step_count,
+                int(self.bias_correction), int(self.adamw_mode))
+            return
+        # numpy fallback (same math as csrc/cpu_adam.cpp)
+        g = grads.astype(np.float32, copy=False)
+        if not self.adamw_mode and self.weight_decay > 0:
+            g = g + self.weight_decay * params
+        np.multiply(m, b1, out=m)
+        m += (1 - b1) * g
+        np.multiply(v, b2, out=v)
+        v += (1 - b2) * g * g
+        bc1 = 1 - b1 ** self.step_count if self.bias_correction else 1.0
+        bc2 = 1 - b2 ** self.step_count if self.bias_correction else 1.0
+        denom = np.sqrt(v) / np.sqrt(bc2) + self.eps
+        if self.adamw_mode and self.weight_decay > 0:
+            params *= 1 - lr * self.weight_decay
+        params -= (lr / bc1) * (m / denom)
+
+
+class DeepSpeedCPUAdagrad:
+    """Adagrad counterpart (reference csrc/adagrad/cpu_adagrad.cpp)."""
+
+    def __init__(self, lr: float = 1e-2, eps: float = 1e-10,
+                 weight_decay: float = 0.0):
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self._lib = CPUAdamBuilder.bind()
+
+    def step(self, params: np.ndarray, grads: np.ndarray, state: np.ndarray,
+             lr: Optional[float] = None) -> None:
+        lr = self.lr if lr is None else lr
+        if self._lib is not None:
+            grads = np.ascontiguousarray(grads, np.float32)
+            self._lib.ds_adagrad_step(_ptr(params), _ptr(grads), _ptr(state),
+                                      params.size, lr, self.eps,
+                                      self.weight_decay)
+            return
+        g = grads.astype(np.float32, copy=False) + self.weight_decay * params
+        state += g * g
+        params -= lr * g / (np.sqrt(state) + self.eps)
+
+
+def sq_norm(x: np.ndarray) -> float:
+    lib = CPUAdamBuilder.bind()
+    if lib is not None and x.dtype == np.float32 and x.flags.c_contiguous:
+        return float(lib.ds_sq_norm(_ptr(x), x.size))
+    return float(np.vdot(x.astype(np.float64), x.astype(np.float64)))
+
+
+def scale_(x: np.ndarray, scale: float) -> None:
+    lib = CPUAdamBuilder.bind()
+    if lib is not None and x.dtype == np.float32 and x.flags.c_contiguous:
+        lib.ds_scale(_ptr(x), x.size, scale)
+    else:
+        x *= scale
+
+
+def all_finite(x: np.ndarray) -> bool:
+    lib = CPUAdamBuilder.bind()
+    if lib is not None and x.dtype == np.float32 and x.flags.c_contiguous:
+        return bool(lib.ds_all_finite(_ptr(x), x.size))
+    return bool(np.isfinite(x).all())

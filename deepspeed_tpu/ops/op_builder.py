@@ -1,0 +1,167 @@
+"""Native op build system — the TPU analog of the reference's ``op_builder/``.
+
+The reference JIT-compiles CUDA/C++ extensions through torch cpp_extension
+(``op_builder/builder.py:112 OpBuilder``, ``:487 jit_load``) and probes
+compatibility before building (``:236 is_compatible``).  Here the native
+surface is host-side C++ only (TPU device code is Pallas), so the builder is
+lean: g++ compiles a shared library once into ``_build/`` keyed by a source
+hash, and Python binds it via ctypes (no pybind11 in the image).  Every
+builder has a pure-python/numpy fallback path so missing toolchains degrade
+gracefully rather than fail (same contract as the reference's
+``is_compatible`` warnings).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from pathlib import Path
+from typing import List, Optional
+
+from ..utils.logging import logger
+
+CSRC = Path(__file__).resolve().parent / "csrc"
+BUILD_DIR = Path(__file__).resolve().parent / "_build"
+
+
+class OpBuilder:
+    """Compile ``sources`` into a cached .so and load it with ctypes."""
+
+    NAME: str = "op"
+    SOURCES: List[str] = []
+    EXTRA_FLAGS: List[str] = []
+
+    _lib_cache: dict = {}
+
+    @classmethod
+    def _source_paths(cls) -> List[Path]:
+        return [CSRC / s for s in cls.SOURCES]
+
+    @classmethod
+    def _hash(cls) -> str:
+        h = hashlib.sha256()
+        for p in cls._source_paths():
+            h.update(p.read_bytes())
+        h.update(" ".join(cls._flags()).encode())
+        return h.hexdigest()[:16]
+
+    @classmethod
+    def _flags(cls) -> List[str]:
+        return ["-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+                "-fopenmp"] + cls.EXTRA_FLAGS
+
+    @classmethod
+    def is_compatible(cls) -> bool:
+        try:
+            subprocess.run(["g++", "--version"], capture_output=True,
+                           check=True)
+            return all(p.exists() for p in cls._source_paths())
+        except (OSError, subprocess.CalledProcessError):
+            return False
+
+    @classmethod
+    def load(cls) -> Optional[ctypes.CDLL]:
+        """Build (if needed) and load the native library; None on failure."""
+        if cls.NAME in cls._lib_cache:
+            return cls._lib_cache[cls.NAME]
+        lib = cls._build_and_load()
+        cls._lib_cache[cls.NAME] = lib
+        return lib
+
+    @classmethod
+    def _build_and_load(cls) -> Optional[ctypes.CDLL]:
+        if not cls.is_compatible():
+            logger.warning(f"op {cls.NAME}: toolchain/sources unavailable, "
+                           "using python fallback")
+            return None
+        BUILD_DIR.mkdir(exist_ok=True)
+        so_path = BUILD_DIR / f"{cls.NAME}_{cls._hash()}.so"
+        if not so_path.exists():
+            cmd = (["g++"] + cls._flags() +
+                   [str(p) for p in cls._source_paths()] +
+                   ["-o", str(so_path)])
+            try:
+                subprocess.run(cmd, capture_output=True, check=True, text=True)
+                logger.info(f"op {cls.NAME}: built {so_path.name}")
+            except subprocess.CalledProcessError as e:
+                # -march=native can fail on exotic hosts; retry portable
+                try:
+                    cmd = [c for c in cmd if c != "-march=native"]
+                    subprocess.run(cmd, capture_output=True, check=True,
+                                   text=True)
+                except subprocess.CalledProcessError:
+                    logger.warning(
+                        f"op {cls.NAME}: build failed ({e.stderr[-500:] if e.stderr else e}); "
+                        "using python fallback")
+                    return None
+        try:
+            return ctypes.CDLL(str(so_path), mode=ctypes.RTLD_GLOBAL)
+        except OSError as e:
+            logger.warning(f"op {cls.NAME}: load failed ({e}); python fallback")
+            return None
+
+
+class CPUAdamBuilder(OpBuilder):
+    """Vectorized host Adam/Adagrad (reference csrc/adam/cpu_adam.cpp)."""
+
+    NAME = "cpu_adam"
+    SOURCES = ["cpu_adam.cpp"]
+
+    @classmethod
+    def bind(cls):
+        lib = cls.load()
+        if lib is None:
+            return None
+        i64, f32p, f64 = ctypes.c_int64, ctypes.POINTER(ctypes.c_float), \
+            ctypes.c_double
+        lib.ds_adam_step.argtypes = [f32p, f32p, f32p, f32p, i64,
+                                     ctypes.c_float, ctypes.c_float,
+                                     ctypes.c_float, ctypes.c_float,
+                                     ctypes.c_float, i64, ctypes.c_int,
+                                     ctypes.c_int]
+        lib.ds_adagrad_step.argtypes = [f32p, f32p, f32p, i64, ctypes.c_float,
+                                        ctypes.c_float, ctypes.c_float]
+        lib.ds_sq_norm.argtypes = [f32p, i64]
+        lib.ds_sq_norm.restype = f64
+        lib.ds_scale.argtypes = [f32p, i64, ctypes.c_float]
+        lib.ds_all_finite.argtypes = [f32p, i64]
+        lib.ds_all_finite.restype = ctypes.c_int
+        return lib
+
+
+class AsyncIOBuilder(OpBuilder):
+    """Threaded async file I/O (reference csrc/aio/)."""
+
+    NAME = "aio"
+    SOURCES = ["aio.cpp"]
+    EXTRA_FLAGS = ["-pthread"]
+
+    @classmethod
+    def bind(cls):
+        lib = cls.load()
+        if lib is None:
+            return None
+        i64, vp = ctypes.c_int64, ctypes.c_void_p
+        lib.ds_aio_handle_new.argtypes = [ctypes.c_int]
+        lib.ds_aio_handle_new.restype = vp
+        lib.ds_aio_handle_free.argtypes = [vp]
+        lib.ds_aio_pread.argtypes = [vp, ctypes.c_char_p, vp, i64, i64]
+        lib.ds_aio_pwrite.argtypes = [vp, ctypes.c_char_p, vp, i64, i64]
+        lib.ds_aio_wait.argtypes = [vp]
+        lib.ds_aio_wait.restype = i64
+        return lib
+
+
+ALL_OPS = {"cpu_adam": CPUAdamBuilder, "aio": AsyncIOBuilder}
+
+
+def op_report() -> dict:
+    """Compat/availability report (feeds the ds_report CLI analog)."""
+    report = {}
+    for name, builder in ALL_OPS.items():
+        compatible = builder.is_compatible()
+        loaded = builder.load() is not None if compatible else False
+        report[name] = {"compatible": compatible, "loaded": loaded}
+    return report

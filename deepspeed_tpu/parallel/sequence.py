@@ -271,10 +271,14 @@ def ring_attention(q, k, v, causal: bool = True,
     s_len = q.shape[2]
     assert s_len % sp == 0, f"seq len {s_len} must divide sp={sp}"
     c = s_len // sp
-    bq = min(block_q, c)
-    bk = min(block_k, c)
-    assert c % bq == 0 and c % bk == 0, (
-        f"per-device chunk {c} must be a multiple of block sizes ({bq},{bk})")
+    # largest block that tiles the chunk exactly (the kernel doesn't pad);
+    # degenerate gcds (prime chunks) fall back to one whole-chunk block
+    bq = math.gcd(c, block_q)
+    bk = math.gcd(c, block_k)
+    if bq < 8:
+        bq = c
+    if bk < 8:
+        bk = c
 
     def local(q, k, v):
         return _ring_attn(q, k, v, sp_axis, sp, sm_scale, causal, bq, bk,
@@ -303,34 +307,42 @@ def ulysses_attention(q, k, v, causal: bool = True,
     mesh = _resolve_mesh(mesh)
     sp = mesh.shape[sp_axis]
     tp = mesh.shape[head_axis] if head_axis in mesh.shape else 1
-    k, v = _repeat_kv(q, k, v)
     if interpret is None:
         interpret = _interpret_default()
     if sp == 1:
+        k, v = _repeat_kv(q, k, v)
         return fa.flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
                                   block_q=block_q, block_k=block_k,
                                   interpret=interpret)
-    h = q.shape[1]
+    h, hkv = q.shape[1], k.shape[1]
     assert h % tp == 0 and (h // tp) % sp == 0, (
         f"ulysses needs heads/tp divisible by sp: H={h}, tp={tp}, sp={sp}")
+    # GQA: keep KV un-repeated through the all-to-alls when its per-shard head
+    # count divides sp — chunk j of the q heads maps exactly onto chunk j of
+    # the kv heads, and flash repeats internally after the exchange.  Only
+    # fall back to an up-front repeat when the counts don't divide.
+    q_heads_sharded = hkv % tp == 0  # shard q heads only if kv can match
+    hkv_loc = hkv // tp if q_heads_sharded else hkv
+    if hkv_loc % sp != 0:
+        k, v = _repeat_kv(q, k, v)
+        hkv = h
+    head = head_axis if q_heads_sharded else None
+    q_spec = P(batch_axes if q.shape[0] % _axis_size(mesh, batch_axes) == 0
+               else None, head, sp_axis, None)
+    kv_spec = P(q_spec[0], head, sp_axis, None)
 
     def local(q, k, v):
         # [b, h_loc, C, D] -> all-to-all -> [b, h_loc/sp, S, D]
-        q = jax.lax.all_to_all(q, sp_axis, split_axis=1, concat_axis=2,
-                               tiled=True)
-        k = jax.lax.all_to_all(k, sp_axis, split_axis=1, concat_axis=2,
-                               tiled=True)
-        v = jax.lax.all_to_all(v, sp_axis, split_axis=1, concat_axis=2,
-                               tiled=True)
-        o = fa.flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
-                               block_q=block_q, block_k=block_k,
-                               interpret=interpret)
+        a2a = functools.partial(jax.lax.all_to_all, axis_name=sp_axis,
+                                split_axis=1, concat_axis=2, tiled=True)
+        o = fa.flash_attention(a2a(q), a2a(k), a2a(v), causal=causal,
+                               sm_scale=sm_scale, block_q=block_q,
+                               block_k=block_k, interpret=interpret)
         return jax.lax.all_to_all(o, sp_axis, split_axis=2, concat_axis=1,
                                   tiled=True)
 
-    spec = _qkvo_spec(mesh, q.shape, batch_axes, head_axis, sp_axis)
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec),
+                       out_specs=q_spec, check_vma=False)
     return fn(q, k, v)
 
 

@@ -113,6 +113,11 @@ class CheckpointManager:
         self.checkpoint_engine.makedirs(ckpt_dir)
 
         self.checkpoint_engine.save(engine.state, os.path.join(ckpt_dir, "state"))
+        if getattr(engine, "_offload_opt", None) is not None and \
+                jax.process_index() == 0:
+            # host-side optimizer partition (ZeRO-Offload/Infinity tier)
+            np.savez(os.path.join(ckpt_dir, "offload_optimizer.npz"),
+                     **engine._offload_opt.state_dict())
         meta = {
             "tag": str(tag),
             "global_steps": engine.global_steps,
@@ -170,6 +175,22 @@ class CheckpointManager:
         else:
             engine.state = self.checkpoint_engine.load(
                 os.path.join(ckpt_dir, "state"), abstract_target=abstract)
+
+        if getattr(engine, "_offload_opt", None) is not None:
+            # re-sync the host master partition with the restored params,
+            # then overlay saved moments/master when present
+            leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(
+                jax.device_get(engine.state["params"]))]
+            for leaf, off, size in zip(leaves,
+                                       engine._offload_opt.offsets[:-1],
+                                       engine._offload_opt.sizes):
+                engine._offload_opt.master[off:off + size] = \
+                    leaf.reshape(-1).astype(np.float32)
+            off_path = os.path.join(ckpt_dir, "offload_optimizer.npz")
+            if load_optimizer_states and not load_module_only and \
+                    os.path.isfile(off_path):
+                with np.load(off_path) as z:
+                    engine._offload_opt.load_state_dict(dict(z))
 
         engine.global_steps = int(meta.get("global_steps", 0))
         engine.global_samples = int(meta.get("global_samples", 0))

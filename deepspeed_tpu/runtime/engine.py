@@ -134,6 +134,18 @@ class DeepSpeedEngine:
         self.zero_stage = self._config.zero_optimization_stage
         self.zero_plan = ZeroShardingPlan(self.zero_stage, self.mesh)
 
+        # ZeRO-Offload / ZeRO-Infinity: optimizer state lives on host
+        # (DRAM or NVMe) and steps through the C++ CPU optimizer
+        off = self._config.zero_config.offload_optimizer
+        self.offload_enabled = bool(off is not None and
+                                    off.device.value != "none")
+        self._offload_opt = None
+        if self.offload_enabled and optimizer is not None:
+            raise ValueError(
+                "offload_optimizer requires a config-specified optimizer "
+                "(adam/adamw/adagrad) — client optax transformations cannot "
+                "run on host (reference: offload needs DeepSpeedCPUAdam)")
+
         # schedules and optimizer
         self._configure_lr_schedule()
         self._configure_optimizer()
@@ -257,7 +269,8 @@ class DeepSpeedEngine:
         def init_state(rng):
             params = self.model_spec.init(rng)
             params = _cast_floating(params, jnp.float32)  # fp32 master weights
-            opt_state = self.tx.init(params)
+            # offload: optimizer state is host-side (HostOffloadOptimizer)
+            opt_state = () if self.offload_enabled else self.tx.init(params)
             return {
                 "step": jnp.zeros((), jnp.int32),
                 "params": params,
@@ -286,6 +299,29 @@ class DeepSpeedEngine:
         n_params = sum(x.size for x in jax.tree_util.tree_leaves(self.state["params"]))
         log_dist(f"initialized {n_params/1e6:.2f}M parameters", ranks=[0])
 
+        if self.offload_enabled:
+            from .zero.offload import HostOffloadOptimizer
+
+            assert jax.process_count() == 1, (
+                "optimizer offload is single-controller for now (per-host "
+                "partitioned offload is future work)")
+            off = self._config.zero_config.offload_optimizer
+            leaves = [np.asarray(x) for x in
+                      jax.tree_util.tree_leaves(jax.device_get(
+                          self.state["params"]))]
+            self._offload_opt = HostOffloadOptimizer(
+                leaves,
+                self._config.optimizer_name or "adam",
+                self._config.optimizer_params or {},
+                device=off.device.value,
+                nvme_path=off.nvme_path,
+                sub_group_size=self._config.zero_config.sub_group_size)
+            log_dist(
+                f"optimizer offload -> {off.device.value} "
+                f"({self._offload_opt.total/1e6:.2f}M elements, "
+                f"native={self._offload_opt.opt.__class__.__name__})",
+                ranks=[0])
+
     # --------------------------------------------------------------- step fns
     def _micro_loss_closure(self):
         loss_fn = self.model_spec.loss_fn
@@ -299,13 +335,40 @@ class DeepSpeedEngine:
 
         return micro_loss
 
+    def _scaler_bookkeeping(self):
+        """Shared fp16 scaler-advance + metrics builders (one source of truth
+        for the in-jit update path and the host-offload path)."""
+        fp16 = self.fp16_enabled
+        dynamic = self.dynamic_loss_scale
+        scaler_args = self._config.dynamic_loss_scale_args
+
+        def next_scaler(scaler, overflow):
+            if not fp16:
+                return scaler
+            return update_scale(
+                scaler, overflow,
+                scale_window=scaler_args["scale_window"],
+                min_scale=scaler_args["min_scale"],
+                delayed_shift=scaler_args["delayed_shift"],
+                dynamic=dynamic)
+
+        def make_metrics(mean_loss, grad_norm, overflow, new_scaler):
+            return {
+                "loss": mean_loss,
+                "grad_norm": grad_norm,
+                "overflow": overflow,
+                "loss_scale": new_scaler.cur_scale,
+                "skipped": new_scaler.skipped,
+            }
+
+        return next_scaler, make_metrics
+
     def _make_apply_update(self):
         """Build the shared optimizer-apply closure (overflow skip, scaler
         update, metrics) — used by both the DP and pipeline step functions."""
         fp16 = self.fp16_enabled
-        dynamic = self.dynamic_loss_scale
-        scaler_args = self._config.dynamic_loss_scale_args
         tx = self.tx
+        next_scaler, make_metrics = self._scaler_bookkeeping()
 
         def apply_update(state, grads, mean_loss):
             """grads: fp32, already averaged over the global batch & unscaled."""
@@ -325,28 +388,16 @@ class DeepSpeedEngine:
             if fp16:
                 new_params, new_opt = jax.lax.cond(overflow, skip_update,
                                                    do_update, None)
-                new_scaler = update_scale(
-                    scaler, overflow,
-                    scale_window=scaler_args["scale_window"],
-                    min_scale=scaler_args["min_scale"],
-                    delayed_shift=scaler_args["delayed_shift"],
-                    dynamic=dynamic)
             else:
                 new_params, new_opt = do_update(None)
-                new_scaler = scaler
+            new_scaler = next_scaler(scaler, overflow)
             new_state = {
                 "step": state["step"] + 1,
                 "params": new_params,
                 "opt_state": new_opt,
                 "scaler": new_scaler,
             }
-            metrics = {
-                "loss": mean_loss,
-                "grad_norm": grad_norm,
-                "overflow": overflow,
-                "loss_scale": new_scaler.cur_scale,
-                "skipped": new_scaler.skipped,
-            }
+            metrics = make_metrics(mean_loss, grad_norm, overflow, new_scaler)
             return new_state, metrics
 
         return apply_update
@@ -369,8 +420,8 @@ class DeepSpeedEngine:
             del scaled_loss
             return loss, grads
 
-        def train_step(state, batch, base_rng):
-            """batch: pytree with leading dims [gas, micro_global, ...]."""
+        def accumulate(state, batch, base_rng):
+            """Scan the GAS microbatches; returns (unscaled fp32 grads, loss)."""
             params, scaler = state["params"], state["scaler"]
             scale = scaler.cur_scale if fp16 else jnp.asarray(1.0, jnp.float32)
             step_rng = jax.random.fold_in(base_rng, state["step"])
@@ -396,7 +447,34 @@ class DeepSpeedEngine:
             grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
             grads = constrain(grads, grad_shardings)
             mean_loss = loss_sum / gas
+            return grads, mean_loss
+
+        def train_step(state, batch, base_rng):
+            """batch: pytree with leading dims [gas, micro_global, ...]."""
+            grads, mean_loss = accumulate(state, batch, base_rng)
             return apply_update(state, grads, mean_loss)
+
+        clip = self._config.gradient_clipping
+        next_scaler, make_metrics = self._scaler_bookkeeping()
+
+        def offload_finish(state, grads, mean_loss):
+            """Clip + overflow + scaler bookkeeping for grads headed to the
+            host optimizer (grads already unscaled/averaged)."""
+            grad_norm = optax.global_norm(grads)
+            if clip:
+                factor = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
+                grads = jax.tree_util.tree_map(lambda g: g * factor, grads)
+            overflow = has_overflow(grads) if fp16 else jnp.asarray(False)
+            new_scaler = next_scaler(state["scaler"], overflow)
+            metrics = make_metrics(mean_loss, grad_norm, overflow, new_scaler)
+            partial = {"step": state["step"] + 1, "scaler": new_scaler}
+            return grads, partial, metrics
+
+        def offload_grads_step(state, batch, base_rng):
+            """Device half of the offload step: grads + clip + scaler
+            bookkeeping in-graph; the optimizer apply happens on host."""
+            grads, mean_loss = accumulate(state, batch, base_rng)
+            return offload_finish(state, grads, mean_loss)
 
         def micro_grads(params, scaler, batch, base_rng, idx):
             """One microbatch fwd+bwd for the forward/backward shim path."""
@@ -419,6 +497,16 @@ class DeepSpeedEngine:
             train_step,
             out_shardings=(self.state_shardings, metrics_shardings),
             donate_argnums=(0,))
+        if self.offload_enabled:
+            scaler_rep = jax.tree_util.tree_map(
+                lambda _: rep, self.state_shardings["scaler"])
+            offload_out = (self.grad_shardings,
+                           {"step": rep, "scaler": scaler_rep},
+                           metrics_shardings)
+            self._offload_grads_fn = jax.jit(offload_grads_step,
+                                             out_shardings=offload_out)
+            self._offload_finish_fn = jax.jit(offload_finish,
+                                              out_shardings=offload_out)
         self._micro_grads_fn = jax.jit(
             micro_grads, out_shardings=(rep, self.grad_shardings),
             static_argnums=())
@@ -499,14 +587,47 @@ class DeepSpeedEngine:
         batch = self._shard_batch(batch, leading_gas_dim=True)
 
         self.tput_timer.start()
-        self.state, metrics = self._train_step_fn(self.state, batch,
-                                                  self._dropout_rng)
+        if self.offload_enabled:
+            self.state, metrics = self._train_step_offload(self.state, batch)
+        else:
+            self.state, metrics = self._train_step_fn(self.state, batch,
+                                                      self._dropout_rng)
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps()
         self.global_samples += self.train_batch_size()
         self.tput_timer.stop(global_step=True, sync_arrays=metrics["loss"])
         self._finalize_metrics(metrics)
         return self.state, self._cached_metrics
+
+    def _train_step_offload(self, state, batch):
+        """Offload step: device grads -> host C++ optimizer -> device params.
+
+        The transfer/step/transfer is the TPU analog of the reference's
+        PCIe grad-offload + CPU-Adam + param copy-back cycle
+        (``stage_1_and_2.py:1096``, ``csrc/adam/cpu_adam.cpp``).
+        """
+        grads, partial, metrics = self._offload_grads_fn(state, batch,
+                                                         self._dropout_rng)
+        return self._host_apply(state, grads, partial, metrics)
+
+    def _host_apply(self, state, grads, partial, metrics):
+        new_params = state["params"]
+        if not (self.fp16_enabled and bool(jax.device_get(metrics["overflow"]))):
+            grad_leaves = [np.asarray(g) for g in
+                           jax.tree_util.tree_leaves(jax.device_get(grads))]
+            new_leaves = self._offload_opt.step(grad_leaves,
+                                                lr=self.get_lr()[0])
+            treedef = jax.tree_util.tree_structure(state["params"])
+            new_params = jax.device_put(
+                jax.tree_util.tree_unflatten(treedef, new_leaves),
+                self.state_shardings["params"])
+        new_state = {
+            "step": partial["step"],
+            "params": new_params,
+            "opt_state": state["opt_state"],
+            "scaler": partial["scaler"],
+        }
+        return new_state, metrics
 
     def _ensure_data_iterator(self):
         if self._data_iterator is None:
@@ -588,8 +709,14 @@ class DeepSpeedEngine:
         mean_loss = (jnp.stack([jnp.asarray(l, jnp.float32)
                                 for l in self._accum_losses]).mean()
                      if self._accum_losses else jnp.asarray(0.0, jnp.float32))
-        self.state, metrics = self._apply_update_fn(self.state, self._accum_grads,
-                                                    mean_loss)
+        if self.offload_enabled:
+            grads, partial, metrics = self._offload_finish_fn(
+                self.state, self._accum_grads, mean_loss)
+            self.state, metrics = self._host_apply(self.state, grads, partial,
+                                                   metrics)
+        else:
+            self.state, metrics = self._apply_update_fn(
+                self.state, self._accum_grads, mean_loss)
         self._accum_grads = None
         self._accum_losses = []
         self.global_steps += 1

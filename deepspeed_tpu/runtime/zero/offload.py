@@ -1,0 +1,241 @@
+"""ZeRO-Offload / ZeRO-Infinity optimizer-state offload tiers.
+
+Reference mechanics being mirrored (SURVEY §2.1):
+ - ZeRO-Offload: optimizer state (fp32 master + Adam moments) lives in host
+   DRAM and the optimizer step runs on host CPU via the vectorized C++ Adam
+   (``runtime/zero/stage_1_and_2.py:1096`` grad offload path +
+   ``csrc/adam/cpu_adam.cpp``).
+ - ZeRO-Infinity: moments live on NVMe and are swapped through host staging
+   buffers in sub-groups (``runtime/swap_tensor/partitioned_optimizer_swapper.py``,
+   ``runtime/zero/stage3.py:1747`` sub-group stepping), with async I/O
+   (``csrc/aio``) double-buffered against compute.
+
+TPU realisation: the jitted step computes loss/grads (+ clip + loss-scale
+bookkeeping) on device; grads stream to host once per step; the C++
+OpenMP/SIMD Adam (``ops/cpu_adam.py``) updates the flat fp32 master partition;
+updated params stream back and are re-sharded by XLA.  With ``device: nvme``
+the moment buffers are files under ``nvme_path`` processed in ``sub_group_size``
+chunks: the read of chunk i+1 and the write-back of chunk i-1 overlap the
+Adam compute of chunk i through the ``ops/aio.py`` worker pool.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import uuid
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from ...ops.aio import AsyncIOHandle
+from ...ops.cpu_adam import DeepSpeedCPUAdagrad, DeepSpeedCPUAdam, sq_norm
+from ...utils.logging import logger
+
+PyTree = Any
+
+
+def _make_cpu_optimizer(name: str, params: dict):
+    name = (name or "adam").lower()
+    params = dict(params or {})
+    params.pop("torch_adam", None)
+    params.pop("fused", None)
+    lr = params.pop("lr", 1e-3)
+    if name in ("adam", "adamw", "fusedadam"):
+        adamw = True if name == "adamw" else bool(params.pop("adam_w_mode", True))
+        return DeepSpeedCPUAdam(
+            lr=lr, betas=tuple(params.pop("betas", (0.9, 0.999))),
+            eps=params.pop("eps", 1e-8),
+            weight_decay=params.pop("weight_decay", 0.0),
+            bias_correction=params.pop("bias_correction", True),
+            adamw_mode=adamw), 2
+    if name == "adagrad":
+        return DeepSpeedCPUAdagrad(
+            lr=lr, eps=params.pop("eps", 1e-10),
+            weight_decay=params.pop("weight_decay", 0.0)), 1
+    raise ValueError(
+        f"optimizer {name!r} has no CPU-offload implementation "
+        "(reference supports cpu adam/adagrad for offload)")
+
+
+class HostOffloadOptimizer:
+    """Flat host-side optimizer partition with optional NVMe moment tier."""
+
+    def __init__(self, init_leaves: Sequence[np.ndarray], optimizer_name: str,
+                 optimizer_params: dict, device: str = "cpu",
+                 nvme_path: Optional[str] = None,
+                 sub_group_size: int = int(1e9), aio_threads: int = 8):
+        self.shapes = [l.shape for l in init_leaves]
+        self.sizes = [int(np.prod(s)) if s else 1 for s in self.shapes]
+        self.offsets = np.concatenate([[0], np.cumsum(self.sizes)]).astype(np.int64)
+        self.total = int(self.offsets[-1])
+        self.master = np.empty(self.total, np.float32)
+        for leaf, off, size in zip(init_leaves, self.offsets[:-1], self.sizes):
+            self.master[off:off + size] = np.asarray(
+                leaf, np.float32).reshape(-1)
+
+        self.opt, self._n_moments = _make_cpu_optimizer(optimizer_name,
+                                                        optimizer_params)
+        self.device = device
+        self.sub_group_size = max(int(sub_group_size), 1)
+        self._nvme_dir = None
+        self._aio: Optional[AsyncIOHandle] = None
+
+        if device == "nvme":
+            base = nvme_path or tempfile.gettempdir()
+            self._nvme_dir = os.path.join(base,
+                                          f"ds_tpu_swap_{uuid.uuid4().hex[:8]}")
+            os.makedirs(self._nvme_dir, exist_ok=True)
+            self._aio = AsyncIOHandle(aio_threads)
+            # one handle per staging buffer so wait() is per-buffer: the
+            # write-back of buffer A only joins when A is about to be reused,
+            # overlapping it with the compute on buffer B
+            self._stage_aio = [AsyncIOHandle(max(aio_threads // 2, 1))
+                               for _ in range(2)]
+            zeros = np.zeros(min(self.sub_group_size, self.total), np.float32)
+            for name in self._moment_names():
+                path = self._moment_path(name)
+                # pre-size the swap file with zero moments
+                with open(path, "wb") as f:
+                    remaining = self.total
+                    while remaining > 0:
+                        n = min(remaining, zeros.size)
+                        f.write(zeros[:n].tobytes())
+                        remaining -= n
+            nbuf = min(self.sub_group_size, self.total)
+            self._stage = [
+                {name: np.zeros(nbuf, np.float32)
+                 for name in self._moment_names()} for _ in range(2)]
+            logger.info(
+                f"nvme offload: {self.total * 4 * self._n_moments / 1e6:.1f}MB "
+                f"of moments at {self._nvme_dir}, "
+                f"sub_group={self.sub_group_size}")
+        else:
+            self._moments = [np.zeros(self.total, np.float32)
+                             for _ in range(self._n_moments)]
+
+    # ------------------------------------------------------------------ utils
+    def _moment_names(self) -> List[str]:
+        return ["exp_avg", "exp_avg_sq"][:self._n_moments]
+
+    def _moment_path(self, name: str) -> str:
+        return os.path.join(self._nvme_dir, f"{name}.bin")
+
+    def _groups(self):
+        for start in range(0, self.total, self.sub_group_size):
+            yield start, min(start + self.sub_group_size, self.total)
+
+    def _opt_step(self, p, g, moments, lr):
+        if self._n_moments == 2:
+            self.opt.step(p, g, moments[0], moments[1], lr=lr)
+        else:
+            self.opt.step(p, g, moments[0], lr=lr)
+
+    # ------------------------------------------------------------------- step
+    def step(self, grad_leaves: Sequence[np.ndarray],
+             lr: Optional[float] = None) -> List[np.ndarray]:
+        """Update the master partition in place; returns new param leaves."""
+        flat_g = np.empty(self.total, np.float32)
+        for leaf, off, size in zip(grad_leaves, self.offsets[:-1], self.sizes):
+            flat_g[off:off + size] = np.asarray(leaf, np.float32).reshape(-1)
+
+        if self.device == "nvme":
+            self._step_nvme(flat_g, lr)
+        else:
+            self._opt_step(self.master, flat_g, self._moments, lr)
+        return self.param_leaves()
+
+    def _step_nvme(self, flat_g: np.ndarray, lr) -> None:
+        # manual sub-group loop so adam compute of group i overlaps the
+        # prefetch of group i+1 and the write-back of group i-1 (reference
+        # PipelinedOptimizerSwapper semantics); each staging buffer has its
+        # own aio handle so waits are per-buffer, not global
+        groups = list(self._groups())
+        names = self._moment_names()
+        # bump step count once for the whole partition, not once per group
+        if self._n_moments == 2:
+            self.opt.step_count += 1
+            step_count = self.opt.step_count
+        cur, nxt = 0, 1
+        # prefetch group 0 into buffer `cur`
+        for name in names:
+            self._stage_aio[cur].async_pread(
+                self._stage[cur][name][:groups[0][1] - groups[0][0]],
+                self._moment_path(name), groups[0][0] * 4)
+        failures = 0
+        for gi, (start, end) in enumerate(groups):
+            n = end - start
+            if gi + 1 < len(groups):
+                # buffer `nxt` may still be writing back group gi-1: join
+                # that first, then start prefetching group gi+1 into it
+                failures += self._stage_aio[nxt].wait()
+                s2, e2 = groups[gi + 1]
+                for name in names:
+                    self._stage_aio[nxt].async_pread(
+                        self._stage[nxt][name][:e2 - s2],
+                        self._moment_path(name), s2 * 4)
+            # join the prefetch of group gi, compute, write back async
+            failures += self._stage_aio[cur].wait()
+            bufs = [self._stage[cur][name][:n] for name in names]
+            if self._n_moments == 2:
+                self.opt.step_count = step_count - 1
+            self._opt_step(self.master[start:end], flat_g[start:end], bufs, lr)
+            for name, buf in zip(names, bufs):
+                self._stage_aio[cur].async_pwrite(buf, self._moment_path(name),
+                                                  start * 4)
+            cur, nxt = nxt, cur
+        failures += self._stage_aio[0].wait() + self._stage_aio[1].wait()
+        if failures:
+            raise IOError(f"nvme swap: {failures} failed I/O ops in "
+                          f"{self._nvme_dir}")
+        if self._n_moments == 2:
+            self.opt.step_count = step_count
+
+    def param_leaves(self) -> List[np.ndarray]:
+        return [self.master[off:off + size].reshape(shape)
+                for off, size, shape in zip(self.offsets[:-1], self.sizes,
+                                            self.shapes)]
+
+    # ------------------------------------------------------- clip / state_dict
+    def global_grad_norm(self, grad_leaves: Sequence[np.ndarray]) -> float:
+        return float(np.sqrt(sum(
+            sq_norm(np.ascontiguousarray(g, np.float32).reshape(-1))
+            for g in grad_leaves)))
+
+    def state_dict(self) -> dict:
+        moments = {}
+        if self.device == "nvme":
+            for name in self._moment_names():
+                buf = np.empty(self.total, np.float32)
+                self._aio.async_pread(buf, self._moment_path(name), 0)
+                self._aio.wait()
+                moments[name] = buf
+        else:
+            for name, m in zip(self._moment_names(), self._moments):
+                moments[name] = m
+        return {"master": self.master,
+                "step_count": getattr(self.opt, "step_count", 0), **moments}
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.master[:] = sd["master"]
+        if hasattr(self.opt, "step_count"):
+            self.opt.step_count = int(sd.get("step_count", 0))
+        for i, name in enumerate(self._moment_names()):
+            if name not in sd:
+                continue
+            if self.device == "nvme":
+                buf = np.ascontiguousarray(sd[name], np.float32)
+                self._aio.async_pwrite(buf, self._moment_path(name), 0)
+                self._aio.wait()
+            else:
+                self._moments[i][:] = sd[name]
+
+    def close(self) -> None:
+        if self._aio is not None:
+            self._aio.close()
+            for h in getattr(self, "_stage_aio", []):
+                h.close()
+        if self._nvme_dir and os.path.isdir(self._nvme_dir):
+            import shutil
+
+            shutil.rmtree(self._nvme_dir, ignore_errors=True)

@@ -71,6 +71,27 @@ def test_ring_attention_grads(sp):
                                    rtol=5e-5, atol=5e-5)
 
 
+@pytest.mark.parametrize("hkv", [2, 1])
+def test_ulysses_gqa(hkv):
+    # hkv=2, sp=2: kv rides the all-to-all un-repeated; hkv=1: repeat fallback
+    mesh = mesh_for(2)
+    q, k, v = make_qkv(jax.random.PRNGKey(7), h=4, hkv=hkv)
+    out = seq.ulysses_attention(q, k, v, causal=True, mesh=mesh)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_uneven_block_chunk():
+    # chunk c=24 is not a multiple of 128: gcd-based block picking must cope
+    mesh = mesh_for(4)
+    q, k, v = make_qkv(jax.random.PRNGKey(8), s=96)
+    out = seq.ring_attention(q, k, v, causal=True, mesh=mesh)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_ulysses_matches_reference(causal):
     mesh = mesh_for(4)
