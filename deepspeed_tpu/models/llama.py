@@ -116,12 +116,19 @@ def rope_angles(cfg: LlamaConfig, seq_len: int, offset: int = 0):
 
 
 def apply_rope(x, cos, sin):
-    """x: [B, H, S, hd]; rotate pairs (HF half-split convention)."""
+    """x: [B, H, S, hd]; rotate pairs (HF half-split convention).
+
+    Rotation math runs in fp32 (cos/sin tables are fp32) but the result is
+    cast back to x's dtype so bf16 activations stay bf16 through the block —
+    scan-over-layers carries require a fixed dtype, and keeping the residual
+    stream in bf16 is what makes the MXU path fast.
+    """
     hd = x.shape[-1]
     x1, x2 = x[..., :hd // 2], x[..., hd // 2:]
     c = cos[None, None, :, :]
     s = sin[None, None, :, :]
-    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
 
 
 def _attention(cfg: LlamaConfig, q, k, v):

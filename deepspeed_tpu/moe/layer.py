@@ -134,5 +134,7 @@ def moe_apply(cfg: MoEConfig, params: PyTree, x, rng=None, train: bool = True
     expert_out = jax.vmap(lambda we, xe: _expert_mlp(cfg, we, xe.reshape(-1, d))
                           .reshape(g_, c, d))(w, expert_in)
     expert_out = _maybe_constrain(expert_out, P(EP_AXIS))
-    y = combine_tokens(expert_out, combine)           # [G, S, D]
+    # Gating math runs in fp32; cast back so bf16 activations stay bf16
+    # through the residual stream (scan carries require a fixed dtype).
+    y = combine_tokens(expert_out, combine).astype(x.dtype)  # [G, S, D]
     return y.reshape(orig_shape), l_aux.astype(jnp.float32)

@@ -114,3 +114,32 @@ def test_get_model_registry():
                                 "hidden_size": 32}) is not None
     with pytest.raises(ValueError):
         get_model("nonexistent-model")
+
+
+def test_llama_mixtral_bf16_keeps_activation_dtype():
+    """bf16 compute must stay bf16 through rope/MoE (scan carries need a
+    fixed dtype; fp32 promotion also silently halves MXU throughput)."""
+    for mod, cfg in ((llama, llama.LlamaConfig.tiny()),
+                     (mixtral, mixtral.MixtralConfig.tiny())):
+        params = mod.init_params(cfg, jax.random.PRNGKey(0))
+        params = jax.tree.map(
+            lambda a: a.astype(jnp.bfloat16)
+            if a.dtype == jnp.float32 else a, params)
+        ids = jnp.zeros((1, 9), jnp.int32)
+        loss = mod.loss_from_batch(cfg, params, {"input_ids": ids})
+        assert np.isfinite(float(loss)), mod.__name__
+        # Direct dtype check: logits must come out bf16, not fp32-promoted.
+        if mod is llama:
+            logits = mod.forward(cfg, params, ids)
+        else:
+            logits = mod.forward_with_aux(cfg, params, ids)[0]
+        assert logits.dtype == jnp.bfloat16, (mod.__name__, logits.dtype)
+
+
+def test_llama_mixtral_bf16_train(eight_devices):
+    for model in (llama.build(llama.LlamaConfig.tiny()),
+                  mixtral.build(mixtral.MixtralConfig.tiny())):
+        _, losses = run(model, base_config(bf16={"enabled": True},
+                                           zero_optimization={"stage": 2}),
+                        steps=3)
+        assert np.isfinite(losses).all()
