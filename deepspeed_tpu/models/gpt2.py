@@ -116,8 +116,13 @@ def _layer_norm(x, scale, bias, eps: float = 1e-5):
     return (y * scale + bias).astype(x.dtype)
 
 
+_warned_sp_dropout = False
+
+
 def _block(cfg: GPT2Config, x, layer, mask, rng, dropout: float):
-    """One transformer block. x: [B, S, D]; layer: per-layer param slice."""
+    """One transformer block. x: [B, S, D]; layer: per-layer param slice.
+    ``mask=None`` means pure causal; the flash/SP fast paths require it (they
+    implement causality internally and would silently drop a custom mask)."""
     b, s, d = x.shape
     h, hd = cfg.num_heads, cfg.head_dim
 
@@ -133,19 +138,25 @@ def _block(cfg: GPT2Config, x, layer, mask, rng, dropout: float):
     if use_flash is None:
         use_flash = jax.default_backend() == "tpu"
     if seq_parallel.sp_size() > 1 and dropout > 0.0:
-        from ..utils.logging import logger
+        global _warned_sp_dropout
+        if not _warned_sp_dropout:
+            _warned_sp_dropout = True
+            from ..utils.logging import logger
 
-        logger.warning("mesh sp>1 with attention dropout>0: sequence-parallel "
-                       "attention requires dropout=0; falling back to the "
-                       "dense path (quadratic in S)")
-    if seq_parallel.sp_size() > 1 and dropout == 0.0:
+            logger.warning(
+                "mesh sp>1 with attention dropout>0: sequence-parallel "
+                "attention requires dropout=0; falling back to the "
+                "dense path (quadratic in S)")
+    if seq_parallel.sp_size() > 1 and dropout == 0.0 and mask is None:
         attn = seq_parallel.sequence_parallel_attention(
             q, k, v, causal=True, impl=getattr(cfg, "sp_impl", "auto"))
-    elif use_flash and dropout == 0.0:
+    elif use_flash and dropout == 0.0 and mask is None:
         from ..ops.flash_attention import flash_attention
 
         attn = flash_attention(q, k, v, causal=True)
     else:
+        if mask is None:
+            mask = jnp.tril(jnp.ones((s, s), bool))[None, None, :, :]
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
         scores = jnp.where(mask, scores.astype(jnp.float32), -1e9)
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
@@ -170,7 +181,7 @@ def forward(cfg: GPT2Config, params: PyTree, input_ids, rng=None,
     compute_dtype = params["wte"].dtype
     x = params["wte"][input_ids] + params["wpe"][:s]
     x = x.astype(compute_dtype)
-    mask = jnp.tril(jnp.ones((s, s), bool))[None, None, :, :]
+    mask = None  # pure causal; _block builds the tril only on the dense path
     dropout = cfg.dropout if train else 0.0
 
     def body(carry, xs):
@@ -315,9 +326,7 @@ def build(cfg: Optional[GPT2Config] = None, **overrides) -> ModelSpec:
         return forward(cfg, params, input_ids, rng=rng, train=False)
 
     def block_fn(layer, x):
-        s = x.shape[1]
-        mask = jnp.tril(jnp.ones((s, s), bool))[None, None, :, :]
-        return _block(cfg, x, layer, mask, None, 0.0)
+        return _block(cfg, x, layer, None, None, 0.0)
 
     pipeline_hooks = {
         "blocks_key": ("blocks",),

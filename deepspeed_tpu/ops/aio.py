@@ -52,6 +52,10 @@ class AsyncIOHandle:
                 data = f.read(buf.nbytes)
             flat = buf.reshape(-1).view(np.uint8)
             flat[:len(data)] = np.frombuffer(data, np.uint8)
+            if len(data) < buf.nbytes:
+                # short read = failure, matching the native path's semantics
+                # (a truncated swap file must not be silently consumed)
+                self._sync_failures += 1
         except OSError:
             self._sync_failures += 1
 

@@ -40,11 +40,38 @@ class OpBuilder:
         return [CSRC / s for s in cls.SOURCES]
 
     @classmethod
-    def _hash(cls) -> str:
+    def _host_id(cls) -> str:
+        """Host identifier folded into the cache key: -march=native output is
+        host-specific, so a _build/ dir shared across heterogeneous machines
+        (NFS, prebuilt container) must not serve another host's .so."""
+        import platform
+
+        ident = platform.machine()
+        seen = set()
+        try:
+            with open("/proc/cpuinfo") as f:
+                for line in f:
+                    key = line.split(":", 1)[0].strip()
+                    # model name alone is not enough: a hypervisor can mask
+                    # ISA features (e.g. AVX-512) on one of two same-model VMs
+                    if key in ("model name", "flags", "Features") and \
+                            key not in seen:
+                        seen.add(key)
+                        ident += line
+                        if len(seen) == 2:
+                            break
+        except OSError:
+            pass
+        return ident
+
+    @classmethod
+    def _hash(cls, flags: List[str]) -> str:
         h = hashlib.sha256()
         for p in cls._source_paths():
             h.update(p.read_bytes())
-        h.update(" ".join(cls._flags()).encode())
+        h.update(" ".join(flags).encode())
+        if "-march=native" in flags:
+            h.update(cls._host_id().encode())
         return h.hexdigest()[:16]
 
     @classmethod
@@ -77,25 +104,33 @@ class OpBuilder:
                            "using python fallback")
             return None
         BUILD_DIR.mkdir(exist_ok=True)
-        so_path = BUILD_DIR / f"{cls.NAME}_{cls._hash()}.so"
-        if not so_path.exists():
-            cmd = (["g++"] + cls._flags() +
+        # -march=native can fail on exotic hosts; retry portable (with its
+        # own cache key, so the portable .so never shadows a native one)
+        flag_sets = [cls._flags(),
+                     [f for f in cls._flags() if f != "-march=native"]]
+        so_path = None
+        last_err = None
+        for flags in flag_sets:
+            candidate = BUILD_DIR / f"{cls.NAME}_{cls._hash(flags)}.so"
+            if candidate.exists():
+                so_path = candidate
+                break
+            cmd = (["g++"] + flags +
                    [str(p) for p in cls._source_paths()] +
-                   ["-o", str(so_path)])
+                   ["-o", str(candidate)])
             try:
                 subprocess.run(cmd, capture_output=True, check=True, text=True)
-                logger.info(f"op {cls.NAME}: built {so_path.name}")
+                logger.info(f"op {cls.NAME}: built {candidate.name}")
+                so_path = candidate
+                break
             except subprocess.CalledProcessError as e:
-                # -march=native can fail on exotic hosts; retry portable
-                try:
-                    cmd = [c for c in cmd if c != "-march=native"]
-                    subprocess.run(cmd, capture_output=True, check=True,
-                                   text=True)
-                except subprocess.CalledProcessError:
-                    logger.warning(
-                        f"op {cls.NAME}: build failed ({e.stderr[-500:] if e.stderr else e}); "
-                        "using python fallback")
-                    return None
+                last_err = e
+        if so_path is None:
+            err = last_err.stderr[-500:] if (last_err and last_err.stderr) \
+                else last_err
+            logger.warning(f"op {cls.NAME}: build failed ({err}); "
+                           "using python fallback")
+            return None
         try:
             return ctypes.CDLL(str(so_path), mode=ctypes.RTLD_GLOBAL)
         except OSError as e:

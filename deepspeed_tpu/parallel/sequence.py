@@ -246,6 +246,32 @@ def _qkvo_spec(mesh, q_shape, batch_axes, head_axis, sp_axis):
     return P(b_axes, h_axes, sp_axis, None)
 
 
+#: whole-chunk fallback cap: a [bq, bk] f32 score tile + scratch must fit VMEM
+_MAX_RING_BLOCK = 512
+
+
+def _ring_block(c: int, want: int) -> int:
+    """TPU-friendly block size for a per-device chunk of length ``c``.
+
+    The ring kernels require the block to tile the chunk exactly (they don't
+    pad), and the TPU needs >=8 sublanes per block.  Pick the largest divisor
+    of ``c`` that is a multiple of 8 and <= max(want, _MAX_RING_BLOCK cap);
+    raise a clear trace-time error instead of letting an undersized or
+    VMEM-busting block surface as an opaque Pallas compile failure on
+    hardware (tests run in interpret mode and would never see it)."""
+    want = max(want, 8)  # TPU needs >=8 sublanes per block
+    if c % 8 == 0:
+        for b in range(min(want, c), 7, -1):
+            if c % b == 0 and b % 8 == 0:
+                return b  # always found: 8 itself divides c
+    if c <= _MAX_RING_BLOCK:
+        return c  # odd chunk: one whole-chunk block (Pallas pads the tile)
+    raise ValueError(
+        f"ring attention: per-device chunk length {c} has no block size that "
+        f"is a multiple of 8, and a whole-chunk block would exceed VMEM "
+        f"(cap {_MAX_RING_BLOCK}); use a sequence length divisible by 8*sp")
+
+
 def ring_attention(q, k, v, causal: bool = True,
                    sm_scale: Optional[float] = None, mesh=None,
                    sp_axis: str = SP_AXIS, batch_axes=DATA_AXES,
@@ -271,14 +297,8 @@ def ring_attention(q, k, v, causal: bool = True,
     s_len = q.shape[2]
     assert s_len % sp == 0, f"seq len {s_len} must divide sp={sp}"
     c = s_len // sp
-    # largest block that tiles the chunk exactly (the kernel doesn't pad);
-    # degenerate gcds (prime chunks) fall back to one whole-chunk block
-    bq = math.gcd(c, block_q)
-    bk = math.gcd(c, block_k)
-    if bq < 8:
-        bq = c
-    if bk < 8:
-        bk = c
+    bq = _ring_block(c, block_q)
+    bk = _ring_block(c, block_k)
 
     def local(q, k, v):
         return _ring_attn(q, k, v, sp_axis, sp, sm_scale, causal, bq, bk,

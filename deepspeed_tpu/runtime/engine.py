@@ -215,7 +215,12 @@ class DeepSpeedEngine:
         return float(jax.device_get(self.state["scaler"].cur_scale))
 
     def get_lr(self):
-        step = max(self.global_steps, 0)
+        if self.offload_enabled and self._offload_opt is not None:
+            return [self._host_lr()]
+        # index by *applied* steps so the reported lr matches the in-graph
+        # optax schedule count, which does not advance on overflow-skipped
+        # steps (nor does the reference scheduler)
+        step = max(self.global_steps - self.skipped_steps, 0)
         if self.lr_schedule is not None:
             return [float(self.lr_schedule(step))]
         return [self._base_lr]
@@ -610,13 +615,21 @@ class DeepSpeedEngine:
                                                          self._dropout_rng)
         return self._host_apply(state, grads, partial, metrics)
 
+    def _host_lr(self) -> float:
+        """LR for the host optimizer: indexed by *applied* steps so overflow-
+        skipped steps don't advance the schedule (matches the in-graph optax
+        scale_by_schedule count and the reference's scheduler semantics)."""
+        if self.lr_schedule is not None:
+            return float(self.lr_schedule(self._offload_opt.applied_steps))
+        return self._base_lr
+
     def _host_apply(self, state, grads, partial, metrics):
         new_params = state["params"]
         if not (self.fp16_enabled and bool(jax.device_get(metrics["overflow"]))):
             grad_leaves = [np.asarray(g) for g in
                            jax.tree_util.tree_leaves(jax.device_get(grads))]
             new_leaves = self._offload_opt.step(grad_leaves,
-                                                lr=self.get_lr()[0])
+                                                lr=self._host_lr())
             treedef = jax.tree_util.tree_structure(state["params"])
             new_params = jax.device_put(
                 jax.tree_util.tree_unflatten(treedef, new_leaves),

@@ -76,6 +76,10 @@ class HostOffloadOptimizer:
 
         self.opt, self._n_moments = _make_cpu_optimizer(optimizer_name,
                                                         optimizer_params)
+        # count of *applied* updates — drives the host-side lr schedule so it
+        # matches the in-graph optax count, which does not advance on
+        # overflow-skipped steps (nor does the reference scheduler)
+        self.applied_steps = 0
         self.device = device
         self.sub_group_size = max(int(sub_group_size), 1)
         self._nvme_dir = None
@@ -143,6 +147,7 @@ class HostOffloadOptimizer:
             self._step_nvme(flat_g, lr)
         else:
             self._opt_step(self.master, flat_g, self._moments, lr)
+        self.applied_steps += 1
         return self.param_leaves()
 
     def _step_nvme(self, flat_g: np.ndarray, lr) -> None:
@@ -208,25 +213,34 @@ class HostOffloadOptimizer:
             for name in self._moment_names():
                 buf = np.empty(self.total, np.float32)
                 self._aio.async_pread(buf, self._moment_path(name), 0)
-                self._aio.wait()
+                if self._aio.wait():
+                    raise IOError(
+                        f"nvme swap: failed to read {name} moments from "
+                        f"{self._nvme_dir} for checkpointing")
                 moments[name] = buf
         else:
             for name, m in zip(self._moment_names(), self._moments):
                 moments[name] = m
         return {"master": self.master,
-                "step_count": getattr(self.opt, "step_count", 0), **moments}
+                "step_count": getattr(self.opt, "step_count", 0),
+                "applied_steps": self.applied_steps, **moments}
 
     def load_state_dict(self, sd: dict) -> None:
         self.master[:] = sd["master"]
         if hasattr(self.opt, "step_count"):
             self.opt.step_count = int(sd.get("step_count", 0))
+        self.applied_steps = int(sd.get("applied_steps",
+                                        sd.get("step_count", 0)))
         for i, name in enumerate(self._moment_names()):
             if name not in sd:
                 continue
             if self.device == "nvme":
                 buf = np.ascontiguousarray(sd[name], np.float32)
                 self._aio.async_pwrite(buf, self._moment_path(name), 0)
-                self._aio.wait()
+                if self._aio.wait():
+                    raise IOError(
+                        f"nvme swap: failed to restore {name} moments into "
+                        f"{self._nvme_dir} from checkpoint")
             else:
                 self._moments[i][:] = sd[name]
 
