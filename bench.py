@@ -9,6 +9,7 @@ Round-1 metric: GPT-2 125M training tokens/sec/chip (driver config #1).
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import time
@@ -30,6 +31,19 @@ def peak_flops_per_chip(device) -> float:
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--flash", dest="flash", default=None,
+                    action="store_true", help="force the Pallas flash kernel")
+    ap.add_argument("--no-flash", dest="flash", action="store_false")
+    ap.add_argument("--remat", dest="remat", default=None, action="store_true")
+    ap.add_argument("--no-remat", dest="remat", action="store_false")
+    ap.add_argument("--micro-bs", type=int, default=None)
+    ap.add_argument("--seq", type=int, default=None)
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--bq", type=int, default=None, help="flash block_q")
+    ap.add_argument("--bk", type=int, default=None, help="flash block_k")
+    args = ap.parse_args()
+
     import jax
     import numpy as np
 
@@ -40,13 +54,24 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
         cfg = gpt2.GPT2Config.gpt2_125m()
-        cfg.remat = True  # recompute blocks in bwd: O(L) residuals, not O(L) attn maps
-        cfg.use_flash = False  # XLA einsum currently beats our kernel at S=1024
+        cfg.remat = False   # flash attention keeps activations O(S), fits HBM
+        cfg.use_flash = True
         micro_bs, seq, steps = 32, 1024, 20
     else:  # CPU smoke mode
         cfg = gpt2.GPT2Config(vocab_size=2048, max_seq_len=256, num_layers=4,
                               num_heads=8, hidden_size=256)
         micro_bs, seq, steps = 2, 128, 5
+    if args.flash is not None:
+        cfg.use_flash = args.flash
+    if args.remat is not None:
+        cfg.remat = args.remat
+    if args.bq:
+        cfg.flash_block_q = args.bq
+    if args.bk:
+        cfg.flash_block_k = args.bk
+    micro_bs = args.micro_bs or micro_bs
+    seq = args.seq or seq
+    steps = args.steps or steps
     cfg.max_seq_len = max(cfg.max_seq_len, seq)
 
     config = {

@@ -1,0 +1,93 @@
+"""Attention micro-benchmark: Pallas flash kernel vs XLA einsum attention.
+
+Sweeps block sizes at training shapes, fwd+bwd, and prints ms/iter + attention
+TFLOPs for each variant.  The analog of the reference's kernel-vs-eager checks
+under ``tests/perf`` (e.g. ``tests/perf/adam_test.py``) but for the attention
+kernel that dominates the training step.
+
+Usage: python benchmarks/attn_microbench.py [B H S D]
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, *args, iters=20):
+    """Time ``fn`` by scanning it ``iters`` times *inside one jit call*.
+
+    Per-call dispatch overhead on remote/tunneled backends (~10ms) would
+    otherwise swamp sub-ms kernels.  Each iteration's q input depends on the
+    previous output so the compiler cannot hoist the body out of the loop.
+    A host fetch of the final scalar forces completion (``block_until_ready``
+    can return at enqueue time on tunneled backends).
+    """
+    q0 = args[0]
+
+    @jax.jit
+    def runner(*a):
+        def body(carry, _):
+            out = fn(carry, *a[1:])
+            lead = jax.tree_util.tree_leaves(out)[0]
+            nxt = (carry + 0.001 * lead.reshape(carry.shape).astype(
+                carry.dtype))
+            return nxt, None
+        final, _ = jax.lax.scan(body, q0, None, length=iters)
+        return jnp.sum(final.astype(jnp.float32))
+
+    jax.device_get(runner(*args))  # warmup/compile
+    t0 = time.perf_counter()
+    jax.device_get(runner(*args))
+    return (time.perf_counter() - t0) / iters * 1e3  # ms
+
+
+def main():
+    from deepspeed_tpu.ops import flash_attention as fa
+
+    b, h, s, d = (int(x) for x in sys.argv[1:5]) if len(sys.argv) > 4 else \
+        (32, 12, 1024, 64)
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv, kg = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, h, s, d), jnp.bfloat16)
+
+    # causal attention flops (fwd): 2 matmuls * b*h*s*s*d * 0.5 (causal)
+    fwd_flops = 2 * 2 * b * h * s * s * d * 0.5
+    fb_flops = fwd_flops * 3.5  # bwd ~2.5x fwd for flash (recompute + 4 mm)
+
+    def loss_of(attn_fn):
+        def f(q, k, v):
+            return (attn_fn(q, k, v) * v).sum(dtype=jnp.float32)
+        return jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+
+    variants = {"xla_einsum": functools.partial(fa.mha_reference, causal=True)}
+    for bq, bk in [(128, 128), (256, 256), (256, 512), (512, 256), (512, 512),
+                   (1024, 512), (256, 1024)]:
+        if bq > s or bk > s:
+            continue
+        variants[f"flash_{bq}x{bk}"] = functools.partial(
+            fa.flash_attention, causal=True, block_q=bq, block_k=bk)
+
+    print(f"shape B={b} H={h} S={s} D={d} bf16, fwd+bwd")
+    for name, attn in variants.items():
+        # fwd only
+        fwd = jax.jit(attn)
+        ms_f = timeit(lambda *a: fwd(*a), q, k, v)
+        # fwd+bwd
+        g = loss_of(attn)
+        ms_fb = timeit(lambda *a: g(*a)[0], q, k, v)
+        print(f"{name:18s} fwd {ms_f:7.3f} ms ({fwd_flops/ms_f/1e9:6.1f} TF/s)"
+              f"   fwd+bwd {ms_fb:7.3f} ms ({fb_flops/ms_fb/1e9:6.1f} TF/s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""Inference latency/throughput harness (reference
+``benchmarks/inference/gpt-bench.py``: p50/p90/p99 latency + tokens/sec).
+
+Measures TTFT (prefill latency) and decode tokens/sec for a model served by
+``init_inference``.  Runs any registered model name or an HF checkpoint dir.
+
+Usage:
+  python benchmarks/gpt_bench.py --model opt-125m --batch 1 --prompt 128 \
+      --gen 64 --trials 10 [--dtype bf16] [--tp 1] [--hf-dir /path/to/ckpt]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def percentile(xs, p):
+    return float(np.percentile(np.asarray(xs), p))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="opt-125m")
+    ap.add_argument("--hf-dir", default=None,
+                    help="HF checkpoint dir (overrides --model)")
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--prompt", type=int, default=128)
+    ap.add_argument("--gen", type=int, default=64)
+    ap.add_argument("--trials", type=int, default=10)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--tp", type=int, default=1)
+    args = ap.parse_args()
+
+    import jax
+
+    import deepspeed_tpu
+
+    if args.hf_dir:
+        model = args.hf_dir
+    else:
+        model = deepspeed_tpu.models.get_model(args.model)
+    engine = deepspeed_tpu.init_inference(
+        model=model,
+        config={"dtype": args.dtype,
+                "tensor_parallel": {"tp_size": args.tp}})
+
+    rng = np.random.default_rng(0)
+    vocab = 1000  # prompt token range; any real vocab exceeds this
+    ids = rng.integers(2, vocab, (args.batch, args.prompt)).astype(np.int32)
+
+    # TTFT: prefill + first token == generate(max_new_tokens=1)
+    engine.generate(ids, max_new_tokens=1)      # compile
+    ttft = []
+    for _ in range(args.trials):
+        t0 = time.perf_counter()
+        out = engine.generate(ids, max_new_tokens=1)
+        ttft.append(time.perf_counter() - t0)
+
+    # full decode: tokens/sec over gen tokens
+    engine.generate(ids, max_new_tokens=args.gen)  # compile
+    lat = []
+    for _ in range(args.trials):
+        t0 = time.perf_counter()
+        out = engine.generate(ids, max_new_tokens=args.gen)
+        lat.append(time.perf_counter() - t0)
+    assert out.shape == (args.batch, args.prompt + args.gen)
+
+    decode_tok_s = [args.batch * args.gen / t for t in lat]
+    print(json.dumps({
+        "model": args.model if not args.hf_dir else args.hf_dir,
+        "batch": args.batch, "prompt": args.prompt, "gen": args.gen,
+        "ttft_ms": {"p50": round(percentile(ttft, 50) * 1e3, 2),
+                    "p90": round(percentile(ttft, 90) * 1e3, 2),
+                    "p99": round(percentile(ttft, 99) * 1e3, 2)},
+        "latency_ms": {"p50": round(percentile(lat, 50) * 1e3, 2),
+                       "p90": round(percentile(lat, 90) * 1e3, 2),
+                       "p99": round(percentile(lat, 99) * 1e3, 2)},
+        "tokens_per_sec": round(percentile(decode_tok_s, 50), 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

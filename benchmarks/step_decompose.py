@@ -1,0 +1,98 @@
+"""Decompose the training-step time: where does the 0.8s go?
+
+Times (on the real chip): fwd-only loss, fwd+bwd+update via engine.train_batch
+with a fresh host batch each step (the headline bench pattern), and the same
+with a device-resident batch — isolating host->device transfer + dispatch
+overhead from compute.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    flash = "--flash" in sys.argv
+    cfg = gpt2.GPT2Config.gpt2_125m()
+    cfg.remat = "--remat" in sys.argv
+    cfg.use_flash = flash
+    micro_bs, seq, steps = 32, 1024, 10
+    cfg.max_seq_len = max(cfg.max_seq_len, seq)
+
+    config = {
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+    }
+    model = gpt2.build(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    rng = np.random.default_rng(0)
+
+    def host_batch():
+        return {"input_ids": rng.integers(
+            0, cfg.vocab_size, size=(micro_bs, seq + 1)).astype(np.int32)}
+
+    def sync(x):
+        jax.device_get(jax.tree_util.tree_leaves(x)[0].sum())
+
+    # 1) fwd-only loss on a device-resident batch (bf16 compute like the step)
+    from deepspeed_tpu.runtime.engine import _cast_floating
+    dev_batch = engine._shard_batch(host_batch())
+    loss_fn = jax.jit(lambda p, b: model.loss_fn(
+        _cast_floating(p, jnp.bfloat16), b, None, False))
+    params = engine.state["params"]
+    sync(loss_fn(params, dev_batch))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = loss_fn(params, dev_batch)
+    sync(out)
+    t_fwd = (time.perf_counter() - t0) / steps
+    print(f"fwd-only loss:              {t_fwd*1e3:8.1f} ms")
+
+    # 1b) fwd+bwd only (no optimizer): value_and_grad of the loss
+    grad_fn = jax.jit(jax.grad(lambda p, b: model.loss_fn(
+        _cast_floating(p, jnp.bfloat16), b, None, True)))
+    sync(grad_fn(params, dev_batch))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        g = grad_fn(params, dev_batch)
+    sync(g)
+    t_grad = (time.perf_counter() - t0) / steps
+    print(f"fwd+bwd (no update):        {t_grad*1e3:8.1f} ms")
+
+    # 2) full train step, device-resident batch (reuse same buffer)
+    engine.train_batch(dev_batch)  # compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        _, m = engine.train_batch(dev_batch)
+    sync(engine.state["params"]["wte"])
+    t_dev = (time.perf_counter() - t0) / steps
+    print(f"train step (device batch):  {t_dev*1e3:8.1f} ms")
+
+    # 3) full train step, fresh host batch per step (headline bench pattern)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        _, m = engine.train_batch(host_batch())
+    sync(engine.state["params"]["wte"])
+    t_host = (time.perf_counter() - t0) / steps
+    print(f"train step (host batch):    {t_host*1e3:8.1f} ms")
+
+    toks = micro_bs * seq
+    print(f"tokens/s: fwd {toks/t_fwd:,.0f}  dev {toks/t_dev:,.0f}  "
+          f"host {toks/t_host:,.0f}")
+
+
+if __name__ == "__main__":
+    main()

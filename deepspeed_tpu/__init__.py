@@ -11,6 +11,7 @@ from typing import Optional, Union
 
 from . import comm
 from . import models
+from . import module_inject
 from . import ops
 from .runtime import lr_schedules
 from .runtime.config import DeepSpeedConfig
@@ -103,8 +104,11 @@ def add_tuning_arguments(parser):
 def init_inference(model=None, config=None, params=None, **kwargs):
     """Inference engine entry (reference __init__.py:233).
 
-    ``params``: trained parameter pytree; without it the engine serves
-    freshly-initialized weights (useful only for tests/benchmarks).
+    ``model`` may be a :class:`ModelSpec`, a HuggingFace torch model (its
+    architecture is matched to an injection policy and the weights converted —
+    the ``replace_transformer_layer`` analog), or a path to an HF checkpoint
+    directory.  ``params``: trained parameter pytree; without it the engine
+    serves the converted HF weights, or freshly-initialized ones.
     """
     from .inference.engine import InferenceEngine
     from .inference.config import DeepSpeedInferenceConfig
@@ -113,4 +117,10 @@ def init_inference(model=None, config=None, params=None, **kwargs):
         config = DeepSpeedInferenceConfig(**config)
     elif config is None:
         config = DeepSpeedInferenceConfig(**kwargs)
+    if model is not None and not isinstance(model, ModelSpec):
+        from .runtime.state_dict_factory import load_hf_weights
+
+        model, converted = load_hf_weights(model)
+        if params is None:
+            params = converted
     return InferenceEngine(model, config, params=params)

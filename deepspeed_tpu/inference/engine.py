@@ -90,9 +90,13 @@ class InferenceEngine:
 
     # ----------------------------------------------------------------- generate
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 eos_token_id: Optional[int] = None):
-        """Greedy decode with static shapes (reference ``_generate`` :571;
-        beam search is likewise rejected there)."""
+                 eos_token_id: Optional[int] = None,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 seed: Optional[int] = None):
+        """Decode with static shapes (reference ``_generate`` :571; beam
+        search is likewise rejected there).  ``do_sample=True`` enables
+        temperature / top-k / top-p sampling in-graph; default is greedy."""
         input_ids = np.asarray(input_ids)
         b, prompt_len = input_ids.shape
         total = prompt_len + max_new_tokens
@@ -102,15 +106,20 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
                 f"= {total} exceeds the model context length {max_ctx}")
-        key = (b, prompt_len, max_new_tokens)
+        sample_cfg = (do_sample, float(temperature), int(top_k),
+                      float(top_p)) if do_sample else None
+        key = (b, prompt_len, max_new_tokens, sample_cfg)
         if key not in self._generate_fns:
+            if len(self._generate_fns) >= 32:  # bound the per-shape jit cache
+                self._generate_fns.pop(next(iter(self._generate_fns)))
             if self.module.decode_hooks is not None:
                 self._generate_fns[key] = self._build_kv_cache_gen(
-                    b, prompt_len, total)
+                    b, prompt_len, total, sample_cfg)
             else:
                 self._generate_fns[key] = self._build_recompute_gen(
-                    b, prompt_len, total)
-        out = self._generate_fns[key](self.params, jnp.asarray(input_ids))
+                    b, prompt_len, total, sample_cfg)
+        rng = jax.random.PRNGKey(0 if seed is None else seed)
+        out = self._generate_fns[key](self.params, jnp.asarray(input_ids), rng)
         out = np.array(out)  # writable host copy (np.asarray view is read-only)
         if eos_token_id is not None:
             for row in range(b):
@@ -119,24 +128,26 @@ class InferenceEngine:
                     out[row, prompt_len + hits[0] + 1:] = eos_token_id
         return out
 
-    def _build_recompute_gen(self, b, prompt_len, total):
+    def _build_recompute_gen(self, b, prompt_len, total, sample_cfg=None):
         """Full-recompute fallback for models without decode hooks."""
         apply_fn = self.module.apply_fn
+        pick = _make_token_picker(sample_cfg)
 
-        def gen(params, ids):
+        def gen(params, ids, rng):
             buf = jnp.zeros((b, total), jnp.int32)
             buf = buf.at[:, :prompt_len].set(ids)
 
             def body(i, buf):
                 logits = apply_fn(params, {"input_ids": buf}, None)
-                next_tok = jnp.argmax(logits[:, i - 1, :], axis=-1)
-                return buf.at[:, i].set(next_tok.astype(jnp.int32))
+                next_tok = pick(logits[:, i - 1, :],
+                                jax.random.fold_in(rng, i))
+                return buf.at[:, i].set(next_tok)
 
             return jax.lax.fori_loop(prompt_len, total, body, buf)
 
         return jax.jit(gen)
 
-    def _build_kv_cache_gen(self, b, prompt_len, total):
+    def _build_kv_cache_gen(self, b, prompt_len, total, sample_cfg=None):
         """Prefill + single-token decode loop over a static KV cache
         (reference ``softmax_context`` path; workspace sized like
         ``inference_context.h`` by the token budget)."""
@@ -145,20 +156,21 @@ class InferenceEngine:
         # round the workspace up so the Pallas kernel's block_k divides it
         cache_len = -(-total // 128) * 128
         cache_dtype = self._config.jnp_dtype
+        pick = _make_token_picker(sample_cfg)
 
-        def gen(params, ids):
+        def gen(params, ids, rng):
             cache = init_cache(b, cache_len, cache_dtype)
             buf = jnp.zeros((b, total), jnp.int32)
             buf = buf.at[:, :prompt_len].set(ids)
             logits, cache = forward_cached(params, ids, cache, 0)   # prefill
             buf = buf.at[:, prompt_len].set(
-                jnp.argmax(logits, axis=-1).astype(jnp.int32))
+                pick(logits, jax.random.fold_in(rng, prompt_len)))
 
             def body(pos, carry):
                 buf, cache = carry
                 tok = jax.lax.dynamic_slice(buf, (0, pos), (b, 1))
                 logits, cache2 = forward_cached(params, tok, cache, pos)
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                nxt = pick(logits, jax.random.fold_in(rng, pos + 1))
                 buf = jax.lax.dynamic_update_slice(buf, nxt[:, None],
                                                    (0, pos + 1))
                 return buf, cache2
@@ -170,4 +182,55 @@ class InferenceEngine:
         return jax.jit(gen)
 
     def profile_model_time(self, use_cuda_events: bool = True):
-        pass  # jax.profiler traces replace per-module CUDA-event hooks
+        """Enable per-forward wall-clock capture (reference
+        ``inference/engine.py:163``); retrieve with :meth:`model_times`.
+        Idempotent — repeated calls do not stack timers."""
+        if getattr(self, "_profiling", False):
+            return
+        self._profiling = True
+        self._model_times = []
+        orig = self._forward_fn
+
+        def timed(p, batch):
+            import time
+            t0 = time.perf_counter()
+            out = orig(p, batch)
+            jax.tree_util.tree_leaves(out)[0].block_until_ready()
+            self._model_times.append(time.perf_counter() - t0)
+            return out
+
+        self._forward_fn = timed
+
+    def model_times(self):
+        times = list(getattr(self, "_model_times", []))
+        self._model_times = []
+        return times
+
+
+def _make_token_picker(sample_cfg):
+    """Greedy argmax, or temperature/top-k/top-p sampling (in-graph).
+
+    The reference's sampling lives in HF ``generate``; here it is part of the
+    jitted decode loop.  logits: [B, V] -> int32 [B].
+    """
+    if sample_cfg is None:
+        return lambda logits, rng: jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    _, temperature, top_k, top_p = sample_cfg
+
+    def pick(logits, rng):
+        logits = logits.astype(jnp.float32) / max(temperature, 1e-6)
+        v = logits.shape[-1]
+        if top_k and top_k < v:
+            kth = jnp.sort(logits, axis=-1)[:, v - top_k][:, None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p < 1.0:
+            sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # keep the smallest prefix with cumulative prob >= top_p
+            cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+            cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+        return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+    return pick

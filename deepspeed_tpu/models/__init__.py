@@ -2,7 +2,7 @@
 
 import dataclasses
 
-from . import gpt2, llama, mixtral
+from . import gpt2, llama, mixtral, opt
 
 
 def _with(cfg, overrides):
@@ -19,6 +19,12 @@ _NAMED = {
     "mixtral": lambda kw: mixtral.build(**kw),
     "mixtral8x7b": lambda kw: mixtral.build(
         _with(mixtral.MixtralConfig.mixtral_8x7b(), kw)),
+    "opt": lambda kw: opt.build(**kw),
+    "opt125m": lambda kw: opt.build(_with(opt.OPTConfig.opt_125m(), kw)),
+    "opt350m": lambda kw: opt.build(_with(opt.OPTConfig.opt_350m(), kw)),
+    "opt13b": lambda kw: opt.build(_with(opt.OPTConfig.opt_13b(), kw)),
+    "opt30b": lambda kw: opt.build(_with(opt.OPTConfig.opt_30b(), kw)),
+    "opt66b": lambda kw: opt.build(_with(opt.OPTConfig.opt_66b(), kw)),
 }
 
 

@@ -42,10 +42,19 @@ class GPT2Config:
     mlp_ratio: int = 4
     dropout: float = 0.0
     remat: bool = False
+    #: "full" recomputes the whole block in bwd (reference activation
+    #: checkpointing); "dots" saves projection outputs and recomputes only
+    #: the attention map + elementwise ops (selective checkpointing —
+    #: ~13% extra flops instead of ~33%, still O(S) memory)
+    remat_policy: str = "dots"
     tie_embeddings: bool = True
     #: None = auto (Pallas flash attention on TPU, einsum elsewhere);
     #: flash path requires attention-dropout == 0
     use_flash: Optional[bool] = None
+    #: flash kernel block sizes; larger blocks amortize grid overhead when
+    #: head_dim is small (d=64 -> half-width MXU ops)
+    flash_block_q: int = 512
+    flash_block_k: int = 1024
     #: sequence-parallel attention impl when mesh sp>1: auto|ulysses|ring
     sp_impl: str = "auto"
 
@@ -116,6 +125,12 @@ def _layer_norm(x, scale, bias, eps: float = 1e-5):
     return (y * scale + bias).astype(x.dtype)
 
 
+def _remat_policy(cfg):
+    if getattr(cfg, "remat_policy", "full") == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    return None
+
+
 _warned_sp_dropout = False
 
 
@@ -153,7 +168,9 @@ def _block(cfg: GPT2Config, x, layer, mask, rng, dropout: float):
     elif use_flash and dropout == 0.0 and mask is None:
         from ..ops.flash_attention import flash_attention
 
-        attn = flash_attention(q, k, v, causal=True)
+        attn = flash_attention(q, k, v, causal=True,
+                               block_q=getattr(cfg, "flash_block_q", 512),
+                               block_k=getattr(cfg, "flash_block_k", 1024))
     else:
         if mask is None:
             mask = jnp.tril(jnp.ones((s, s), bool))[None, None, :, :]
@@ -177,26 +194,7 @@ def _block(cfg: GPT2Config, x, layer, mask, rng, dropout: float):
 def forward(cfg: GPT2Config, params: PyTree, input_ids, rng=None,
             train: bool = True):
     """Token logits. input_ids: [B, S] int32."""
-    b, s = input_ids.shape
-    compute_dtype = params["wte"].dtype
-    x = params["wte"][input_ids] + params["wpe"][:s]
-    x = x.astype(compute_dtype)
-    mask = None  # pure causal; _block builds the tril only on the dense path
-    dropout = cfg.dropout if train else 0.0
-
-    def body(carry, xs):
-        x, idx = carry
-        layer, = xs
-        r = (jax.random.fold_in(rng, idx) if (rng is not None and dropout > 0.0)
-             else None)
-        block_fn = _block
-        if cfg.remat:
-            block_fn = jax.checkpoint(_block, static_argnums=(0, 5))
-        x = block_fn(cfg, x, layer, mask, r, dropout)
-        return (x, idx + 1), None
-
-    (x, _), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.int32)),
-                             (params["blocks"],))
+    x = _trunk(cfg, params, input_ids, rng=rng, train=train)
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
     logits = x @ params["wte"].T.astype(x.dtype)
     return logits
@@ -256,9 +254,39 @@ def forward_cached(cfg: GPT2Config, params, input_ids, cache, pos):
     return logits, {"k": ks, "v": vs}
 
 
+def _trunk(cfg: GPT2Config, params, input_ids, rng=None, train: bool = True):
+    """Embeddings + all blocks; returns pre-final-LN activations [B, S, D]."""
+    b, s = input_ids.shape
+    compute_dtype = params["wte"].dtype
+    x = params["wte"][input_ids] + params["wpe"][:s]
+    x = x.astype(compute_dtype)
+    dropout = cfg.dropout if train else 0.0
+
+    def body(carry, xs):
+        x, idx = carry
+        layer, = xs
+        r = (jax.random.fold_in(rng, idx) if (rng is not None and dropout > 0.0)
+             else None)
+        block_fn = _block
+        if cfg.remat:
+            block_fn = jax.checkpoint(_block, static_argnums=(0, 5),
+                                      policy=_remat_policy(cfg))
+        x = block_fn(cfg, x, layer, None, r, dropout)
+        return (x, idx + 1), None
+
+    (x, _), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.int32)),
+                             (params["blocks"],))
+    return x
+
+
 def loss_from_batch(cfg: GPT2Config, params, batch, rng=None, train: bool = True):
     """Next-token cross entropy. batch: {"input_ids": [B, S]} (targets = shift)
-    or {"input_ids", "labels"}; label -100 entries are masked (HF convention)."""
+    or {"input_ids", "labels"}; label -100 entries are masked (HF convention).
+
+    The LN + lm-head matmul + CE is checkpointed: backward recomputes the
+    [T, V] logits from the saved [T, D] activations instead of storing a
+    float32 logit tensor (6.6 GB at B=32, S=1024, V=50k) — the dominant
+    activation-memory/HBM-traffic term for small models."""
     if isinstance(batch, (tuple, list)):
         input_ids, labels = batch
     else:
@@ -267,14 +295,10 @@ def loss_from_batch(cfg: GPT2Config, params, batch, rng=None, train: bool = True
     if labels is None:
         labels = input_ids[:, 1:]
         input_ids = input_ids[:, :-1]
-    logits = forward(cfg, params, input_ids, rng=rng, train=train)
-    logits = logits.astype(jnp.float32)
-    valid = labels >= 0
-    safe_labels = jnp.where(valid, labels, 0)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
-    nll = jnp.where(valid, nll, 0.0)
-    return nll.sum() / jnp.maximum(valid.sum(), 1)
+    x = _trunk(cfg, params, input_ids, rng=rng, train=train)
+    head = jax.checkpoint(lambda p, x, t: _head_loss(cfg, p, x, t),
+                          policy=None)
+    return head(params, x, labels)
 
 
 def tp_rules(cfg: GPT2Config, abstract_params: PyTree) -> PyTree:
@@ -303,12 +327,17 @@ def _embed(cfg: GPT2Config, params, input_ids):
 
 
 def _head_loss(cfg: GPT2Config, params, x, targets):
+    """Final LN + tied head + CE, as ``lse - label_logit`` so no [T, V]
+    log-softmax tensor is ever materialized (XLA fuses the f32 upcast into
+    the reductions)."""
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
-    logits = (x @ params["wte"].T.astype(x.dtype)).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
+    logits = x @ params["wte"].T.astype(x.dtype)
     valid = targets >= 0  # -100 = ignore (HF convention, same as loss_from_batch)
     safe = jnp.where(valid, targets, 0)
-    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logits, safe[..., None],
+                                 axis=-1)[..., 0].astype(jnp.float32)
+    nll = lse - picked
     return jnp.where(valid, nll, 0.0).sum() / jnp.maximum(valid.sum(), 1)
 
 
@@ -325,8 +354,9 @@ def build(cfg: Optional[GPT2Config] = None, **overrides) -> ModelSpec:
         input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
         return forward(cfg, params, input_ids, rng=rng, train=False)
 
-    def block_fn(layer, x):
-        return _block(cfg, x, layer, None, None, 0.0)
+    def block_fn(layer, x, rng=None):
+        return _block(cfg, x, layer, None, rng,
+                      cfg.dropout if rng is not None else 0.0)
 
     pipeline_hooks = {
         "blocks_key": ("blocks",),

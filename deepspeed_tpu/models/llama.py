@@ -310,7 +310,7 @@ def build(cfg: Optional[LlamaConfig] = None, **overrides) -> ModelSpec:
     def pp_embed(params, ids):
         return params["embed"][ids].astype(params["embed"].dtype)
 
-    def pp_block(layer, x):
+    def pp_block(layer, x, rng=None):
         cos, sin = rope_angles(cfg, x.shape[1])
         return block_apply(cfg, layer, x, cos, sin)
 

@@ -1,0 +1,21 @@
+"""module_inject — tensor-parallel "injection" for arbitrary models.
+
+The reference swaps ``torch.nn`` modules for fused/TP-sharded replacements
+(``module_inject/replace_module.py:308``).  On TPU the model is a param pytree
+and compute is compiler-partitioned, so injection reduces to *annotation*:
+derive a ``PartitionSpec`` pytree and let pjit insert the collectives.
+
+ - :func:`auto_tp.infer_tp_specs` — the ``AutoTP`` analog
+   (``module_inject/auto_tp.py:10``): generic column/row classification by
+   name + shape analysis of the pytree, no per-arch policy needed.
+ - :mod:`replace_policy` — the per-architecture policy registry
+   (``module_inject/replace_policy.py:4-28``): HF architecture name ->
+   (config translation, weight conversion, ModelSpec builder).
+"""
+
+from .auto_tp import infer_tp_specs
+from .replace_policy import (HFPolicy, generic_policies, policy_for,
+                             replace_module)
+
+__all__ = ["infer_tp_specs", "HFPolicy", "generic_policies", "policy_for",
+           "replace_module"]

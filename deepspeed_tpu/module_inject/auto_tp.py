@@ -1,0 +1,162 @@
+"""Generic tensor-parallel spec inference over an arbitrary param pytree.
+
+The reference ``AutoTP`` (``module_inject/auto_tp.py:10``) walks the torch
+module tree to find the Linear layers that must become ``LinearAllreduce``
+(row-parallel, followed by an all-reduce) vs ``LinearLayer`` (column-parallel),
+keying off module names and the module *after* them.  The TPU analog walks the
+param pytree: each weight leaf gets a ``PartitionSpec`` placing the ``tp`` axis
+on its column (output) or row (input) dimension; XLA's SPMD partitioner then
+inserts the same all-reduces the reference issues by hand.
+
+Classification, in priority order:
+ 1. **name patterns** — Megatron/HF naming conventions for column
+    (qkv/query/fc1/up/gate...) vs row (out_proj/down/fc2/o_proj...) layers;
+ 2. **shape heuristics** — rectangular [in, out] with out > in is column
+    (expansion), out < in is row (contraction); used only when names don't
+    match any pattern;
+ 3. everything else (norms, biases of row layers, scalars) replicates.
+
+Bias vectors are paired with their weight by key stem so a column-parallel
+weight's bias is sharded on the same axis and a row-parallel layer's bias
+replicates (it is added after the all-reduce, once).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.topology import TP_AXIS
+
+PyTree = Any
+
+# ordered: first match wins. Sources: Megatron naming, HF gpt2/opt/llama/
+# mixtral/bloom/neox/falcon/mpt/t5 layer names, our models/ naming.
+_COLUMN_PATTERNS = (
+    "qkv", "q_proj", "k_proj", "v_proj", "query_key_value", "query", "key",
+    "value", "c_attn", "fc1", "fc_w", "fc_b", "fc_in", "up_proj", "gate_proj",
+    "gate_up", "w1", "w3", "wi", "intermediate", "dense_h_to_4h", "c_fc",
+)
+_ROW_PATTERNS = (
+    "out_proj", "o_proj", "o_w", "o_b", "c_proj", "fc2", "fc_out", "down_proj",
+    "proj_w", "proj_b", "w2", "wo", "dense_4h_to_h", "attention.dense",
+    "self_attn.dense", "attn.dense",
+)
+# vocab-sharded embeddings (reference shards these at inference load,
+# state_dict_factory.py merge/split of word embeddings)
+_EMBED_PATTERNS = ("wte", "embed_tokens", "word_embeddings", "embed_in",
+                   "lm_head", "embed_out", "shared")
+_NEVER_PATTERNS = ("embed_positions", "wpe", "position_embeddings", "norm",
+                   "ln_", "ln1", "ln2", "lnf", "layernorm", "layer_norm",
+                   "scale", "bias_ln", "rotary", "inv_freq", "router",
+                   "gate.w",  # MoE router stays replicated (tiny, all ranks)
+)
+
+
+def _stem(key: str) -> str:
+    """Normalized stem for weight/bias pairing: strip trailing
+    .weight/.bias/_w/_b and lowercase."""
+    k = key.lower()
+    for suf in (".weight", ".bias", "_w", "_b"):
+        if k.endswith(suf):
+            return k[: -len(suf)]
+    return k
+
+
+def _matches(path: str, patterns) -> bool:
+    return any(p in path for p in patterns)
+
+
+def _classify(path: str) -> Optional[str]:
+    """'column' | 'row' | 'embed' | 'never' | None (unknown) for a leaf path."""
+    p = path.lower()
+    if _matches(p, _NEVER_PATTERNS):
+        return "never"
+    if _matches(p, _EMBED_PATTERNS):
+        return "embed"
+    if _matches(p, _COLUMN_PATTERNS):
+        return "column"
+    if _matches(p, _ROW_PATTERNS):
+        return "row"
+    return None
+
+
+def _leaf_paths(tree: PyTree):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        name = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        yield name, path, leaf
+    return
+
+
+def infer_tp_specs(abstract_params: PyTree, num_layers_stacked: bool = True,
+                   tp_axis: str = TP_AXIS,
+                   hints: Optional[Dict[str, str]] = None) -> PyTree:
+    """Derive a PartitionSpec pytree for tensor parallelism.
+
+    ``abstract_params``: pytree of arrays or ShapeDtypeStructs.
+    ``num_layers_stacked``: leaves under a scan-stacked blocks subtree have a
+    leading [L] dim that must never be sharded; detected per-leaf by ndim.
+    ``hints``: optional {substring: 'column'|'row'|'replicate'} overrides.
+
+    Returns a pytree of ``PartitionSpec`` with the same structure.
+    """
+    hints = hints or {}
+
+    # Pass 1: classify every leaf; collect stems so biases follow weights.
+    classes: Dict[str, str] = {}
+    stem_class: Dict[str, str] = {}
+    leaves = list(_leaf_paths(abstract_params))
+    for name, _, leaf in leaves:
+        cls = None
+        for sub, c in hints.items():
+            if sub in name:
+                cls = {"replicate": "never"}.get(c, c)
+                break
+        if cls is None:
+            cls = _classify(name)
+        if cls is None and getattr(leaf, "ndim", 0) >= 2:
+            # shape heuristic on the trailing two dims
+            din, dout = leaf.shape[-2], leaf.shape[-1]
+            if dout > din * 2:
+                cls = "column"
+            elif din > dout * 2:
+                cls = "row"
+        if cls is not None:
+            classes[name] = cls
+            stem_class.setdefault(_stem(name), cls)
+
+    # Pass 2: emit specs. Unknown leaves inherit their stem's class
+    # (bias follows weight), else replicate.
+    def spec_for(name: str, leaf) -> P:
+        cls = classes.get(name) or stem_class.get(_stem(name))
+        nd = getattr(leaf, "ndim", 0)
+        is_bias = name.lower().endswith((".bias", "_b", "/bias"))
+        if cls in (None, "never") or nd == 0:
+            return P()
+        if cls == "embed":
+            # shard the vocab dim (first of the trailing 2)
+            if nd == 1:
+                return P()
+            return P(*([None] * (nd - 2)), tp_axis, None)
+        if cls == "column":
+            # tp on the LAST (output) dim — for bias and weight alike
+            return P(*([None] * (nd - 1)), tp_axis)
+        if cls == "row":
+            # tp on the input dim (second-to-last); a row layer's bias is
+            # added once after the all-reduce, so it replicates — even when
+            # scan-stacked to [L, d]
+            if is_bias or nd == 1:
+                return P()
+            return P(*([None] * (nd - 2)), tp_axis, None)
+        return P()
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(abstract_params)
+    specs = []
+    for (path, leaf), (name, _, _) in zip(flat, leaves):
+        specs.append(spec_for(name, leaf))
+    return jax.tree_util.tree_unflatten(treedef, specs)

@@ -1,0 +1,203 @@
+"""Per-architecture injection policies (reference
+``module_inject/replace_policy.py:4-28`` + ``containers/*``).
+
+A :class:`HFPolicy` maps a HuggingFace architecture to:
+ - a config translation (HF config -> our model config),
+ - a weight conversion (HF state dict -> scan-stacked param pytree),
+ - a ModelSpec builder.
+
+``replace_module(hf_model)`` is the ``replace_transformer_layer`` analog
+(``replace_module.py:308``): given a torch HF model (or its config + state
+dict), returns ``(ModelSpec, params)`` ready for ``init_inference``.  TP
+sharding is applied by the InferenceEngine from the spec's ``tp_rules`` —
+for architectures without a policy, ``auto_tp.infer_tp_specs`` provides the
+generic fallback.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class HFPolicy:
+    arch: str                                  # HF `architectures[0]` name
+    translate_config: Callable[[Any], Any]     # hf config -> our config
+    convert_weights: Callable[[Any, Dict], PyTree]  # (cfg, state_dict) -> params
+    build: Callable[[Any], Any]                # cfg -> ModelSpec
+
+
+def _np(t) -> np.ndarray:
+    return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach") else t,
+                      dtype=np.float32)
+
+
+# ----------------------------------------------------------------- GPT-2
+def _gpt2_translate(hf):
+    from ..models.gpt2 import GPT2Config
+    return GPT2Config(vocab_size=hf.vocab_size, max_seq_len=hf.n_positions,
+                      num_layers=hf.n_layer, num_heads=hf.n_head,
+                      hidden_size=hf.n_embd)
+
+
+def _gpt2_convert(cfg, sd) -> PyTree:
+    def get(name):
+        for prefix in ("transformer.", ""):
+            if prefix + name in sd:
+                return _np(sd[prefix + name])
+        raise KeyError(name)
+
+    l = cfg.num_layers
+
+    def stack(fmt):
+        return jnp.asarray(np.stack([get(fmt.format(i=i)) for i in range(l)]))
+
+    # HF GPT-2 uses Conv1D: weights already [in, out] — no transpose
+    return {
+        "wte": jnp.asarray(get("wte.weight")),
+        "wpe": jnp.asarray(get("wpe.weight")),
+        "blocks": {
+            "ln1_scale": stack("h.{i}.ln_1.weight"),
+            "ln1_bias": stack("h.{i}.ln_1.bias"),
+            "qkv_w": stack("h.{i}.attn.c_attn.weight"),
+            "qkv_b": stack("h.{i}.attn.c_attn.bias"),
+            "o_w": stack("h.{i}.attn.c_proj.weight"),
+            "o_b": stack("h.{i}.attn.c_proj.bias"),
+            "ln2_scale": stack("h.{i}.ln_2.weight"),
+            "ln2_bias": stack("h.{i}.ln_2.bias"),
+            "fc_w": stack("h.{i}.mlp.c_fc.weight"),
+            "fc_b": stack("h.{i}.mlp.c_fc.bias"),
+            "proj_w": stack("h.{i}.mlp.c_proj.weight"),
+            "proj_b": stack("h.{i}.mlp.c_proj.bias"),
+        },
+        "lnf_scale": jnp.asarray(get("ln_f.weight")),
+        "lnf_bias": jnp.asarray(get("ln_f.bias")),
+    }
+
+
+def _gpt2_build(cfg):
+    from ..models import gpt2
+    return gpt2.build(cfg)
+
+
+# ------------------------------------------------------------------- OPT
+def _opt_translate(hf):
+    from ..models.opt import OPTConfig
+    return OPTConfig.from_hf(hf)
+
+
+def _opt_convert(cfg, sd) -> PyTree:
+    from ..models.opt import from_hf_state_dict
+    return from_hf_state_dict(cfg, sd)
+
+
+def _opt_build(cfg):
+    from ..models import opt
+    return opt.build(cfg)
+
+
+# ----------------------------------------------------------------- Llama
+def _llama_translate(hf):
+    from ..models.llama import LlamaConfig
+    return LlamaConfig(
+        vocab_size=hf.vocab_size, max_seq_len=hf.max_position_embeddings,
+        num_layers=hf.num_hidden_layers, num_heads=hf.num_attention_heads,
+        num_kv_heads=hf.num_key_value_heads, hidden_size=hf.hidden_size,
+        ffn_size=hf.intermediate_size,
+        rope_theta=getattr(hf, "rope_theta", 10000.0))
+
+
+def _llama_convert(cfg, sd) -> PyTree:
+    def get(name):
+        for prefix in ("model.", ""):
+            if prefix + name in sd:
+                return _np(sd[prefix + name])
+        raise KeyError(name)
+
+    l = cfg.num_layers
+
+    def stack(fmt, transpose=True):
+        rows = [get(fmt.format(i=i)) for i in range(l)]
+        return jnp.asarray(np.stack([r.T if transpose else r for r in rows]))
+
+    if "lm_head.weight" in sd:
+        lm_head = jnp.asarray(_np(sd["lm_head.weight"]).T)
+    else:  # tied
+        lm_head = jnp.asarray(get("embed_tokens.weight").T)
+    return {
+        "embed": jnp.asarray(get("embed_tokens.weight")),
+        "blocks": {
+            "attn_norm": stack("layers.{i}.input_layernorm.weight",
+                               transpose=False),
+            "q_w": stack("layers.{i}.self_attn.q_proj.weight"),
+            "k_w": stack("layers.{i}.self_attn.k_proj.weight"),
+            "v_w": stack("layers.{i}.self_attn.v_proj.weight"),
+            "o_w": stack("layers.{i}.self_attn.o_proj.weight"),
+            "mlp_norm": stack("layers.{i}.post_attention_layernorm.weight",
+                              transpose=False),
+            "w1": stack("layers.{i}.mlp.gate_proj.weight"),
+            "w3": stack("layers.{i}.mlp.up_proj.weight"),
+            "w2": stack("layers.{i}.mlp.down_proj.weight"),
+        },
+        "final_norm": jnp.asarray(get("norm.weight")),
+        "lm_head": lm_head,
+    }
+
+
+def _llama_build(cfg):
+    from ..models import llama
+    return llama.build(cfg)
+
+
+_POLICIES: Dict[str, HFPolicy] = {}
+
+
+def _register(arch, translate, convert, build):
+    _POLICIES[arch.lower()] = HFPolicy(arch, translate, convert, build)
+
+
+_register("GPT2LMHeadModel", _gpt2_translate, _gpt2_convert, _gpt2_build)
+_register("OPTForCausalLM", _opt_translate, _opt_convert, _opt_build)
+_register("LlamaForCausalLM", _llama_translate, _llama_convert, _llama_build)
+
+
+def generic_policies():
+    return list(_POLICIES.values())
+
+
+def policy_for(model_or_config) -> Optional[HFPolicy]:
+    """Look up the policy for a HF model/config by its architecture name."""
+    cfg = getattr(model_or_config, "config", model_or_config)
+    archs = getattr(cfg, "architectures", None) or []
+    cls_name = type(model_or_config).__name__
+    for name in list(archs) + [cls_name]:
+        pol = _POLICIES.get(str(name).lower())
+        if pol is not None:
+            return pol
+    return None
+
+
+def replace_module(hf_model=None, config=None, state_dict=None):
+    """HF model -> (ModelSpec, params) (reference ``replace_module.py:308``).
+
+    Pass either a torch HF model, or its ``config`` + ``state_dict``.
+    """
+    if hf_model is not None:
+        config = hf_model.config
+        state_dict = hf_model.state_dict()
+    assert config is not None and state_dict is not None
+    pol = policy_for(hf_model if hf_model is not None else config)
+    if pol is None:
+        archs = getattr(config, "architectures", None)
+        raise ValueError(
+            f"no injection policy for architecture {archs}; supported: "
+            f"{sorted(p.arch for p in _POLICIES.values())}")
+    cfg = pol.translate_config(config)
+    params = pol.convert_weights(cfg, dict(state_dict))
+    return pol.build(cfg), params

@@ -53,10 +53,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, ...].astype(jnp.float32)  # [bq, d]
-        k = k_ref[0, ...].astype(jnp.float32)  # [bk, d]
-        v = v_ref[0, ...].astype(jnp.float32)  # [bk, d]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * sm_scale
+        # keep native (bf16) dtype into the MXU; f32 comes out via
+        # preferred_element_type — f32 MXU inputs run at 1/8 rate on v5e
+        q = q_ref[0, ...]  # [bq, d]
+        k = k_ref[0, ...]  # [bk, d]
+        v = v_ref[0, ...]  # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
 
         col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32,
                                                       (block_q, block_k), 1)
@@ -75,7 +79,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         l_prev = l_scr[...][:, :1]
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())))
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -89,11 +94,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
 
 
 def _fwd(q, k, v, sm_scale: float, causal: bool, block_q: int, block_k: int,
-         interpret: bool, true_kv_len: int):
+         interpret: bool, true_kv_len: int, head_rep: int = 1):
+    """``head_rep``: GQA ratio — q has ``bh`` leading entries, k/v have
+    ``bh // head_rep``; the KV index map divides so repeated heads read the
+    same KV block in place (no ``jnp.repeat`` materialization)."""
     bh, q_len, d = q.shape
     kv_len = true_kv_len  # mask out padded keys beyond the real length
     nq = pl.cdiv(q_len, block_q)
     nk = pl.cdiv(kv_len, block_k)
+    rep = head_rep
 
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                                block_q=block_q, block_k=block_k, kv_len=kv_len,
@@ -107,8 +116,8 @@ def _fwd(q, k, v, sm_scale: float, causal: bool, block_q: int, block_k: int,
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // rep, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // rep, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -144,14 +153,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, ...].astype(jnp.float32)
-        k = k_ref[0, ...].astype(jnp.float32)
-        v = v_ref[0, ...].astype(jnp.float32)
-        do = do_ref[0, ...].astype(jnp.float32)
+        q = q_ref[0, ...]
+        k = k_ref[0, ...]
+        v = v_ref[0, ...]
+        do = do_ref[0, ...]
         lse = lse_ref[0, ...][:, :1]      # [bq, 1]
         delta = delta_ref[0, ...][:, :1]  # [bq, 1]
 
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * sm_scale
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
         col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32,
                                                       (block_q, block_k), 1)
         mask = col < kv_len
@@ -160,9 +171,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                 jnp.int32, (block_q, block_k), 0)
             mask = jnp.logical_and(mask, row >= col)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
-        dq_scr[...] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())))
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(ki == num_k_blocks - 1)
     def _finalize():
@@ -171,11 +185,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
                     dv_ref, dk_scr, dv_scr, *, sm_scale: float, causal: bool,
-                    block_q: int, block_k: int, kv_len: int, num_q_blocks: int):
+                    block_q: int, block_k: int, kv_len: int, num_q_blocks: int,
+                    rep: int = 1):
+    """Inner grid dim 2 runs over (head_rep, q_blocks) flattened: for GQA the
+    dk/dv of one KV head accumulates contributions from all ``rep`` query
+    heads without materializing repeated K/V."""
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    inner = pl.program_id(2)
+    qi = inner % num_q_blocks
 
-    @pl.when(qi == 0)
+    @pl.when(inner == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
@@ -184,14 +203,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, ...].astype(jnp.float32)
-        k = k_ref[0, ...].astype(jnp.float32)
-        v = v_ref[0, ...].astype(jnp.float32)
-        do = do_ref[0, ...].astype(jnp.float32)
+        q = q_ref[0, ...]
+        k = k_ref[0, ...]
+        v = v_ref[0, ...]
+        do = do_ref[0, ...]
         lse = lse_ref[0, ...][:, :1]
         delta = delta_ref[0, ...][:, :1]
 
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * sm_scale
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
         col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32,
                                                       (block_q, block_k), 1)
         mask = col < kv_len
@@ -200,19 +221,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
                 jnp.int32, (block_q, block_k), 0)
             mask = jnp.logical_and(mask, row >= col)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)                 # [bq, bk]
-        dv_scr[...] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale                           # [bq, bk]
-        dk_scr[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    @pl.when(qi == num_q_blocks - 1)
+    @pl.when(inner == rep * num_q_blocks - 1)
     def _finalize():
         dk_ref[0, ...] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0, ...] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _bwd_dq_call(q, k, v, do, lse_b, delta_b, *, sm_scale, causal, block_q,
-                 block_k, kv_len, interpret):
+                 block_k, kv_len, interpret, head_rep: int = 1):
     """dq for one (q-chunk, kv-chunk) pair given *global* lse/delta.
 
     Exposed separately so ring attention (parallel/sequence.py) can reuse the
@@ -221,6 +247,7 @@ def _bwd_dq_call(q, k, v, do, lse_b, delta_b, *, sm_scale, causal, block_q,
     bh, q_len, d = q.shape
     nq = pl.cdiv(q_len, block_q)
     nk = pl.cdiv(kv_len, block_k)
+    rep = head_rep
     dq_kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
                                   causal=causal, block_q=block_q,
                                   block_k=block_k, kv_len=kv_len,
@@ -230,8 +257,8 @@ def _bwd_dq_call(q, k, v, do, lse_b, delta_b, *, sm_scale, causal, block_q,
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // rep, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // rep, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
@@ -247,25 +274,32 @@ def _bwd_dq_call(q, k, v, do, lse_b, delta_b, *, sm_scale, causal, block_q,
 
 
 def _bwd_dkv_call(q, k, v, do, lse_b, delta_b, *, sm_scale, causal, block_q,
-                  block_k, kv_len, interpret):
-    """dk, dv for one (q-chunk, kv-chunk) pair given *global* lse/delta."""
-    bh, q_len, d = q.shape
+                  block_k, kv_len, interpret, head_rep: int = 1):
+    """dk, dv for one (q-chunk, kv-chunk) pair given *global* lse/delta.
+
+    For GQA (``head_rep > 1``) q/do/lse/delta have ``rep`` times more heads
+    than k/v; the inner grid walks (rep, q_blocks) and accumulates into the
+    single KV head's dk/dv."""
+    bh_kv = k.shape[0]
+    q_len, d = q.shape[1], q.shape[2]
+    rep = head_rep
     nq = pl.cdiv(q_len, block_q)
     nk = pl.cdiv(kv_len, block_k)
     dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
                                    causal=causal, block_q=block_q,
                                    block_k=block_k, kv_len=kv_len,
-                                   num_q_blocks=nq)
+                                   num_q_blocks=nq, rep=rep)
+    q_map = lambda b, j, i: (b * rep + i // nq, i % nq, 0)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(bh, nk, nq),
+        grid=(bh_kv, nk, rep * nq),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), q_map),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, block_q, LANES), q_map),
+            pl.BlockSpec((1, block_q, LANES), q_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -286,7 +320,7 @@ def _bwd_dkv_call(q, k, v, do, lse_b, delta_b, *, sm_scale, causal, block_q,
     return dk, dv
 
 
-def _bwd(sm_scale, causal, block_q, block_k, interpret, true_kv_len,
+def _bwd(sm_scale, causal, block_q, block_k, interpret, true_kv_len, head_rep,
          residuals, g):
     q, k, v, o, lse = residuals
     do = g
@@ -297,7 +331,8 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, true_kv_len,
     delta_b = jnp.broadcast_to(delta[..., None], delta.shape + (LANES,))
 
     kw = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
-              block_k=block_k, kv_len=kv_len, interpret=interpret)
+              block_k=block_k, kv_len=kv_len, interpret=interpret,
+              head_rep=head_rep)
     dq = _bwd_dq_call(q, k, v, do, lse_b, delta_b, **kw)
     dk, dv = _bwd_dkv_call(q, k, v, do, lse_b, delta_b, **kw)
     return dq, dk, dv
@@ -306,25 +341,25 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, true_kv_len,
 # ---------------------------------------------------------------------------
 # public op
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash_attention_bh(q, k, v, sm_scale, causal, block_q, block_k, interpret,
-                        true_kv_len):
+                        true_kv_len, head_rep):
     o, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
-                true_kv_len)
+                true_kv_len, head_rep)
     return o
 
 
 def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, interpret,
-                    true_kv_len):
+                    true_kv_len, head_rep):
     o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
-                  true_kv_len)
+                  true_kv_len, head_rep)
     return o, (q, k, v, o, lse)
 
 
 def _flash_bwd_rule(sm_scale, causal, block_q, block_k, interpret, true_kv_len,
-                    res, g):
+                    head_rep, res, g):
     return _bwd(sm_scale, causal, block_q, block_k, interpret, true_kv_len,
-                res, g)
+                head_rep, res, g)
 
 
 _flash_attention_bh.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -344,9 +379,7 @@ def flash_attention(q, k, v, causal: bool = True,
     hkv = k.shape[1]
     if hkv != h:
         assert h % hkv == 0, f"GQA needs num_heads {h} % kv_heads {hkv} == 0"
-        rep = h // hkv
-        k = jnp.repeat(k, rep, axis=1)
-        v = jnp.repeat(v, rep, axis=1)
+    rep = h // hkv  # repeated heads read KV blocks in place via the index map
     kv_len = k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
@@ -360,11 +393,11 @@ def flash_attention(q, k, v, causal: bool = True,
     vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else v
 
     qf = qp.reshape(b * h, q_len + pad_q, d)
-    kf = kp.reshape(b * h, kv_len + pad_k, d)
-    vf = vp.reshape(b * h, kv_len + pad_k, d)
+    kf = kp.reshape(b * hkv, kv_len + pad_k, d)
+    vf = vp.reshape(b * hkv, kv_len + pad_k, d)
     # kv_len for masking must be the real length: padded keys get masked out
     o = _flash_attention_bh(qf, kf, vf, sm_scale, causal, block_q, block_k,
-                            interpret, kv_len)
+                            interpret, kv_len, rep)
     o = o.reshape(b, h, q_len + pad_q, d)
     if pad_q:
         o = o[:, :, :q_len, :]

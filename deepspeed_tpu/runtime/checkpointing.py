@@ -113,11 +113,19 @@ class CheckpointManager:
         self.checkpoint_engine.makedirs(ckpt_dir)
 
         self.checkpoint_engine.save(engine.state, os.path.join(ckpt_dir, "state"))
-        if getattr(engine, "_offload_opt", None) is not None and \
+        if getattr(engine, "_offload_opt", None) is not None:
+            # host-side optimizer partition (ZeRO-Offload/Infinity tier):
+            # every process saves ITS ZeRO partition (reference writes
+            # per-rank ``zero_pp_rank_*_optim_states.pt`` the same way)
+            np.savez(os.path.join(
+                ckpt_dir, f"offload_optimizer.p{jax.process_index()}.npz"),
+                **engine._offload_opt.state_dict())
+        if getattr(engine, "_block_opt", None) is not None and \
                 jax.process_index() == 0:
-            # host-side optimizer partition (ZeRO-Offload/Infinity tier)
-            np.savez(os.path.join(ckpt_dir, "offload_optimizer.npz"),
-                     **engine._offload_opt.state_dict())
+            # streamed block params: fp32 master + moments (param-stream tier;
+            # single-controller, so process 0 owns the whole store)
+            np.savez(os.path.join(ckpt_dir, "offload_blocks.npz"),
+                     **engine._block_opt.state_dict())
         meta = {
             "tag": str(tag),
             "global_steps": engine.global_steps,
@@ -177,20 +185,39 @@ class CheckpointManager:
                 os.path.join(ckpt_dir, "state"), abstract_target=abstract)
 
         if getattr(engine, "_offload_opt", None) is not None:
-            # re-sync the host master partition with the restored params,
-            # then overlay saved moments/master when present
-            leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(
-                jax.device_get(engine.state["params"]))]
-            for leaf, off, size in zip(leaves,
-                                       engine._offload_opt.offsets[:-1],
-                                       engine._offload_opt.sizes):
+            # re-sync this process's host master partition with the restored
+            # params (grad/ZeRO-partition layout), then overlay saved
+            # moments/master when present
+            partitioned = engine.to_grad_layout(engine.state["params"])
+            pieces = engine._offload_pieces_of(partitioned)
+            for piece, off, size in zip(pieces,
+                                        engine._offload_opt.offsets[:-1],
+                                        engine._offload_opt.sizes):
                 engine._offload_opt.master[off:off + size] = \
-                    leaf.reshape(-1).astype(np.float32)
-            off_path = os.path.join(ckpt_dir, "offload_optimizer.npz")
+                    np.asarray(piece, np.float32).reshape(-1)
+            off_path = os.path.join(
+                ckpt_dir, f"offload_optimizer.p{jax.process_index()}.npz")
+            if not os.path.isfile(off_path) and jax.process_count() == 1:
+                # round-1 checkpoints used the unsuffixed name
+                off_path = os.path.join(ckpt_dir, "offload_optimizer.npz")
             if load_optimizer_states and not load_module_only and \
                     os.path.isfile(off_path):
                 with np.load(off_path) as z:
                     engine._offload_opt.load_state_dict(dict(z))
+
+        if getattr(engine, "_block_opt", None) is not None:
+            blk_path = os.path.join(ckpt_dir, "offload_blocks.npz")
+            if os.path.isfile(blk_path):
+                with np.load(blk_path) as z:
+                    sd = dict(z)
+                if load_optimizer_states and not load_module_only:
+                    engine._block_opt.load_state_dict(sd)
+                else:
+                    # module-only load: restore the master weights but keep
+                    # fresh moments/step counts (matches the resident gating)
+                    engine._block_opt.master[:] = sd["master"]
+                engine._param_store.master = engine._block_opt.param_leaves()
+                engine._param_store.refresh_compute()
 
         engine.global_steps = int(meta.get("global_steps", 0))
         engine.global_samples = int(meta.get("global_samples", 0))

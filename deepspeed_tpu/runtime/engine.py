@@ -146,6 +146,34 @@ class DeepSpeedEngine:
                 "(adam/adamw/adagrad) — client optax transformations cannot "
                 "run on host (reference: offload needs DeepSpeedCPUAdam)")
 
+        # ZeRO-Infinity parameter streaming: block params stay host-resident
+        # and stream through io_callback per scan step (zero/param_stream.py)
+        offp = self._config.zero_config.offload_param
+        self.param_stream_enabled = bool(offp is not None and
+                                         offp.device.value != "none")
+        self._param_store = None
+        self._block_opt = None
+        if self.param_stream_enabled:
+            if not self.offload_enabled:
+                raise ValueError(
+                    "offload_param requires offload_optimizer too: streamed "
+                    "block gradients are accumulated on host and must be "
+                    "stepped by the host optimizer (reference ZeRO-Infinity "
+                    "couples param+optimizer NVMe tiers, zero/stage3.py:486)")
+            if model.pipeline_hooks is None:
+                raise ValueError(
+                    "offload_param needs a block-structured model "
+                    "(ModelSpec.pipeline_hooks) so layers can stream "
+                    "one scan step at a time")
+            assert jax.process_count() == 1, (
+                "param streaming is single-controller for now (multi-host "
+                "needs a host-side grad reduction)")
+            if self.topology.pipe_parallel_size > 1:
+                raise ValueError(
+                    "offload_param with pp>1 is unsupported: the pipeline "
+                    "engine shards the block params the streaming tier "
+                    "removes from device state")
+
         # schedules and optimizer
         self._configure_lr_schedule()
         self._configure_optimizer()
@@ -271,6 +299,11 @@ class DeepSpeedEngine:
             delayed_shift=self._config.dynamic_loss_scale_args["delayed_shift"])
 
     def _build_state(self) -> None:
+        if self.param_stream_enabled:
+            self._build_state_streamed()
+            self._init_offload_optimizer()
+            return
+
         def init_state(rng):
             params = self.model_spec.init(rng)
             params = _cast_floating(params, jnp.float32)  # fp32 master weights
@@ -305,31 +338,225 @@ class DeepSpeedEngine:
         log_dist(f"initialized {n_params/1e6:.2f}M parameters", ranks=[0])
 
         if self.offload_enabled:
-            from .zero.offload import HostOffloadOptimizer
+            self._init_offload_optimizer()
 
-            assert jax.process_count() == 1, (
-                "optimizer offload is single-controller for now (per-host "
-                "partitioned offload is future work)")
-            off = self._config.zero_config.offload_optimizer
-            leaves = [np.asarray(x) for x in
-                      jax.tree_util.tree_leaves(jax.device_get(
-                          self.state["params"]))]
-            self._offload_opt = HostOffloadOptimizer(
-                leaves,
-                self._config.optimizer_name or "adam",
-                self._config.optimizer_params or {},
-                device=off.device.value,
-                nvme_path=off.nvme_path,
-                sub_group_size=self._config.zero_config.sub_group_size)
-            log_dist(
-                f"optimizer offload -> {off.device.value} "
-                f"({self._offload_opt.total/1e6:.2f}M elements, "
-                f"native={self._offload_opt.opt.__class__.__name__})",
-                ranks=[0])
+    # ------------------------------------------------- ZeRO-Infinity streaming
+    def _pp_blocks_path(self) -> tuple:
+        key = self.model_spec.pipeline_hooks["blocks_key"]
+        return (key,) if isinstance(key, str) else tuple(key)
+
+    def _build_state_streamed(self) -> None:
+        """offload_param: init params on HOST, keep the stacked blocks in a
+        StreamedParamStore (device HBM never holds more than one layer of
+        them), device state holds only the small resident params — the
+        persistence-threshold analog (``parameter_offload.py:316``)."""
+        from .zero.param_stream import StreamedParamStore
+
+        path = self._pp_blocks_path()
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            params_full = jax.jit(
+                lambda r: _cast_floating(self.model_spec.init(r),
+                                         jnp.float32))(self._init_rng)
+        params_full = jax.device_get(params_full)
+        node = params_full
+        for k in path[:-1]:
+            node = node[k]
+        blocks = node[path[-1]]
+        self._param_store = StreamedParamStore(blocks, self.compute_dtype)
+        node[path[-1]] = {}  # resident tree: blocks live host-side only
+        resident = params_full
+
+        if self.model_spec.tp_rules is not None:
+            log_dist("offload_param: ignoring tp_rules — streamed blocks are "
+                     "replicated (TP over streamed layers is future work)",
+                     ranks=[0])
+        self.tp_specs = None
+        abstract = jax.eval_shape(lambda: resident)
+        self._abstract_params = abstract
+        rep = NamedSharding(self.mesh, P())
+        self.state_shardings = {
+            "step": rep,
+            "params": self.zero_plan.param_shardings(abstract, None),
+            "opt_state": (),
+            "scaler": jax.tree_util.tree_map(
+                lambda _: rep, jax.eval_shape(self._scaler_init)),
+        }
+        self.grad_shardings = self.zero_plan.grad_shardings(abstract, None)
+        with self.mesh:
+            state_host = {
+                "step": jnp.zeros((), jnp.int32),
+                "params": resident,
+                "opt_state": (),
+                "scaler": self._scaler_init(),
+            }
+            self.state = jax.device_put(state_host, self.state_shardings)
+        n_res = sum(x.size for x in
+                    jax.tree_util.tree_leaves(self.state["params"]))
+        n_blk = sum(m.size for m in self._param_store.master)
+        log_dist(
+            f"param streaming: {n_blk/1e6:.2f}M block params host-resident, "
+            f"{n_res/1e6:.2f}M resident on device", ranks=[0])
+
+        # block-master optimizer on host; adopt its flat buffer as the store's
+        # master so updates land in place
+        from .zero.offload import HostOffloadOptimizer
+
+        off = self._config.zero_config.offload_optimizer
+        self._block_opt = HostOffloadOptimizer(
+            self._param_store.master,
+            self._config.optimizer_name or "adam",
+            self._config.optimizer_params or {},
+            device=off.device.value,
+            nvme_path=off.nvme_path,
+            sub_group_size=self._config.zero_config.sub_group_size)
+        self._param_store.master = self._block_opt.param_leaves()
+        self._param_store.refresh_compute()
+
+    def _streamed_loss_fn(self):
+        """Loss over streamed blocks: embed/head use resident params; the
+        scan body fetches one layer from host per step and is checkpointed so
+        the backward re-fetches instead of saving L layers of weights."""
+        import inspect
+
+        hooks = self.model_spec.pipeline_hooks
+        embed_fn, block_fn = hooks["embed_fn"], hooks["block_fn"]
+        head_loss_fn = hooks["head_loss_fn"]
+        dropout = float(hooks.get("dropout", 0.0) or 0.0)
+        if dropout > 0.0:
+            raise ValueError(
+                "offload_param does not support dropout yet (the streamed "
+                "block vjp would need the rng threaded through its "
+                "residuals); set dropout=0")
+        store = self._param_store
+        L = store.num_layers
+        if len(inspect.signature(block_fn).parameters) >= 3:
+            call_block = lambda layer, x: block_fn(layer, x, None)
+        else:
+            call_block = block_fn
+        apply_streamed = store.streamed_block(call_block)
+
+        def loss_fn(params, batch, rng, train):
+            if isinstance(batch, dict) and batch.get("labels") is not None:
+                inputs, targets = batch["input_ids"], batch["labels"]
+            else:
+                ids = batch["input_ids"] if isinstance(batch, dict) else batch
+                inputs, targets = ids[:, :-1], ids[:, 1:]
+            x = embed_fn(params, inputs)
+
+            def body(x, i):
+                return apply_streamed(i, x), None
+
+            x, _ = jax.lax.scan(body, x, jnp.arange(L))
+            return head_loss_fn(params, x, targets)
+
+        return loss_fn
+
+    # ------------------------------------------------- partitioned host offload
+    def to_grad_layout(self, params):
+        """Reshard a params-shaped pytree into the grad (ZeRO partition)
+        layout — one cached jitted identity, shared by offload init,
+        checkpoint resync, and tests."""
+        if not hasattr(self, "_to_grad_layout_fn"):
+            self._to_grad_layout_fn = jax.jit(
+                lambda p: p, out_shardings=self.grad_shardings)
+        with self.mesh:
+            return self._to_grad_layout_fn(params)
+
+    @staticmethod
+    def _piece_key(index) -> tuple:
+        """Hashable key for a shard's index tuple (slices)."""
+        return tuple((s.start or 0, s.stop) for s in index)
+
+    def _local_pieces(self, arr) -> list:
+        """Unique (key, np.ndarray) pieces of this process's shards, sorted.
+
+        Replicated leaves dedupe to one piece; ZeRO-sharded leaves yield this
+        process's partitions — the host-side analog of the reference's
+        per-rank flat partition (``stage_1_and_2.py:102``)."""
+        seen = {}
+        for sh in arr.addressable_shards:
+            key = self._piece_key(sh.index)
+            if key not in seen:
+                seen[key] = np.asarray(sh.data)
+        return sorted(seen.items())
+
+    def _init_offload_optimizer(self) -> None:
+        """Partitioned host offload: every process owns the master/moments of
+        its ZeRO partition (the grad sharding), updates it with the C++ CPU
+        optimizer, and the updated partitions reshard back to the param layout
+        through a jitted identity — XLA emits the all-gather the reference
+        issues by hand after the offloaded step (``stage_1_and_2.py:1772``).
+        Works multi-process: no ``process_count == 1`` restriction."""
+        from .zero.offload import HostOffloadOptimizer
+
+        off = self._config.zero_config.offload_optimizer
+        # reshard the fp32 params into the grad (ZeRO partition) layout once;
+        # each process then extracts its local pieces
+        partitioned = self.to_grad_layout(self.state["params"])
+        flat_parts, _ = jax.tree_util.tree_flatten(partitioned)
+        self._offload_piece_keys = []
+        init_pieces = []
+        for leaf in flat_parts:
+            items = self._local_pieces(leaf)
+            self._offload_piece_keys.append([k for k, _ in items])
+            init_pieces.extend(v for _, v in items)
+        self._offload_opt = HostOffloadOptimizer(
+            init_pieces,
+            self._config.optimizer_name or "adam",
+            self._config.optimizer_params or {},
+            device=off.device.value,
+            nvme_path=off.nvme_path,
+            sub_group_size=self._config.zero_config.sub_group_size)
+        # updated partitions -> param layout (replicates/allgathers as needed)
+        self._offload_gather_fn = jax.jit(
+            lambda p: p, out_shardings=self.state_shardings["params"],
+            donate_argnums=(0,))
+        log_dist(
+            f"optimizer offload -> {off.device.value} "
+            f"({self._offload_opt.total/1e6:.2f}M local elements, "
+            f"native={self._offload_opt.opt.__class__.__name__}, "
+            f"process {jax.process_index()}/{jax.process_count()})",
+            ranks=[0])
+
+    def _offload_pieces_of(self, tree) -> list:
+        """Flatten a (grad-sharded) pytree into this process's pieces, in the
+        same order as the host optimizer's layout."""
+        pieces = []
+        for leaf, keys in zip(jax.tree_util.tree_leaves(tree),
+                              self._offload_piece_keys):
+            items = dict(self._local_pieces(leaf))
+            pieces.extend(items[k] for k in keys)
+        return pieces
+
+    def _offload_rebuild_params(self, new_pieces: list):
+        """Reassemble updated partitions into sharded jax arrays (grad layout)
+        then reshard to the param layout on device."""
+        flat_abs, treedef = jax.tree_util.tree_flatten(self._abstract_params)
+        flat_specs = jax.tree_util.tree_leaves(
+            self.grad_shardings, is_leaf=lambda x: hasattr(x, "spec"))
+        arrays = []
+        i = 0
+        for leaf_abs, spec, keys in zip(flat_abs, flat_specs,
+                                        self._offload_piece_keys):
+            by_key = {k: np.asarray(new_pieces[i + j], np.float32)
+                      for j, k in enumerate(keys)}
+            i += len(keys)
+            dev_map = spec.addressable_devices_indices_map(leaf_abs.shape)
+            bufs = [jax.device_put(by_key[self._piece_key(idx)], d)
+                    for d, idx in dev_map.items()]
+            arrays.append(jax.make_array_from_single_device_arrays(
+                leaf_abs.shape, spec, bufs))
+        partitioned = jax.tree_util.tree_unflatten(treedef, arrays)
+        with self.mesh:
+            return self._offload_gather_fn(partitioned)
 
     # --------------------------------------------------------------- step fns
     def _micro_loss_closure(self):
-        loss_fn = self.model_spec.loss_fn
+        loss_fn = (self._streamed_loss_fn() if self.param_stream_enabled
+                   else self.model_spec.loss_fn)
+        self._loss_impl = loss_fn  # eval shares it (streamed blocks strip
+        # params["blocks"], so model_spec.loss_fn would not trace there)
         compute_dtype = self.compute_dtype
         cast = self.fp16_enabled or self.bfloat16_enabled
 
@@ -461,12 +688,15 @@ class DeepSpeedEngine:
 
         clip = self._config.gradient_clipping
         next_scaler, make_metrics = self._scaler_bookkeeping()
+        self._next_scaler = next_scaler  # host-side reuse (param streaming)
 
         def offload_finish(state, grads, mean_loss):
             """Clip + overflow + scaler bookkeeping for grads headed to the
-            host optimizer (grads already unscaled/averaged)."""
+            host optimizer (grads already unscaled/averaged).  Under param
+            streaming, clipping moves to the host where the streamed block
+            grads can contribute to the global norm."""
             grad_norm = optax.global_norm(grads)
-            if clip:
+            if clip and not self.param_stream_enabled:
                 factor = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
                 grads = jax.tree_util.tree_map(lambda g: g * factor, grads)
             overflow = has_overflow(grads) if fp16 else jnp.asarray(False)
@@ -494,7 +724,7 @@ class DeepSpeedEngine:
         def eval_step(params, batch, base_rng):
             p = (_cast_floating(params, self.compute_dtype)
                  if (self.fp16_enabled or self.bfloat16_enabled) else params)
-            return self.model_spec.loss_fn(p, batch, base_rng, False)
+            return self._loss_impl(p, batch, base_rng, False)
 
         rep = NamedSharding(self.mesh, P())
         metrics_shardings = self._metrics_shardings()
@@ -625,15 +855,56 @@ class DeepSpeedEngine:
 
     def _host_apply(self, state, grads, partial, metrics):
         new_params = state["params"]
-        if not (self.fp16_enabled and bool(jax.device_get(metrics["overflow"]))):
-            grad_leaves = [np.asarray(g) for g in
-                           jax.tree_util.tree_leaves(jax.device_get(grads))]
-            new_leaves = self._offload_opt.step(grad_leaves,
+        overflow = self.fp16_enabled and bool(
+            jax.device_get(metrics["overflow"]))
+        if self.param_stream_enabled:
+            # join in-flight grad-push io_callbacks before reading the host
+            # accumulator — array readiness does not imply callback completion
+            jax.effects_barrier()
+            # scale/average the host-accumulated block grads exactly like the
+            # in-graph path did for the resident grads
+            scale = (float(jax.device_get(state["scaler"].cur_scale))
+                     if self.fp16_enabled else 1.0)
+            factor = 1.0 / (self.gradient_accumulation_steps() * scale)
+            block_grads = self._param_store.pop_grads()
+            for g in block_grads:
+                g *= factor
+            if self.fp16_enabled and not overflow:
+                block_overflow = not all(np.isfinite(g).all()
+                                         for g in block_grads)
+                if block_overflow:
+                    # the in-graph scaler bookkeeping saw only resident grads
+                    # and advanced as a successful step; redo it with
+                    # overflow=True so the scale backs off (no livelock)
+                    overflow = True
+                    new_scaler = self._next_scaler(state["scaler"],
+                                                   jnp.asarray(True))
+                    partial = dict(partial)
+                    partial["scaler"] = new_scaler
+                    metrics = dict(metrics)
+                    metrics["overflow"] = np.asarray(True)
+                    metrics["loss_scale"] = new_scaler.cur_scale
+                    metrics["skipped"] = new_scaler.skipped
+        if not overflow:
+            grad_pieces = [np.array(p, np.float32) for p in
+                           self._offload_pieces_of(grads)]
+            if self.param_stream_enabled:
+                # host-side global clip across resident + streamed grads
+                clip = self._config.gradient_clipping
+                sq = sum(float(np.vdot(p, p)) for p in grad_pieces) + \
+                    sum(float(np.vdot(g, g)) for g in block_grads)
+                total_norm = float(np.sqrt(sq))
+                if clip:
+                    c = min(1.0, clip / (total_norm + 1e-6))
+                    for p in grad_pieces:
+                        p *= c
+                    for g in block_grads:
+                        g *= c
+                self._block_opt.step(block_grads, lr=self._host_lr())
+                self._param_store.refresh_compute()
+            new_pieces = self._offload_opt.step(grad_pieces,
                                                 lr=self._host_lr())
-            treedef = jax.tree_util.tree_structure(state["params"])
-            new_params = jax.device_put(
-                jax.tree_util.tree_unflatten(treedef, new_leaves),
-                self.state_shardings["params"])
+            new_params = self._offload_rebuild_params(new_pieces)
         new_state = {
             "step": partial["step"],
             "params": new_params,

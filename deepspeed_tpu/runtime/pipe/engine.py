@@ -1,31 +1,35 @@
-"""Pipeline-parallel engine.
+"""Pipeline-parallel engine — lockstep 1F1B under SPMD.
 
-Analog of reference ``runtime/pipe/engine.py:37`` (``PipelineEngine``), built the
-TPU way.  The reference runs a host-driven 1F1B instruction stream
-(``TrainSchedule``) issuing p2p sends/recvs between stage processes.  Under XLA
-SPMD the whole pipeline is ONE jitted program:
+Analog of reference ``runtime/pipe/engine.py:37`` (``PipelineEngine``).  The
+reference runs a host-driven 1F1B instruction stream (``TrainSchedule``)
+issuing p2p sends/recvs between stage processes.  Here the whole pipeline is
+ONE jitted program executing the same 1F1B schedule as closed-form tick rules
+(``pipe/schedule.py``):
 
- - the model's stacked block params ``[L, ...]`` are sharded over the ``pp`` mesh
-   axis (dim 0), viewed as ``[PP, F, ...]`` — each stage holds F = L/PP layers;
- - a ``lax.scan`` over T = M + PP - 1 ticks rotates microbatch activations
-   through the stages: every tick, ``vmap`` applies each stage's layers to its
-   current activation (XLA partitions the vmapped dim over ``pp``), then the
-   activation buffer rolls by one stage — compiled to a ``collective_permute``
-   over ICI, the analog of the reference's ``p2p.send/recv`` pairs
-   (``pipe/p2p.py:48/:70``);
- - stage 0 ingests a fresh microbatch each tick (``LoadMicroBatch``), the last
-   stage computes the loss for the microbatch that just drained;
- - autodiff through the scan produces the backward pipeline (reverse rotation),
-   and the optimizer update reuses the shared ``apply_update`` closure, so ZeRO /
-   fp16 / clipping semantics are identical to the DP engine.
+ - the model's stacked block params ``[L, ...]`` are sharded over the ``pp``
+   mesh axis (dim 0), viewed as ``[PP, F, ...]`` — each stage holds
+   F = L/PP layers;
+ - a ``lax.scan`` over T = M + 2*(PP-1) ticks runs, per tick, one forward
+   *and one backward* phase on every stage (different in-flight microbatches,
+   per the schedule's tick rules).  Forward activations rotate down the
+   stages, backward cotangents rotate up — each a ``collective_permute``
+   over ICI (the p2p analog);
+ - the backward phase re-runs the stage forward under ``jax.vjp`` from a
+   stashed stage *input* (activation recompute, the reference's activation
+   checkpointing posture), so a stage stores only the inputs of in-flight
+   microbatches: **O(PP) activation liveness, independent of M** — the 1F1B
+   memory property the GPipe-shaped round-1 engine lacked;
+ - per-(microbatch, layer) RNG keys are threaded into the blocks, so
+   **dropout works** (the backward recompute folds the same keys, giving
+   identical masks);
+ - gradients accumulate in f32 across ticks; the optimizer update reuses the
+   shared ``apply_update`` closure, so ZeRO / fp16 / clipping semantics are
+   identical to the DP engine.
 
-Bubble fraction is (PP-1)/(M+PP-1) — GPipe-shaped.  Embedding/head params stay
-replicated over ``pp``; their gradients all-reduce over the axis automatically,
-which is exactly the reference's tied-weight reduction
-(``pipe/engine.py:233 _exec_reduce_tied_grads``) in declarative form.
-
-The instruction-stream schedules (``pipe/schedule.py``) are kept for parity,
-tests and the host-driven executor variant.
+Embedding/head params stay replicated over ``pp``; their per-tick gradient
+contributions accumulate and all-reduce over the axis automatically — the
+reference's tied-weight reduction (``pipe/engine.py:233
+_exec_reduce_tied_grads``) in declarative form.
 """
 
 from __future__ import annotations
@@ -55,12 +59,6 @@ class PipelineEngine(DeepSpeedEngine):
         super().__init__(*args, **kwargs)
         assert self.model_spec.pipeline_hooks is not None, (
             "pp>1 requires a model with pipeline_hooks (see ModelSpec)")
-        if self.model_spec.pipeline_hooks.get("dropout", 0.0) > 0.0:
-            raise ValueError(
-                "the pipelined train step does not support dropout yet; "
-                "set dropout=0 or run without pp (reference PipelineEngine "
-                "delegates dropout to the wrapped module — ours will once the "
-                "rotation loop threads per-tick RNG)")
 
     # -- sharding: stacked blocks get the pp axis on dim 0 --------------------
     def _pp_blocks_key(self) -> Tuple[str, ...]:
@@ -113,6 +111,10 @@ class PipelineEngine(DeepSpeedEngine):
 
     # -- the pipelined train step ---------------------------------------------
     def _build_step_fns(self) -> None:
+        import inspect
+
+        from . import schedule as sched
+
         hooks = self.model_spec.pipeline_hooks
         pp = self.topology.pipe_parallel_size
         M = self.gradient_accumulation_steps()
@@ -122,13 +124,26 @@ class PipelineEngine(DeepSpeedEngine):
         embed_fn = hooks["embed_fn"]
         block_fn = hooks["block_fn"]
         head_loss_fn = hooks["head_loss_fn"]
+        dropout = float(hooks.get("dropout", 0.0) or 0.0)
         blocks_key = self._pp_blocks_key()
         apply_update = self._make_apply_update()
         grad_shardings = self.grad_shardings
         act_spec = NamedSharding(self.mesh, P(PP_AXIS, DATA_AXES))
+        T = sched.num_ticks(M, pp)
+        K = sched.stash_slots(pp)
+
+        n_block_params = len(inspect.signature(block_fn).parameters)
+        if dropout > 0.0 and n_block_params < 3:
+            raise ValueError(
+                "model pipeline_hooks block_fn must accept (layer, x, rng) "
+                "for dropout > 0")
+        if n_block_params >= 3:
+            call_block = block_fn
+        else:
+            call_block = lambda layer, x, rng: block_fn(layer, x)
 
         def split_blocks(params):
-            """params -> (params_without_blocks_view, blocks [PP, F, ...])."""
+            """view the [L, ...] stacked blocks as [PP, F, ...]."""
             node = params
             for k in blocks_key[:-1]:
                 node = node[k]
@@ -145,19 +160,26 @@ class PipelineEngine(DeepSpeedEngine):
                     lambda _: NamedSharding(self.mesh, P(PP_AXIS)), blocks))
             return blocks
 
-        def stage_apply(blocks_f, x):
-            def body(x, layer):
-                return block_fn(layer, x), None
+        def stage_apply(blocks_f, x, mb_key, sid):
+            """Run one stage's F layers; rng folded per (microbatch, layer) so
+            the backward recompute reproduces identical dropout masks."""
+            layers_per_stage = jax.tree_util.tree_leaves(blocks_f)[0].shape[0]
 
-            x, _ = jax.lax.scan(body, x, blocks_f)
+            def body(x, xs):
+                layer, li = xs
+                r = (jax.random.fold_in(mb_key, sid * layers_per_stage + li)
+                     if dropout > 0.0 else None)
+                return call_block(layer, x, r), None
+
+            x, _ = jax.lax.scan(body, x,
+                                (blocks_f, jnp.arange(layers_per_stage)))
             return x
 
-        stage_apply = jax.checkpoint(stage_apply)
-
-        def pp_loss(params, batch, scale):
-            """batch: [M, mb, S+1] token ids, or {"input_ids": [M, mb, S],
-            "labels": [M, mb, S]} (labels may carry -100 ignore entries, masked
-            by the model's head_loss_fn); returns scaled mean loss."""
+        def pp_loss_and_grads(params, batch, scale, step_rng):
+            """Lockstep 1F1B (schedule rules in ``pipe/schedule.py``): every
+            tick runs one fwd and one bwd phase per stage; backward re-runs the
+            stage forward under ``jax.vjp`` from the stashed stage input.
+            Returns (scale * mean_loss, scaled f32 grads)."""
             p = _cast_floating(params, compute_dtype) if cast else params
             if isinstance(batch, dict) and batch.get("labels") is not None:
                 inputs = batch["input_ids"]
@@ -167,37 +189,132 @@ class PipelineEngine(DeepSpeedEngine):
                 inputs = ids[:, :, :-1]
                 targets = ids[:, :, 1:]
             blocks = split_blocks(p)
-            mb, s = inputs.shape[1], inputs.shape[2]
-            T = M + pp - 1
+            stage_ids = jnp.arange(pp)
 
-            x0 = embed_fn(p, inputs[0])
-            acts = jnp.zeros((pp,) + x0.shape, x0.dtype)
-            acts = jax.lax.with_sharding_constraint(acts, act_spec)
-            acts = acts.at[0].set(x0)
+            x0 = jax.eval_shape(embed_fn, p, inputs[0])
+            act_shape, act_dtype = x0.shape, x0.dtype
+            fwd_buf = jnp.zeros((pp,) + act_shape, act_dtype)
+            cot_buf = jnp.zeros((pp,) + act_shape, jnp.float32)
+            stash = jnp.zeros((pp, K) + act_shape, act_dtype)
+            fwd_buf = jax.lax.with_sharding_constraint(fwd_buf, act_spec)
+            cot_buf = jax.lax.with_sharding_constraint(cot_buf, act_spec)
+
+            zero_block_grads = jax.tree_util.tree_map(
+                lambda b: jnp.zeros(b.shape, jnp.float32), blocks)
+            zero_other_grads = jax.tree_util.tree_map(
+                lambda q: jnp.zeros(q.shape, jnp.float32), p)
+
+            def mb_key(m):
+                return jax.random.fold_in(step_rng, jnp.clip(m, 0, M - 1))
 
             def tick(carry, t):
-                acts = carry
-                new = jax.vmap(stage_apply)(blocks, acts)
-                new = jax.lax.with_sharding_constraint(new, act_spec)
-                out = new[pp - 1]
-                tgt = jax.lax.dynamic_index_in_dim(
-                    targets, jnp.clip(t - (pp - 1), 0, M - 1), 0, keepdims=False)
-                loss_t = head_loss_fn(p, out, tgt)
-                loss_t = jnp.where(t >= pp - 1, loss_t, 0.0)
-                nxt_ids = jax.lax.dynamic_index_in_dim(
-                    inputs, jnp.clip(t + 1, 0, M - 1), 0, keepdims=False)
-                acts = jnp.roll(new, 1, axis=0).at[0].set(embed_fn(p, nxt_ids))
-                acts = jax.lax.with_sharding_constraint(acts, act_spec)
-                return acts, loss_t
+                fwd_buf, cot_buf, stash, bg, og, loss_acc = carry
 
-            _, losses = jax.lax.scan(tick, acts, jnp.arange(T))
-            return (losses.sum() / M).astype(jnp.float32) * scale
+                # ---- forward phase: stage s runs fwd of mb f = t - s
+                f_mb = t - stage_ids                                  # [pp]
+                ids_f = jax.lax.dynamic_index_in_dim(
+                    inputs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+                x_in = fwd_buf.at[0].set(embed_fn(p, ids_f))
+                x_in = jax.lax.with_sharding_constraint(x_in, act_spec)
+                f_keys = jax.vmap(mb_key)(f_mb)
+                y = jax.vmap(stage_apply, in_axes=(0, 0, 0, 0))(
+                    blocks, x_in, f_keys, stage_ids)
+                y = jax.lax.with_sharding_constraint(y, act_spec)
+                # stash this tick's stage inputs, keyed by microbatch mod K
+                # (never collides: a slot is reused 2*PP microbatches later,
+                # after its backward drained — see schedule.py)
+                slot_f = jnp.mod(f_mb, K)
+                stash = jax.vmap(
+                    lambda st, sl, xi: jax.lax.dynamic_update_index_in_dim(
+                        st, xi, sl, 0))(stash, slot_f, x_in)
+
+                # ---- head: mb m = t - (pp-1) finishes fwd at the last stage
+                m_t = t - (pp - 1)
+                tgt = jax.lax.dynamic_index_in_dim(
+                    targets, jnp.clip(m_t, 0, M - 1), 0, keepdims=False)
+                out = y[pp - 1]
+
+                def head_scaled(p_, o_):
+                    return (head_loss_fn(p_, o_, tgt).astype(jnp.float32) *
+                            (scale / M))
+
+                loss_t, (dp_head, dseed) = jax.value_and_grad(
+                    head_scaled, argnums=(0, 1))(p, out)
+                valid_m = jnp.logical_and(m_t >= 0, m_t < M)
+                loss_acc = loss_acc + jnp.where(valid_m, loss_t, 0.0)
+                og = jax.tree_util.tree_map(
+                    lambda a, g: a + jnp.where(valid_m, g.astype(jnp.float32),
+                                               0.0), og, dp_head)
+
+                # ---- backward phase: stage s runs bwd of mb
+                #      b = t - 2*(pp-1) + s
+                b_mb = t - 2 * (pp - 1) + stage_ids                   # [pp]
+                slot_b = jnp.mod(b_mb, K)
+                x_saved = jax.vmap(
+                    lambda st, sl: jax.lax.dynamic_index_in_dim(
+                        st, sl, 0, keepdims=False))(stash, slot_b)
+                b_keys = jax.vmap(mb_key)(b_mb)
+                cot_in = cot_buf.at[pp - 1].set(dseed.astype(jnp.float32))
+                cot_in = jax.lax.with_sharding_constraint(cot_in, act_spec)
+
+                def stage_bwd(blocks_f, x, key, sid, ct):
+                    y2, vjp = jax.vjp(
+                        lambda bf, xx: stage_apply(bf, xx, key, sid),
+                        blocks_f, x)
+                    db, dx = vjp(ct.astype(y2.dtype))
+                    return db, dx
+
+                db, dx = jax.vmap(stage_bwd, in_axes=(0, 0, 0, 0, 0))(
+                    blocks, x_saved, b_keys, stage_ids, cot_in)
+                valid_b = jnp.logical_and(b_mb >= 0, b_mb < M)        # [pp]
+
+                def mask_stage(a, g):
+                    m = valid_b.reshape((pp,) + (1,) * (g.ndim - 1))
+                    return a + jnp.where(m, g.astype(jnp.float32), 0.0)
+
+                bg = jax.tree_util.tree_map(mask_stage, bg, db)
+
+                # stage 0's input cotangent flows into the embedding
+                b0 = t - 2 * (pp - 1)
+                ids_b = jax.lax.dynamic_index_in_dim(
+                    inputs, jnp.clip(b0, 0, M - 1), 0, keepdims=False)
+                _, vjp_e = jax.vjp(lambda p_: embed_fn(p_, ids_b), p)
+                (dp_embed,) = vjp_e(dx[0].astype(act_dtype))
+                valid0 = jnp.logical_and(b0 >= 0, b0 < M)
+                og = jax.tree_util.tree_map(
+                    lambda a, g: a + jnp.where(valid0, g.astype(jnp.float32),
+                                               0.0), og, dp_embed)
+
+                # ---- rotate: activations go down one stage, cotangents up
+                fwd_buf = jnp.roll(y, 1, axis=0)
+                cot_buf = jnp.roll(dx, -1, axis=0).astype(jnp.float32)
+                fwd_buf = jax.lax.with_sharding_constraint(fwd_buf, act_spec)
+                cot_buf = jax.lax.with_sharding_constraint(cot_buf, act_spec)
+                return (fwd_buf, cot_buf, stash, bg, og, loss_acc), None
+
+            carry0 = (fwd_buf, cot_buf, stash, zero_block_grads,
+                      zero_other_grads, jnp.zeros((), jnp.float32))
+            (_, _, _, bg, og, loss_acc), _ = jax.lax.scan(
+                tick, carry0, jnp.arange(T))
+
+            # merge: [PP, F, ...] block grads back to [L, ...] layout
+            def unstack(g):
+                return g.reshape((g.shape[0] * g.shape[1],) + g.shape[2:])
+
+            bg = jax.tree_util.tree_map(unstack, bg)
+            node = og
+            for k in blocks_key[:-1]:
+                node = node[k]
+            node[blocks_key[-1]] = jax.tree_util.tree_map(
+                lambda a, b: a + b, node[blocks_key[-1]], bg)
+            return loss_acc, og
 
         def train_step(state, batch, base_rng):
-            del base_rng  # dropout unsupported in the pipelined path (yet)
             params, scaler = state["params"], state["scaler"]
             scale = scaler.cur_scale if fp16 else jnp.asarray(1.0, jnp.float32)
-            scaled_loss, grads = jax.value_and_grad(pp_loss)(params, batch, scale)
+            step_rng = jax.random.fold_in(base_rng, state["step"])
+            scaled_loss, grads = pp_loss_and_grads(params, batch, scale,
+                                                   step_rng)
             inv = 1.0 / scale
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32) * inv, grads)

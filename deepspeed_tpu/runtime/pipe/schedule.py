@@ -1,283 +1,98 @@
-"""Pipeline schedules — pure instruction-stream math.
+"""Pipeline schedule math — lockstep 1F1B for the SPMD engine.
 
-Port of the reference's schedule semantics (``runtime/pipe/schedule.py``:
-``PipeSchedule`` base, ``InferenceSchedule`` :117, ``TrainSchedule`` :184 — the
-1F1B alternation) as device-free Python.  On TPU the *executed* schedule for the
-SPMD pipelined train step is the rotation loop in ``pipe/engine.py`` (GPipe-like,
-derived by XLA from shardings); these instruction streams drive the host-driven
-executor variant, tests, and bubble accounting, and keep parity with the
-reference's scheduling contract.
+The reference drives each stage process with an instruction stream
+(``runtime/pipe/schedule.py``: TrainSchedule's 1F1B alternation).  Under XLA
+SPMD every stage executes the *same* program, so the schedule is expressed as
+closed-form tick rules instead of per-rank instruction lists: at global tick
+``t`` each stage ``s`` (optionally) runs one forward and one backward on
+different in-flight microbatches, and the activation/cotangent buffers rotate
+by one stage between ticks (a ``collective_permute`` over ICI — the p2p
+send/recv analog, ``pipe/p2p.py:48/:70``).
+
+Tick rules (M microbatches, PP stages, T = M + 2*(PP-1) ticks):
+
+ - **forward**:  stage ``s`` runs fwd of microbatch ``f = t - s``
+   when ``0 <= f < M``   (microbatch m enters stage 0 at tick m and reaches
+   stage s at tick m + s);
+ - **backward**: stage ``s`` runs bwd of microbatch ``b = t - 2*(PP-1) + s``
+   when ``0 <= b < M``   (the cotangent of microbatch m leaves the last stage
+   the same tick its forward completes there — t = m + PP - 1 — and reaches
+   stage s after PP-1-s more ticks).
+
+Consequences (verified by ``tests/unit/test_pipe_schedule.py``):
+ - every (stage, microbatch) runs exactly one F and one B, B strictly after
+   F except at the last stage where they coincide in one tick (F then B);
+ - forwards a stage holds live (run but not yet backpropped) peak at
+   ``2*(PP-1-s) + 1`` — **O(PP), independent of M** (the 1F1B memory
+   property; GPipe's peak is O(M));
+ - a ring buffer of ``2*PP`` slots indexed by ``microbatch mod 2*PP`` never
+   collides: a slot is reused only 2*PP microbatches later, after the
+   earlier microbatch's backward has drained.
 """
 
 from __future__ import annotations
 
-from typing import Iterator, List
+from typing import Dict
 
+import numpy as np
 
-class PipeInstruction:
-    """A single step directive (reference ``schedule.py:310``)."""
 
-    def __init__(self, **kwargs):
-        self.name = self.__class__.__name__
-        self.kwargs = kwargs
-        for key, val in kwargs.items():
-            setattr(self, key, val)
+def num_ticks(micro_batches: int, stages: int) -> int:
+    """Total lockstep ticks for one optimizer step."""
+    return micro_batches + 2 * (stages - 1)
 
-    def __repr__(self):
-        if not self.kwargs:
-            return self.name
-        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
-        return f"{self.name}({args})"
 
-    def __eq__(self, other):
-        return (type(self) is type(other)) and self.kwargs == other.kwargs
+def stash_slots(stages: int) -> int:
+    """Ring-buffer slots each stage needs for saved forward inputs."""
+    return 2 * stages
 
 
-class OptimizerStep(PipeInstruction):
-    pass
+def fwd_microbatch(t: int, stage: int) -> int:
+    """Microbatch whose forward stage ``stage`` runs at tick ``t``
+    (may fall outside [0, M) — then the stage idles this phase)."""
+    return t - stage
 
 
-class ReduceGrads(PipeInstruction):
-    pass
+def bwd_microbatch(t: int, stage: int, stages: int) -> int:
+    """Microbatch whose backward stage ``stage`` runs at tick ``t``."""
+    return t - 2 * (stages - 1) + stage
 
 
-class ReduceTiedGrads(PipeInstruction):
-    pass
+def schedule_arrays(micro_batches: int, stages: int) -> Dict[str, np.ndarray]:
+    """Dense [T, PP] arrays of the tick rules; -1 marks an idle phase.
 
+    This is exactly what the SPMD engine's scan computes on the fly
+    (``pipe/engine.py``); exposed densely for tests, bubble accounting, and
+    host-driven execution.
+    """
+    T = num_ticks(micro_batches, stages)
+    fwd = np.full((T, stages), -1, np.int64)
+    bwd = np.full((T, stages), -1, np.int64)
+    for t in range(T):
+        for s in range(stages):
+            f = fwd_microbatch(t, s)
+            if 0 <= f < micro_batches:
+                fwd[t, s] = f
+            b = bwd_microbatch(t, s, stages)
+            if 0 <= b < micro_batches:
+                bwd[t, s] = b
+    return {"fwd": fwd, "bwd": bwd}
 
-class BufferOpInstruction(PipeInstruction):
-    def __init__(self, buffer_id: int, **kwargs):
-        super().__init__(buffer_id=buffer_id, **kwargs)
 
+def peak_inflight(stage: int, stages: int, micro_batches: int) -> int:
+    """Max forwards outstanding (awaiting backward) at ``stage``, counting a
+    same-tick F+B as momentarily live."""
+    sched = schedule_arrays(micro_batches, stages)
+    live = peak = 0
+    for t in range(sched["fwd"].shape[0]):
+        if sched["fwd"][t, stage] >= 0:
+            live += 1
+        peak = max(peak, live)
+        if sched["bwd"][t, stage] >= 0:
+            live -= 1
+    return peak
 
-class LoadMicroBatch(BufferOpInstruction):
-    pass
 
-
-class ForwardPass(BufferOpInstruction):
-    pass
-
-
-class BackwardPass(BufferOpInstruction):
-    pass
-
-
-class SendActivation(BufferOpInstruction):
-    pass
-
-
-class RecvActivation(BufferOpInstruction):
-    pass
-
-
-class SendGrad(BufferOpInstruction):
-    pass
-
-
-class RecvGrad(BufferOpInstruction):
-    pass
-
-
-class PipeSchedule:
-    """Generates lists of instructions per step (reference ``schedule.py:7``)."""
-
-    def __init__(self, micro_batches: int, stages: int, stage_id: int):
-        assert 0 <= stage_id < stages
-        self.micro_batches = micro_batches
-        self.stages = stages
-        self.stage_id = stage_id
-        self.prev_stage = self.stage_id - 1
-        self.next_stage = self.stage_id + 1
-
-    def steps(self) -> Iterator[List[PipeInstruction]]:
-        raise NotImplementedError()
-
-    def num_pipe_buffers(self) -> int:
-        return self.micro_batches
-
-    @property
-    def stage(self):
-        return self.stage_id
-
-    @property
-    def num_stages(self):
-        return self.stages
-
-    @property
-    def num_micro_batches(self):
-        return self.micro_batches
-
-    @property
-    def is_first_stage(self):
-        return self.stage_id == 0
-
-    @property
-    def is_last_stage(self):
-        return self.stage_id == self.stages - 1
-
-    def _valid_micro_batch(self, micro_batch_id: int) -> bool:
-        return 0 <= micro_batch_id < self.micro_batches
-
-    def _valid_stage(self, stage_id: int) -> bool:
-        return 0 <= stage_id < self.num_stages
-
-    def _buffer_idx(self, micro_batch_id: int) -> int:
-        assert self._valid_micro_batch(micro_batch_id)
-        return micro_batch_id % self.num_pipe_buffers()
-
-    def __iter__(self):
-        self.it = None
-        return self
-
-    def __next__(self):
-        if self.it is None:
-            self.it = self.steps()
-        return next(self.it)
-
-
-class InferenceSchedule(PipeSchedule):
-    """Forward-only fill-drain (reference ``schedule.py:117``)."""
-
-    def steps(self):
-        total_steps = self.micro_batches + self.stages - 1
-        for step_id in range(total_steps):
-            cmds = []
-            micro_batch_id = step_id - self.stage_id
-
-            # alternate send/recv buffers to overlap transfers
-            if _is_even(step_id) and _is_even(self.stage_id) or \
-                    _is_odd(step_id) and _is_odd(self.stage_id):
-                recv_buf, send_buf = step_id % 2, (step_id + 1) % 2
-            else:
-                recv_buf, send_buf = (step_id + 1) % 2, step_id % 2
-
-            if self.is_first_stage or self.is_last_stage:
-                if self._valid_micro_batch(micro_batch_id) and self.is_first_stage:
-                    cmds.append(LoadMicroBatch(recv_buf))
-            if _is_even(self.stage_id):
-                if self._valid_stage(self.next_stage) and \
-                        self._valid_micro_batch(micro_batch_id - 1):
-                    cmds.append(SendActivation(send_buf))
-                if self._valid_stage(self.prev_stage) and \
-                        self._valid_micro_batch(micro_batch_id):
-                    cmds.append(RecvActivation(recv_buf))
-            else:
-                if self._valid_stage(self.prev_stage) and \
-                        self._valid_micro_batch(micro_batch_id):
-                    cmds.append(RecvActivation(recv_buf))
-                if self._valid_stage(self.next_stage) and \
-                        self._valid_micro_batch(micro_batch_id - 1):
-                    cmds.append(SendActivation(send_buf))
-            if self._valid_micro_batch(micro_batch_id):
-                cmds.append(ForwardPass(recv_buf))
-            yield cmds
-
-    def num_pipe_buffers(self) -> int:
-        return 2
-
-
-class TrainSchedule(PipeSchedule):
-    """1F1B alternation (reference ``schedule.py:184``): even steps forward, odd
-    steps backward, offset per stage so steady state interleaves 1 fwd / 1 bwd."""
-
-    def steps(self):
-        prev_micro_batch_id = -1
-        total_steps = 2 * (self.micro_batches + self.stages - 1)
-        for step_id in range(total_steps):
-            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
-            cmds = []
-
-            # exchange activations/grads with neighbours
-            if self._valid_micro_batch(prev_micro_batch_id) and \
-                    self._valid_stage(self.next_stage):
-                if is_forward:
-                    cmds.append(RecvGrad(self._buffer_idx(prev_micro_batch_id)))
-                else:
-                    cmds.append(SendActivation(self._buffer_idx(prev_micro_batch_id)))
-            if self._valid_micro_batch(micro_batch_id) and \
-                    self._valid_stage(self.prev_stage):
-                if is_forward:
-                    cmds.append(RecvActivation(self._buffer_idx(micro_batch_id)))
-                else:
-                    cmds.append(SendGrad(self._buffer_idx(micro_batch_id)))
-
-            # first/last stage loads data
-            if self.stage_id == 0 or self.stage_id == self.stages - 1:
-                if is_forward and self._valid_micro_batch(micro_batch_id):
-                    cmds.append(LoadMicroBatch(self._buffer_idx(micro_batch_id)))
-
-            # compute
-            if self._valid_micro_batch(micro_batch_id):
-                if is_forward:
-                    cmds.append(ForwardPass(self._buffer_idx(micro_batch_id)))
-                else:
-                    cmds.append(BackwardPass(self._buffer_idx(micro_batch_id)))
-
-            # step at the very end
-            if step_id == total_steps - 1:
-                cmds.append(ReduceTiedGrads())
-                cmds.append(ReduceGrads())
-                cmds.append(OptimizerStep())
-
-            prev_micro_batch_id = micro_batch_id
-            yield cmds
-
-    def num_pipe_buffers(self) -> int:
-        """Max buffers in flight (reference :290): shrinks for later stages."""
-        buffers = min(self.stages - self.stage_id, self.micro_batches)
-        return max(2, buffers)
-
-    def _step_to_micro_batch(self, step_id: int):
-        if _is_even(step_id) and _is_even(self.stage_id):
-            micro_batch_id = self._even_step_forward_id(step_id)
-            is_forward = True
-        elif _is_odd(step_id) and _is_odd(self.stage_id):
-            micro_batch_id = self._odd_step_forward_id(step_id)
-            is_forward = True
-        elif _is_even(step_id) and _is_odd(self.stage_id):
-            micro_batch_id = self._even_step_backward_id(step_id)
-            is_forward = False
-        elif _is_odd(step_id) and _is_even(self.stage_id):
-            micro_batch_id = self._odd_step_backward_id(step_id)
-            is_forward = False
-        else:
-            raise AssertionError()
-        return micro_batch_id, is_forward
-
-    def _even_step_forward_id(self, step_id: int) -> int:
-        base = step_id // 2
-        return int(base - self.stage_id // 2)
-
-    def _odd_step_forward_id(self, step_id: int) -> int:
-        base = (step_id - 1) // 2
-        return int(base - self.stage_id // 2)
-
-    def _even_step_backward_id(self, step_id: int) -> int:
-        base = step_id // 2
-        return int(base - self.stages + (self.stage_id + 1) // 2)
-
-    def _odd_step_backward_id(self, step_id: int) -> int:
-        base = ((step_id - 1) // 2) - self.stages + 1
-        return int(base + (self.stage_id + 1) // 2)
-
-
-class DataParallelSchedule(PipeSchedule):
-    """Degenerate single-stage schedule (reference ``schedule.py:465``)."""
-
-    def steps(self):
-        for step_id in range(self.micro_batches):
-            cmds = [LoadMicroBatch(0), ForwardPass(0), BackwardPass(0)]
-            if step_id == self.micro_batches - 1:
-                cmds.extend([ReduceGrads(), OptimizerStep()])
-            yield cmds
-
-    def num_pipe_buffers(self) -> int:
-        return 1
-
-
-def _is_even(x: int) -> bool:
-    return x % 2 == 0
-
-
-def _is_odd(x: int) -> bool:
-    return x % 2 != 0
+def bubble_fraction(micro_batches: int, stages: int) -> float:
+    """Idle fraction of the lockstep pipeline: 2*(PP-1) / T."""
+    return 2.0 * (stages - 1) / num_ticks(micro_batches, stages)

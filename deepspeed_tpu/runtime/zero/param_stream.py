@@ -1,0 +1,140 @@
+"""ZeRO-Infinity parameter streaming: host-resident block params.
+
+Reference mechanics (SURVEY §2.1): ``offload_param`` keeps parameter
+partitions in host DRAM / NVMe and streams them to the device just before
+use, freeing them after (``runtime/swap_tensor/partitioned_param_swapper.py:35``,
+``zero/stage3.py:486``, persistence thresholds in
+``parameter_offload.py:316``).
+
+TPU realisation: the model's scan-stacked block params live in **host numpy**
+(fp32 master + a bf16 compute copy).  Inside the jitted step, each scan
+iteration pulls one layer's weights with ``io_callback`` and the layer's
+weight gradient flows *back to the host* through the fetch's ``custom_vjp``
+(an ordered ``io_callback`` accumulating into pinned host buffers).  Device
+HBM therefore holds only ONE layer's weights (plus activations) at any time —
+models larger than HBM train, at PCIe speed.  Small "resident" params
+(embeddings, norms, head — the persistence-threshold analog: anything not in
+the stacked blocks) stay on device and follow the normal offload path.
+
+The host optimizer step for streamed blocks runs on the fp32 master with the
+same C++ CPU Adam as the optimizer-offload tier; the bf16 compute copy is
+refreshed after each applied step.  Single-controller for now (multi-host
+streaming needs a host-side grad reduction).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import io_callback
+
+from ...utils.logging import logger
+
+PyTree = Any
+
+
+class StreamedParamStore:
+    """Host store for [L, ...]-stacked block params with grad accumulation."""
+
+    def __init__(self, blocks: PyTree, compute_dtype=jnp.bfloat16):
+        leaves, self.treedef = jax.tree_util.tree_flatten(blocks)
+        self.num_layers = leaves[0].shape[0]
+        self.master: List[np.ndarray] = [
+            np.ascontiguousarray(np.asarray(x), np.float32) for x in leaves]
+        self.compute_dtype = compute_dtype
+        np_compute = np.dtype(jnp.dtype(compute_dtype).name)
+        self.compute: List[np.ndarray] = [
+            m.astype(np_compute) for m in self.master]
+        self.grad_acc: List[np.ndarray] = [
+            np.zeros_like(m) for m in self.master]
+        self._layer_struct = tuple(
+            jax.ShapeDtypeStruct(m.shape[1:], compute_dtype)
+            for m in self.master)
+        bytes_ = sum(m.nbytes for m in self.master)
+        logger.info(f"param streaming: {self.num_layers} layers, "
+                    f"{bytes_/1e9:.2f}GB fp32 master host-resident")
+
+    # -------------------------------------------------------- host callbacks
+    def _load_layer(self, i):
+        i = int(i)
+        return tuple(c[i] for c in self.compute)
+
+    def _store_grad(self, i, *grads):
+        i = int(i)
+        for acc, g in zip(self.grad_acc, grads):
+            acc[i] += np.asarray(g, np.float32)
+
+    # ------------------------------------------------------------- jit-side
+    def _load(self, i):
+        """Layer ``i``'s params via (re-executable) host callback."""
+        flat = io_callback(self._load_layer, list(self._layer_struct), i,
+                           ordered=False)
+        return jax.tree_util.tree_unflatten(self.treedef, list(flat))
+
+    def _push(self, i, dlayer):
+        io_callback(self._store_grad, None, i,
+                    *jax.tree_util.tree_leaves(dlayer), ordered=True)
+
+    def streamed_block(self, call_block):
+        """Wrap ``call_block(layer, x) -> x`` so the layer weights stream.
+
+        The custom_vjp is a manual remat: forward loads layer ``i`` from host
+        and saves only ``(i, x)``; backward re-loads the layer, re-runs the
+        block under ``jax.vjp``, pushes the weight cotangent to the host
+        accumulator, and returns only the activation cotangent.  Device HBM
+        thus never holds more than one streamed layer (``jax.checkpoint``
+        can't express this: io_callback effects are rejected by its partial
+        eval)."""
+
+        @jax.custom_vjp
+        def blk(i, x):
+            return call_block(self._load(i), x)
+
+        def blk_fwd(i, x):
+            return blk(i, x), (i, x)
+
+        def blk_bwd(res, ct):
+            i, x = res
+            layer = self._load(i)
+            _, vjp = jax.vjp(call_block, layer, x)
+            dlayer, dx = vjp(ct)
+            self._push(i, dlayer)
+            return (jnp.zeros((), jnp.float32), dx)
+
+        blk.defvjp(blk_fwd, blk_bwd)
+
+        def apply(i, x):
+            return blk(jnp.asarray(i, jnp.float32), x)
+
+        return apply
+
+    # ---------------------------------------------------------- host-side API
+    def pop_grads(self) -> List[np.ndarray]:
+        """Return and clear the accumulated [L, ...] block grads (fp32)."""
+        out = self.grad_acc
+        self.grad_acc = [np.zeros_like(g) for g in self.master]
+        return out
+
+    def sq_grad_norm(self) -> float:
+        return float(sum(float(np.vdot(g, g)) for g in self.grad_acc))
+
+    def grads_finite(self) -> bool:
+        return all(np.isfinite(g).all() for g in self.grad_acc)
+
+    def refresh_compute(self) -> None:
+        """Re-cast the bf16 compute copy after a master update."""
+        for c, m in zip(self.compute, self.master):
+            np.copyto(c, m.astype(c.dtype))
+
+    def master_blocks(self) -> PyTree:
+        return jax.tree_util.tree_unflatten(self.treedef, self.master)
+
+    def load_master(self, blocks: PyTree) -> None:
+        for m, x in zip(self.master,
+                        jax.tree_util.tree_leaves(blocks)):
+            np.copyto(m, np.asarray(x, np.float32))
+        self.refresh_compute()

@@ -1,0 +1,129 @@
+"""ZeRO-Infinity param-streaming tests (reference posture:
+``tests/unit/runtime/zero`` offload matrix — here the ground truth is the
+optimizer-offload engine with device-resident params)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt2
+
+
+def _cfg(stream: bool, **over):
+    zero = {"stage": 0,
+            "offload_optimizer": {"device": "cpu"}}
+    if stream:
+        zero["offload_param"] = {"device": "cpu"}
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": zero,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _run(config, steps=4, seed=0):
+    deepspeed_tpu.comm.reset_topology()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt2.build(gpt2.GPT2Config.tiny()), config=config)
+    rng = np.random.default_rng(seed)
+    losses = []
+    for _ in range(steps):
+        batch = {"input_ids": rng.integers(
+            0, 512, size=(engine.train_batch_size(), 33)).astype(np.int32)}
+        _, m = engine.train_batch(batch)
+        losses.append(float(m["loss"]))
+    return engine, losses
+
+
+def test_streamed_grads_match_autodiff():
+    """The streamed block vjp (host round-trip) reproduces autodiff grads to
+    float rounding — the rigorous correctness check."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.runtime.zero.param_stream import StreamedParamStore
+
+    cfg = gpt2.GPT2Config.tiny()
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    spec = gpt2.build(cfg)
+    hooks = spec.pipeline_hooks
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, 512, (2, 33)).astype(np.int32)}
+
+    ref_grads = jax.grad(lambda p: spec.loss_fn(p, batch, None, True))(params)
+
+    store = StreamedParamStore(params["blocks"], jnp.float32)
+    blk = store.streamed_block(lambda layer, x: hooks["block_fn"](layer, x,
+                                                                  None))
+    resident = dict(params)
+    resident["blocks"] = {}
+
+    def loss_fn(p):
+        ids = batch["input_ids"]
+        inputs, targets = ids[:, :-1], ids[:, 1:]
+        x = hooks["embed_fn"](p, inputs)
+        x, _ = jax.lax.scan(lambda x, i: (blk(i, x), None), x,
+                            jnp.arange(cfg.num_layers))
+        return hooks["head_loss_fn"](p, x, targets)
+
+    loss, res_grads = jax.jit(jax.value_and_grad(loss_fn))(resident)
+    loss.block_until_ready()
+    block_grads = store.pop_grads()
+    for gr, gs in zip(jax.tree_util.tree_leaves(ref_grads["blocks"]),
+                      block_grads):
+        np.testing.assert_allclose(np.asarray(gr), gs, atol=2e-6)
+    for k in ("wte", "wpe", "lnf_scale", "lnf_bias"):
+        np.testing.assert_allclose(np.asarray(ref_grads[k]),
+                                   np.asarray(res_grads[k]), atol=2e-6)
+
+
+def test_streamed_matches_resident_offload():
+    """Loss trajectories agree with the device-resident offload baseline
+    (loosely: the two computation graphs differ in op order, and Adam
+    amplifies f32 rounding over steps — exact grad parity is asserted by
+    test_streamed_grads_match_autodiff)."""
+    _, base = _run(_cfg(stream=False))
+    engine, stream = _run(_cfg(stream=True))
+    np.testing.assert_allclose(stream, base, atol=8e-3)
+    # device state holds no blocks — they live in the host store
+    assert engine.state["params"]["blocks"] == {}
+    assert engine._param_store.num_layers == 2
+
+
+def test_streamed_with_clipping_matches():
+    _, base = _run(_cfg(stream=False, gradient_clipping=0.1))
+    _, stream = _run(_cfg(stream=True, gradient_clipping=0.1))
+    np.testing.assert_allclose(stream, base, atol=8e-3)
+
+
+def test_streamed_checkpoint_roundtrip(tmp_path):
+    engine, _ = _run(_cfg(stream=True), steps=2)
+    engine.save_checkpoint(str(tmp_path / "ck"))
+    master_before = [m.copy() for m in engine._param_store.master]
+
+    engine2, _ = _run(_cfg(stream=True), steps=1, seed=9)
+    engine2.load_checkpoint(str(tmp_path / "ck"))
+    for a, b in zip(master_before, engine2._param_store.master):
+        np.testing.assert_allclose(a, b, rtol=1e-7)
+    # training continues from the restored masters
+    rng = np.random.default_rng(3)
+    batch = {"input_ids": rng.integers(
+        0, 512, size=(engine2.train_batch_size(), 33)).astype(np.int32)}
+    _, m = engine2.train_batch(batch)
+    assert np.isfinite(m["loss"])
+
+
+def test_streamed_requires_offload_optimizer():
+    deepspeed_tpu.comm.reset_topology()
+    with pytest.raises(ValueError, match="offload_param requires"):
+        deepspeed_tpu.initialize(
+            model=gpt2.build(gpt2.GPT2Config.tiny()),
+            config={
+                "train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {
+                    "stage": 0, "offload_param": {"device": "cpu"}},
+            })

@@ -1,72 +1,83 @@
-"""Schedule tests (model: reference tests/unit/runtime/pipe/test_pipe_schedule.py)."""
+"""Schedule math invariants (pure, device-free — reference keeps these pure
+too: ``tests/unit/runtime/pipe/test_pipe_schedule.py``)."""
 
 import pytest
 
-from deepspeed_tpu.runtime.pipe.schedule import (BackwardPass, ForwardPass,
-                                                 InferenceSchedule,
-                                                 LoadMicroBatch, OptimizerStep,
-                                                 PipeSchedule, RecvActivation,
-                                                 SendActivation, TrainSchedule)
+from deepspeed_tpu.runtime.pipe import schedule as sched
 
 
-def _flatten(sched):
-    return [cmd for step in sched for cmd in step]
+@pytest.mark.parametrize("M,PP", [(1, 2), (4, 2), (4, 4), (8, 4), (3, 5)])
+def test_every_microbatch_fwd_and_bwd_once(M, PP):
+    arr = sched.schedule_arrays(M, PP)
+    for s in range(PP):
+        fwd_mbs = [m for m in arr["fwd"][:, s] if m >= 0]
+        bwd_mbs = [m for m in arr["bwd"][:, s] if m >= 0]
+        assert sorted(fwd_mbs) == list(range(M))
+        assert sorted(bwd_mbs) == list(range(M))
 
 
-def test_pipe_schedule_bounds():
-    with pytest.raises(AssertionError):
-        TrainSchedule(micro_batches=1, stages=2, stage_id=2)
+@pytest.mark.parametrize("M,PP", [(4, 2), (8, 4), (3, 5)])
+def test_backward_after_forward(M, PP):
+    arr = sched.schedule_arrays(M, PP)
+    T = arr["fwd"].shape[0]
+    for s in range(PP):
+        f_tick = {arr["fwd"][t, s]: t for t in range(T) if arr["fwd"][t, s] >= 0}
+        b_tick = {arr["bwd"][t, s]: t for t in range(T) if arr["bwd"][t, s] >= 0}
+        for m in range(M):
+            assert b_tick[m] >= f_tick[m]
+            if s == PP - 1:  # last stage: bwd fires the tick fwd completes
+                assert b_tick[m] == f_tick[m]
 
 
-def test_inference_schedule_firststage():
-    sched = InferenceSchedule(micro_batches=4, stages=3, stage_id=0)
-    assert sched.num_pipe_buffers() == 2
-    cmds = _flatten(sched)
-    assert sum(isinstance(c, ForwardPass) for c in cmds) == 4
-    assert sum(isinstance(c, LoadMicroBatch) for c in cmds) == 4
-    assert sum(isinstance(c, SendActivation) for c in cmds) == 4
-    assert not any(isinstance(c, RecvActivation) for c in cmds)
+@pytest.mark.parametrize("M,PP", [(8, 4), (3, 5)])
+def test_stage_dependencies(M, PP):
+    """Stage s cannot run fwd of m before stage s-1 did; symmetric for bwd."""
+    arr = sched.schedule_arrays(M, PP)
+    T = arr["fwd"].shape[0]
+    f_tick = {(s, arr["fwd"][t, s]): t
+              for t in range(T) for s in range(PP) if arr["fwd"][t, s] >= 0}
+    b_tick = {(s, arr["bwd"][t, s]): t
+              for t in range(T) for s in range(PP) if arr["bwd"][t, s] >= 0}
+    for m in range(M):
+        for s in range(1, PP):
+            assert f_tick[(s, m)] > f_tick[(s - 1, m)]
+            assert b_tick[(s - 1, m)] > b_tick[(s, m)]
 
 
-def test_inference_schedule_laststage():
-    sched = InferenceSchedule(micro_batches=4, stages=3, stage_id=2)
-    cmds = _flatten(sched)
-    assert sum(isinstance(c, ForwardPass) for c in cmds) == 4
-    assert sum(isinstance(c, RecvActivation) for c in cmds) == 4
-    assert not any(isinstance(c, SendActivation) for c in cmds)
+def test_inflight_is_O_pp_not_O_m():
+    """The 1F1B property: stash peak independent of microbatch count."""
+    for pp in (2, 4, 8):
+        p_small = sched.peak_inflight(0, pp, micro_batches=4 * pp)
+        p_large = sched.peak_inflight(0, pp, micro_batches=16 * pp)
+        assert p_large == p_small <= sched.stash_slots(pp)
+        # later stages hold strictly fewer
+        assert sched.peak_inflight(pp - 1, pp, 16 * pp) <= p_large
 
 
-@pytest.mark.parametrize("micro_batches,stages", [(4, 2), (8, 4), (3, 3)])
-def test_train_schedule_counts(micro_batches, stages):
-    for stage in range(stages):
-        sched = TrainSchedule(micro_batches=micro_batches, stages=stages,
-                              stage_id=stage)
-        cmds = _flatten(sched)
-        assert sum(isinstance(c, ForwardPass) for c in cmds) == micro_batches
-        assert sum(isinstance(c, BackwardPass) for c in cmds) == micro_batches
-        assert sum(isinstance(c, OptimizerStep) for c in cmds) == 1
+def test_ring_buffer_no_collisions():
+    """A slot (mb mod 2*PP) is never overwritten while its backward is
+    pending."""
+    M, PP = 32, 4
+    K = sched.stash_slots(PP)
+    arr = sched.schedule_arrays(M, PP)
+    T = arr["fwd"].shape[0]
+    for s in range(PP):
+        slots = {}
+        for t in range(T):
+            f = arr["fwd"][t, s]
+            if f >= 0:
+                slot = f % K
+                assert slot not in slots, f"stage {s} slot {slot} clobbered"
+                slots[slot] = f
+            b = arr["bwd"][t, s]
+            if b >= 0:
+                del slots[b % K]
 
 
-def test_train_schedule_ordering():
-    """Every microbatch's forward precedes its backward on each stage."""
-    sched = TrainSchedule(micro_batches=4, stages=2, stage_id=1)
-    seen_fwd = set()
-    for step in sched:
-        for cmd in step:
-            if isinstance(cmd, ForwardPass):
-                seen_fwd.add(cmd.buffer_id)
-            if isinstance(cmd, BackwardPass):
-                assert cmd.buffer_id in seen_fwd
-
-
-def test_train_schedule_buffer_counts():
-    # earlier stages need more in-flight buffers (1F1B property)
-    s0 = TrainSchedule(micro_batches=8, stages=4, stage_id=0)
-    s3 = TrainSchedule(micro_batches=8, stages=4, stage_id=3)
-    assert s0.num_pipe_buffers() == 4
-    assert s3.num_pipe_buffers() == 2
-
-
-def test_schedule_steps_total():
-    sched = TrainSchedule(micro_batches=4, stages=2, stage_id=0)
-    assert len(list(sched.steps())) == 2 * (4 + 2 - 1)
+def test_tick_count_and_bubble():
+    assert sched.num_ticks(8, 4) == 8 + 2 * 3
+    assert sched.num_ticks(1, 1) == 1
+    assert sched.bubble_fraction(8, 1) == 0.0
+    assert 0 < sched.bubble_fraction(8, 4) < 1
+    # more microbatches amortize the bubble
+    assert sched.bubble_fraction(64, 4) < sched.bubble_fraction(8, 4)
