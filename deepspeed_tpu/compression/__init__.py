@@ -1,0 +1,12 @@
+"""Compression suite (reference ``deepspeed/compression``): quantization-
+aware training, activation quantization, sparse/row/head pruning, layer
+reduction for distillation — all config-driven via ``init_compression``."""
+
+from .compress import init_compression, redundancy_clean
+from .config import get_compression_config
+from .ops import (fake_quantize, head_pruning_mask, quantize_activation,
+                  row_pruning_mask, sparse_pruning_mask)
+
+__all__ = ["init_compression", "redundancy_clean", "get_compression_config",
+           "fake_quantize", "quantize_activation", "sparse_pruning_mask",
+           "row_pruning_mask", "head_pruning_mask"]

@@ -1,0 +1,158 @@
+"""``init_compression`` — config-driven model compression.
+
+Reference ``compression/compress.py:99 init_compression`` walks the torch
+module tree replacing Linear/Embedding with ``*_Compress`` variants per the
+config's ``different_groups`` module patterns.  The TPU analog wraps a
+:class:`ModelSpec`: a *param transform* applies scheduled fake-quantization
+and pruning masks to the leaves whose path matches a group's ``modules``
+patterns, just before the loss/apply functions run — same QAT semantics
+(compression in forward, straight-through gradients), no module tree needed.
+
+``redundancy_clean`` (reference ``compress.py:148``) bakes the masks/quant
+into the weights permanently for deployment.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..runtime.model import ModelSpec
+from ..utils.logging import log_dist
+from .config import CompressionConfig, get_compression_config
+from .ops import (fake_quantize, head_pruning_mask, row_pruning_mask,
+                  sparse_pruning_mask)
+
+PyTree = Any
+
+
+def _leaf_path_names(tree):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    names = ["/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                      for k in path) for path, _ in flat]
+    return names, [leaf for _, leaf in flat], treedef
+
+
+def _matches(name: str, patterns: List[str]) -> bool:
+    return any(fnmatch.fnmatch(name, p) or p in name for p in patterns)
+
+
+def _build_transform(cfg: CompressionConfig, num_heads: Optional[int]):
+    """Compile the config into a per-leaf transform list."""
+    rules = []  # (kind, patterns, fn(leaf) -> leaf)
+
+    wq = cfg.weight_quantization
+    if wq.shared_parameters.enabled:
+        for gname, grp in wq.different_groups.items():
+            bits = grp.target_bits
+            qt = wq.shared_parameters.quantization_type
+            groups = wq.shared_parameters.quantize_groups
+            rules.append(("quant", grp.modules,
+                          lambda w, b=bits, q=qt, g=groups:
+                          fake_quantize(w, b, g, q, False)))
+
+    sp = cfg.sparse_pruning
+    if sp.shared_parameters.enabled:
+        for gname, grp in sp.different_groups.items():
+            ratio = grp.dense_ratio
+            rules.append(("sparse", grp.modules,
+                          lambda w, r=ratio: w * sparse_pruning_mask(w, r)))
+
+    rp = cfg.row_pruning
+    if rp.shared_parameters.enabled:
+        for gname, grp in rp.different_groups.items():
+            ratio = grp.dense_ratio
+            rules.append(("row", grp.modules,
+                          lambda w, r=ratio: w * row_pruning_mask(w, r)))
+
+    hp = cfg.head_pruning
+    if hp.shared_parameters.enabled:
+        assert num_heads, "head_pruning needs num_heads (pass via model cfg)"
+        for gname, grp in hp.different_groups.items():
+            ratio = grp.dense_ratio
+            rules.append(("head", grp.modules,
+                          lambda w, r=ratio: w * head_pruning_mask(
+                              w, r, num_heads)))
+    return rules
+
+
+def compress_params(params: PyTree, rules) -> PyTree:
+    names, leaves, treedef = _leaf_path_names(params)
+    out = []
+    for name, leaf in zip(names, leaves):
+        for kind, patterns, fn in rules:
+            if getattr(leaf, "ndim", 0) >= 2 and _matches(name, patterns):
+                leaf = fn(leaf)
+        out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def init_compression(model: ModelSpec, deepspeed_config,
+                     num_heads: Optional[int] = None,
+                     mpu=None) -> ModelSpec:
+    """Wrap ``model`` so its forward sees compressed weights
+    (reference ``init_compression``, ``compress.py:99``).
+
+    ``deepspeed_config``: dict (or parsed config) containing
+    ``compression_training``.  Scheduling: ``schedule_offset`` is honored by
+    the engine which flips the transform on at that step; standalone use
+    applies it immediately.
+    """
+    pd = deepspeed_config if isinstance(deepspeed_config, dict) else \
+        getattr(deepspeed_config, "_param_dict", {})
+    cfg = get_compression_config(pd)
+    rules = _build_transform(cfg, num_heads)
+    if not rules:
+        log_dist("init_compression: no compression groups enabled", ranks=[0])
+        return model
+
+    import dataclasses
+
+    orig_loss, orig_apply = model.loss_fn, model.apply_fn
+
+    def loss_fn(params, batch, rng=None, train=True):
+        return orig_loss(compress_params(params, rules), batch, rng, train)
+
+    def apply_fn(params, batch, rng=None):
+        return orig_apply(compress_params(params, rules), batch, rng)
+
+    wrapped = dataclasses.replace(
+        model, loss_fn=loss_fn,
+        apply_fn=apply_fn if orig_apply else None,
+        name=model.name + "+compressed")
+    wrapped._compression_rules = rules
+    return wrapped
+
+
+def redundancy_clean(model_or_params, deepspeed_config,
+                     num_heads: Optional[int] = None) -> PyTree:
+    """Bake compression into the weights for deployment
+    (reference ``compress.py:148``).  Accepts a param pytree; returns the
+    quantize-dequantized / masked copy."""
+    pd = deepspeed_config if isinstance(deepspeed_config, dict) else \
+        getattr(deepspeed_config, "_param_dict", {})
+    cfg = get_compression_config(pd)
+    rules = _build_transform(cfg, num_heads)
+    return compress_params(model_or_params, rules)
+
+
+def apply_layer_reduction(params: PyTree, blocks_key, keep_layers: List[int]
+                          ) -> PyTree:
+    """Student init from selected teacher layers (reference
+    ``compression/helper.py student_initialization``): slice the scan-stacked
+    blocks to ``keep_layers``."""
+    import copy
+
+    path = (blocks_key,) if isinstance(blocks_key, str) else tuple(blocks_key)
+    out = copy.copy(params) if isinstance(params, dict) else dict(params)
+    node = out
+    for k in path[:-1]:
+        node[k] = dict(node[k])
+        node = node[k]
+    idx = np.asarray(keep_layers)
+    node[path[-1]] = jax.tree_util.tree_map(lambda b: b[idx],
+                                            node[path[-1]])
+    return out

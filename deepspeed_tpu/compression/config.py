@@ -1,0 +1,95 @@
+"""Compression config schema — same key names as the reference
+(``compression/config.py`` / ``compression/constants.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+
+class QuantSharedParams(DeepSpeedConfigModel):
+    enabled: bool = False
+    quantizer_kernel: bool = False
+    schedule_offset: int = 0
+    quantize_groups: int = 1
+    quantize_verbose: bool = False
+    quantization_type: str = "symmetric"       # symmetric | asymmetric
+    rounding: str = "nearest"                  # nearest | stochastic
+    quantize_weight_in_forward: bool = True
+    fp16_mixed_quantize: Dict[str, Any] = {}
+
+
+class QuantGroup(DeepSpeedConfigModel):
+    params: Dict[str, Any] = {}
+    modules: List[str] = ["*"]
+    related_modules: Optional[List[str]] = None
+
+    @property
+    def target_bits(self) -> int:
+        return int(self.params.get("target_bits", 8))
+
+    @property
+    def start_bits(self) -> int:
+        return int(self.params.get("start_bits", self.target_bits))
+
+
+class WeightQuantConfig(DeepSpeedConfigModel):
+    shared_parameters: QuantSharedParams = QuantSharedParams()
+    different_groups: Dict[str, QuantGroup] = {}
+
+
+class ActQuantSharedParams(DeepSpeedConfigModel):
+    enabled: bool = False
+    quantization_type: str = "symmetric"
+    range_calibration: str = "dynamic"
+    schedule_offset: int = 0
+
+
+class ActQuantConfig(DeepSpeedConfigModel):
+    shared_parameters: ActQuantSharedParams = ActQuantSharedParams()
+    different_groups: Dict[str, QuantGroup] = {}
+
+
+class PruneSharedParams(DeepSpeedConfigModel):
+    enabled: bool = False
+    schedule_offset: int = 0
+    method: str = "l1"  # l1 | topk
+    dense_ratio: float = 1.0
+
+
+class PruneGroup(DeepSpeedConfigModel):
+    params: Dict[str, Any] = {}
+    modules: List[str] = ["*"]
+    related_modules: Optional[List[str]] = None
+
+    @property
+    def dense_ratio(self) -> float:
+        return float(self.params.get("dense_ratio", 0.5))
+
+
+class PruneConfig(DeepSpeedConfigModel):
+    shared_parameters: PruneSharedParams = PruneSharedParams()
+    different_groups: Dict[str, PruneGroup] = {}
+
+
+class LayerReductionConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    keep_number_layer: int = 0
+    module_name_prefix: str = ""
+    teacher_layer: List[int] = []
+    other_module_name: List[str] = []
+
+
+class CompressionConfig(DeepSpeedConfigModel):
+    weight_quantization: WeightQuantConfig = WeightQuantConfig()
+    activation_quantization: ActQuantConfig = ActQuantConfig()
+    sparse_pruning: PruneConfig = PruneConfig()
+    row_pruning: PruneConfig = PruneConfig()
+    head_pruning: PruneConfig = PruneConfig()
+    layer_reduction: LayerReductionConfig = LayerReductionConfig()
+
+
+def get_compression_config(param_dict: dict) -> CompressionConfig:
+    block = param_dict.get("compression_training", {})
+    return CompressionConfig(**block)

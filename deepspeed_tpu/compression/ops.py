@@ -1,0 +1,112 @@
+"""Compression primitives: fake-quant with straight-through gradients,
+pruning masks.
+
+Reference kernels: ``csrc/quantization/{quantize,fake_quantizer}.cu`` (group
+symmetric/asymmetric/stochastic quantization) and the mask construction in
+``compression/basic_layer.py`` (``LinearLayer_Compress.fix_sparse_pruning``
+etc.).  On TPU the quantization arithmetic fuses into the surrounding ops via
+XLA; the straight-through estimator is a ``custom_vjp`` identity backward.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def fake_quantize(w, bits: int = 8, groups: int = 1,
+                  quant_type: str = "symmetric", stochastic: bool = False):
+    """Quantize-dequantize ``w`` to ``bits`` with per-group scaling; gradient
+    is straight-through (identity)."""
+    return _fake_quantize_fwd_value(w, bits, groups, quant_type, stochastic,
+                                    None)
+
+
+def _group_view(w, groups: int):
+    flat = w.reshape(-1)
+    pad = (-flat.size) % groups
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(groups, -1), pad, w.shape
+
+
+def _fake_quantize_fwd_value(w, bits, groups, quant_type, stochastic, rng):
+    q_max = 2.0 ** (bits - 1) - 1
+    g, pad, shape = _group_view(w.astype(jnp.float32), groups)
+    if quant_type == "asymmetric":
+        lo = jnp.min(g, axis=-1, keepdims=True)
+        hi = jnp.max(g, axis=-1, keepdims=True)
+        scale = (hi - lo) / (2.0 ** bits - 1)
+        scale = jnp.where(scale == 0, 1.0, scale)
+        q = (g - lo) / scale
+        q = _round(q, stochastic, rng)
+        deq = q * scale + lo
+    else:  # symmetric
+        amax = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
+        scale = jnp.where(amax == 0, 1.0, amax / q_max)
+        q = jnp.clip(_round(g / scale, stochastic, rng), -q_max - 1, q_max)
+        deq = q * scale
+    out = deq.reshape(-1)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(shape).astype(w.dtype)
+
+
+def _round(x, stochastic: bool, rng):
+    if stochastic and rng is not None:
+        return jnp.floor(x + jax.random.uniform(rng, x.shape))
+    return jnp.round(x)
+
+
+def _fq_fwd(w, bits, groups, quant_type, stochastic):
+    return fake_quantize(w, bits, groups, quant_type, stochastic), None
+
+
+def _fq_bwd(bits, groups, quant_type, stochastic, res, g):
+    return (g,)  # straight-through estimator
+
+
+fake_quantize.defvjp(_fq_fwd, _fq_bwd)
+
+
+def quantize_activation(x, bits: int = 8, quant_type: str = "symmetric"):
+    """Dynamic-range activation fake-quant (per-tensor); STE gradient."""
+    return fake_quantize(x, bits, 1, quant_type, False)
+
+
+# ------------------------------------------------------------------ pruning
+def sparse_pruning_mask(w, dense_ratio: float, method: str = "l1"):
+    """Unstructured magnitude mask keeping ``dense_ratio`` of elements
+    (reference ``fix_sparse_pruning``)."""
+    flat = jnp.abs(w.reshape(-1))
+    k = max(1, int(flat.size * dense_ratio))
+    thresh = jnp.sort(flat)[-k]
+    return (jnp.abs(w) >= thresh).astype(w.dtype)
+
+
+def row_pruning_mask(w, dense_ratio: float):
+    """Structured row mask by l1 row norm (reference ``fix_row_pruning``);
+    w: [..., rows, cols] — masks rows."""
+    norms = jnp.sum(jnp.abs(w), axis=-1)
+    k = max(1, int(norms.shape[-1] * dense_ratio))
+    thresh = jnp.sort(norms, axis=-1)[..., -k][..., None]
+    return (norms >= thresh)[..., None].astype(w.dtype) * jnp.ones_like(w)
+
+
+def head_pruning_mask(w, dense_ratio: float, num_heads: int):
+    """Attention-head mask by per-head l1 norm on an output-projection-shaped
+    weight [in(=H*hd), out] (reference ``fix_head_pruning``)."""
+    in_dim = w.shape[-2]
+    hd = in_dim // num_heads
+    heads = w.reshape(w.shape[:-2] + (num_heads, hd, w.shape[-1]))
+    norms = jnp.sum(jnp.abs(heads), axis=(-1, -2))          # [..., H]
+    k = max(1, int(num_heads * dense_ratio))
+    thresh = jnp.sort(norms, axis=-1)[..., -k][..., None]
+    mask = (norms >= thresh).astype(w.dtype)                # [..., H]
+    mask = jnp.repeat(mask[..., None], hd, axis=-1).reshape(
+        w.shape[:-2] + (in_dim, 1))
+    return mask * jnp.ones_like(w)

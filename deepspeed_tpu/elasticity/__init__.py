@@ -1,0 +1,24 @@
+"""Elastic training: batch-size math + preemption resume.
+
+Reference: ``deepspeed/elasticity`` — ``compute_elastic_config``
+(``elasticity/elasticity.py:287``) derives, from a target batch-size range
+and allowed micro-batch sizes, the set of accelerator counts that keep the
+*global* batch size constant, so a job can lose or gain nodes and resume
+without changing training semantics; ``DSElasticAgent``
+(``elastic_agent.py:25``) restarts workers on membership changes.
+
+TPU realisation: the math is framework-neutral and lives in
+:mod:`elasticity`.  The agent role is played by the platform (GKE job
+controller / ``gcloud`` queued resources restart preempted slices); resume =
+``load_checkpoint`` under the new mesh, which the universal-checkpoint
+resharding already handles (``tests/unit/test_universal_checkpoint.py``) —
+see :func:`resume_notes` for the operational recipe.
+"""
+
+from .config import ElasticityConfig, ElasticityConfigError, ElasticityError
+from .elasticity import (compute_elastic_config, elasticity_enabled,
+                         get_compatible_accelerator_counts, resume_notes)
+
+__all__ = ["ElasticityConfig", "ElasticityConfigError", "ElasticityError",
+           "compute_elastic_config", "elasticity_enabled",
+           "get_compatible_accelerator_counts", "resume_notes"]

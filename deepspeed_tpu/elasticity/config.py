@@ -1,0 +1,58 @@
+"""Elasticity config schema (reference ``elasticity/config.py``)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+
+class ElasticityError(Exception):
+    """Base error for elastic training (reference ``elasticity/constants``)."""
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+LATEST_ELASTICITY_VERSION = 0.2
+
+
+class ElasticityConfig(DeepSpeedConfigModel):
+    """Keys mirror the reference's ``elasticity`` block."""
+
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = [2, 4, 6]
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.2
+    prefer_larger_batch: bool = True
+    ignore_non_elastic_batch_info: bool = False
+    #: v0.2: accelerator counts must be multiples of this (chips per host x
+    #: model-parallel degree)
+    model_parallel_size: int = 1
+    num_gpus_per_node: int = 1
+
+    def validate(self) -> None:
+        if not self.micro_batch_sizes:
+            raise ElasticityConfigError("micro_batch_sizes must be non-empty")
+        if any(m <= 0 for m in self.micro_batch_sizes):
+            raise ElasticityConfigError(
+                f"micro_batch_sizes must be positive: {self.micro_batch_sizes}")
+        if self.max_train_batch_size < min(self.micro_batch_sizes):
+            raise ElasticityConfigError(
+                f"max_train_batch_size {self.max_train_batch_size} is smaller "
+                f"than the smallest micro batch {min(self.micro_batch_sizes)}")
+        if self.min_gpus < 1 or self.max_gpus < self.min_gpus:
+            raise ElasticityConfigError(
+                f"invalid accelerator range [{self.min_gpus}, {self.max_gpus}]")
+        if self.version > LATEST_ELASTICITY_VERSION:
+            raise ElasticityConfigError(
+                f"elasticity version {self.version} > latest supported "
+                f"{LATEST_ELASTICITY_VERSION}")

@@ -1,0 +1,138 @@
+"""Elastic batch-size math (reference ``elasticity/elasticity.py:287``).
+
+Given a maximum acceptable global batch, a set of candidate micro-batch
+sizes, and an accelerator-count range, find the global batch size B and the
+set of accelerator counts W such that for every w in W there is a micro
+batch m and accumulation steps g with ``m * g * w == B`` — so scaling the
+job up or down inside W never changes the effective batch.
+
+v0.2 semantics: accelerator counts are constrained to multiples of
+``num_gpus_per_node * model_parallel_size`` and the data-parallel degree is
+``w / model_parallel_size`` (reference ``_get_compatible_gpus_v02``:173).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.logging import logger
+from .config import (ElasticityConfig, ElasticityConfigError,
+                     ElasticityIncompatibleWorldSize)
+
+
+def _valid_counts_for_batch(batch: int, micro_batches: List[int],
+                            min_n: int, max_n: int, step: int) -> List[int]:
+    """Accelerator counts in [min_n, max_n] (multiples of ``step``) that can
+    realize ``batch`` with some (micro, gas) pair."""
+    valid = []
+    start = -(-max(min_n, step) // step) * step  # round UP to a step multiple
+    for w in range(start, max_n + 1, step):
+        for m in micro_batches:
+            if batch % (m * w) == 0:
+                valid.append(w)
+                break
+    return valid
+
+
+def get_compatible_accelerator_counts(
+        max_batch: int, micro_batches: List[int], min_n: int, max_n: int,
+        prefer_larger: bool = True, step: int = 1) -> Tuple[int, List[int]]:
+    """Pick the global batch <= max_batch maximizing elastic range.
+
+    Candidates are multiples of each micro batch padded up to highly
+    composite values (a batch with many divisors is compatible with many
+    world sizes).  Returns (batch, sorted valid counts)."""
+    candidates = set()
+    base = max(micro_batches)
+    # highly-divisible candidates: lcm of micros scaled by 2^k, plus each
+    # micro's largest power-of-two multiple under the cap
+    l = math.lcm(*micro_batches)
+    v = l
+    while v <= max_batch:
+        candidates.add(v)
+        v *= 2
+    for m in micro_batches:
+        v = m
+        while v * 2 <= max_batch:
+            v *= 2
+        candidates.add(v)
+    candidates.add(max_batch - (max_batch % base) or base)
+
+    best: Tuple[int, List[int]] = (0, [])
+    for batch in sorted(candidates):
+        if batch <= 0 or batch > max_batch:
+            continue
+        valid = _valid_counts_for_batch(batch, micro_batches, min_n, max_n,
+                                        step)
+        better = (len(valid), batch if prefer_larger else -batch) > \
+            (len(best[1]), best[0] if prefer_larger else -best[0])
+        if valid and better:
+            best = (batch, valid)
+    if not best[1]:
+        raise ElasticityConfigError(
+            f"no batch <= {max_batch} over micro batches {micro_batches} is "
+            f"compatible with any count in [{min_n}, {max_n}] (step {step})")
+    return best
+
+
+def compute_elastic_config(ds_config: dict, world_size: int = 0,
+                           return_microbatch: bool = False):
+    """Reference-parity entry (``elasticity.py:287``).
+
+    Returns ``(final_batch_size, valid_world_sizes[, micro_batch])``; with
+    ``world_size > 0`` also validates it and derives that size's
+    micro-batch/GAS pair.
+    """
+    ecfg = ElasticityConfig(**ds_config.get("elasticity", {}))
+    ecfg.validate()
+    if not ecfg.enabled:
+        raise ElasticityConfigError("elasticity is not enabled in the config")
+
+    step = (ecfg.num_gpus_per_node * ecfg.model_parallel_size
+            if ecfg.version >= 0.2 else 1)
+    batch, valid = get_compatible_accelerator_counts(
+        ecfg.max_train_batch_size, sorted(ecfg.micro_batch_sizes),
+        ecfg.min_gpus, ecfg.max_gpus, prefer_larger=ecfg.prefer_larger_batch,
+        step=step)
+
+    micro = None
+    if world_size > 0:
+        if world_size not in valid:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} is not in the elastic set {valid} "
+                f"for global batch {batch}")
+        dp = world_size // ecfg.model_parallel_size \
+            if ecfg.version >= 0.2 else world_size
+        # largest micro batch that divides the per-dp share (prefer fewer
+        # accumulation steps)
+        for m in sorted(ecfg.micro_batch_sizes, reverse=True):
+            if batch % (m * dp) == 0:
+                micro = m
+                break
+        assert micro is not None
+    if return_microbatch:
+        return batch, valid, micro
+    return batch, valid
+
+
+def elasticity_enabled(ds_config: dict) -> bool:
+    return bool(ds_config.get("elasticity", {}).get("enabled", False))
+
+
+def resume_notes() -> str:
+    """Operational recipe for preemption resume on TPU (the DSElasticAgent
+    analog — reference ``elastic_agent.py:25`` restarts torch workers; on
+    TPU the platform restarts the slice and training resumes by reloading
+    under the new mesh)."""
+    return (
+        "1. run under a restarting controller (GKE Job/JobSet or gcloud "
+        "queued-resources) so preempted slices are re-created;\n"
+        "2. save checkpoints at a cadence >= elasticity.min_time via "
+        "engine.save_checkpoint (orbax writes are multi-host);\n"
+        "3. on restart, build the mesh from the surviving slice size, pick "
+        "the micro-batch from compute_elastic_config(world_size=N), and "
+        "engine.load_checkpoint — universal-checkpoint resharding restores "
+        "params/optimizer under the new (dp, tp, pp) layout;\n"
+        "4. the global batch is unchanged by construction, so schedules and "
+        "convergence are unaffected.")

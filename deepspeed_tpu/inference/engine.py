@@ -118,7 +118,12 @@ class InferenceEngine:
             else:
                 self._generate_fns[key] = self._build_recompute_gen(
                     b, prompt_len, total, sample_cfg)
-        rng = jax.random.PRNGKey(0 if seed is None else seed)
+        if seed is None:
+            # fresh draws per call (HF generate uses a stateful RNG); pass
+            # an explicit seed for reproducibility
+            self._sample_calls = getattr(self, "_sample_calls", -1) + 1
+            seed = self._sample_calls
+        rng = jax.random.PRNGKey(seed)
         out = self._generate_fns[key](self.params, jnp.asarray(input_ids), rng)
         out = np.array(out)  # writable host copy (np.asarray view is read-only)
         if eos_token_id is not None:

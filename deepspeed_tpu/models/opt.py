@@ -310,6 +310,18 @@ def forward_cached(cfg: OPTConfig, params, input_ids, cache, pos):
     return logits, {"k": ks, "v": vs}
 
 
+def _ce_from_logits(logits, targets):
+    """``lse - picked_logit`` cross entropy: never materializes a [T, V] f32
+    log-softmax tensor (same memory reasoning as gpt2._head_loss)."""
+    valid = targets >= 0  # -100 = ignore (HF convention)
+    safe = jnp.where(valid, targets, 0)
+    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logits, safe[..., None],
+                                 axis=-1)[..., 0].astype(jnp.float32)
+    nll = lse - picked
+    return jnp.where(valid, nll, 0.0).sum() / jnp.maximum(valid.sum(), 1)
+
+
 def loss_from_batch(cfg: OPTConfig, params, batch, rng=None,
                     train: bool = True):
     if isinstance(batch, (tuple, list)):
@@ -320,13 +332,18 @@ def loss_from_batch(cfg: OPTConfig, params, batch, rng=None,
     if labels is None:
         labels = input_ids[:, 1:]
         input_ids = input_ids[:, :-1]
-    logits = forward(cfg, params, input_ids, rng=rng, train=train)
-    logits = logits.astype(jnp.float32)
-    valid = labels >= 0
-    safe = jnp.where(valid, labels, 0)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-    return jnp.where(valid, nll, 0.0).sum() / jnp.maximum(valid.sum(), 1)
+    x = _embed(cfg, params, input_ids)
+
+    def body(x, xs):
+        layer, = xs
+        block_fn = jax.checkpoint(_block, static_argnums=(0,)) if cfg.remat \
+            else _block
+        return block_fn(cfg, x, layer), None
+
+    x, _ = jax.lax.scan(body, x, (params["blocks"],))
+    # checkpointed head: backward recomputes logits from [T, D] activations
+    head = jax.checkpoint(lambda p, x, t: _head_loss(cfg, p, x, t))
+    return head(params, x, labels)
 
 
 def tp_rules(cfg: OPTConfig, abstract_params: PyTree) -> PyTree:
@@ -454,9 +471,4 @@ def build(cfg: Optional[OPTConfig] = None, **overrides) -> ModelSpec:
 
 
 def _head_loss(cfg: OPTConfig, params, x, targets):
-    logits = _head(cfg, params, x).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    valid = targets >= 0
-    safe = jnp.where(valid, targets, 0)
-    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-    return jnp.where(valid, nll, 0.0).sum() / jnp.maximum(valid.sum(), 1)
+    return _ce_from_logits(_head(cfg, params, x), targets)

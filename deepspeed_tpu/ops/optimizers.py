@@ -150,4 +150,23 @@ def get_optimizer(name: str, params: dict,
     if name == "lion":
         return lion(lr=lr, betas=tuple(params.pop("betas", (0.9, 0.99))),
                     weight_decay=params.pop("weight_decay", 0.0))
+    if name in ("onebitadam", "onebitlamb", "zerooneadam"):
+        # 1-bit optimizers (reference runtime/fp16/onebit/*)
+        from ..runtime.fp16.onebit import (onebit_adam, onebit_lamb,
+                                           zero_one_adam)
+
+        common = dict(lr=lr, betas=tuple(params.pop("betas", (0.9, 0.999))),
+                      eps=params.pop("eps", 1e-8),
+                      weight_decay=params.pop("weight_decay", 0.0))
+        if name == "onebitadam":
+            return onebit_adam(freeze_step=params.pop("freeze_step", 100),
+                               **common)
+        if name == "onebitlamb":
+            return onebit_lamb(freeze_step=params.pop("freeze_step", 100),
+                               min_coeff=params.pop("min_coeff", 0.01),
+                               max_coeff=params.pop("max_coeff", 0.3),
+                               **common)
+        return zero_one_adam(
+            var_freeze_step=params.pop("var_freeze_step", 100),
+            var_update_scaler=params.pop("var_update_scaler", 16), **common)
     raise ValueError(f"unknown optimizer {name!r}")

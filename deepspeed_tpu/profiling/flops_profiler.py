@@ -1,0 +1,185 @@
+"""Flops profiler — XLA cost analysis + wall-clock, per model component.
+
+Analog of reference ``profiling/flops_profiler/profiler.py:20 FlopsProfiler``,
+which monkey-patches ``torch.nn.functional`` to count MACs/params/latency per
+module.  Under XLA nothing needs patching: the compiler already knows the op
+costs — ``jit(...).lower(...).compile().cost_analysis()`` returns the flops /
+bytes-accessed estimates for the exact program that runs.  Per-component
+breakdown (embed / one transformer block / head) comes from cost-analyzing the
+model's pipeline hooks when present.
+
+Engine integration mirrors the reference (``runtime/engine.py:315,1796``):
+with ``flops_profiler.enabled``, the engine profiles the step at
+``profile_step`` and prints the table (+ optional ``output_file``).
+
+Standalone API parity: :func:`get_model_profile` (reference
+``profiler.py:1119``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+
+PyTree = Any
+
+
+def _cost(fn, *args) -> Dict[str, float]:
+    """XLA cost analysis of jit(fn)(*args): flops + bytes accessed."""
+    try:
+        compiled = jax.jit(fn).lower(*args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return {"flops": float(ca.get("flops", 0.0)),
+                "bytes": float(ca.get("bytes accessed", 0.0))}
+    except Exception as e:  # cost analysis is best-effort on some backends
+        logger.warning(f"flops profiler: cost analysis failed: {e}")
+        return {"flops": 0.0, "bytes": 0.0}
+
+
+def _num_params(tree) -> int:
+    return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(tree)
+               if hasattr(x, "shape"))
+
+
+def _fmt_flops(f: float) -> str:
+    for unit, div in (("TFLOPs", 1e12), ("GFLOPs", 1e9), ("MFLOPs", 1e6)):
+        if f >= div:
+            return f"{f/div:.2f} {unit}"
+    return f"{f:.0f} FLOPs"
+
+
+class FlopsProfiler:
+    """Profiles an engine's train step (or a bare model fwd)."""
+
+    def __init__(self, engine=None, model_spec=None):
+        self.engine = engine
+        self.model_spec = model_spec or (engine.model_spec if engine else None)
+        self.profile: Dict[str, Any] = {}
+
+    # ---------------------------------------------------------------- engine
+    def profile_engine_step(self, batch,
+                            latency: Optional[float] = None) -> Dict[str, Any]:
+        """Cost-analyze the engine's compiled train step; breaks down per
+        component when pipeline hooks exist.  ``latency``: the wall clock of
+        an already-executed step (the engine hook passes it — profiling never
+        runs extra optimizer updates)."""
+        eng = self.engine
+        first = jax.tree_util.tree_leaves(batch)[0]
+        if first.ndim == 2:  # host [B, S]: shape like train_batch does
+            batch = eng._reshape_global_batch(batch)
+            batch = eng._shard_batch(batch, leading_gas_dim=True)
+        prof: Dict[str, Any] = {}
+        prof["params"] = _num_params(eng.state["params"])
+        if getattr(eng, "_param_store", None) is not None:
+            prof["params"] += sum(m.size for m in eng._param_store.master)
+
+        step_fn = eng._train_step_fn if not eng.offload_enabled else \
+            eng._offload_grads_fn
+        c = _cost(step_fn, eng.state, batch, eng._dropout_rng)
+        # NOTE: XLA cost analysis counts a scan/while body ONCE, so this
+        # aggregate under-reports layer-scanned models; the per-module
+        # breakdown below (block cost x num_layers) is authoritative
+        prof["xla_step_flops"] = c["flops"]
+        prof["step_bytes"] = c["bytes"]
+        prof["step_latency_s"] = latency or 0.0
+
+        hooks = self.model_spec.pipeline_hooks if self.model_spec else None
+        if hooks:
+            mods = self._module_breakdown(hooks, batch)
+            prof["modules"] = mods
+            gas = eng.gradient_accumulation_steps()
+            micro_fwd = (mods["embedding"]["flops"] +
+                         mods["transformer_block"]["flops"] *
+                         mods["transformer_block"]["count"] +
+                         mods["head_loss"]["flops"])
+            prof["fwd_flops"] = micro_fwd * gas
+            # fwd + bwd (~2x fwd) + optional activation-recompute factor
+            refwd = eng._config.flops_profiler_config.recompute_fwd_factor
+            prof["step_flops"] = prof["fwd_flops"] * (3.0 + refwd)
+        else:
+            prof["step_flops"] = prof["xla_step_flops"]
+        if prof["step_latency_s"] > 0 and prof["step_flops"]:
+            prof["achieved_tflops"] = (prof["step_flops"] /
+                                       prof["step_latency_s"] / 1e12)
+        self.profile = prof
+        return prof
+
+    def _module_breakdown(self, hooks, batch):
+        eng = self.engine
+        ids = jax.tree_util.tree_leaves(batch)[0]
+        # one microbatch of token ids
+        mb_ids = np.zeros((ids.shape[-2] if ids.ndim > 2 else ids.shape[0],
+                           ids.shape[-1] - 1), np.int32)
+        params = jax.device_get(eng.state["params"])
+        out = {}
+        embed_fn = hooks["embed_fn"]
+        out["embedding"] = _cost(embed_fn, params, mb_ids)
+        x = jax.eval_shape(embed_fn, params, mb_ids)
+        x0 = np.zeros(x.shape, x.dtype)
+
+        blocks = None
+        try:
+            node = params
+            key = hooks["blocks_key"]
+            for k in ((key,) if isinstance(key, str) else key):
+                node = node[k]
+            blocks = node
+        except (KeyError, TypeError):
+            pass
+        if blocks and jax.tree_util.tree_leaves(blocks):
+            layer0 = jax.tree_util.tree_map(lambda b: b[0], blocks)
+            block_fn = hooks["block_fn"]
+            n_layers = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+            bc = _cost(lambda l, xx: block_fn(l, xx), layer0, x0)
+            out["transformer_block"] = dict(bc, count=n_layers,
+                                            params=_num_params(layer0))
+        targets = np.zeros(mb_ids.shape, np.int32)
+        out["head_loss"] = _cost(hooks["head_loss_fn"], params, x0, targets)
+        return out
+
+    # ------------------------------------------------------------ standalone
+    def print_profile(self, output_file: Optional[str] = None) -> str:
+        p = self.profile
+        lines = ["", "-" * 64,
+                 "DeepSpeed-TPU Flops Profiler",
+                 "-" * 64,
+                 f"params:               {p.get('params', 0)/1e6:,.2f} M",
+                 f"fwd+bwd+update flops: {_fmt_flops(p.get('step_flops', 0))}",
+                 f"step HBM traffic:     {p.get('step_bytes', 0)/1e9:,.2f} GB",
+                 f"step latency:         {p.get('step_latency_s', 0)*1e3:,.1f} ms",
+                 ]
+        if "achieved_tflops" in p:
+            lines.append(f"achieved throughput:  "
+                         f"{p['achieved_tflops']:,.2f} TFLOPS")
+        for name, m in (p.get("modules") or {}).items():
+            cnt = f" x{m['count']}" if "count" in m else ""
+            par = f", {m['params']/1e6:.2f}M params" if "params" in m else ""
+            lines.append(f"  {name:20s}{cnt:5s} "
+                         f"{_fmt_flops(m['flops'])}{par}")
+        lines.append("-" * 64)
+        text = "\n".join(lines)
+        log_dist(text, ranks=[0])
+        if output_file:
+            with open(output_file, "w") as f:
+                f.write(text + "\n")
+        return text
+
+
+def get_model_profile(model_spec, batch, rng=None) -> Dict[str, float]:
+    """Standalone fwd-pass profile of a ModelSpec on a sample batch
+    (reference ``get_model_profile``, ``profiler.py:1119``).
+
+    Returns {"flops", "macs", "params"} for one forward pass.
+    """
+    params = model_spec.init(jax.random.PRNGKey(0))
+    c = _cost(lambda p, b: model_spec.loss_fn(p, b, None, False), params,
+              batch)
+    return {"flops": c["flops"], "macs": c["flops"] / 2,
+            "params": _num_params(params)}

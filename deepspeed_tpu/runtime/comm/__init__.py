@@ -1,0 +1,7 @@
+"""Custom communication backends (reference ``runtime/comm/*``):
+error-compensated compressed collectives for the 1-bit optimizers."""
+
+from .compressed import (CompressedBackend, compressed_allreduce,
+                         error_shapes)
+
+__all__ = ["CompressedBackend", "compressed_allreduce", "error_shapes"]

@@ -1,0 +1,125 @@
+"""Error-compensated 1-bit compressed collectives.
+
+Reference: ``runtime/comm/nccl.py:52 NcclBackend.compressed_allreduce`` — the
+1-bit Adam communication layer.  The algorithm is two-stage, chunked:
+
+ 1. every worker splits its tensor into ``n`` chunks, 1-bit-compresses each
+    (sign int8 + one f32 scale per chunk, residual kept as **worker error**
+    feedback), and all-to-alls the chunks so worker ``j`` holds everyone's
+    chunk ``j``;
+ 2. worker ``j`` decompresses and averages its chunk, compresses the average
+    (residual kept as **server error** feedback), and all-gathers the result.
+
+Wire traffic is ~2x size x 1 byte (int8 both rounds) vs ~2x size x 4 bytes
+for an fp32 ring all-reduce — the same ~4x compression the reference gets,
+here expressed with ``lax.all_to_all``/``all_gather`` on int8 inside
+``shard_map`` so XLA moves the small dtype over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _compress(comp):
+    """sign/scale 1-bit quantization per leading chunk: comp [n, c] ->
+    (signs int8 [n, c], scales f32 [n], residual)."""
+    scales = jnp.mean(jnp.abs(comp), axis=-1)
+    signs = jnp.where(comp >= 0, 1, -1).astype(jnp.int8)
+    deq = signs.astype(jnp.float32) * scales[..., None]
+    return signs, scales, comp - deq
+
+
+def compressed_allreduce(x, worker_error, server_error, axis_name: str):
+    """All-reduce-mean of ``x`` over ``axis_name`` with 1-bit compression.
+
+    Must run inside ``shard_map``/``pmap``.  ``worker_error`` has ``x``'s
+    (padded, chunked) shape [n, c]; ``server_error`` has one chunk's shape
+    [c].  Returns ``(mean, new_worker_error, new_server_error)``; threading
+    the errors into the next call keeps the *accumulated* reduction unbiased
+    even though each step is lossy (the 1-bit Adam convergence argument).
+
+    Use :func:`error_shapes` to initialize the error buffers.
+    """
+    n = jax.lax.psum(1, axis_name)
+    orig_shape = x.shape
+    flat = x.reshape(-1)
+    pad = (-flat.size) % n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    chunks = flat.reshape(n, -1)                              # [n, c]
+
+    # stage 1: worker-side compression + all-to-all
+    comp = chunks + worker_error
+    signs, scales, new_worker_error = _compress(comp)
+    # worker j receives row j of every peer: [n, c] rows ordered by source
+    recv_signs = jax.lax.all_to_all(signs, axis_name, split_axis=0,
+                                    concat_axis=0, tiled=True)
+    recv_scales = jax.lax.all_to_all(scales, axis_name, split_axis=0,
+                                     concat_axis=0, tiled=True)
+    chunk_mean = jnp.mean(
+        recv_signs.astype(jnp.float32) * recv_scales[:, None], axis=0)  # [c]
+
+    # stage 2: server-side compression + all-gather
+    comp2 = (chunk_mean + server_error)[None, :]
+    signs2, scales2, server_residual = _compress(comp2)
+    new_server_error = server_residual[0]
+    out_signs = jax.lax.all_gather(signs2[0], axis_name)      # [n, c] int8
+    out_scales = jax.lax.all_gather(scales2[0], axis_name)    # [n]
+    out = (out_signs.astype(jnp.float32) *
+           out_scales[:, None]).reshape(-1)
+    size = int(np.prod(orig_shape))
+    return out[:size].reshape(orig_shape), new_worker_error, new_server_error
+
+
+def error_shapes(x_shape, n: int) -> Tuple[tuple, tuple]:
+    """(worker_error_shape, server_error_shape) for a tensor of x_shape
+    reduced over n workers."""
+    size = int(np.prod(x_shape))
+    c = -(-size // n)
+    return (n, c), (c,)
+
+
+class CompressedBackend:
+    """Stateful convenience wrapper holding per-worker/server error buffers
+    (reference ``NcclBackend`` keeps ``worker_error``/``server_error``)."""
+
+    def __init__(self, mesh, axis_name: str = "dp"):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.n = mesh.shape[axis_name]
+        self._errors = {}
+
+    def allreduce(self, key: str, x_sharded):
+        """All-reduce a [n, ...]-stacked per-worker array (leading dim =
+        worker) with persistent error feedback keyed by ``key``."""
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        n = self.n
+        per_shape = x_sharded.shape[1:]
+        if key not in self._errors:
+            we_s, se_s = error_shapes(per_shape, n)
+            self._errors[key] = (jnp.zeros((n,) + we_s, jnp.float32),
+                                 jnp.zeros((n,) + se_s, jnp.float32))
+        we, se = self._errors[key]
+
+        @jax.jit
+        def run(x, we, se):
+            def body(xw, wew, sew):
+                m, nwe, nse = compressed_allreduce(
+                    xw[0], wew[0], sew[0], self.axis_name)
+                return m[None], nwe[None], nse[None]
+
+            return shard_map(
+                body, mesh=self.mesh,
+                in_specs=(P(self.axis_name),) * 3,
+                out_specs=(P(self.axis_name),) * 3)(x, we, se)
+
+        mean_sh, nwe, nse = run(x_sharded, we, se)
+        self._errors[key] = (nwe, nse)
+        return mean_sh

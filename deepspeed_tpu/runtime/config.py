@@ -114,8 +114,65 @@ class DeepSpeedConfig:
         else:
             self.world_size = 1
         self._initialize_params(self._param_dict)
+        self._init_curriculum(self._param_dict)
+        self._apply_elasticity(self._param_dict)
         self._configure_train_batch_size()
         self._do_sanity_check()
+
+    def _init_curriculum(self, pd: dict) -> None:
+        """Curriculum learning block: legacy top-level ``curriculum_learning``
+        (reference v0.8.2, ``runtime/config.py curriculum_params``) or nested
+        under ``data_efficiency`` (newer layout, forward-compat)."""
+        block = pd.get("curriculum_learning")
+        if block is None:
+            block = pd.get("data_efficiency", {}).get(
+                "data_sampling", {}).get("curriculum_learning")
+        self.curriculum_params = dict(block or {})
+        self.curriculum_enabled = bool(
+            self.curriculum_params.get("enabled", False))
+
+    def _apply_elasticity(self, pd: dict) -> None:
+        """Elastic batch adoption + world-size validation (reference
+        ``runtime/engine.py:504`` + ``elasticity/elasticity.py:287``)."""
+        self.elasticity_config = None
+        eblock = pd.get("elasticity", {})
+        if not eblock.get("enabled", False):
+            return
+        from ..elasticity import ElasticityConfig, compute_elastic_config
+        from ..elasticity.config import ElasticityConfigError
+
+        ecfg = ElasticityConfig(**eblock)
+        mp = 1
+        for ax, n in self.mesh_config.items():
+            if ax != "dp":
+                mp *= int(n)
+        total = self.world_size * mp
+        batch, valid, micro = compute_elastic_config(
+            pd, world_size=total, return_microbatch=True)
+        explicit = (self.train_batch_size or
+                    self.train_micro_batch_size_per_gpu or
+                    self.gradient_accumulation_steps)
+        if explicit and not ecfg.ignore_non_elastic_batch_info:
+            raise ElasticityConfigError(
+                "elasticity is enabled but train_batch_size/"
+                "train_micro_batch_size_per_gpu/gradient_accumulation_steps "
+                "are also set; remove them or set "
+                "elasticity.ignore_non_elastic_batch_info "
+                "(reference config.py elastic checks)")
+        dp = total // ecfg.model_parallel_size if ecfg.version >= 0.2 \
+            else total
+        self.train_batch_size = batch
+        self.train_micro_batch_size_per_gpu = micro * dp // self.world_size \
+            if dp != self.world_size else micro
+        self.gradient_accumulation_steps = batch // (micro * dp)
+        self.elasticity_config = ecfg
+        self.elastic_valid_world_sizes = valid
+        from ..utils.logging import log_dist
+
+        log_dist(
+            f"elasticity: global batch {batch}, valid accelerator counts "
+            f"{valid}, micro={micro}, gas={self.gradient_accumulation_steps} "
+            f"at {total} accelerators", ranks=[0])
 
     # -- parsing --------------------------------------------------------------
     def _initialize_params(self, pd: dict) -> None:

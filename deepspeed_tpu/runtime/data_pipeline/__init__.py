@@ -1,0 +1,11 @@
+"""Data-efficiency pipeline (reference ``runtime/data_pipeline/*``):
+curriculum learning, memory-mapped indexed datasets, random layerwise token
+dropping (random-LTD)."""
+
+from .curriculum_scheduler import CurriculumScheduler
+from .indexed_dataset import MMapIndexedDataset, MMapIndexedDatasetBuilder
+from .random_ltd import RandomLTDScheduler, token_drop, token_restore
+
+__all__ = ["CurriculumScheduler", "MMapIndexedDataset",
+           "MMapIndexedDatasetBuilder", "RandomLTDScheduler", "token_drop",
+           "token_restore"]

@@ -1,0 +1,92 @@
+"""Curriculum learning scheduler.
+
+Reference ``runtime/data_pipeline/curriculum_scheduler.py:9
+CurriculumScheduler``: maps the global step to a "difficulty" (for
+``curriculum_type: seqlen``, the sequence length trained on), under one of
+four schedules.  The math is framework-neutral; the engine consumes the
+difficulty by truncating batches (reference ``runtime/engine.py:1806-1812``).
+
+Schedules (same config keys as the reference):
+ - ``fixed_linear``:   difficulty ramps linearly from ``min_difficulty`` to
+   ``max_difficulty`` over ``total_curriculum_step`` steps, quantized to
+   ``difficulty_step`` (quantization keeps the set of jit shapes small);
+ - ``fixed_root``:     same but on a root curve (``root_degree``);
+ - ``fixed_discrete``: explicit ``difficulty`` list with ``max_step``
+   boundaries;
+ - ``custom``:         user callable ``fn(step) -> difficulty``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+
+class CurriculumScheduler:
+
+    def __init__(self, config: Dict[str, Any]):
+        self.curriculum_type = config.get("curriculum_type", "seqlen")
+        self.min_difficulty = int(config.get("min_difficulty", 8))
+        self.max_difficulty = int(config.get("max_difficulty", 1024))
+        self.schedule_type = config.get("schedule_type", FIXED_LINEAR)
+        self.config = config.get("schedule_config", {})
+        self.current_difficulty = self.min_difficulty
+        self.custom_fn: Optional[Callable[[int], int]] = config.get(
+            "schedule_fn")
+        if self.schedule_type == FIXED_DISCRETE:
+            diffs = self.config.get("difficulty", [])
+            steps = self.config.get("max_step", [])
+            assert len(diffs) >= 1 and len(steps) == len(diffs) - 1, (
+                "fixed_discrete needs N difficulties and N-1 max_step "
+                "boundaries")
+        elif self.schedule_type in (FIXED_LINEAR, FIXED_ROOT):
+            assert self.config.get("total_curriculum_step", 0) > 0, (
+                f"{self.schedule_type} needs schedule_config."
+                "total_curriculum_step")
+        elif self.schedule_type == CUSTOM:
+            assert callable(self.custom_fn), "custom schedule needs schedule_fn"
+        else:
+            raise ValueError(f"unknown schedule_type {self.schedule_type!r}")
+
+    def _quantize(self, d: float) -> int:
+        step = int(self.config.get("difficulty_step", 8))
+        d = int(d // step * step)
+        return max(self.min_difficulty, min(d, self.max_difficulty))
+
+    def get_difficulty(self, global_step: int) -> int:
+        t = self.config.get("total_curriculum_step", 1)
+        if self.schedule_type == FIXED_LINEAR:
+            frac = min(global_step / t, 1.0)
+        elif self.schedule_type == FIXED_ROOT:
+            deg = float(self.config.get("root_degree", 2))
+            frac = min(global_step / t, 1.0) ** (1.0 / deg)
+        elif self.schedule_type == FIXED_DISCRETE:
+            diffs = self.config["difficulty"]
+            bounds = self.config["max_step"]
+            for d, b in zip(diffs, bounds):
+                if global_step < b:
+                    return int(d)
+            return int(diffs[-1])
+        else:  # custom
+            return int(self.custom_fn(global_step))
+        raw = self.min_difficulty + frac * (self.max_difficulty -
+                                            self.min_difficulty)
+        return self._quantize(raw)
+
+    def update_difficulty(self, global_step: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_step)
+        return self.current_difficulty
+
+    def is_fully_ramped(self, global_step: int) -> bool:
+        return self.get_difficulty(global_step) >= self.max_difficulty
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"current_difficulty": self.current_difficulty}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.current_difficulty = int(sd["current_difficulty"])

@@ -1,0 +1,107 @@
+"""Memory-mapped indexed dataset (reference
+``data_pipeline/data_sampling/indexed_dataset.py`` ``MMapIndexedDataset``,
+itself the Megatron format): a ``.bin`` of concatenated token arrays plus a
+``.idx`` with dtype code, sizes, and byte offsets; reads are zero-copy mmap
+views.
+
+Format (little-endian):
+  .idx: magic b"DSTPUIDX" | version u64 | dtype_code u8 | count u64 |
+        sizes u32[count] | pointers u64[count]
+  .bin: raw element data, row-major, concatenated
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import List, Sequence
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_VERSION = 1
+
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
+           6: np.float32, 7: np.float64, 8: np.uint16, 9: np.uint32}
+_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    """Streaming writer: ``add_item`` rows, then ``finalize``."""
+
+    def __init__(self, prefix: str, dtype=np.int32):
+        self._prefix = prefix
+        self._dtype = np.dtype(dtype)
+        assert self._dtype in _CODES, f"unsupported dtype {dtype}"
+        self._bin = open(data_file_path(prefix), "wb")
+        self._sizes: List[int] = []
+        self._pointers: List[int] = []
+        self._offset = 0
+
+    def add_item(self, array: Sequence) -> None:
+        arr = np.ascontiguousarray(array, dtype=self._dtype)
+        self._bin.write(arr.tobytes())
+        self._pointers.append(self._offset)
+        self._sizes.append(arr.size)
+        self._offset += arr.nbytes
+
+    def finalize(self) -> None:
+        self._bin.close()
+        with open(index_file_path(self._prefix), "wb") as idx:
+            idx.write(_MAGIC)
+            idx.write(struct.pack("<Q", _VERSION))
+            idx.write(struct.pack("<B", _CODES[self._dtype]))
+            idx.write(struct.pack("<Q", len(self._sizes)))
+            idx.write(np.asarray(self._sizes, np.uint32).tobytes())
+            idx.write(np.asarray(self._pointers, np.uint64).tobytes())
+
+
+class MMapIndexedDataset:
+    """Zero-copy reader; ``ds[i]`` returns a read-only numpy view."""
+
+    def __init__(self, prefix: str):
+        with open(index_file_path(prefix), "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError(
+                    f"{index_file_path(prefix)}: bad magic {magic!r}")
+            (version,) = struct.unpack("<Q", f.read(8))
+            if version != _VERSION:
+                raise ValueError(f"unsupported index version {version}")
+            (code,) = struct.unpack("<B", f.read(1))
+            self._dtype = np.dtype(_DTYPES[code])
+            (count,) = struct.unpack("<Q", f.read(8))
+            self._sizes = np.frombuffer(f.read(4 * count), np.uint32)
+            self._pointers = np.frombuffer(f.read(8 * count), np.uint64)
+        self._data = np.memmap(data_file_path(prefix), mode="r", dtype=np.uint8)
+
+    def __len__(self) -> int:
+        return len(self._sizes)
+
+    @property
+    def sizes(self) -> np.ndarray:
+        return self._sizes
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        size = int(self._sizes[i])
+        off = int(self._pointers[i])
+        return np.frombuffer(self._data, self._dtype, count=size, offset=off)
+
+    def get(self, i, offset: int = 0, length: int = None):
+        row = self[i]
+        return row[offset: None if length is None else offset + length]
+
+    @staticmethod
+    def exists(prefix: str) -> bool:
+        return (os.path.exists(index_file_path(prefix)) and
+                os.path.exists(data_file_path(prefix)))

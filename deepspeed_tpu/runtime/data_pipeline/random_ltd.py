@@ -1,0 +1,83 @@
+"""Random layerwise token dropping (random-LTD).
+
+Reference: ``data_pipeline/data_routing/basic_layer.py:13
+RandomLayerTokenDrop`` + the gather/scatter CUDA kernels
+(``csrc/random_ltd/*``): middle transformer layers process a random subset
+of tokens; dropped tokens skip the layer and are re-inserted afterwards, on
+a schedule that grows the kept-token count until all layers see the full
+sequence.
+
+TPU realisation: ``token_drop`` draws a sorted random keep-index set (sorted
+so causal attention within the kept subset remains causal in the original
+order) and gathers with ``jnp.take_along_axis``; ``token_restore`` scatters
+the processed subset back over the layer input (dropped positions ride the
+residual stream unchanged — exactly the reference semantics).  The kept
+count is a *static* per-compile constant; the scheduler quantizes it so
+retraces are few.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from .curriculum_scheduler import CurriculumScheduler
+
+
+def token_drop(x, rng, keep: int):
+    """Select ``keep`` random (order-preserving) positions of x [B, S, D].
+
+    Returns (x_kept [B, keep, D], indices [B, keep]).  The kernel-analog of
+    ``gather_tokens`` (``csrc/random_ltd/gather_scatter.cu``)."""
+    b, s, _ = x.shape
+    scores = jax.random.uniform(rng, (b, s))
+    _, idx = jax.lax.top_k(scores, keep)          # random subset
+    idx = jnp.sort(idx, axis=1)                   # preserve temporal order
+    return jnp.take_along_axis(x, idx[..., None], axis=1), idx
+
+
+def token_restore(x_full, x_kept, idx):
+    """Scatter processed kept tokens back over the layer input
+    (``scatter_tokens`` analog): dropped positions keep x_full's values."""
+    b = x_full.shape[0]
+    batch_idx = jnp.arange(b)[:, None]
+    return x_full.at[batch_idx, idx].set(x_kept)
+
+
+class RandomLTDScheduler:
+    """Maps global step -> kept-token count (reference
+    ``random_ltd_scheduler``); reuses the curriculum schedule math."""
+
+    def __init__(self, config: Dict[str, Any]):
+        cfg = dict(config)
+        self.total_layers = int(cfg.get("random_ltd_layer_num", 0))
+        self.ltd_start = int(cfg.get("random_ltd_layer_id_start", 1))
+        sched = cfg.get("random_ltd_schedule", cfg)
+        self._sched = CurriculumScheduler({
+            "curriculum_type": "seqlen",
+            "min_difficulty": sched.get("min_value",
+                                        cfg.get("min_value", 128)),
+            "max_difficulty": sched.get("max_value",
+                                        cfg.get("max_value", 1024)),
+            "schedule_type": sched.get("schedule_type", "fixed_linear"),
+            "schedule_config": sched.get("schedule_config", {
+                "total_curriculum_step": cfg.get("total_ltd_step", 1000),
+                "difficulty_step": cfg.get("difficulty_step", 64),
+            }),
+        })
+
+    def get_keep_count(self, global_step: int, seq_len: int) -> int:
+        return min(self._sched.get_difficulty(global_step), seq_len)
+
+    def applies_to_layer(self, layer_idx: int, num_layers: int) -> bool:
+        """First and last layer always see the full sequence (reference
+        keeps boundary layers dense)."""
+        return 0 < layer_idx < num_layers - 1
+
+    def state_dict(self):
+        return self._sched.state_dict()
+
+    def load_state_dict(self, sd):
+        self._sched.load_state_dict(sd)

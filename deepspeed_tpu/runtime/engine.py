@@ -30,6 +30,7 @@ bf16_optimizer.py:38).
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple, Union
 
 import jax
@@ -173,6 +174,15 @@ class DeepSpeedEngine:
                     "offload_param with pp>1 is unsupported: the pipeline "
                     "engine shards the block params the streaming tier "
                     "removes from device state")
+
+        # curriculum learning (reference engine consumes curriculum seqlen
+        # at :1806-1812)
+        self.curriculum_scheduler = None
+        if self._config.curriculum_enabled:
+            from .data_pipeline.curriculum_scheduler import CurriculumScheduler
+
+            self.curriculum_scheduler = CurriculumScheduler(
+                self._config.curriculum_params)
 
         # schedules and optimizer
         self._configure_lr_schedule()
@@ -800,6 +810,25 @@ class DeepSpeedEngine:
 
         return jax.tree_util.tree_map(reshape, batch)
 
+    def _apply_curriculum(self, batch):
+        """Truncate token batches to the curriculum difficulty (reference
+        ``engine.py:1806-1812`` curriculum seqlen).  Difficulty is quantized
+        by the schedule so the set of compiled shapes stays small."""
+        if self.curriculum_scheduler is None or \
+                self.curriculum_scheduler.curriculum_type != "seqlen":
+            return batch
+        diff = self.curriculum_scheduler.update_difficulty(
+            self.global_steps + 1)
+
+        def trunc(x):
+            x = np.asarray(x)
+            if x.ndim >= 1 and np.issubdtype(x.dtype, np.integer) and \
+                    x.shape[-1] > diff + 1:
+                return x[..., : diff + 1]  # +1: targets shift by one
+            return x
+
+        return jax.tree_util.tree_map(trunc, batch)
+
     # ------------------------------------------------------------------- train
     def train_batch(self, batch=None, data_iter=None) -> Tuple[Any, Dict]:
         """Run one full global step (all GAS microbatches + update) in one jit.
@@ -819,7 +848,13 @@ class DeepSpeedEngine:
                     self.gradient_accumulation_steps() * self.micro_batch_global() \
                     == self.train_batch_size():
                 batch = self._reshape_global_batch(batch)
+        batch = self._apply_curriculum(batch)
         batch = self._shard_batch(batch, leading_gas_dim=True)
+
+        fp = self._config.flops_profiler_config
+        profiling_now = fp.enabled and \
+            self.global_steps + 1 == fp.profile_step
+        t0 = time.perf_counter() if profiling_now else None
 
         self.tput_timer.start()
         if self.offload_enabled:
@@ -832,6 +867,18 @@ class DeepSpeedEngine:
         self.global_samples += self.train_batch_size()
         self.tput_timer.stop(global_step=True, sync_arrays=metrics["loss"])
         self._finalize_metrics(metrics)
+
+        if profiling_now:
+            # reference hooks the profiler at profile_step
+            # (``runtime/engine.py:315,1796``); cost-analyze the compiled
+            # step and reuse THIS step's measured wall clock — the profiler
+            # observes training, it does not run extra updates
+            from ..profiling.flops_profiler import FlopsProfiler
+
+            latency = time.perf_counter() - t0
+            self.flops_profiler = FlopsProfiler(engine=self)
+            self.flops_profiler.profile_engine_step(batch, latency=latency)
+            self.flops_profiler.print_profile(fp.output_file)
         return self.state, self._cached_metrics
 
     def _train_step_offload(self, state, batch):

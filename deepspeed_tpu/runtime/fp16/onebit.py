@@ -1,0 +1,184 @@
+"""1-bit optimizers: OnebitAdam, OnebitLamb, ZeroOneAdam.
+
+Reference: ``runtime/fp16/onebit/{adam,lamb,zoadam}.py`` — two-stage
+optimizers: a full-precision **warmup** stage (exact Adam/Lamb, variance
+learning), then a **compressed** stage where the variance freezes and only
+the momentum is exchanged, 1-bit quantized with per-worker error feedback
+(``runtime/comm/nccl.py:52``), cutting gradient-communication volume ~32x
+(fp32) while matching sample-wise convergence
+(``docs/_posts/2020-09-09-onebit-adam-blog-post.md``).
+
+TPU realisation: the optimizers are optax ``GradientTransformation``s that
+run *inside the jitted step on already-reduced gradients* (XLA SPMD performs
+the reduction), so the stage semantics — frozen variance, sign-quantized
+momentum with error feedback — are preserved exactly; the *wire* compression
+lives in ``runtime/comm/compressed.py`` (``compressed_allreduce``: int8
+signs over ICI inside ``shard_map``) for loops that manage their own
+gradient exchange.  Engine config names match the reference
+("OneBitAdam", "OneBitLamb", "ZeroOneAdam").
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class OnebitState(NamedTuple):
+    count: jnp.ndarray
+    m: optax.Updates
+    v: optax.Updates
+    error: optax.Updates
+
+
+def _quantize_with_feedback(m, error):
+    """sign+scale quantization of the momentum, error carried forward."""
+    comp = jax.tree_util.tree_map(lambda a, e: a + e, m, error)
+    scale = jax.tree_util.tree_map(
+        lambda c: jnp.mean(jnp.abs(c)), comp)
+    mq = jax.tree_util.tree_map(
+        lambda c, s: jnp.sign(c) * s, comp, scale)
+    new_error = jax.tree_util.tree_map(lambda c, q: c - q, comp, mq)
+    return mq, new_error
+
+
+def scale_by_onebit_adam(b1: float = 0.9, b2: float = 0.999,
+                         eps: float = 1e-8, freeze_step: int = 100
+                         ) -> optax.GradientTransformation:
+    """Adam whose variance freezes at ``freeze_step``; afterwards the update
+    direction is the 1-bit-quantized momentum (reference ``onebit/adam.py``
+    ``comm_time``/compressed stage)."""
+
+    def init_fn(params):
+        z = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)
+        return OnebitState(jnp.zeros((), jnp.int32), z(), z(), z())
+
+    def update_fn(updates, state, params=None):
+        count = state.count + 1
+        warm = count <= freeze_step
+        m = jax.tree_util.tree_map(
+            lambda mm, g: b1 * mm + (1 - b1) * g, state.m, updates)
+        # variance only learns during warmup (frozen afterwards)
+        v = jax.tree_util.tree_map(
+            lambda vv, g: jnp.where(warm, b2 * vv + (1 - b2) * g * g, vv),
+            state.v, updates)
+        mq, err_q = _quantize_with_feedback(m, state.error)
+        m_eff = jax.tree_util.tree_map(
+            lambda mm, q: jnp.where(warm, mm, q), m, mq)
+        error = jax.tree_util.tree_map(
+            lambda e, ne: jnp.where(warm, e, ne), state.error, err_q)
+        bc1 = 1 - b1 ** count.astype(jnp.float32)
+        bc2 = 1 - b2 ** jnp.minimum(count, freeze_step).astype(jnp.float32)
+        out = jax.tree_util.tree_map(
+            lambda mm, vv: (mm / bc1) / (jnp.sqrt(vv / bc2) + eps),
+            m_eff, v)
+        return out, OnebitState(count, m, v, error)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def scale_by_zero_one_adam(b1: float = 0.9, b2: float = 0.999,
+                           eps: float = 1e-8,
+                           var_freeze_step: int = 100,
+                           var_update_scaler: int = 16,
+                           local_step_scaler: int = 32678,
+                           local_step_clipper: int = 16
+                           ) -> optax.GradientTransformation:
+    """0/1 Adam (reference ``onebit/zoadam.py``): the variance keeps updating
+    after the freeze point but only at exponentially-spaced steps (the
+    "variance update policy"), and compression applies between those
+    refreshes — a strict generalization of 1-bit Adam."""
+
+    def init_fn(params):
+        z = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)
+        return OnebitState(jnp.zeros((), jnp.int32), z(), z(), z())
+
+    def update_fn(updates, state, params=None):
+        count = state.count + 1
+        cf = count.astype(jnp.float32)
+        warm = count <= var_freeze_step
+        # after freeze: update variance when (count - freeze) is a multiple
+        # of var_update_scaler (the reference's interval policy, simplified
+        # to a fixed interval)
+        refresh = jnp.logical_and(
+            jnp.logical_not(warm),
+            jnp.equal(jnp.mod(count - var_freeze_step, var_update_scaler), 0))
+        learn_v = jnp.logical_or(warm, refresh)
+        m = jax.tree_util.tree_map(
+            lambda mm, g: b1 * mm + (1 - b1) * g, state.m, updates)
+        v = jax.tree_util.tree_map(
+            lambda vv, g: jnp.where(learn_v, b2 * vv + (1 - b2) * g * g, vv),
+            state.v, updates)
+        mq, err_q = _quantize_with_feedback(m, state.error)
+        m_eff = jax.tree_util.tree_map(
+            lambda mm, q: jnp.where(warm, mm, q), m, mq)
+        error = jax.tree_util.tree_map(
+            lambda e, ne: jnp.where(warm, e, ne), state.error, err_q)
+        bc1 = 1 - b1 ** cf
+        out = jax.tree_util.tree_map(
+            lambda mm, vv: (mm / bc1) / (jnp.sqrt(vv) + eps), m_eff, v)
+        return out, OnebitState(count, m, v, error)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def _trust_ratio(min_coeff: float, max_coeff: float):
+    def apply(params, updates):
+        def per_leaf(p, u):
+            pn = jnp.linalg.norm(p.reshape(-1))
+            un = jnp.linalg.norm(u.reshape(-1))
+            ratio = jnp.where(un > 0, pn / jnp.maximum(un, 1e-12), 1.0)
+            return u * jnp.clip(ratio, min_coeff, max_coeff)
+
+        return jax.tree_util.tree_map(per_leaf, params, updates)
+
+    return apply
+
+
+def onebit_adam(lr=1e-3, betas: Tuple[float, float] = (0.9, 0.999),
+                eps: float = 1e-8, weight_decay: float = 0.0,
+                freeze_step: int = 100) -> optax.GradientTransformation:
+    txs = [scale_by_onebit_adam(betas[0], betas[1], eps, freeze_step)]
+    if weight_decay:
+        txs.append(optax.add_decayed_weights(weight_decay))
+    txs.append(optax.scale_by_learning_rate(lr))
+    return optax.chain(*txs)
+
+
+def onebit_lamb(lr=1e-3, betas: Tuple[float, float] = (0.9, 0.999),
+                eps: float = 1e-8, weight_decay: float = 0.0,
+                freeze_step: int = 100, min_coeff: float = 0.01,
+                max_coeff: float = 0.3) -> optax.GradientTransformation:
+    """1-bit LAMB (reference ``onebit/lamb.py``): onebit-adam direction with
+    the LAMB layerwise trust ratio applied on top."""
+    inner = scale_by_onebit_adam(betas[0], betas[1], eps, freeze_step)
+    trust = _trust_ratio(min_coeff, max_coeff)
+
+    def init_fn(params):
+        return inner.init(params)
+
+    def update_fn(updates, state, params=None):
+        out, state = inner.update(updates, state, params)
+        if weight_decay:
+            out = jax.tree_util.tree_map(
+                lambda u, p: u + weight_decay * p, out, params)
+        out = trust(params, out)
+        return out, state
+
+    return optax.chain(optax.GradientTransformation(init_fn, update_fn),
+                       optax.scale_by_learning_rate(lr))
+
+
+def zero_one_adam(lr=1e-3, betas: Tuple[float, float] = (0.9, 0.999),
+                  eps: float = 1e-8, weight_decay: float = 0.0,
+                  var_freeze_step: int = 100, var_update_scaler: int = 16
+                  ) -> optax.GradientTransformation:
+    txs = [scale_by_zero_one_adam(betas[0], betas[1], eps, var_freeze_step,
+                                  var_update_scaler)]
+    if weight_decay:
+        txs.append(optax.add_decayed_weights(weight_decay))
+    txs.append(optax.scale_by_learning_rate(lr))
+    return optax.chain(*txs)

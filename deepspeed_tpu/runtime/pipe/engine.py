@@ -348,6 +348,7 @@ class PipelineEngine(DeepSpeedEngine):
             batch = {"input_ids": batch["input_ids"], "labels": batch["labels"]}
         else:
             batch = batch["input_ids"] if isinstance(batch, dict) else batch
+        batch = self._apply_curriculum(batch)
         ids = self._shard_batch(batch, leading_gas_dim=True)
 
         self.tput_timer.start()

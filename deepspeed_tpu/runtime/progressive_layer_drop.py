@@ -1,0 +1,35 @@
+"""Progressive layer drop (reference ``runtime/progressive_layer_drop.py:7``):
+stochastic-depth schedule — the keep probability theta anneals from 1 toward
+``theta`` as ``(1 - theta) * exp(-gamma * t) + theta``, and layer ``l`` of
+``L`` keeps with probability ``1 - (l / L) * (1 - theta_t)`` (deeper layers
+drop more).  Models consume ``layer_keep_prob`` inside their block scan
+(multiply the residual branch by a Bernoulli draw / keep_prob)."""
+
+from __future__ import annotations
+
+
+class ProgressiveLayerDrop:
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_theta(self, global_step: int) -> float:
+        import math
+
+        t = (1.0 - self.theta) * math.exp(-self.gamma * global_step) + \
+            self.theta
+        return t
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = self.get_theta(global_step)
+        return self.current_theta
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta}
+
+    def layer_keep_prob(self, layer_idx: int, num_layers: int,
+                        global_step: int) -> float:
+        theta_t = self.get_theta(global_step)
+        return 1.0 - (layer_idx + 1) / max(num_layers, 1) * (1.0 - theta_t)

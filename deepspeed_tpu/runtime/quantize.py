@@ -1,0 +1,66 @@
+"""MoQ — Mixed-precision quantization-during-training.
+
+Reference ``runtime/quantize.py:11 Quantizer``: bits anneal from
+``start_bits`` to ``target_bits``, halving the value range every
+``quantize_period`` steps; with eigenvalue guidance each layer's period is
+scaled by its (normalized) leading Hessian eigenvalue so sensitive layers
+quantize later.  The quantization itself is the group fake-quant from
+``compression/ops.py`` (kernel analog: ``csrc/quantization/fake_quantizer.cu``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+
+from ..compression.ops import fake_quantize
+
+
+class Quantizer:
+
+    def __init__(self, q_target_bits: int = 8, q_start_bits: int = 16,
+                 q_period: int = 100, q_offset: int = 0, q_groups: int = 1,
+                 q_type: str = "symmetric", q_rounding: str = "nearest",
+                 use_quantizer_kernel: bool = False, layer_num: int = 0):
+        self.q_target_bits = q_target_bits
+        self.q_start_bits = q_start_bits
+        self.q_period = max(int(q_period), 1)
+        self.q_offset = q_offset
+        self.q_groups = q_groups
+        self.q_type = q_type
+        self.q_rounding = q_rounding
+        self.layer_num = layer_num
+
+    def current_bits(self, step: int,
+                     eigenvalue_ratio: Optional[float] = None) -> int:
+        """Bits at ``step``: one bit dropped per (scaled) period after the
+        offset, floored at target_bits."""
+        if step < self.q_offset:
+            return self.q_start_bits
+        period = self.q_period
+        if eigenvalue_ratio is not None:
+            # sensitive layers (ratio ~1) quantize slower (longer period)
+            period = max(1, int(period * (1.0 + eigenvalue_ratio)))
+        drops = (step - self.q_offset) // period
+        return max(self.q_target_bits, self.q_start_bits - int(drops))
+
+    def quantize(self, params, step: int,
+                 eigenvalue_ratios: Optional[Dict[str, float]] = None):
+        """Fake-quantize every >=2D leaf at its current bit width."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        out = []
+        for path, leaf in flat:
+            name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                            for k in path)
+            if getattr(leaf, "ndim", 0) < 2:
+                out.append(leaf)
+                continue
+            ratio = (eigenvalue_ratios or {}).get(name)
+            bits = self.current_bits(step, ratio)
+            if bits >= 16:
+                out.append(leaf)
+            else:
+                out.append(fake_quantize(leaf, bits, self.q_groups,
+                                         self.q_type, False))
+        return jax.tree_util.tree_unflatten(treedef, out)

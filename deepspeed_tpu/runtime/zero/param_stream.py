@@ -69,15 +69,26 @@ class StreamedParamStore:
             acc[i] += np.asarray(g, np.float32)
 
     # ------------------------------------------------------------- jit-side
+    @property
+    def _cb_sharding(self):
+        """Pin callbacks to one device: with >1 local device (dp>1 in one
+        process) unpinned io_callback invocation count is implementation-
+        defined — the grad push must fire exactly once per bwd step or the
+        host accumulator double-counts."""
+        import jax.sharding as jsh
+
+        return jsh.SingleDeviceSharding(jax.devices()[0])
+
     def _load(self, i):
         """Layer ``i``'s params via (re-executable) host callback."""
         flat = io_callback(self._load_layer, list(self._layer_struct), i,
-                           ordered=False)
+                           ordered=False, sharding=self._cb_sharding)
         return jax.tree_util.tree_unflatten(self.treedef, list(flat))
 
     def _push(self, i, dlayer):
         io_callback(self._store_grad, None, i,
-                    *jax.tree_util.tree_leaves(dlayer), ordered=True)
+                    *jax.tree_util.tree_leaves(dlayer), ordered=True,
+                    sharding=self._cb_sharding)
 
     def streamed_block(self, call_block):
         """Wrap ``call_block(layer, x) -> x`` so the layer weights stream.

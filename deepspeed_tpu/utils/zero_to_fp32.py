@@ -1,0 +1,81 @@
+"""Offline extraction of consolidated fp32 weights from a checkpoint.
+
+Analog of reference ``deepspeed/utils/zero_to_fp32.py:361
+get_fp32_state_dict_from_zero_checkpoint``: the reference merges per-rank
+ZeRO partition files; here orbax already stores the logical arrays (written
+cooperatively by all hosts), so extraction is a host-side restore + flatten —
+no engine, no devices, no mesh required.
+
+Usage (CLI, reference parity with the script dropped into checkpoint dirs)::
+
+    python -m deepspeed_tpu.utils.zero_to_fp32 <checkpoint_dir> <output_file>
+
+``checkpoint_dir`` is the save_dir given to ``engine.save_checkpoint`` (the
+``latest`` tag file selects the tag) or a specific ``<tag>`` directory.
+Output is ``.npz`` (numpy) or ``.pt`` (torch state-dict style, if the suffix
+is ``.pt`` and torch is importable).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any, Dict
+
+import numpy as np
+
+
+def _resolve_tag_dir(checkpoint_dir: str) -> str:
+    if os.path.isdir(os.path.join(checkpoint_dir, "state")):
+        return checkpoint_dir  # already a tag dir
+    latest = os.path.join(checkpoint_dir, "latest")
+    if os.path.isfile(latest):
+        with open(latest) as f:
+            tag = f.read().strip()
+        return os.path.join(checkpoint_dir, tag)
+    raise FileNotFoundError(
+        f"{checkpoint_dir} is neither a tag directory (no state/) nor a "
+        f"save dir (no latest file)")
+
+
+def get_fp32_state_dict_from_checkpoint(checkpoint_dir: str) -> Dict[str, Any]:
+    """Flat {dotted_name: np.float32 array} of the model params."""
+    import orbax.checkpoint as ocp
+
+    tag_dir = _resolve_tag_dir(checkpoint_dir)
+    restored = ocp.StandardCheckpointer().restore(
+        os.path.abspath(os.path.join(tag_dir, "state")))
+    params = restored["params"]
+
+    flat = {}
+    import jax
+
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        name = ".".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in path)
+        flat[name] = np.asarray(leaf, np.float32)
+    return flat
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("checkpoint_dir")
+    ap.add_argument("output_file")
+    args = ap.parse_args(argv)
+
+    sd = get_fp32_state_dict_from_checkpoint(args.checkpoint_dir)
+    n = sum(v.size for v in sd.values())
+    if args.output_file.endswith(".pt"):
+        import torch
+
+        torch.save({k: torch.from_numpy(v.copy()) for k, v in sd.items()},
+                   args.output_file)
+    else:
+        np.savez(args.output_file, **sd)
+    print(f"saved {len(sd)} tensors / {n/1e6:.2f}M fp32 params "
+          f"-> {args.output_file}")
+
+
+if __name__ == "__main__":
+    main()

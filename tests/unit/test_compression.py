@@ -1,0 +1,172 @@
+"""Compression suite tests (reference ``tests/unit/compression/
+test_compression.py``: quantization/pruning numerics + init_compression)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.compression import (fake_quantize, head_pruning_mask,
+                                       init_compression, redundancy_clean,
+                                       row_pruning_mask, sparse_pruning_mask)
+from deepspeed_tpu.compression.compress import apply_layer_reduction
+from deepspeed_tpu.models import gpt2
+
+
+# ------------------------------------------------------------------- quant
+def test_fake_quantize_symmetric_8bit_accuracy():
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(64, 64)).astype(np.float32)
+    q = np.asarray(fake_quantize(jnp.asarray(w), 8, 4, "symmetric", False))
+    assert not np.array_equal(q, w)            # actually quantized
+    assert np.abs(q - w).max() < np.abs(w).max() / 60  # 8-bit error bound
+    # quantization is idempotent
+    q2 = np.asarray(fake_quantize(jnp.asarray(q), 8, 4, "symmetric", False))
+    np.testing.assert_allclose(q2, q, atol=1e-6)
+
+
+def test_fake_quantize_asymmetric():
+    w = np.linspace(0.0, 1.0, 256).astype(np.float32).reshape(16, 16)
+    q = np.asarray(fake_quantize(jnp.asarray(w), 4, 1, "asymmetric", False))
+    assert len(np.unique(q.round(6))) <= 16    # 4 bits -> <=16 levels
+    assert np.abs(q - w).max() < 0.05
+
+
+def test_fake_quantize_straight_through_gradient():
+    w = jnp.asarray(np.random.default_rng(1).normal(size=(8, 8)),
+                    jnp.float32)
+    g = jax.grad(lambda w: jnp.sum(fake_quantize(w, 4, 1, "symmetric",
+                                                 False) ** 2))(w)
+    # STE: gradient flows as if identity (2*q(w), not zero)
+    assert np.abs(np.asarray(g)).max() > 0.1
+
+
+# ----------------------------------------------------------------- pruning
+def test_sparse_pruning_mask_ratio():
+    w = jnp.asarray(np.random.default_rng(2).normal(size=(32, 32)),
+                    jnp.float32)
+    m = np.asarray(sparse_pruning_mask(w, 0.25))
+    assert abs(m.mean() - 0.25) < 0.01
+    kept = np.abs(np.asarray(w))[m > 0]
+    dropped = np.abs(np.asarray(w))[m == 0]
+    assert kept.min() >= dropped.max() - 1e-6  # magnitude criterion
+
+
+def test_row_pruning_mask():
+    w = jnp.asarray(np.diag(np.arange(1.0, 9.0)), jnp.float32)
+    m = np.asarray(row_pruning_mask(w, 0.5))
+    assert m[:4].sum() == 0 and m[4:].sum() == 4 * 8  # smallest rows dropped
+
+
+def test_head_pruning_mask():
+    # 4 heads x head_dim 2, out 8; zero out heads 0-1
+    w = np.ones((8, 8), np.float32)
+    w[:4] = 1e-4
+    m = np.asarray(head_pruning_mask(jnp.asarray(w), 0.5, num_heads=4))
+    assert m[:4].sum() == 0 and m[4:].sum() == 4 * 8
+
+
+# --------------------------------------------------------- init_compression
+def _compression_cfg():
+    return {"compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "quantize_groups": 2},
+            "different_groups": {
+                "wq1": {"params": {"target_bits": 8},
+                        "modules": ["*fc_w*", "*proj_w*"]}},
+        },
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {
+                "sp1": {"params": {"dense_ratio": 0.5},
+                        "modules": ["*qkv_w*"]}},
+        },
+    }}
+
+
+def test_init_compression_wraps_model_and_trains():
+    deepspeed_tpu.comm.reset_topology()
+    spec = gpt2.build(gpt2.GPT2Config.tiny())
+    wrapped = init_compression(spec, _compression_cfg())
+    assert wrapped.name.endswith("+compressed")
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=wrapped,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}})
+    rng = np.random.default_rng(0)
+    losses = []
+    for _ in range(4):
+        batch = {"input_ids": rng.integers(
+            0, 512, (engine.train_batch_size(), 17)).astype(np.int32)}
+        _, m = engine.train_batch(batch)
+        losses.append(float(m["loss"]))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_redundancy_clean_bakes_compression():
+    spec = gpt2.build(gpt2.GPT2Config.tiny())
+    params = spec.init(jax.random.PRNGKey(0))
+    cleaned = redundancy_clean(params, _compression_cfg())
+    qkv = np.asarray(cleaned["blocks"]["qkv_w"])
+    # sparse pruning at 0.5 -> about half the qkv weights are zero
+    assert 0.4 < (qkv == 0).mean() < 0.6
+    # untouched leaves unchanged
+    np.testing.assert_array_equal(np.asarray(cleaned["wte"]),
+                                  np.asarray(params["wte"]))
+
+
+def test_layer_reduction_student_init():
+    spec = gpt2.build(gpt2.GPT2Config.tiny())
+    params = spec.init(jax.random.PRNGKey(0))
+    student = apply_layer_reduction(params, ("blocks",), [1])
+    assert jax.tree_util.tree_leaves(student["blocks"])[0].shape[0] == 1
+    np.testing.assert_array_equal(
+        np.asarray(student["blocks"]["fc_w"][0]),
+        np.asarray(params["blocks"]["fc_w"][1]))
+
+
+# ----------------------------------------------------------- MoQ/eigenvalue
+def test_moq_bit_schedule():
+    from deepspeed_tpu.runtime.quantize import Quantizer
+
+    q = Quantizer(q_target_bits=4, q_start_bits=8, q_period=10, q_offset=5)
+    assert q.current_bits(0) == 8
+    assert q.current_bits(15) == 7
+    assert q.current_bits(1000) == 4
+    # eigenvalue guidance slows sensitive layers
+    assert q.current_bits(15, eigenvalue_ratio=1.0) == 8
+
+
+def test_power_iteration_finds_leading_eigenvalue():
+    from deepspeed_tpu.runtime.eigenvalue import power_iteration
+
+    a = np.diag([5.0, 1.0, 0.1]).astype(np.float32)
+    lam, v = power_iteration(lambda x: jnp.asarray(a) @ x,
+                             jnp.ones(3), iters=50)
+    assert abs(float(lam) - 5.0) < 1e-3
+    assert abs(abs(float(v[0])) - 1.0) < 1e-2
+
+
+def test_hessian_eigenvalue_quadratic():
+    from deepspeed_tpu.runtime.eigenvalue import hessian_eigenvalue
+
+    # loss = sum(c_i x_i^2): Hessian eigenvalues 2*c -> leading 6
+    def loss(p):
+        return jnp.sum(jnp.asarray([3.0, 1.0, 0.5]) * p["x"] ** 2)
+
+    lam = hessian_eigenvalue(loss, {"x": jnp.ones(3)}, iters=50)
+    assert abs(float(lam) - 6.0) < 1e-2
+
+
+def test_progressive_layer_drop_schedule():
+    from deepspeed_tpu.runtime.progressive_layer_drop import (
+        ProgressiveLayerDrop)
+
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta(0) == pytest.approx(1.0)
+    assert pld.get_theta(10**6) == pytest.approx(0.5)
+    assert pld.get_theta(100) < pld.get_theta(10)
+    # deeper layers drop more
+    assert pld.layer_keep_prob(11, 12, 1000) < \
+        pld.layer_keep_prob(0, 12, 1000)

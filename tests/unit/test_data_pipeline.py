@@ -1,0 +1,137 @@
+"""Data-efficiency pipeline tests (reference
+``tests/unit/runtime/test_data_efficiency.py`` + Megatron indexed-dataset
+round-trips)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt2
+from deepspeed_tpu.runtime.data_pipeline import (CurriculumScheduler,
+                                                 MMapIndexedDataset,
+                                                 MMapIndexedDatasetBuilder,
+                                                 RandomLTDScheduler,
+                                                 token_drop, token_restore)
+
+
+# ------------------------------------------------------------- curriculum
+def test_fixed_linear_schedule():
+    s = CurriculumScheduler({
+        "curriculum_type": "seqlen", "min_difficulty": 8,
+        "max_difficulty": 128, "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 100,
+                            "difficulty_step": 8}})
+    assert s.get_difficulty(0) == 8
+    assert s.get_difficulty(100) == 128
+    assert s.get_difficulty(1000) == 128
+    mid = s.get_difficulty(50)
+    assert 8 < mid < 128 and mid % 8 == 0
+    diffs = [s.get_difficulty(t) for t in range(0, 101, 10)]
+    assert diffs == sorted(diffs)
+
+
+def test_fixed_root_ramp_is_faster_early():
+    kw = dict(curriculum_type="seqlen", min_difficulty=8, max_difficulty=128,
+              schedule_config={"total_curriculum_step": 100,
+                               "difficulty_step": 1})
+    lin = CurriculumScheduler(dict(kw, schedule_type="fixed_linear"))
+    root = CurriculumScheduler(dict(kw, schedule_type="fixed_root"))
+    assert root.get_difficulty(25) > lin.get_difficulty(25)
+
+
+def test_fixed_discrete_and_custom():
+    s = CurriculumScheduler({
+        "schedule_type": "fixed_discrete", "min_difficulty": 4,
+        "max_difficulty": 64,
+        "schedule_config": {"difficulty": [4, 16, 64],
+                            "max_step": [10, 20]}})
+    assert [s.get_difficulty(t) for t in (0, 9, 10, 19, 20, 99)] == \
+        [4, 4, 16, 16, 64, 64]
+    c = CurriculumScheduler({
+        "schedule_type": "custom", "schedule_fn": lambda t: 7 + t})
+    assert c.get_difficulty(3) == 10
+
+
+def test_engine_curriculum_truncates_seqlen(eight_devices):
+    deepspeed_tpu.comm.reset_topology()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt2.build(gpt2.GPT2Config.tiny()),
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "curriculum_learning": {
+                "enabled": True, "curriculum_type": "seqlen",
+                "min_difficulty": 8, "max_difficulty": 32,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 4,
+                                    "difficulty_step": 8}},
+        })
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, 512, size=(engine.train_batch_size(), 33)).astype(np.int32)}
+    for _ in range(5):
+        _, m = engine.train_batch(batch)
+        assert np.isfinite(m["loss"])
+    # ramped to max by step 4; difficulty tracked on the engine
+    assert engine.curriculum_scheduler.current_difficulty == 32
+
+
+# --------------------------------------------------------- indexed dataset
+def test_indexed_dataset_roundtrip(tmp_path):
+    prefix = str(tmp_path / "docs")
+    rows = [np.arange(n, dtype=np.int32) * 3 for n in (5, 1, 17)]
+    b = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+    for r in rows:
+        b.add_item(r)
+    b.finalize()
+
+    assert MMapIndexedDataset.exists(prefix)
+    ds = MMapIndexedDataset(prefix)
+    assert len(ds) == 3
+    np.testing.assert_array_equal(ds.sizes, [5, 1, 17])
+    for r, got in zip(rows, ds[:]):
+        np.testing.assert_array_equal(got, r)
+    np.testing.assert_array_equal(ds.get(2, offset=4, length=3), rows[2][4:7])
+
+
+def test_indexed_dataset_bad_magic(tmp_path):
+    prefix = str(tmp_path / "x")
+    open(prefix + ".idx", "wb").write(b"NOTMAGIC" + b"\x00" * 32)
+    open(prefix + ".bin", "wb").write(b"")
+    with pytest.raises(ValueError, match="bad magic"):
+        MMapIndexedDataset(prefix)
+
+
+# --------------------------------------------------------------- random-LTD
+def test_token_drop_restore():
+    import jax
+
+    x = np.arange(2 * 8 * 4, dtype=np.float32).reshape(2, 8, 4)
+    kept, idx = token_drop(jax.numpy.asarray(x), jax.random.PRNGKey(0), 5)
+    assert kept.shape == (2, 5, 4) and idx.shape == (2, 5)
+    idx_np = np.asarray(idx)
+    for b in range(2):
+        assert sorted(set(idx_np[b])) == list(idx_np[b])  # sorted, unique
+        np.testing.assert_array_equal(np.asarray(kept)[b], x[b, idx_np[b]])
+
+    processed = kept * 10.0
+    restored = np.asarray(token_restore(jax.numpy.asarray(x), processed, idx))
+    for b in range(2):
+        for s in range(8):
+            if s in idx_np[b]:
+                np.testing.assert_allclose(restored[b, s], x[b, s] * 10.0)
+            else:
+                np.testing.assert_array_equal(restored[b, s], x[b, s])
+
+
+def test_random_ltd_scheduler():
+    s = RandomLTDScheduler({
+        "random_ltd_layer_num": 10,
+        "min_value": 64, "max_value": 512,
+        "total_ltd_step": 100, "difficulty_step": 64})
+    assert s.get_keep_count(0, seq_len=512) == 64
+    assert s.get_keep_count(100, seq_len=512) == 512
+    assert s.get_keep_count(100, seq_len=256) == 256  # capped by seq
+    assert not s.applies_to_layer(0, 12)
+    assert s.applies_to_layer(5, 12)
+    assert not s.applies_to_layer(11, 12)

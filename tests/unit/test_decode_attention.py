@@ -13,6 +13,8 @@ import pytest
 from deepspeed_tpu.ops.decode_attention import (decode_attention_pallas,
                                                 decode_attention_reference)
 
+pytestmark = pytest.mark.slow  # Pallas interpret mode: minutes on CPU
+
 
 def _dense_reference(q, k, v, q_pos):
     """Naive masked attention, fp32."""

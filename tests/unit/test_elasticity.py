@@ -1,0 +1,106 @@
+"""Elasticity tests (reference ``tests/unit/elasticity/test_elastic.py``)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.elasticity import (compute_elastic_config,
+                                      get_compatible_accelerator_counts)
+from deepspeed_tpu.elasticity.config import (ElasticityConfigError,
+                                             ElasticityIncompatibleWorldSize)
+from deepspeed_tpu.models import gpt2
+
+BASE = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 2000,
+        "micro_batch_sizes": [2, 4, 6],
+        "min_gpus": 1,
+        "max_gpus": 64,
+        "version": 0.2,
+    }
+}
+
+
+def test_compute_elastic_config_invariant():
+    """Every valid world size realizes the SAME global batch."""
+    batch, valid = compute_elastic_config(BASE)
+    assert batch <= 2000 and len(valid) >= 8
+    micros = BASE["elasticity"]["micro_batch_sizes"]
+    for w in valid:
+        assert any(batch % (m * w) == 0 for m in micros), (batch, w)
+
+
+def test_world_size_validation_and_microbatch():
+    valid_ws = 8
+    batch, valid, micro = compute_elastic_config(
+        BASE, world_size=valid_ws, return_microbatch=True)
+    assert valid_ws in valid
+    assert micro in BASE["elasticity"]["micro_batch_sizes"]
+    assert batch % (micro * valid_ws) == 0
+
+    bad = dict(BASE)
+    bad["elasticity"] = dict(BASE["elasticity"], max_gpus=4)
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(bad, world_size=64)
+
+
+def test_v02_model_parallel_step():
+    cfg = {"elasticity": dict(BASE["elasticity"], model_parallel_size=2,
+                              num_gpus_per_node=4)}
+    batch, valid = compute_elastic_config(cfg)
+    assert all(w % 8 == 0 for w in valid)  # multiples of 4*2
+
+
+def test_prefer_larger_batch():
+    small = {"elasticity": dict(BASE["elasticity"],
+                                prefer_larger_batch=False)}
+    b_large, _ = compute_elastic_config(BASE)
+    b_small, _ = compute_elastic_config(small)
+    assert b_small <= b_large
+
+
+def test_invalid_configs():
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"elasticity": {"enabled": True,
+                                               "micro_batch_sizes": []}})
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"elasticity": {"enabled": False}})
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"elasticity": {
+            "enabled": True, "version": 99}})
+
+
+def test_engine_adopts_elastic_batch(eight_devices):
+    """initialize() with elasticity derives the batch triple itself."""
+    deepspeed_tpu.comm.reset_topology()
+    cfg = {
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "elasticity": dict(BASE["elasticity"], max_gpus=16,
+                           micro_batch_sizes=[1, 2, 4],
+                           max_train_batch_size=64),
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt2.build(gpt2.GPT2Config.tiny()), config=cfg)
+    assert engine.train_batch_size() <= 64
+    assert engine.train_batch_size() == (
+        engine.train_micro_batch_size_per_gpu() *
+        engine.gradient_accumulation_steps() * 8)
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, 512, (engine.train_batch_size(), 17)).astype(np.int32)}
+    _, m = engine.train_batch(batch)
+    assert np.isfinite(m["loss"])
+
+
+def test_engine_rejects_conflicting_batch_config(eight_devices):
+    deepspeed_tpu.comm.reset_topology()
+    with pytest.raises(Exception, match="elastic"):
+        deepspeed_tpu.initialize(
+            model=gpt2.build(gpt2.GPT2Config.tiny()),
+            config={
+                "train_batch_size": 16,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "elasticity": dict(BASE["elasticity"], max_gpus=16,
+                                   micro_batch_sizes=[1, 2, 4],
+                                   max_train_batch_size=64),
+            })

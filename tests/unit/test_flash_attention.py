@@ -10,6 +10,8 @@ import pytest
 
 from deepspeed_tpu.ops.flash_attention import flash_attention, mha_reference
 
+pytestmark = pytest.mark.slow  # Pallas interpret mode: minutes on CPU
+
 
 def rand_qkv(key, b=2, h=4, s=256, d=64, hkv=None, dtype=jnp.float32):
     ks = jax.random.split(key, 3)

@@ -1,0 +1,75 @@
+"""Flops profiler tests (reference ``tests/unit/profiling/flops_profiler``)."""
+
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt2
+from deepspeed_tpu.profiling.flops_profiler import (FlopsProfiler,
+                                                    get_model_profile)
+
+
+def _engine(extra=None):
+    deepspeed_tpu.comm.reset_topology()
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+    }
+    cfg.update(extra or {})
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt2.build(gpt2.GPT2Config.tiny()), config=cfg)
+    return engine
+
+
+def _batch(engine):
+    rng = np.random.default_rng(0)
+    return {"input_ids": rng.integers(
+        0, 512, size=(engine.train_batch_size(), 33)).astype(np.int32)}
+
+
+def test_engine_profile_step(tmp_path):
+    out = tmp_path / "profile.txt"
+    engine = _engine({"flops_profiler": {
+        "enabled": True, "profile_step": 2, "output_file": str(out)}})
+    for _ in range(2):
+        engine.train_batch(_batch(engine))
+    prof = engine.flops_profiler.profile
+    assert prof["params"] > 0.1e6
+    assert prof["step_flops"] > 1e6  # tiny model, but real flops
+    assert prof["step_latency_s"] > 0
+    mods = prof["modules"]
+    assert mods["transformer_block"]["count"] == 2
+    assert mods["transformer_block"]["flops"] > 0
+    assert mods["head_loss"]["flops"] > 0
+    text = out.read_text()
+    assert "Flops Profiler" in text and "transformer_block" in text
+
+
+def test_profile_counts_scale_with_depth():
+    """4 layers ~2x the block flops of 2 layers; total step flops grow."""
+    def step_flops(layers):
+        deepspeed_tpu.comm.reset_topology()
+        cfg = gpt2.GPT2Config.tiny()
+        cfg.num_layers = layers
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=gpt2.build(cfg),
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}})
+        prof = FlopsProfiler(engine=engine)
+        return prof.profile_engine_step(_batch(engine))
+
+    p2, p4 = step_flops(2), step_flops(4)
+    b2, b4 = p2["modules"]["transformer_block"], \
+        p4["modules"]["transformer_block"]
+    assert b4["count"] == 4 and b2["count"] == 2
+    # per-block flops identical; totals scale with depth
+    np.testing.assert_allclose(b4["flops"], b2["flops"], rtol=1e-6)
+    assert p4["step_flops"] > p2["step_flops"]
+
+
+def test_get_model_profile_standalone():
+    spec = gpt2.build(gpt2.GPT2Config.tiny())
+    batch = {"input_ids": np.zeros((2, 17), np.int32)}
+    prof = get_model_profile(spec, batch)
+    assert prof["params"] > 0.1e6
+    assert prof["flops"] > 0
+    assert prof["macs"] == prof["flops"] / 2

@@ -15,6 +15,8 @@ from deepspeed_tpu.ops.flash_attention import mha_reference
 from deepspeed_tpu.parallel import sequence as seq
 from deepspeed_tpu.parallel.topology import MeshTopology
 
+pytestmark = pytest.mark.slow  # Pallas interpret mode: minutes on CPU
+
 
 def make_qkv(key, b=2, h=4, s=32, d=8, hkv=None):
     hkv = hkv or h

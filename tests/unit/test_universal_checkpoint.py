@@ -1,0 +1,128 @@
+"""Universal checkpoint: save under one mesh/ZeRO layout, load under another.
+
+Reference: ``checkpoint/deepspeed_checkpoint.py:39`` reshapes DS checkpoints
+across TP/PP/DP degrees and ``tests/unit/checkpoint/`` resumes across world
+sizes via DistributedFixture.  Here orbax stores the logical arrays, so the
+reshard is target-sharding-driven on load — these tests prove that claim
+instead of just stating it.
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt2
+
+
+def _engine(config):
+    deepspeed_tpu.comm.reset_topology()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt2.build(gpt2.GPT2Config.tiny()), config=config)
+    return engine
+
+
+def _cfg(**over):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _train(engine, steps=2, seed=0):
+    rng = np.random.default_rng(seed)
+    for _ in range(steps):
+        batch = {"input_ids": rng.integers(
+            0, 512, size=(engine.train_batch_size(), 33)).astype(np.int32)}
+        _, m = engine.train_batch(batch)
+    return m
+
+
+def _full_params(engine):
+    import jax
+
+    return {k: np.asarray(v) for k, v in
+            zip(_param_names(engine), jax.tree_util.tree_leaves(
+                jax.device_get(engine.state["params"])))}
+
+
+def _param_names(engine):
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(engine.state["params"])
+    return ["/".join(str(getattr(k, "key", k)) for k in p) for p, _ in flat]
+
+
+@pytest.mark.parametrize("save_cfg,load_cfg", [
+    # dp8/zero2 -> dp4 x tp2 / zero3
+    (dict(zero_optimization={"stage": 2}),
+     dict(zero_optimization={"stage": 3}, mesh={"tp": 2})),
+    # dp8/zero3 -> pp2 x dp4
+    (dict(zero_optimization={"stage": 3}),
+     dict(mesh={"pp": 2}, train_micro_batch_size_per_gpu=2)),
+    # tp2 -> plain dp8
+    (dict(mesh={"tp": 2}),
+     dict(zero_optimization={"stage": 1})),
+])
+def test_cross_mesh_reshard(tmp_path, save_cfg, load_cfg, eight_devices):
+    """Params saved under one (mesh, ZeRO stage) load bit-equal under
+    another; training resumes with finite loss."""
+    e1 = _engine(_cfg(**save_cfg))
+    _train(e1, steps=2)
+    before = _full_params(e1)
+    step_before = int(np.asarray(e1.state["step"]))
+    e1.save_checkpoint(str(tmp_path / "ck"))
+
+    e2 = _engine(_cfg(**load_cfg))
+    e2.load_checkpoint(str(tmp_path / "ck"))
+    after = _full_params(e2)
+    assert set(before) == set(after)
+    for k in before:
+        np.testing.assert_array_equal(before[k], after[k]), k
+    assert int(np.asarray(e2.state["step"])) == step_before
+
+    m = _train(e2, steps=1, seed=5)
+    assert np.isfinite(m["loss"])
+
+
+def test_optimizer_state_carries_across_mesh(tmp_path, eight_devices):
+    """Adam moments survive a dp8 -> dp4xtp2 reshard (not just params)."""
+    import jax
+
+    e1 = _engine(_cfg(zero_optimization={"stage": 1}))
+    _train(e1, steps=3)
+    mom1 = [np.asarray(x) for x in jax.tree_util.tree_leaves(
+        jax.device_get(e1.state["opt_state"]))]
+    assert any(np.abs(m).max() > 0 for m in mom1 if m.ndim > 0)
+    e1.save_checkpoint(str(tmp_path / "ck"))
+
+    e2 = _engine(_cfg(zero_optimization={"stage": 2}, mesh={"tp": 2}))
+    e2.load_checkpoint(str(tmp_path / "ck"))
+    mom2 = [np.asarray(x) for x in jax.tree_util.tree_leaves(
+        jax.device_get(e2.state["opt_state"]))]
+    assert len(mom1) == len(mom2)
+    for a, b in zip(mom1, mom2):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_zero_to_fp32_offline_extraction(tmp_path, eight_devices):
+    """The offline script consolidates fp32 weights without an engine."""
+    e1 = _engine(_cfg(zero_optimization={"stage": 3}))
+    _train(e1, steps=1)
+    expected = _full_params(e1)
+    e1.save_checkpoint(str(tmp_path / "ck"))
+
+    from deepspeed_tpu.utils.zero_to_fp32 import (
+        get_fp32_state_dict_from_checkpoint, main)
+
+    sd = get_fp32_state_dict_from_checkpoint(str(tmp_path / "ck"))
+    assert set(sd) == {k.replace("/", ".") for k in expected}
+    for k, v in expected.items():
+        np.testing.assert_array_equal(sd[k.replace("/", ".")], v)
+
+    out = str(tmp_path / "consolidated.npz")
+    main([str(tmp_path / "ck"), out])
+    with np.load(out) as z:
+        assert len(z.files) == len(sd)
