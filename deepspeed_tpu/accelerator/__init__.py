@@ -1,0 +1,8 @@
+"""Accelerator abstraction (reference ``accelerator/``): the device-dispatch
+seam every device touch goes through (``abstract_accelerator.py:7``,
+``real_accelerator.py:39 get_accelerator``)."""
+
+from .abstract_accelerator import DeepSpeedAccelerator
+from .real_accelerator import get_accelerator, set_accelerator
+
+__all__ = ["DeepSpeedAccelerator", "get_accelerator", "set_accelerator"]

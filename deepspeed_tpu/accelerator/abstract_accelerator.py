@@ -1,0 +1,68 @@
+"""Abstract accelerator interface.
+
+Reference ``accelerator/abstract_accelerator.py:7 DeepSpeedAccelerator``:
+every device interaction (device queries, memory stats, RNG, op-builder
+dispatch, communication backend name) routes through this seam so a new
+backend plugs in by implementing one class (``create_op_builder``/
+``get_op_builder`` at :226/:231 are the hook Pallas/C++ builders attach to).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, Optional
+
+
+class DeepSpeedAccelerator(abc.ABC):
+    _name: str = "abstract"
+    _communication_backend_name: str = "none"
+
+    # ------------------------------------------------------------- device
+    @abc.abstractmethod
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        ...
+
+    @abc.abstractmethod
+    def device_count(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def current_device(self):
+        ...
+
+    def is_available(self) -> bool:
+        return self.device_count() > 0
+
+    def communication_backend_name(self) -> str:
+        return self._communication_backend_name
+
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        pass
+
+    # ------------------------------------------------------------- memory
+    @abc.abstractmethod
+    def memory_stats(self, device_index: Optional[int] = None) -> Dict:
+        ...
+
+    def total_memory(self, device_index: Optional[int] = None) -> int:
+        return int(self.memory_stats(device_index).get("bytes_limit", 0))
+
+    def memory_allocated(self, device_index: Optional[int] = None) -> int:
+        return int(self.memory_stats(device_index).get("bytes_in_use", 0))
+
+    # ---------------------------------------------------------------- rng
+    @abc.abstractmethod
+    def manual_seed(self, seed: int):
+        ...
+
+    # --------------------------------------------------------- op builders
+    @abc.abstractmethod
+    def op_builder_dict(self) -> Dict[str, Any]:
+        ...
+
+    def create_op_builder(self, op_name: str):
+        builder = self.get_op_builder(op_name)
+        return builder if builder is not None else None
+
+    def get_op_builder(self, op_name: str):
+        return self.op_builder_dict().get(op_name)

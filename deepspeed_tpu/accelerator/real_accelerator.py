@@ -1,0 +1,31 @@
+"""Accelerator selection (reference ``accelerator/real_accelerator.py:39``):
+``get_accelerator`` returns the process-wide accelerator, selected by the
+``DS_ACCELERATOR`` env var or auto-detected (tpu covers the CPU-sim backend
+too — JAX abstracts the device)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_accelerator = None
+
+
+def get_accelerator():
+    global _accelerator
+    if _accelerator is None:
+        name = os.environ.get("DS_ACCELERATOR", "tpu").lower()
+        if name not in ("tpu", "cpu"):
+            raise ValueError(
+                f"DS_ACCELERATOR={name!r} is not supported "
+                "(this framework targets tpu; 'cpu' maps to the CPU-sim "
+                "backend of the same accelerator class)")
+        from .tpu_accelerator import TPU_Accelerator
+
+        _accelerator = TPU_Accelerator()
+    return _accelerator
+
+
+def set_accelerator(accel) -> None:
+    global _accelerator
+    _accelerator = accel

@@ -1,0 +1,78 @@
+"""TPU (and CPU-sim) accelerator over JAX — the ``cuda_accelerator`` analog.
+
+The communication backend name is the XLA collective stack over ICI/DCN
+(reference returns "nccl"; ``runtime/engine.py:228`` keys off this)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class TPU_Accelerator:
+    _name = "tpu"
+    _communication_backend_name = "xla"
+
+    # ------------------------------------------------------------- device
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        import jax
+
+        devs = jax.devices()
+        if not devs:
+            return "tpu"
+        d = devs[device_index or 0]
+        return f"{d.platform}:{d.id} ({d.device_kind})"
+
+    def device_count(self) -> int:
+        import jax
+
+        return len(jax.devices())
+
+    def current_device(self):
+        import jax
+
+        return jax.devices()[0]
+
+    def communication_backend_name(self) -> str:
+        return self._communication_backend_name
+
+    def is_available(self) -> bool:
+        try:
+            return self.device_count() > 0
+        except RuntimeError:
+            return False
+
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        import jax
+
+        (jax.device_put(0.0) + 0).block_until_ready()
+
+    # ------------------------------------------------------------- memory
+    def memory_stats(self, device_index: Optional[int] = None) -> Dict:
+        import jax
+
+        d = jax.devices()[device_index or 0]
+        return getattr(d, "memory_stats", lambda: {})() or {}
+
+    def total_memory(self, device_index: Optional[int] = None) -> int:
+        return int(self.memory_stats(device_index).get("bytes_limit", 0))
+
+    def memory_allocated(self, device_index: Optional[int] = None) -> int:
+        return int(self.memory_stats(device_index).get("bytes_in_use", 0))
+
+    # ---------------------------------------------------------------- rng
+    def manual_seed(self, seed: int):
+        import jax
+
+        return jax.random.PRNGKey(seed)
+
+    # --------------------------------------------------------- op builders
+    def op_builder_dict(self) -> Dict[str, Any]:
+        from ..ops.op_builder import ALL_OPS
+
+        return dict(ALL_OPS)
+
+    def create_op_builder(self, op_name: str):
+        return self.get_op_builder(op_name)
+
+    def get_op_builder(self, op_name: str):
+        return self.op_builder_dict().get(op_name)

@@ -1,0 +1,7 @@
+"""Autotuning (reference ``deepspeed/autotuning``): search ZeRO stage /
+micro-batch / remat configurations by measuring short training runs."""
+
+from .autotuner import Autotuner, autotune
+from .config import AutotuningConfig
+
+__all__ = ["Autotuner", "autotune", "AutotuningConfig"]

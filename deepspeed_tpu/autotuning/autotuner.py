@@ -1,0 +1,165 @@
+"""Autotuner — measured search over ZeRO stage x micro-batch (x remat).
+
+Reference ``autotuning/autotuner.py:423 Autotuner.tune``: builds an
+experiment space from the user config ("fast" mode: ZeRO stage and
+micro-batch size), launches short training runs per experiment, records
+throughput, prunes infeasible points, and emits the best config.  The
+reference spawns cluster jobs per experiment; here each experiment is an
+in-process engine build + a few measured ``train_batch`` steps (XLA compile
+cache makes repeats cheap), with HBM OOM treated as infeasible-and-prune
+(larger micro batches of the same stage are skipped — the reference's
+memory-based pruning).
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+from .config import AutotuningConfig
+
+OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Allocation", "exceed", "out of memory")
+
+
+class Autotuner:
+
+    def __init__(self, model_factory: Callable[[], Any], base_config: dict,
+                 batch_factory: Callable[[int, int], dict],
+                 seq_len: int = 128):
+        """``model_factory()`` -> fresh ModelSpec per experiment;
+        ``batch_factory(global_batch, seq_len)`` -> host batch dict."""
+        self.model_factory = model_factory
+        self.base_config = dict(base_config)
+        self.cfg = AutotuningConfig(**self.base_config.get("autotuning", {}))
+        self.batch_factory = batch_factory
+        self.seq_len = seq_len
+        self.results: List[Dict[str, Any]] = []
+
+    # ----------------------------------------------------------- exp space
+    def experiment_space(self) -> List[dict]:
+        """Fast-mode space (reference ``_generate_experiments``): ZeRO
+        stages x micro-batch powers of two."""
+        base_micro = int(self.base_config.get(
+            "train_micro_batch_size_per_gpu", 1))
+        micros = []
+        m = max(self.cfg.min_train_micro_batch_size_per_gpu, base_micro)
+        for _ in range(self.cfg.num_tuning_micro_batch_sizes):
+            if m > self.cfg.max_train_micro_batch_size_per_gpu:
+                break
+            micros.append(m)
+            m *= 2
+        stages = self.base_config.get("autotuning", {}).get(
+            "zero_stages", [0, 1, 2, 3])
+        exps = []
+        for stage in stages:
+            for micro in micros:
+                overrides = {
+                    "train_micro_batch_size_per_gpu": micro,
+                    "zero_optimization": {"stage": stage},
+                }
+                exps.append(overrides)
+        return exps[: self.cfg.tuner_num_trials]
+
+    # ------------------------------------------------------------ measure
+    def _run_experiment(self, overrides: dict) -> Dict[str, Any]:
+        import jax
+
+        import deepspeed_tpu
+
+        config = dict(self.base_config)
+        config.pop("autotuning", None)
+        config.update({k: v for k, v in overrides.items()})
+        rec: Dict[str, Any] = {"config": overrides}
+        deepspeed_tpu.comm.reset_topology()
+        engine = None
+        try:
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=self.model_factory(), config=config)
+            warm = self.cfg.start_profile_step
+            steps = max(self.cfg.end_profile_step - warm, 1)
+            for _ in range(warm):
+                engine.train_batch(self.batch_factory(
+                    engine.train_batch_size(), self.seq_len))
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                _, m = engine.train_batch(self.batch_factory(
+                    engine.train_batch_size(), self.seq_len))
+            jax.device_get(jax.tree_util.tree_leaves(
+                engine.state["params"])[0].sum())
+            dt = (time.perf_counter() - t0) / steps
+            toks = engine.train_batch_size() * self.seq_len
+            rec.update(feasible=True, step_s=dt,
+                       throughput=toks / dt, loss=float(m["loss"]))
+        except Exception as e:  # infeasible (OOM / invalid combo)
+            msg = str(e)
+            rec.update(feasible=False,
+                       oom=any(s in msg for s in OOM_MARKERS),
+                       error=msg[:300])
+        finally:
+            del engine
+            gc.collect()
+        return rec
+
+    # ---------------------------------------------------------------- tune
+    def tune(self) -> Dict[str, Any]:
+        """Run the space; returns the best record (reference ``tune``:423).
+
+        Pruning: an OOM at micro batch m skips larger micros for the same
+        stage; ``tuner_early_stopping`` consecutive non-improving trials end
+        the search."""
+        best: Optional[Dict[str, Any]] = None
+        stale = 0
+        pruned_stage_micro: Dict[int, int] = {}
+        for overrides in self.experiment_space():
+            stage = overrides["zero_optimization"]["stage"]
+            micro = overrides["train_micro_batch_size_per_gpu"]
+            if stage in pruned_stage_micro and \
+                    micro >= pruned_stage_micro[stage]:
+                continue
+            rec = self._run_experiment(overrides)
+            self.results.append(rec)
+            log_dist(f"autotuning exp {overrides}: "
+                     f"{'%.1f tok/s' % rec['throughput'] if rec.get('feasible') else 'infeasible'}",
+                     ranks=[0])
+            if not rec.get("feasible"):
+                if rec.get("oom"):
+                    pruned_stage_micro[stage] = micro
+                continue
+            if best is None or rec["throughput"] > best["throughput"]:
+                best, stale = rec, 0
+            else:
+                stale += 1
+                if stale >= self.cfg.tuner_early_stopping:
+                    break
+        if best is None:
+            raise RuntimeError(
+                "autotuning found no feasible configuration; "
+                f"records: {self.results}")
+        self._write_results(best)
+        return best
+
+    def _write_results(self, best) -> None:
+        os.makedirs(self.cfg.results_dir, exist_ok=True)
+        with open(os.path.join(self.cfg.results_dir, "exps.json"), "w") as f:
+            json.dump(self.results, f, indent=2, default=str)
+        with open(os.path.join(self.cfg.results_dir,
+                               "best_config.json"), "w") as f:
+            cfg = dict(self.base_config)
+            cfg.pop("autotuning", None)
+            cfg.update(best["config"])
+            json.dump(cfg, f, indent=2)
+        log_dist(f"autotuning: best {best['config']} at "
+                 f"{best['throughput']:.1f} tok/s -> "
+                 f"{self.cfg.results_dir}/best_config.json", ranks=[0])
+
+
+def autotune(model_factory, base_config, batch_factory, seq_len=128):
+    """One-call entry (the ``deepspeed --autotuning run`` analog)."""
+    return Autotuner(model_factory, base_config, batch_factory,
+                     seq_len).tune()

@@ -46,6 +46,11 @@ def _build_transform(cfg: CompressionConfig, num_heads: Optional[int]):
 
     wq = cfg.weight_quantization
     if wq.shared_parameters.enabled:
+        if wq.shared_parameters.rounding == "stochastic":
+            raise NotImplementedError(
+                "rounding='stochastic' is not implemented on TPU yet "
+                "(needs an rng threaded through the weight transform); use "
+                "'nearest'")
         for gname, grp in wq.different_groups.items():
             bits = grp.target_bits
             qt = wq.shared_parameters.quantization_type
@@ -112,18 +117,35 @@ def init_compression(model: ModelSpec, deepspeed_config,
     import dataclasses
 
     orig_loss, orig_apply = model.loss_fn, model.apply_fn
+    offset = max([cfg.weight_quantization.shared_parameters.schedule_offset
+                  if cfg.weight_quantization.shared_parameters.enabled else 0,
+                  cfg.sparse_pruning.shared_parameters.schedule_offset
+                  if cfg.sparse_pruning.shared_parameters.enabled else 0,
+                  cfg.row_pruning.shared_parameters.schedule_offset
+                  if cfg.row_pruning.shared_parameters.enabled else 0,
+                  cfg.head_pruning.shared_parameters.schedule_offset
+                  if cfg.head_pruning.shared_parameters.enabled else 0])
+
+    class _Toggle:
+        active = offset == 0
 
     def loss_fn(params, batch, rng=None, train=True):
-        return orig_loss(compress_params(params, rules), batch, rng, train)
+        p = compress_params(params, rules) if _Toggle.active else params
+        return orig_loss(p, batch, rng, train)
 
     def apply_fn(params, batch, rng=None):
-        return orig_apply(compress_params(params, rules), batch, rng)
+        p = compress_params(params, rules) if _Toggle.active else params
+        return orig_apply(p, batch, rng)
 
     wrapped = dataclasses.replace(
         model, loss_fn=loss_fn,
         apply_fn=apply_fn if orig_apply else None,
         name=model.name + "+compressed")
     wrapped._compression_rules = rules
+    # the engine flips this at schedule_offset and rebuilds its step fns
+    # (reference applies compression from schedule_offset onward)
+    wrapped._compression_toggle = _Toggle
+    wrapped._compression_schedule_offset = offset
     return wrapped
 
 

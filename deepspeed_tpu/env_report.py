@@ -1,0 +1,85 @@
+"""Environment / compatibility report — the ``ds_report`` analog
+(reference ``deepspeed/env_report.py:132``): package versions, device
+inventory, native-op toolchain compatibility, and general runtime info.
+
+CLI: ``python -m deepspeed_tpu.env_report`` or ``bin/ds_report``.
+"""
+
+from __future__ import annotations
+
+import importlib
+import platform
+import shutil
+import sys
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+DOT = "." * 2
+
+
+def _version(mod_name: str) -> str:
+    try:
+        mod = importlib.import_module(mod_name)
+        return getattr(mod, "__version__", "unknown")
+    except ImportError:
+        return None
+
+
+def op_report() -> list:
+    """Native op compatibility (reference ``env_report.py op_report``):
+    can each C++ builder compile/load on this host?"""
+    from .ops.op_builder import ALL_OPS
+
+    rows = []
+    for name, builder in sorted(ALL_OPS.items()):
+        try:
+            compatible = builder.is_compatible()
+        except Exception:
+            compatible = False
+        try:
+            loaded = builder.bind() is not None
+        except Exception:
+            loaded = False
+        rows.append((name, compatible, loaded))
+    return rows
+
+
+def main(argv=None):
+    print("-" * 60)
+    print("DeepSpeed-TPU C++/native op report")
+    print("-" * 60)
+    print(f"{'op name':20} {'compatible':12} {'loaded':8}")
+    for name, compatible, loaded in op_report():
+        print(f"{name:20} {GREEN_OK if compatible else RED_NO:12} "
+              f"{GREEN_OK if loaded else RED_NO}")
+    print(f"g++ {DOT} {shutil.which('g++') or 'not found'}")
+
+    print("-" * 60)
+    print("DeepSpeed-TPU general environment info")
+    print("-" * 60)
+    for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint",
+                "numpy", "transformers", "torch"):
+        v = _version(mod)
+        print(f"{mod:20} version {DOT} {v if v else 'not installed'}")
+    import deepspeed_tpu
+
+    print(f"{'deepspeed_tpu':20} version {DOT} {deepspeed_tpu.__version__}")
+    print(f"python {DOT} {sys.version.split()[0]}  "
+          f"platform {DOT} {platform.platform()}")
+
+    try:
+        import jax
+
+        devs = jax.devices()
+        print(f"jax backend {DOT} {jax.default_backend()}  "
+              f"devices {DOT} {len(devs)} x {devs[0].device_kind}")
+        stats = getattr(devs[0], "memory_stats", lambda: None)() or {}
+        if stats.get("bytes_limit"):
+            print(f"device memory {DOT} {stats['bytes_limit']/2**30:.1f} GiB")
+    except Exception as e:  # no device is still a valid report
+        print(f"jax devices {DOT} unavailable ({e})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

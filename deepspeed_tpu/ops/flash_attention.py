@@ -36,9 +36,14 @@ def _use_interpret() -> bool:
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                sm_scale: float, causal: bool, block_q: int, block_k: int,
-                kv_len: int, num_k_blocks: int):
+def _fwd_kernel(*refs, sm_scale: float, causal: bool, block_q: int,
+                block_k: int, kv_len: int, num_k_blocks: int,
+                has_layout: bool = False):
+    if has_layout:
+        (q_ref, k_ref, v_ref, layout_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -48,8 +53,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # causal: block (qi, ki) contributes iff some col <= some row
+    # causal: block (qi, ki) contributes iff some col <= some row;
+    # a sparsity layout gates blocks on top (ops/sparse_attention)
     run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+    if has_layout:
+        run = jnp.logical_and(run, layout_ref[0, 0, 0] != 0)
 
     @pl.when(run)
     def _compute():
@@ -94,10 +102,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
 
 
 def _fwd(q, k, v, sm_scale: float, causal: bool, block_q: int, block_k: int,
-         interpret: bool, true_kv_len: int, head_rep: int = 1):
+         interpret: bool, true_kv_len: int, head_rep: int = 1, layout=None):
     """``head_rep``: GQA ratio — q has ``bh`` leading entries, k/v have
     ``bh // head_rep``; the KV index map divides so repeated heads read the
-    same KV block in place (no ``jnp.repeat`` materialization)."""
+    same KV block in place (no ``jnp.repeat`` materialization).
+    ``layout``: optional f32 [H, nq, nk] block-sparsity gate."""
     bh, q_len, d = q.shape
     kv_len = true_kv_len  # mask out padded keys beyond the real length
     nq = pl.cdiv(q_len, block_q)
@@ -106,19 +115,26 @@ def _fwd(q, k, v, sm_scale: float, causal: bool, block_q: int, block_k: int,
 
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                                block_q=block_q, block_k=block_k, kv_len=kv_len,
-                               num_k_blocks=nk)
+                               num_k_blocks=nk, has_layout=layout is not None)
     out_shape = [
         jax.ShapeDtypeStruct((bh, q_len, d), q.dtype),          # o
         jax.ShapeDtypeStruct((bh, q_len, LANES), jnp.float32),  # lse (lane-bcast)
     ]
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // rep, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // rep, j, 0)),
+    ]
+    inputs = [q, k, v]
+    if layout is not None:
+        h = layout.shape[0]
+        in_specs.append(pl.BlockSpec((1, 1, 1),
+                                     lambda b, i, j: (b % h, i, j)))
+        inputs.append(layout)
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // rep, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // rep, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
@@ -132,16 +148,22 @@ def _fwd(q, k, v, sm_scale: float, causal: bool, block_q: int, block_k: int,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
     return o, lse[:, :, 0]
 
 
 # ---------------------------------------------------------------------------
 # backward: dq kernel (grid kv-innermost) and dkv kernel (grid q-innermost)
 # ---------------------------------------------------------------------------
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, sm_scale: float, causal: bool, block_q: int,
-                   block_k: int, kv_len: int, num_k_blocks: int):
+def _bwd_dq_kernel(*refs, sm_scale: float, causal: bool, block_q: int,
+                   block_k: int, kv_len: int, num_k_blocks: int,
+                   has_layout: bool = False):
+    if has_layout:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, layout_ref,
+         dq_ref, dq_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+         dq_scr) = refs
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -150,6 +172,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
     run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+    if has_layout:
+        run = jnp.logical_and(run, layout_ref[0, 0, 0] != 0)
 
     @pl.when(run)
     def _compute():
@@ -183,13 +207,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, ...] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-                    dv_ref, dk_scr, dv_scr, *, sm_scale: float, causal: bool,
+def _bwd_dkv_kernel(*refs, sm_scale: float, causal: bool,
                     block_q: int, block_k: int, kv_len: int, num_q_blocks: int,
-                    rep: int = 1):
+                    rep: int = 1, has_layout: bool = False):
     """Inner grid dim 2 runs over (head_rep, q_blocks) flattened: for GQA the
     dk/dv of one KV head accumulates contributions from all ``rep`` query
     heads without materializing repeated K/V."""
+    if has_layout:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, layout_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+         dk_scr, dv_scr) = refs
     ki = pl.program_id(1)
     inner = pl.program_id(2)
     qi = inner % num_q_blocks
@@ -200,6 +229,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
     run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+    if has_layout:
+        run = jnp.logical_and(run, layout_ref[0, 0, 0] != 0)
 
     @pl.when(run)
     def _compute():
@@ -238,7 +269,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
 
 
 def _bwd_dq_call(q, k, v, do, lse_b, delta_b, *, sm_scale, causal, block_q,
-                 block_k, kv_len, interpret, head_rep: int = 1):
+                 block_k, kv_len, interpret, head_rep: int = 1, layout=None):
     """dq for one (q-chunk, kv-chunk) pair given *global* lse/delta.
 
     Exposed separately so ring attention (parallel/sequence.py) can reuse the
@@ -251,30 +282,38 @@ def _bwd_dq_call(q, k, v, do, lse_b, delta_b, *, sm_scale, causal, block_q,
     dq_kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
                                   causal=causal, block_q=block_q,
                                   block_k=block_k, kv_len=kv_len,
-                                  num_k_blocks=nk)
+                                  num_k_blocks=nk,
+                                  has_layout=layout is not None)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // rep, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // rep, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
+    ]
+    inputs = [q, k, v, do, lse_b, delta_b]
+    if layout is not None:
+        h = layout.shape[0]
+        in_specs.append(pl.BlockSpec((1, 1, 1),
+                                     lambda b, i, j: (b % h, i, j)))
+        inputs.append(layout)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // rep, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // rep, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse_b, delta_b)
+    )(*inputs)
     return dq
 
 
 def _bwd_dkv_call(q, k, v, do, lse_b, delta_b, *, sm_scale, causal, block_q,
-                  block_k, kv_len, interpret, head_rep: int = 1):
+                  block_k, kv_len, interpret, head_rep: int = 1, layout=None):
     """dk, dv for one (q-chunk, kv-chunk) pair given *global* lse/delta.
 
     For GQA (``head_rep > 1``) q/do/lse/delta have ``rep`` times more heads
@@ -283,24 +322,31 @@ def _bwd_dkv_call(q, k, v, do, lse_b, delta_b, *, sm_scale, causal, block_q,
     bh_kv = k.shape[0]
     q_len, d = q.shape[1], q.shape[2]
     rep = head_rep
+    assert layout is None or rep == 1, "sparse layout + GQA not supported"
     nq = pl.cdiv(q_len, block_q)
     nk = pl.cdiv(kv_len, block_k)
     dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
                                    causal=causal, block_q=block_q,
                                    block_k=block_k, kv_len=kv_len,
-                                   num_q_blocks=nq, rep=rep)
+                                   num_q_blocks=nq, rep=rep,
+                                   has_layout=layout is not None)
     q_map = lambda b, j, i: (b * rep + i // nq, i % nq, 0)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), q_map),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d), q_map),
+        pl.BlockSpec((1, block_q, LANES), q_map),
+        pl.BlockSpec((1, block_q, LANES), q_map),
+    ]
+    if layout is not None:
+        h = layout.shape[0]
+        in_specs.append(pl.BlockSpec((1, 1, 1),
+                                     lambda b, j, i: (b % h, i % nq, j)))
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bh_kv, nk, rep * nq),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), q_map),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), q_map),
-            pl.BlockSpec((1, block_q, LANES), q_map),
-            pl.BlockSpec((1, block_q, LANES), q_map),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -316,7 +362,8 @@ def _bwd_dkv_call(q, k, v, do, lse_b, delta_b, *, sm_scale, causal, block_q,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse_b, delta_b)
+    )(*([q, k, v, do, lse_b, delta_b] +
+        ([layout] if layout is not None else [])))
     return dk, dv
 
 
@@ -363,6 +410,38 @@ def _flash_bwd_rule(sm_scale, causal, block_q, block_k, interpret, true_kv_len,
 
 
 _flash_attention_bh.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _sparse_attention_bh(q, k, v, layout, sm_scale, causal, block_q, block_k,
+                         interpret):
+    o, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                k.shape[1], 1, layout)
+    return o
+
+
+def _sparse_fwd_rule(q, k, v, layout, sm_scale, causal, block_q, block_k,
+                     interpret):
+    o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                  k.shape[1], 1, layout)
+    return o, (q, k, v, layout, o, lse)
+
+
+def _sparse_bwd_rule(sm_scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, layout, o, lse = res
+    kv_len = k.shape[1]
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    lse_b = jnp.broadcast_to(lse[..., None], lse.shape + (LANES,))
+    delta_b = jnp.broadcast_to(delta[..., None], delta.shape + (LANES,))
+    kw = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
+              block_k=block_k, kv_len=kv_len, interpret=interpret,
+              layout=layout)
+    dq = _bwd_dq_call(q, k, v, g, lse_b, delta_b, **kw)
+    dk, dv = _bwd_dkv_call(q, k, v, g, lse_b, delta_b, **kw)
+    return dq, dk, dv, jnp.zeros_like(layout)
+
+
+_sparse_attention_bh.defvjp(_sparse_fwd_rule, _sparse_bwd_rule)
 
 
 def flash_attention(q, k, v, causal: bool = True,

@@ -1,0 +1,15 @@
+"""Block-sparse attention (reference ``deepspeed/ops/sparse_attention``):
+pluggable sparsity *structures* (Fixed, Variable, BigBird, BSLongformer,
+LocalSlidingWindow) producing block-level layouts, executed by the Pallas
+flash kernel's layout gating instead of Triton block-sparse matmuls."""
+
+from .sparse_self_attention import SparseSelfAttention, sparse_attention
+from .sparsity_config import (BigBirdSparsityConfig, BSLongformerSparsityConfig,
+                              DenseSparsityConfig, FixedSparsityConfig,
+                              LocalSlidingWindowSparsityConfig,
+                              SparsityConfig, VariableSparsityConfig)
+
+__all__ = ["SparsityConfig", "DenseSparsityConfig", "FixedSparsityConfig",
+           "VariableSparsityConfig", "BigBirdSparsityConfig",
+           "BSLongformerSparsityConfig", "LocalSlidingWindowSparsityConfig",
+           "SparseSelfAttention", "sparse_attention"]

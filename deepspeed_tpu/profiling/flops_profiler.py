@@ -91,8 +91,8 @@ class FlopsProfiler:
         prof["step_latency_s"] = latency or 0.0
 
         hooks = self.model_spec.pipeline_hooks if self.model_spec else None
-        if hooks:
-            mods = self._module_breakdown(hooks, batch)
+        mods = self._module_breakdown(hooks, batch) if hooks else None
+        if mods and "transformer_block" in mods:
             prof["modules"] = mods
             gas = eng.gradient_accumulation_steps()
             micro_fwd = (mods["embedding"]["flops"] +
@@ -104,6 +104,10 @@ class FlopsProfiler:
             refwd = eng._config.flops_profiler_config.recompute_fwd_factor
             prof["step_flops"] = prof["fwd_flops"] * (3.0 + refwd)
         else:
+            # no block breakdown (hookless model or mismatched blocks_key):
+            # fall back to the XLA aggregate
+            if mods:
+                prof["modules"] = mods
             prof["step_flops"] = prof["xla_step_flops"]
         if prof["step_latency_s"] > 0 and prof["step_flops"]:
             prof["achieved_tflops"] = (prof["step_flops"] /

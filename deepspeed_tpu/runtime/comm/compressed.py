@@ -93,20 +93,11 @@ class CompressedBackend:
         self.axis_name = axis_name
         self.n = mesh.shape[axis_name]
         self._errors = {}
+        self._run = self._build_run()  # jitted ONCE; retraces only per shape
 
-    def allreduce(self, key: str, x_sharded):
-        """All-reduce a [n, ...]-stacked per-worker array (leading dim =
-        worker) with persistent error feedback keyed by ``key``."""
+    def _build_run(self):
         from jax.experimental.shard_map import shard_map
         from jax.sharding import PartitionSpec as P
-
-        n = self.n
-        per_shape = x_sharded.shape[1:]
-        if key not in self._errors:
-            we_s, se_s = error_shapes(per_shape, n)
-            self._errors[key] = (jnp.zeros((n,) + we_s, jnp.float32),
-                                 jnp.zeros((n,) + se_s, jnp.float32))
-        we, se = self._errors[key]
 
         @jax.jit
         def run(x, we, se):
@@ -120,6 +111,18 @@ class CompressedBackend:
                 in_specs=(P(self.axis_name),) * 3,
                 out_specs=(P(self.axis_name),) * 3)(x, we, se)
 
-        mean_sh, nwe, nse = run(x_sharded, we, se)
+        return run
+
+    def allreduce(self, key: str, x_sharded):
+        """All-reduce a [n, ...]-stacked per-worker array (leading dim =
+        worker) with persistent error feedback keyed by ``key``."""
+        n = self.n
+        per_shape = x_sharded.shape[1:]
+        if key not in self._errors:
+            we_s, se_s = error_shapes(per_shape, n)
+            self._errors[key] = (jnp.zeros((n,) + we_s, jnp.float32),
+                                 jnp.zeros((n,) + se_s, jnp.float32))
+        we, se = self._errors[key]
+        mean_sh, nwe, nse = self._run(x_sharded, we, se)
         self._errors[key] = (nwe, nse)
         return mean_sh

@@ -851,6 +851,19 @@ class DeepSpeedEngine:
         batch = self._apply_curriculum(batch)
         batch = self._shard_batch(batch, leading_gas_dim=True)
 
+        # compression schedule_offset: flip the transform on and retrace
+        # (reference applies compression from schedule_offset onward)
+        toggle = getattr(self.model_spec, "_compression_toggle", None)
+        if toggle is not None and not toggle.active and \
+                self.global_steps + 1 > \
+                self.model_spec._compression_schedule_offset:
+            toggle.active = True
+            log_dist(
+                f"compression: activating at step {self.global_steps + 1} "
+                f"(schedule_offset "
+                f"{self.model_spec._compression_schedule_offset})", ranks=[0])
+            self._build_step_fns()
+
         fp = self._config.flops_profiler_config
         profiling_now = fp.enabled and \
             self.global_steps + 1 == fp.profile_step

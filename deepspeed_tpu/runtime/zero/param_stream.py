@@ -77,7 +77,7 @@ class StreamedParamStore:
         host accumulator double-counts."""
         import jax.sharding as jsh
 
-        return jsh.SingleDeviceSharding(jax.devices()[0])
+        return jsh.SingleDeviceSharding(jax.local_devices()[0])
 
     def _load(self, i):
         """Layer ``i``'s params via (re-executable) host callback."""

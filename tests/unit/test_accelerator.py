@@ -1,0 +1,38 @@
+"""Accelerator seam tests (reference ``tests/unit/accelerator``)."""
+
+import numpy as np
+
+from deepspeed_tpu.accelerator import get_accelerator, set_accelerator
+
+
+def test_get_accelerator_singleton_and_api(eight_devices):
+    a = get_accelerator()
+    assert a is get_accelerator()
+    assert a.is_available()
+    assert a.device_count() == 8
+    assert "cpu" in a.device_name().lower() or "tpu" in a.device_name().lower()
+    assert a.communication_backend_name() == "xla"
+    a.synchronize()
+    key = a.manual_seed(7)
+    assert np.asarray(key).shape[-1] == 2 or np.asarray(key).dtype is not None
+
+
+def test_op_builder_dispatch():
+    a = get_accelerator()
+    builders = a.op_builder_dict()
+    assert "cpu_adam" in builders and "aio" in builders
+    assert a.get_op_builder("cpu_adam") is builders["cpu_adam"]
+    assert a.get_op_builder("does_not_exist") is None
+
+
+def test_set_accelerator_override():
+    class Fake:
+        def device_count(self):
+            return 3
+
+    orig = get_accelerator()
+    try:
+        set_accelerator(Fake())
+        assert get_accelerator().device_count() == 3
+    finally:
+        set_accelerator(orig)

@@ -1,0 +1,70 @@
+"""Autotuner tests (reference ``tests/unit/autotuning/test_autotuning.py``)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import Autotuner
+from deepspeed_tpu.models import gpt2
+
+
+def _factory():
+    return gpt2.build(gpt2.GPT2Config.tiny())
+
+
+def _batch(global_batch, seq_len):
+    rng = np.random.default_rng(0)
+    return {"input_ids": rng.integers(
+        0, 512, (global_batch, seq_len + 1)).astype(np.int32)}
+
+
+def _base(**over):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "autotuning": {"enabled": True, "start_profile_step": 1,
+                       "end_profile_step": 2,
+                       "num_tuning_micro_batch_sizes": 2,
+                       "zero_stages": [0, 1]},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def test_experiment_space():
+    at = Autotuner(_factory, _base(), _batch, seq_len=16)
+    space = at.experiment_space()
+    # 2 stages x 2 micro batches
+    assert len(space) == 4
+    stages = {e["zero_optimization"]["stage"] for e in space}
+    micros = {e["train_micro_batch_size_per_gpu"] for e in space}
+    assert stages == {0, 1} and micros == {1, 2}
+
+
+def test_tune_picks_feasible_best(tmp_path, eight_devices):
+    base = _base()
+    base["autotuning"]["results_dir"] = str(tmp_path / "results")
+    at = Autotuner(_factory, base, _batch, seq_len=16)
+    best = at.tune()
+    assert best["feasible"] and best["throughput"] > 0
+    assert len(at.results) == 4
+    assert all("config" in r for r in at.results)
+    assert best["throughput"] == max(
+        r["throughput"] for r in at.results if r.get("feasible"))
+    import json
+    import os
+
+    best_cfg = json.load(open(os.path.join(str(tmp_path / "results"),
+                                           "best_config.json")))
+    assert "autotuning" not in best_cfg
+    assert best_cfg["zero_optimization"]["stage"] in (0, 1)
+
+
+def test_infeasible_configs_recorded_not_fatal(tmp_path, eight_devices):
+    """A bad stage in the space is recorded infeasible; tuning continues."""
+    base = _base()
+    base["autotuning"]["zero_stages"] = [99, 0]  # 99: invalid stage
+    base["autotuning"]["results_dir"] = str(tmp_path / "results")
+    at = Autotuner(_factory, base, _batch, seq_len=16)
+    best = at.tune()
+    assert best["feasible"]
+    assert any(not r.get("feasible") for r in at.results)

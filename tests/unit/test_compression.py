@@ -170,3 +170,45 @@ def test_progressive_layer_drop_schedule():
     # deeper layers drop more
     assert pld.layer_keep_prob(11, 12, 1000) < \
         pld.layer_keep_prob(0, 12, 1000)
+
+
+def test_schedule_offset_delays_compression():
+    """Before schedule_offset the forward sees raw weights; after, quantized
+    (reference applies compression from schedule_offset onward)."""
+    deepspeed_tpu.comm.reset_topology()
+    cfg = {"compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 2,
+                                  "quantize_groups": 1},
+            "different_groups": {
+                "g": {"params": {"target_bits": 2},  # 2 bits: huge effect
+                      "modules": ["*fc_w*"]}}},
+    }}
+    spec = gpt2.build(gpt2.GPT2Config.tiny())
+    wrapped = init_compression(spec, cfg)
+    assert not wrapped._compression_toggle.active
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=wrapped,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 0.0}}})
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, 512, (engine.train_batch_size(), 17)).astype(np.int32)}
+    _, m1 = engine.train_batch(batch)        # step 1: uncompressed
+    assert not wrapped._compression_toggle.active
+    _, m2 = engine.train_batch(batch)        # step 2: uncompressed
+    _, m3 = engine.train_batch(batch)        # step 3: compressed (2-bit!)
+    assert wrapped._compression_toggle.active
+    # lr=0 so params don't change: loss delta isolates the quantization
+    assert abs(m2["loss"] - m1["loss"]) < 1e-5
+    assert abs(m3["loss"] - m2["loss"]) > 1e-3
+
+
+def test_stochastic_rounding_rejected():
+    with pytest.raises(NotImplementedError, match="stochastic"):
+        init_compression(gpt2.build(gpt2.GPT2Config.tiny()),
+                         {"compression_training": {"weight_quantization": {
+                             "shared_parameters": {
+                                 "enabled": True, "rounding": "stochastic"},
+                             "different_groups": {
+                                 "g": {"modules": ["*"]}}}}})
