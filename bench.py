@@ -54,7 +54,9 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
         cfg = gpt2.GPT2Config.gpt2_125m()
-        cfg.remat = False   # flash attention keeps activations O(S), fits HBM
+        # selective remat ("dots" policy): saves projection outputs,
+        # recomputes attention + elementwise — fits 16GB HBM at bs=32
+        cfg.remat = True
         cfg.use_flash = True
         micro_bs, seq, steps = 32, 1024, 20
     else:  # CPU smoke mode

@@ -82,7 +82,7 @@ def main():
         fn = ops[op]
         for nbytes in sizes:
             elems = nbytes // 4
-            if op == "all_to_all" and elems % n:
+            if elems % n:  # psum_scatter/all_to_all need n | elems
                 elems += n - elems % n
 
             @jax.jit

@@ -74,7 +74,15 @@ class Autotuner:
 
         config = dict(self.base_config)
         config.pop("autotuning", None)
-        config.update({k: v for k, v in overrides.items()})
+        for k, v in overrides.items():
+            if isinstance(v, dict):
+                # deep-merge sub-configs: the stage override must not drop
+                # the user's other zero_optimization options (offload, ...)
+                merged = dict(config.get(k, {}))
+                merged.update(v)
+                config[k] = merged
+            else:
+                config[k] = v
         rec: Dict[str, Any] = {"config": overrides}
         deepspeed_tpu.comm.reset_topology()
         engine = None
@@ -152,7 +160,13 @@ class Autotuner:
                                "best_config.json"), "w") as f:
             cfg = dict(self.base_config)
             cfg.pop("autotuning", None)
-            cfg.update(best["config"])
+            for k, v in best["config"].items():
+                if isinstance(v, dict):
+                    merged = dict(cfg.get(k, {}))
+                    merged.update(v)
+                    cfg[k] = merged
+                else:
+                    cfg[k] = v
             json.dump(cfg, f, indent=2)
         log_dist(f"autotuning: best {best['config']} at "
                  f"{best['throughput']:.1f} tok/s -> "

@@ -41,8 +41,11 @@ def _matches(name: str, patterns: List[str]) -> bool:
 
 
 def _build_transform(cfg: CompressionConfig, num_heads: Optional[int]):
-    """Compile the config into a per-leaf transform list."""
-    rules = []  # (kind, patterns, fn(leaf) -> leaf)
+    """Compile the config into a per-leaf transform list.
+
+    Each rule carries its mechanism's ``schedule_offset`` so mechanisms
+    activate independently (reference: per-method offsets)."""
+    rules = []  # (kind, patterns, fn(leaf) -> leaf, schedule_offset)
 
     wq = cfg.weight_quantization
     if wq.shared_parameters.enabled:
@@ -51,44 +54,55 @@ def _build_transform(cfg: CompressionConfig, num_heads: Optional[int]):
                 "rounding='stochastic' is not implemented on TPU yet "
                 "(needs an rng threaded through the weight transform); use "
                 "'nearest'")
+        off = wq.shared_parameters.schedule_offset
         for gname, grp in wq.different_groups.items():
             bits = grp.target_bits
             qt = wq.shared_parameters.quantization_type
             groups = wq.shared_parameters.quantize_groups
             rules.append(("quant", grp.modules,
                           lambda w, b=bits, q=qt, g=groups:
-                          fake_quantize(w, b, g, q, False)))
+                          fake_quantize(w, b, g, q, False), off))
 
     sp = cfg.sparse_pruning
     if sp.shared_parameters.enabled:
+        off = sp.shared_parameters.schedule_offset
         for gname, grp in sp.different_groups.items():
             ratio = grp.dense_ratio
             rules.append(("sparse", grp.modules,
-                          lambda w, r=ratio: w * sparse_pruning_mask(w, r)))
+                          lambda w, r=ratio: w * sparse_pruning_mask(w, r),
+                          off))
 
     rp = cfg.row_pruning
     if rp.shared_parameters.enabled:
+        off = rp.shared_parameters.schedule_offset
         for gname, grp in rp.different_groups.items():
             ratio = grp.dense_ratio
             rules.append(("row", grp.modules,
-                          lambda w, r=ratio: w * row_pruning_mask(w, r)))
+                          lambda w, r=ratio: w * row_pruning_mask(w, r),
+                          off))
 
     hp = cfg.head_pruning
     if hp.shared_parameters.enabled:
         assert num_heads, "head_pruning needs num_heads (pass via model cfg)"
+        off = hp.shared_parameters.schedule_offset
         for gname, grp in hp.different_groups.items():
             ratio = grp.dense_ratio
             rules.append(("head", grp.modules,
                           lambda w, r=ratio: w * head_pruning_mask(
-                              w, r, num_heads)))
+                              w, r, num_heads), off))
     return rules
 
 
-def compress_params(params: PyTree, rules) -> PyTree:
+def compress_params(params: PyTree, rules, step: Optional[int] = None
+                    ) -> PyTree:
+    """Apply rules whose schedule_offset has passed (``step=None`` applies
+    all — standalone/deployment use)."""
     names, leaves, treedef = _leaf_path_names(params)
     out = []
     for name, leaf in zip(names, leaves):
-        for kind, patterns, fn in rules:
+        for kind, patterns, fn, offset in rules:
+            if step is not None and step < offset:
+                continue
             if getattr(leaf, "ndim", 0) >= 2 and _matches(name, patterns):
                 leaf = fn(leaf)
         out.append(leaf)
@@ -117,24 +131,24 @@ def init_compression(model: ModelSpec, deepspeed_config,
     import dataclasses
 
     orig_loss, orig_apply = model.loss_fn, model.apply_fn
-    offset = max([cfg.weight_quantization.shared_parameters.schedule_offset
-                  if cfg.weight_quantization.shared_parameters.enabled else 0,
-                  cfg.sparse_pruning.shared_parameters.schedule_offset
-                  if cfg.sparse_pruning.shared_parameters.enabled else 0,
-                  cfg.row_pruning.shared_parameters.schedule_offset
-                  if cfg.row_pruning.shared_parameters.enabled else 0,
-                  cfg.head_pruning.shared_parameters.schedule_offset
-                  if cfg.head_pruning.shared_parameters.enabled else 0])
+    offsets = sorted({off for _, _, _, off in rules})
 
     class _Toggle:
-        active = offset == 0
+        """Trace-time step marker: the engine advances ``step`` as offsets
+        are crossed and rebuilds its jitted step (one retrace per distinct
+        offset); mechanisms with offset 0 are active from the start."""
+        step = 0
+
+        @classmethod
+        def active(cls):
+            return any(off <= cls.step for off in offsets)
 
     def loss_fn(params, batch, rng=None, train=True):
-        p = compress_params(params, rules) if _Toggle.active else params
+        p = compress_params(params, rules, step=_Toggle.step)
         return orig_loss(p, batch, rng, train)
 
     def apply_fn(params, batch, rng=None):
-        p = compress_params(params, rules) if _Toggle.active else params
+        p = compress_params(params, rules, step=_Toggle.step)
         return orig_apply(p, batch, rng)
 
     wrapped = dataclasses.replace(
@@ -142,10 +156,8 @@ def init_compression(model: ModelSpec, deepspeed_config,
         apply_fn=apply_fn if orig_apply else None,
         name=model.name + "+compressed")
     wrapped._compression_rules = rules
-    # the engine flips this at schedule_offset and rebuilds its step fns
-    # (reference applies compression from schedule_offset onward)
     wrapped._compression_toggle = _Toggle
-    wrapped._compression_schedule_offset = offset
+    wrapped._compression_offsets = offsets
     return wrapped
 
 

@@ -80,6 +80,11 @@ class FixedSparsityConfig(SparsityConfig):
         assert num_local_blocks % num_global_blocks == 0, (
             f"num_local_blocks {num_local_blocks} must be a multiple of "
             f"num_global_blocks {num_global_blocks}")
+        assert num_different_global_patterns * num_global_blocks <= \
+            num_local_blocks, (
+                f"{num_different_global_patterns} global patterns x "
+                f"{num_global_blocks} global blocks don't fit a window of "
+                f"{num_local_blocks} local blocks")
         self.num_different_global_patterns = num_different_global_patterns
 
     def _set_local(self, layout, h, n):

@@ -60,6 +60,53 @@ PyTree = Any
 MEMORY_OPT_ALLREDUCE_SIZE = 500000000
 
 
+class _LazyMetrics:
+    """Mapping over device-side metrics that defers the host transfer until
+    first read.  Keeps the train loop free of per-step device_get round
+    trips (which serialize the pipeline; very costly on remote backends)."""
+
+    __slots__ = ("_dev", "_host")
+
+    def __init__(self, device_metrics):
+        self._dev = dict(device_metrics)
+        self._host = None
+
+    def _force(self):
+        if self._host is None:
+            got = jax.device_get(self._dev)
+            self._host = {k: np.asarray(v).item() for k, v in got.items()}
+        return self._host
+
+    def __getitem__(self, k):
+        return self._force()[k]
+
+    def get(self, k, default=None):
+        if k not in self._dev:       # don't force a transfer for a miss
+            return default
+        return self._force().get(k, default)
+
+    def __contains__(self, k):
+        return k in self._dev
+
+    def __iter__(self):
+        return iter(self._dev)
+
+    def __len__(self):
+        return len(self._dev)
+
+    def keys(self):
+        return self._dev.keys()
+
+    def items(self):
+        return self._force().items()
+
+    def values(self):
+        return self._force().values()
+
+    def __repr__(self):
+        return repr(self._force())
+
+
 def _cast_floating(tree: PyTree, dtype) -> PyTree:
     def cast(x):
         if jnp.issubdtype(x.dtype, jnp.floating):
@@ -851,18 +898,20 @@ class DeepSpeedEngine:
         batch = self._apply_curriculum(batch)
         batch = self._shard_batch(batch, leading_gas_dim=True)
 
-        # compression schedule_offset: flip the transform on and retrace
-        # (reference applies compression from schedule_offset onward)
+        # compression schedule_offsets: advance the trace-time step marker
+        # when a mechanism's offset is crossed and retrace (reference applies
+        # each mechanism from its own schedule_offset onward)
         toggle = getattr(self.model_spec, "_compression_toggle", None)
-        if toggle is not None and not toggle.active and \
-                self.global_steps + 1 > \
-                self.model_spec._compression_schedule_offset:
-            toggle.active = True
-            log_dist(
-                f"compression: activating at step {self.global_steps + 1} "
-                f"(schedule_offset "
-                f"{self.model_spec._compression_schedule_offset})", ranks=[0])
-            self._build_step_fns()
+        if toggle is not None:
+            completed = self.global_steps  # steps finished before this one
+            crossed = [off for off in self.model_spec._compression_offsets
+                       if toggle.step < off <= completed]
+            if crossed:
+                toggle.step = completed
+                log_dist(
+                    f"compression: mechanisms with schedule_offset in "
+                    f"{crossed} activate after {completed} steps", ranks=[0])
+                self._build_step_fns()
 
         fp = self._config.flops_profiler_config
         profiling_now = fp.enabled and \
@@ -878,7 +927,12 @@ class DeepSpeedEngine:
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps()
         self.global_samples += self.train_batch_size()
-        self.tput_timer.stop(global_step=True, sync_arrays=metrics["loss"])
+        # sync only on report steps: a per-step block would serialize the
+        # dispatch pipeline (expensive host round-trip on remote backends)
+        sync = metrics["loss"] if (profiling_now or self.global_steps %
+                                   max(self.steps_per_print(), 1) == 0) \
+            else None
+        self.tput_timer.stop(global_step=True, sync_arrays=sync)
         self._finalize_metrics(metrics)
 
         if profiling_now:
@@ -981,10 +1035,25 @@ class DeepSpeedEngine:
             self._data_iterator = iter(RepeatingLoader(self.training_dataloader))
         return self._data_iterator
 
+    @property
+    def skipped_steps(self) -> int:
+        """Cumulative overflow-skipped steps; forces a metrics sync only when
+        read (the scaler carries the cumulative count in-graph)."""
+        m = getattr(self, "_cached_metrics", None)
+        if m is not None and "skipped" in m:
+            return int(m.get("skipped", self._skipped_steps_base))
+        return self._skipped_steps_base
+
+    @skipped_steps.setter
+    def skipped_steps(self, v: int) -> None:
+        self._skipped_steps_base = int(v)
+
     def _finalize_metrics(self, metrics) -> None:
-        metrics = jax.device_get(metrics)
-        self._cached_metrics = {k: np.asarray(v).item() for k, v in metrics.items()}
-        self.skipped_steps = int(self._cached_metrics.get("skipped", 0))
+        # Lazy: metrics stay device-side until someone reads them.  A
+        # device_get here would force a host round-trip EVERY step (hundreds
+        # of ms on remote/tunneled backends), serializing the pipeline; the
+        # log/monitor branches below force them only every steps_per_print.
+        self._cached_metrics = _LazyMetrics(metrics)
         if self.lr_scheduler is not None:
             self.lr_scheduler.step(self.global_steps)
         if self.monitor.enabled and self.global_steps % max(

@@ -357,7 +357,9 @@ class PipelineEngine(DeepSpeedEngine):
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps()
         self.global_samples += self.train_batch_size()
-        self.tput_timer.stop(global_step=True, sync_arrays=metrics["loss"])
+        sync = metrics["loss"] if self.global_steps % \
+            max(self.steps_per_print(), 1) == 0 else None
+        self.tput_timer.stop(global_step=True, sync_arrays=sync)
         self._finalize_metrics(metrics)
         return self.state, self._cached_metrics
 

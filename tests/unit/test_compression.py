@@ -186,7 +186,7 @@ def test_schedule_offset_delays_compression():
     }}
     spec = gpt2.build(gpt2.GPT2Config.tiny())
     wrapped = init_compression(spec, cfg)
-    assert not wrapped._compression_toggle.active
+    assert not wrapped._compression_toggle.active()
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=wrapped,
         config={"train_micro_batch_size_per_gpu": 1,
@@ -195,10 +195,10 @@ def test_schedule_offset_delays_compression():
     batch = {"input_ids": rng.integers(
         0, 512, (engine.train_batch_size(), 17)).astype(np.int32)}
     _, m1 = engine.train_batch(batch)        # step 1: uncompressed
-    assert not wrapped._compression_toggle.active
+    assert not wrapped._compression_toggle.active()
     _, m2 = engine.train_batch(batch)        # step 2: uncompressed
     _, m3 = engine.train_batch(batch)        # step 3: compressed (2-bit!)
-    assert wrapped._compression_toggle.active
+    assert wrapped._compression_toggle.active()
     # lr=0 so params don't change: loss delta isolates the quantization
     assert abs(m2["loss"] - m1["loss"]) < 1e-5
     assert abs(m3["loss"] - m2["loss"]) > 1e-3
