@@ -27,6 +27,21 @@ from .config import AutotuningConfig
 OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Allocation", "exceed", "out of memory")
 
 
+def _merge_overrides(base: dict, overrides: dict) -> dict:
+    """One-level deep merge: dict-valued overrides update the base sub-dict
+    instead of replacing it (zero_optimization.stage must not drop the
+    user's offload/bucket options)."""
+    out = dict(base)
+    for k, v in overrides.items():
+        if isinstance(v, dict):
+            merged = dict(out.get(k, {}))
+            merged.update(v)
+            out[k] = merged
+        else:
+            out[k] = v
+    return out
+
+
 class Autotuner:
 
     def __init__(self, model_factory: Callable[[], Any], base_config: dict,
@@ -74,15 +89,7 @@ class Autotuner:
 
         config = dict(self.base_config)
         config.pop("autotuning", None)
-        for k, v in overrides.items():
-            if isinstance(v, dict):
-                # deep-merge sub-configs: the stage override must not drop
-                # the user's other zero_optimization options (offload, ...)
-                merged = dict(config.get(k, {}))
-                merged.update(v)
-                config[k] = merged
-            else:
-                config[k] = v
+        config = _merge_overrides(config, overrides)
         rec: Dict[str, Any] = {"config": overrides}
         deepspeed_tpu.comm.reset_topology()
         engine = None
@@ -160,14 +167,7 @@ class Autotuner:
                                "best_config.json"), "w") as f:
             cfg = dict(self.base_config)
             cfg.pop("autotuning", None)
-            for k, v in best["config"].items():
-                if isinstance(v, dict):
-                    merged = dict(cfg.get(k, {}))
-                    merged.update(v)
-                    cfg[k] = merged
-                else:
-                    cfg[k] = v
-            json.dump(cfg, f, indent=2)
+            json.dump(_merge_overrides(cfg, best["config"]), f, indent=2)
         log_dist(f"autotuning: best {best['config']} at "
                  f"{best['throughput']:.1f} tok/s -> "
                  f"{self.cfg.results_dir}/best_config.json", ranks=[0])

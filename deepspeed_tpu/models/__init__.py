@@ -2,7 +2,7 @@
 
 import dataclasses
 
-from . import gpt2, llama, mixtral, opt
+from . import bloom, gpt2, gptneox, llama, mixtral, opt
 
 
 def _with(cfg, overrides):
@@ -19,6 +19,16 @@ _NAMED = {
     "mixtral": lambda kw: mixtral.build(**kw),
     "mixtral8x7b": lambda kw: mixtral.build(
         _with(mixtral.MixtralConfig.mixtral_8x7b(), kw)),
+    "bloom": lambda kw: bloom.build(**kw),
+    "bloom560m": lambda kw: bloom.build(_with(bloom.BloomConfig.bloom_560m(),
+                                              kw)),
+    "bloom7b1": lambda kw: bloom.build(_with(bloom.BloomConfig.bloom_7b1(),
+                                             kw)),
+    "gptneox": lambda kw: gptneox.build(**kw),
+    "gptneox20b": lambda kw: gptneox.build(
+        _with(gptneox.GPTNeoXConfig.neox_20b(), kw)),
+    "pythia160m": lambda kw: gptneox.build(
+        _with(gptneox.GPTNeoXConfig.pythia_160m(), kw)),
     "opt": lambda kw: opt.build(**kw),
     "opt125m": lambda kw: opt.build(_with(opt.OPTConfig.opt_125m(), kw)),
     "opt350m": lambda kw: opt.build(_with(opt.OPTConfig.opt_350m(), kw)),

@@ -162,9 +162,41 @@ def _register(arch, translate, convert, build):
     _POLICIES[arch.lower()] = HFPolicy(arch, translate, convert, build)
 
 
+def _bloom_translate(hf):
+    from ..models.bloom import BloomConfig
+    return BloomConfig.from_hf(hf)
+
+
+def _bloom_convert(cfg, sd):
+    from ..models.bloom import from_hf_state_dict
+    return from_hf_state_dict(cfg, sd)
+
+
+def _bloom_build(cfg):
+    from ..models import bloom
+    return bloom.build(cfg)
+
+
+def _neox_translate(hf):
+    from ..models.gptneox import GPTNeoXConfig
+    return GPTNeoXConfig.from_hf(hf)
+
+
+def _neox_convert(cfg, sd):
+    from ..models.gptneox import from_hf_state_dict
+    return from_hf_state_dict(cfg, sd)
+
+
+def _neox_build(cfg):
+    from ..models import gptneox
+    return gptneox.build(cfg)
+
+
 _register("GPT2LMHeadModel", _gpt2_translate, _gpt2_convert, _gpt2_build)
 _register("OPTForCausalLM", _opt_translate, _opt_convert, _opt_build)
 _register("LlamaForCausalLM", _llama_translate, _llama_convert, _llama_build)
+_register("BloomForCausalLM", _bloom_translate, _bloom_convert, _bloom_build)
+_register("GPTNeoXForCausalLM", _neox_translate, _neox_convert, _neox_build)
 
 
 def generic_policies():

@@ -3,9 +3,10 @@ curriculum learning, memory-mapped indexed datasets, random layerwise token
 dropping (random-LTD)."""
 
 from .curriculum_scheduler import CurriculumScheduler
+from .data_sampler import DataAnalyzer, DeepSpeedDataSampler
 from .indexed_dataset import MMapIndexedDataset, MMapIndexedDatasetBuilder
 from .random_ltd import RandomLTDScheduler, token_drop, token_restore
 
-__all__ = ["CurriculumScheduler", "MMapIndexedDataset",
-           "MMapIndexedDatasetBuilder", "RandomLTDScheduler", "token_drop",
-           "token_restore"]
+__all__ = ["CurriculumScheduler", "DataAnalyzer", "DeepSpeedDataSampler",
+           "MMapIndexedDataset", "MMapIndexedDatasetBuilder",
+           "RandomLTDScheduler", "token_drop", "token_restore"]

@@ -1,0 +1,148 @@
+"""Curriculum data sampling (reference
+``data_pipeline/data_sampling/data_sampler.py:33 DeepSpeedDataSampler`` +
+``data_analyzer.py DataAnalyzer``): rank-sharded sample selection driven by
+per-sample difficulty metrics — at each step the sampler draws only samples
+whose difficulty is within the curriculum's current value.
+
+The analyzer computes per-sample metrics (e.g. sequence length) over an
+indexable dataset and persists them; the sampler filters+shuffles
+deterministically per epoch and shards by data-parallel rank.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from .curriculum_scheduler import CurriculumScheduler
+
+
+class DataAnalyzer:
+    """Compute + persist per-sample difficulty metrics
+    (reference ``data_analyzer.py``; the 'seqlen' metric is the one the
+    curriculum uses by default)."""
+
+    def __init__(self, dataset: Sequence,
+                 metric_fns: Optional[Dict[str, Callable[[Any], float]]] = None):
+        self.dataset = dataset
+        self.metric_fns = metric_fns or {"seqlen": _seqlen_metric}
+
+    def run(self) -> Dict[str, np.ndarray]:
+        out = {name: np.empty(len(self.dataset), np.float64)
+               for name in self.metric_fns}
+        for i in range(len(self.dataset)):
+            sample = self.dataset[i]
+            for name, fn in self.metric_fns.items():
+                out[name][i] = fn(sample)
+        return out
+
+    def save(self, path: str) -> Dict[str, np.ndarray]:
+        metrics = self.run()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        np.savez(path, **metrics)
+        return metrics
+
+    @staticmethod
+    def load(path: str) -> Dict[str, np.ndarray]:
+        with np.load(path) as z:
+            return {k: z[k] for k in z.files}
+
+
+def _seqlen_metric(sample) -> float:
+    if isinstance(sample, dict):
+        ids = sample.get("input_ids", next(iter(sample.values())))
+    else:
+        ids = sample
+    return float(np.asarray(ids).shape[-1])
+
+
+class DeepSpeedDataSampler:
+    """Difficulty-gated, epoch-shuffled, rank-sharded index sampler.
+
+    Per step: eligible = samples with metric <= current curriculum
+    difficulty; indices are drawn in a deterministic per-epoch shuffle and
+    split contiguously across dp ranks (reference
+    ``data_sampler.py:33,get_next_global_batch``)."""
+
+    def __init__(self, metric_values: np.ndarray,
+                 curriculum: Optional[CurriculumScheduler],
+                 global_batch_size: int,
+                 process_rank: int = 0, process_count: int = 1,
+                 seed: int = 0, drop_last: bool = True):
+        assert global_batch_size % process_count == 0
+        self.metric = np.asarray(metric_values)
+        self.curriculum = curriculum
+        self.global_batch = global_batch_size
+        self.rank = process_rank
+        self.world = process_count
+        self.seed = seed
+        self.global_step = 0
+        self._epoch = 0
+        self._order: Optional[np.ndarray] = None
+        self._cursor = 0
+
+    def _reshuffle(self) -> None:
+        rng = np.random.default_rng(self.seed + self._epoch)
+        self._order = rng.permutation(len(self.metric))
+        self._cursor = 0
+
+    def set_custom_curriculum(self, scheduler: CurriculumScheduler) -> None:
+        self.curriculum = scheduler
+
+    def next_batch_indices(self) -> np.ndarray:
+        """Global-batch sample indices for this rank at the current step."""
+        if self._order is None:
+            self._reshuffle()
+        if self.curriculum is not None:
+            difficulty = self.curriculum.update_difficulty(self.global_step)
+            eligible_mask = self.metric <= difficulty
+        else:
+            eligible_mask = np.ones(len(self.metric), bool)
+        n_eligible = int(eligible_mask.sum())
+        if n_eligible < self.global_batch:
+            # wrapping the epoch would silently fill the batch with
+            # duplicates (and give ranks overlapping shares)
+            raise RuntimeError(
+                f"curriculum difficulty "
+                f"{self.curriculum.current_difficulty if self.curriculum else None} "
+                f"admits fewer samples than one global batch "
+                f"({n_eligible} eligible / {self.global_batch} needed)")
+
+        picked: List[int] = []
+        scanned = 0
+        while len(picked) < self.global_batch:
+            if self._cursor >= len(self._order):
+                self._epoch += 1
+                self._reshuffle()
+            idx = self._order[self._cursor]
+            self._cursor += 1
+            scanned += 1
+            if eligible_mask[idx]:
+                picked.append(int(idx))
+            if scanned > 2 * len(self.metric) + self.global_batch:
+                raise RuntimeError(
+                    f"curriculum difficulty "
+                    f"{self.curriculum.current_difficulty if self.curriculum else None} "
+                    f"admits fewer samples than one global batch "
+                    f"({eligible_mask.sum()} eligible / "
+                    f"{self.global_batch} needed)")
+        self.global_step += 1
+        per_rank = self.global_batch // self.world
+        mine = picked[self.rank * per_rank:(self.rank + 1) * per_rank]
+        return np.asarray(mine)
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:
+            yield self.next_batch_indices()
+
+    def state_dict(self) -> dict:
+        return {"global_step": self.global_step, "epoch": self._epoch,
+                "cursor": self._cursor}
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.global_step = int(sd["global_step"])
+        self._epoch = int(sd["epoch"])
+        self._reshuffle()
+        self._cursor = int(sd["cursor"])

@@ -1047,6 +1047,9 @@ class DeepSpeedEngine:
     @skipped_steps.setter
     def skipped_steps(self, v: int) -> None:
         self._skipped_steps_base = int(v)
+        # drop stale metrics so a checkpoint restore's value takes effect
+        # (the getter prefers the live metrics' cumulative counter)
+        self._cached_metrics = {}
 
     def _finalize_metrics(self, metrics) -> None:
         # Lazy: metrics stay device-side until someone reads them.  A

@@ -135,3 +135,72 @@ def test_random_ltd_scheduler():
     assert not s.applies_to_layer(0, 12)
     assert s.applies_to_layer(5, 12)
     assert not s.applies_to_layer(11, 12)
+
+
+# --------------------------------------------------------------- data sampler
+def test_data_analyzer_and_sampler(tmp_path):
+    from deepspeed_tpu.runtime.data_pipeline import (DataAnalyzer,
+                                                     DeepSpeedDataSampler)
+
+    rng = np.random.default_rng(0)
+    lens = rng.integers(4, 64, size=200)
+    data = [{"input_ids": np.zeros(n, np.int32)} for n in lens]
+    metrics = DataAnalyzer(data).save(str(tmp_path / "metrics.npz"))
+    np.testing.assert_array_equal(metrics["seqlen"], lens)
+    loaded = DataAnalyzer.load(str(tmp_path / "metrics.npz"))
+    np.testing.assert_array_equal(loaded["seqlen"], lens)
+
+    sched = CurriculumScheduler({
+        "curriculum_type": "seqlen", "min_difficulty": 8,
+        "max_difficulty": 64, "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 10,
+                            "difficulty_step": 8}})
+    sampler = DeepSpeedDataSampler(loaded["seqlen"], sched,
+                                   global_batch_size=8,
+                                   process_rank=0, process_count=2)
+    # early steps: only short samples
+    idx = sampler.next_batch_indices()
+    assert len(idx) == 4  # per-rank share
+    assert (lens[idx] <= 8).all()
+    # after the ramp: longer samples admitted
+    for _ in range(12):
+        idx = sampler.next_batch_indices()
+    assert (lens[idx] <= 64).all()
+    assert lens[idx].max() > 8  # not stuck at the easy set
+
+
+def test_sampler_rank_sharding_disjoint():
+    from deepspeed_tpu.runtime.data_pipeline import DeepSpeedDataSampler
+
+    metric = np.full(64, 1.0)
+    a = DeepSpeedDataSampler(metric, None, 8, process_rank=0, process_count=2)
+    b = DeepSpeedDataSampler(metric, None, 8, process_rank=1, process_count=2)
+    ia, ib = a.next_batch_indices(), b.next_batch_indices()
+    assert len(set(ia) & set(ib)) == 0  # same shuffle, disjoint shares
+
+
+def test_sampler_infeasible_difficulty_raises():
+    from deepspeed_tpu.runtime.data_pipeline import DeepSpeedDataSampler
+
+    sched = CurriculumScheduler({
+        "curriculum_type": "seqlen", "min_difficulty": 1,
+        "max_difficulty": 1, "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 5}})
+    metric = np.full(16, 100.0)  # nothing is ever eligible
+    s = DeepSpeedDataSampler(metric, sched, 4)
+    with pytest.raises(RuntimeError, match="admits fewer"):
+        s.next_batch_indices()
+
+
+def test_sampler_state_roundtrip():
+    from deepspeed_tpu.runtime.data_pipeline import DeepSpeedDataSampler
+
+    metric = np.full(32, 1.0)
+    a = DeepSpeedDataSampler(metric, None, 4, seed=3)
+    for _ in range(5):
+        a.next_batch_indices()
+    sd = a.state_dict()
+    b = DeepSpeedDataSampler(metric, None, 4, seed=3)
+    b.load_state_dict(sd)
+    np.testing.assert_array_equal(a.next_batch_indices(),
+                                  b.next_batch_indices())
