@@ -311,6 +311,10 @@ def from_hf_state_dict(cfg: BloomConfig, sd: Dict[str, Any]) -> PyTree:
 
 def build(cfg: Optional[BloomConfig] = None, **overrides) -> ModelSpec:
     cfg = cfg or BloomConfig(**overrides)
+    if cfg.dropout:
+        raise NotImplementedError(
+            "bloom: dropout is not implemented yet (the forward ignores it);"
+            " set dropout=0")
 
     def init_fn(rng):
         return init_params(cfg, rng)
@@ -338,7 +342,7 @@ def build(cfg: Optional[BloomConfig] = None, **overrides) -> ModelSpec:
         "block_fn": lambda layer, x, rng=None: _block(cfg, x, layer)[0],
         "head_loss_fn": lambda params, x, tgt: _head_loss(cfg, params, x,
                                                           tgt),
-        "dropout": cfg.dropout,
+        "dropout": 0.0,  # dropout unimplemented (build() rejects > 0)
     }
 
     return ModelSpec(init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,

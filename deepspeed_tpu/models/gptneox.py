@@ -308,15 +308,16 @@ def from_hf_state_dict(cfg: GPTNeoXConfig, sd: Dict[str, Any]) -> PyTree:
         },
         "lnf_scale": jnp.asarray(get("final_layer_norm.weight")),
         "lnf_bias": jnp.asarray(get("final_layer_norm.bias")),
-        "embed_out": jnp.asarray(np.asarray(
-            sd["embed_out.weight"].detach().cpu().numpy()
-            if hasattr(sd["embed_out.weight"], "detach")
-            else sd["embed_out.weight"], np.float32).T),
+        "embed_out": jnp.asarray(get("embed_out.weight").T),
     }
 
 
 def build(cfg: Optional[GPTNeoXConfig] = None, **overrides) -> ModelSpec:
     cfg = cfg or GPTNeoXConfig(**overrides)
+    if cfg.dropout:
+        raise NotImplementedError(
+            "gptneox: dropout is not implemented yet (the forward ignores "
+            "it); set dropout=0")
 
     def init_fn(rng):
         return init_params(cfg, rng)

@@ -214,7 +214,9 @@ def _rope_cached(cfg: LlamaConfig, x, pos):
     return apply_rope(x, jnp.cos(angles), jnp.sin(angles))
 
 
-def _block_cached(cfg: LlamaConfig, x, layer, ck, cv, pos):
+def _block_cached(cfg: LlamaConfig, x, layer, ck, cv, pos, mlp_fn=None):
+    """Cached-attention block; ``mlp_fn(layer, y) -> y`` overrides the dense
+    SwiGLU (mixtral reuses this path with its MoE FFN)."""
     from ..ops.decode_attention import decode_attention
 
     b, t, d = x.shape
@@ -234,6 +236,8 @@ def _block_cached(cfg: LlamaConfig, x, layer, ck, cv, pos):
     x = x + attn @ layer["o_w"].astype(x.dtype)
 
     y = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
+    if mlp_fn is not None:
+        return x + mlp_fn(layer, y), ck, cv
     gate = jax.nn.silu(y @ layer["w1"].astype(y.dtype))
     up = y @ layer["w3"].astype(y.dtype)
     x = x + (gate * up) @ layer["w2"].astype(x.dtype)

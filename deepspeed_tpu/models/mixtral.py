@@ -31,6 +31,12 @@ class MixtralConfig(L.LlamaConfig):
     num_experts: int = 8
     top_k: int = 2
     capacity_factor: float = 1.25
+    #: eval/inference capacity. The default (2.0) is the reference's
+    #: capacity-bucket posture: rare high-load tokens may drop at prefill,
+    #: memory stays O(S*E*C) with C ~ S*k*2/E.  Set to ``num_experts`` for
+    #: provably drop-free routing (HF Mixtral semantics; C grows to S*k, so
+    #: dispatch memory becomes O(E*S^2) — fine for short prompts/tests).
+    eval_capacity_factor: float = 2.0
     router_aux_loss_coef: float = 0.02
 
     @staticmethod
@@ -59,6 +65,7 @@ class MixtralConfig(L.LlamaConfig):
                          ffn_hidden_size=self.ffn_size,
                          num_experts=self.num_experts, k=self.top_k,
                          capacity_factor=self.capacity_factor,
+                         eval_capacity_factor=self.eval_capacity_factor,
                          activation="silu_glu")
 
 
@@ -96,12 +103,7 @@ def _moe_block(cfg: MixtralConfig, layer: PyTree, x, cos, sin, train: bool = Tru
     x = x + attn @ layer["o_w"].astype(x.dtype)
 
     y = L.rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
-    moe_params = {
-        "gate_w": layer["gate_w"],
-        "experts": {"w1": layer["experts_w1"], "w3": layer["experts_w3"],
-                    "w2": layer["experts_w2"]},
-    }
-    moe_out, aux = moe_apply(cfg.moe_cfg(), moe_params, y, train=train)
+    moe_out, aux = _moe_ffn(cfg, layer, y, train=train)
     return x + moe_out, aux
 
 
@@ -146,6 +148,39 @@ def loss_from_batch(cfg: MixtralConfig, params, batch, rng=None,
     return lm_loss + cfg.router_aux_loss_coef * aux
 
 
+def _moe_ffn(cfg: MixtralConfig, layer, y, train: bool):
+    moe_params = {
+        "gate_w": layer["gate_w"],
+        "experts": {"w1": layer["experts_w1"], "w3": layer["experts_w3"],
+                    "w2": layer["experts_w2"]},
+    }
+    return moe_apply(cfg.moe_cfg(), moe_params, y, train=train)
+
+
+def _block_cached(cfg: MixtralConfig, x, layer, ck, cv, pos):
+    """Llama cached attention + MoE FFN (reference ``moe_inference.py``:
+    expert routing runs per decode token too)."""
+    return L._block_cached(
+        cfg, x, layer, ck, cv, pos,
+        mlp_fn=lambda lyr, y: _moe_ffn(cfg, lyr, y, train=False)[0])
+
+
+def forward_cached(cfg: MixtralConfig, params, input_ids, cache, pos):
+    """Incremental MoE forward: last-position logits + updated cache."""
+    pos = jnp.asarray(pos, jnp.int32)
+    x = params["embed"][input_ids].astype(params["embed"].dtype)
+
+    def body(x, xs):
+        layer, ck, cv = xs
+        x, ck, cv = _block_cached(cfg, x, layer, ck, cv, pos)
+        return x, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"],
+                                         cache["v"]))
+    x = L.rms_norm(x[:, -1], params["final_norm"], cfg.rms_eps)
+    return x @ params["lm_head"].astype(x.dtype), {"k": ks, "v": vs}
+
+
 def tp_rules(cfg: MixtralConfig, abstract_params: PyTree) -> PyTree:
     rules = L.tp_rules(cfg, abstract_params)
     blocks = rules["blocks"]
@@ -171,9 +206,18 @@ def build(cfg: Optional[MixtralConfig] = None, **overrides) -> ModelSpec:
         ids = batch["input_ids"] if isinstance(batch, dict) else batch
         return forward_with_aux(cfg, params, ids, train=False)[0]
 
+    decode_hooks = {
+        "init_cache": lambda b, s, dtype=jnp.bfloat16: L.init_cache(
+            cfg, b, s, dtype),
+        "forward_cached": lambda params, ids, cache, pos: forward_cached(
+            cfg, params, ids, cache, pos),
+        "max_seq_len": cfg.max_seq_len,
+    }
+
     return ModelSpec(
         init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
         tp_rules=lambda ap: tp_rules(cfg, ap),
         flops_per_token=6.0 * (cfg.num_params() / cfg.num_experts *
                                (cfg.top_k + 1)),
+        decode_hooks=decode_hooks,
         name=f"mixtral-{cfg.num_layers}l-{cfg.num_experts}e")

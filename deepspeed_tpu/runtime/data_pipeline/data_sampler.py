@@ -70,7 +70,7 @@ class DeepSpeedDataSampler:
                  curriculum: Optional[CurriculumScheduler],
                  global_batch_size: int,
                  process_rank: int = 0, process_count: int = 1,
-                 seed: int = 0, drop_last: bool = True):
+                 seed: int = 0):
         assert global_batch_size % process_count == 0
         self.metric = np.asarray(metric_values)
         self.curriculum = curriculum
@@ -110,24 +110,21 @@ class DeepSpeedDataSampler:
                 f"admits fewer samples than one global batch "
                 f"({n_eligible} eligible / {self.global_batch} needed)")
 
+        # batches never repeat a sample: on an epoch-boundary wrap, indices
+        # already picked from the old permutation's tail are skipped in the
+        # fresh one (n_eligible >= global_batch guarantees termination
+        # within the fresh epoch)
         picked: List[int] = []
-        scanned = 0
+        picked_set: set = set()
         while len(picked) < self.global_batch:
             if self._cursor >= len(self._order):
                 self._epoch += 1
                 self._reshuffle()
-            idx = self._order[self._cursor]
+            idx = int(self._order[self._cursor])
             self._cursor += 1
-            scanned += 1
-            if eligible_mask[idx]:
-                picked.append(int(idx))
-            if scanned > 2 * len(self.metric) + self.global_batch:
-                raise RuntimeError(
-                    f"curriculum difficulty "
-                    f"{self.curriculum.current_difficulty if self.curriculum else None} "
-                    f"admits fewer samples than one global batch "
-                    f"({eligible_mask.sum()} eligible / "
-                    f"{self.global_batch} needed)")
+            if eligible_mask[idx] and idx not in picked_set:
+                picked.append(idx)
+                picked_set.add(idx)
         self.global_step += 1
         per_rank = self.global_batch // self.world
         mine = picked[self.rank * per_rank:(self.rank + 1) * per_rank]
