@@ -42,6 +42,8 @@ def main():
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--bq", type=int, default=None, help="flash block_q")
     ap.add_argument("--bk", type=int, default=None, help="flash block_k")
+    ap.add_argument("--remat-policy", default=None,
+                    choices=["full", "dots", "dots_flash"])
     args = ap.parse_args()
 
     import jax
@@ -71,6 +73,8 @@ def main():
         cfg.flash_block_q = args.bq
     if args.bk:
         cfg.flash_block_k = args.bk
+    if args.remat_policy:
+        cfg.remat_policy = args.remat_policy
     micro_bs = args.micro_bs or micro_bs
     seq = args.seq or seq
     steps = args.steps or steps

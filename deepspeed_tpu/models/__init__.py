@@ -2,7 +2,7 @@
 
 import dataclasses
 
-from . import bloom, gpt2, gptneox, llama, mixtral, opt
+from . import bert, bloom, gpt2, gptneox, llama, mixtral, opt
 
 
 def _with(cfg, overrides):
@@ -19,6 +19,10 @@ _NAMED = {
     "mixtral": lambda kw: mixtral.build(**kw),
     "mixtral8x7b": lambda kw: mixtral.build(
         _with(mixtral.MixtralConfig.mixtral_8x7b(), kw)),
+    "bert": lambda kw: bert.build(**kw),
+    "bertbase": lambda kw: bert.build(_with(bert.BertConfig.bert_base(), kw)),
+    "bertlarge": lambda kw: bert.build(_with(bert.BertConfig.bert_large(),
+                                             kw)),
     "bloom": lambda kw: bloom.build(**kw),
     "bloom560m": lambda kw: bloom.build(_with(bloom.BloomConfig.bloom_560m(),
                                               kw)),

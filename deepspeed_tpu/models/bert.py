@@ -1,0 +1,318 @@
+"""BERT family (encoder + MLM head), TPU-native.
+
+Reference parity: the HFBertLayerPolicy (``module_inject/replace_policy.py``,
+``containers/bert.py``) and the *training* transformer kernel whose headline
+was BERT pretraining (``docs/_posts/2020-05-28-fastest-bert-training.md``,
+``csrc/transformer/ds_transformer_cuda.cpp``).  Encoder blocks are post-LN
+(original BERT), bidirectional with a padding mask, scan-stacked like the
+decoder families; the MLM head ties to the word embeddings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.topology import TP_AXIS
+from ..runtime.model import ModelSpec
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    num_layers: int = 12
+    num_heads: int = 12
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    layer_norm_eps: float = 1e-12
+    dropout: float = 0.0
+
+    @property
+    def head_dim(self) -> int:
+        assert self.hidden_size % self.num_heads == 0
+        return self.hidden_size // self.num_heads
+
+    @staticmethod
+    def bert_base() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def bert_large() -> "BertConfig":
+        return BertConfig(num_layers=24, num_heads=16, hidden_size=1024,
+                          intermediate_size=4096)
+
+    @staticmethod
+    def tiny(vocab_size: int = 512, max_seq_len: int = 64) -> "BertConfig":
+        return BertConfig(vocab_size=vocab_size, max_seq_len=max_seq_len,
+                          num_layers=2, num_heads=4, hidden_size=64,
+                          intermediate_size=256)
+
+    @staticmethod
+    def from_hf(hf) -> "BertConfig":
+        return BertConfig(
+            vocab_size=hf.vocab_size,
+            max_seq_len=hf.max_position_embeddings,
+            type_vocab_size=hf.type_vocab_size,
+            num_layers=hf.num_hidden_layers,
+            num_heads=hf.num_attention_heads,
+            hidden_size=hf.hidden_size,
+            intermediate_size=hf.intermediate_size,
+            layer_norm_eps=hf.layer_norm_eps)
+
+    def num_params(self) -> int:
+        d, l, f = self.hidden_size, self.num_layers, self.intermediate_size
+        per_layer = 4 * (d * d + d) + (d * f + f) + (f * d + d) + 4 * d
+        emb = (self.vocab_size + self.max_seq_len +
+               self.type_vocab_size) * d + 2 * d
+        head = d * d + d + 2 * d + self.vocab_size  # transform + LN + bias
+        return emb + l * per_layer + head
+
+
+def init_params(cfg: BertConfig, rng) -> PyTree:
+    d, l, f = cfg.hidden_size, cfg.num_layers, cfg.intermediate_size
+    keys = jax.random.split(rng, 8)
+    std = 0.02
+
+    def normal(key, shape, s=std):
+        return (jax.random.normal(key, shape) * s).astype(jnp.float32)
+
+    return {
+        "word_embeddings": normal(keys[0], (cfg.vocab_size, d)),
+        "position_embeddings": normal(keys[1], (cfg.max_seq_len, d)),
+        "token_type_embeddings": normal(keys[2], (cfg.type_vocab_size, d)),
+        "emb_ln_scale": jnp.ones((d,)), "emb_ln_bias": jnp.zeros((d,)),
+        "blocks": {
+            "qkv_w": normal(keys[3], (l, d, 3 * d)),
+            "qkv_b": jnp.zeros((l, 3 * d)),
+            "attn_out_w": normal(keys[4], (l, d, d)),
+            "attn_out_b": jnp.zeros((l, d)),
+            "attn_ln_scale": jnp.ones((l, d)),
+            "attn_ln_bias": jnp.zeros((l, d)),
+            "inter_w": normal(keys[5], (l, d, f)),
+            "inter_b": jnp.zeros((l, f)),
+            "out_w": normal(keys[6], (l, f, d)),
+            "out_b": jnp.zeros((l, d)),
+            "out_ln_scale": jnp.ones((l, d)),
+            "out_ln_bias": jnp.zeros((l, d)),
+        },
+        "mlm_dense_w": normal(keys[7], (d, d)),
+        "mlm_dense_b": jnp.zeros((d,)),
+        "mlm_ln_scale": jnp.ones((d,)), "mlm_ln_bias": jnp.zeros((d,)),
+        "mlm_bias": jnp.zeros((cfg.vocab_size,)),
+    }
+
+
+def _ln(cfg, x, scale, bias):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return ((x32 - mean) * jax.lax.rsqrt(var + cfg.layer_norm_eps) * scale +
+            bias).astype(x.dtype)
+
+
+def _block(cfg: BertConfig, x, layer, attn_bias):
+    """Post-LN encoder layer; ``attn_bias``: [B, 1, 1, S] additive mask."""
+    b, s, d = x.shape
+    h, hd = cfg.num_heads, cfg.head_dim
+
+    qkv = x @ layer["qkv_w"].astype(x.dtype) + layer["qkv_b"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+    scores = scores.astype(jnp.float32) + attn_bias
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
+    attn = attn @ layer["attn_out_w"].astype(x.dtype) + \
+        layer["attn_out_b"].astype(x.dtype)
+    x = _ln(cfg, x + attn, layer["attn_ln_scale"], layer["attn_ln_bias"])
+
+    hid = jax.nn.gelu(x @ layer["inter_w"].astype(x.dtype) +
+                      layer["inter_b"].astype(x.dtype), approximate=False)
+    out = hid @ layer["out_w"].astype(x.dtype) + \
+        layer["out_b"].astype(x.dtype)
+    return _ln(cfg, x + out, layer["out_ln_scale"], layer["out_ln_bias"])
+
+
+def encode(cfg: BertConfig, params, input_ids, attention_mask=None,
+           token_type_ids=None):
+    """Encoder activations [B, S, D]."""
+    b, s = input_ids.shape
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros_like(input_ids)
+    x = (params["word_embeddings"][input_ids] +
+         params["position_embeddings"][:s][None] +
+         params["token_type_embeddings"][token_type_ids])
+    x = _ln(cfg, x, params["emb_ln_scale"], params["emb_ln_bias"])
+    if attention_mask is None:
+        bias = jnp.zeros((b, 1, 1, s), jnp.float32)
+    else:
+        bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
+                         -1e30).astype(jnp.float32)
+
+    def body(x, xs):
+        layer, = xs
+        return _block(cfg, x, layer, bias), None
+
+    x, _ = jax.lax.scan(body, x, (params["blocks"],))
+    return x
+
+
+def mlm_logits(cfg: BertConfig, params, x):
+    """MLM head: transform + tied decoder (reference BertLMPredictionHead)."""
+    y = jax.nn.gelu(x @ params["mlm_dense_w"].astype(x.dtype) +
+                    params["mlm_dense_b"].astype(x.dtype), approximate=False)
+    y = _ln(cfg, y, params["mlm_ln_scale"], params["mlm_ln_bias"])
+    return y @ params["word_embeddings"].T.astype(y.dtype) + \
+        params["mlm_bias"].astype(y.dtype)
+
+
+def forward(cfg: BertConfig, params, input_ids, attention_mask=None,
+            token_type_ids=None, rng=None, train: bool = True):
+    x = encode(cfg, params, input_ids, attention_mask, token_type_ids)
+    return mlm_logits(cfg, params, x)
+
+
+def loss_from_batch(cfg: BertConfig, params, batch, rng=None,
+                    train: bool = True):
+    """MLM cross entropy over labeled (non -100) positions."""
+    input_ids = batch["input_ids"]
+    labels = batch.get("labels")
+    assert labels is not None, (
+        "bert training needs batch['labels'] with -100 at unmasked positions "
+        "(MLM objective)")
+    logits = forward(cfg, params, input_ids,
+                     batch.get("attention_mask"),
+                     batch.get("token_type_ids"), rng=rng, train=train)
+    valid = labels >= 0
+    safe = jnp.where(valid, labels, 0)
+    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logits, safe[..., None],
+                                 axis=-1)[..., 0].astype(jnp.float32)
+    nll = jnp.where(valid, lse - picked, 0.0)
+    return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def tp_rules(cfg: BertConfig, abstract_params: PyTree) -> PyTree:
+    return {
+        "word_embeddings": P(TP_AXIS, None),
+        "position_embeddings": P(), "token_type_embeddings": P(),
+        "emb_ln_scale": P(), "emb_ln_bias": P(),
+        "blocks": {
+            "qkv_w": P(None, None, TP_AXIS), "qkv_b": P(None, TP_AXIS),
+            "attn_out_w": P(None, TP_AXIS, None), "attn_out_b": P(),
+            "attn_ln_scale": P(), "attn_ln_bias": P(),
+            "inter_w": P(None, None, TP_AXIS), "inter_b": P(None, TP_AXIS),
+            "out_w": P(None, TP_AXIS, None), "out_b": P(),
+            "out_ln_scale": P(), "out_ln_bias": P(),
+        },
+        "mlm_dense_w": P(), "mlm_dense_b": P(),
+        "mlm_ln_scale": P(), "mlm_ln_bias": P(),
+        "mlm_bias": P(),
+    }
+
+
+# --------------------------------------------------------------------- HF I/O
+def from_hf_state_dict(cfg: BertConfig, sd: Dict[str, Any]) -> PyTree:
+    """HF BertForMaskedLM state dict -> pytree (q/k/v fused)."""
+    def get(name):
+        for prefix in ("bert.", ""):
+            if prefix + name in sd:
+                t = sd[prefix + name]
+                return np.asarray(t.detach().cpu().numpy()
+                                  if hasattr(t, "detach") else t, np.float32)
+        raise KeyError(name)
+
+    l = cfg.num_layers
+
+    def stack(fmt, transpose=False, fuse_qkv=False):
+        rows = []
+        for i in range(l):
+            if fuse_qkv:
+                parts = [get(fmt.format(i=i, p=p))
+                         for p in ("query", "key", "value")]
+                w = np.concatenate(parts, axis=0)
+            else:
+                w = get(fmt.format(i=i))
+            rows.append(w.T if transpose else w)
+        return jnp.asarray(np.stack(rows))
+
+    return {
+        "word_embeddings": jnp.asarray(
+            get("embeddings.word_embeddings.weight")),
+        "position_embeddings": jnp.asarray(
+            get("embeddings.position_embeddings.weight")),
+        "token_type_embeddings": jnp.asarray(
+            get("embeddings.token_type_embeddings.weight")),
+        "emb_ln_scale": jnp.asarray(get("embeddings.LayerNorm.weight")),
+        "emb_ln_bias": jnp.asarray(get("embeddings.LayerNorm.bias")),
+        "blocks": {
+            "qkv_w": stack("encoder.layer.{i}.attention.self.{p}.weight",
+                           transpose=True, fuse_qkv=True),
+            "qkv_b": stack("encoder.layer.{i}.attention.self.{p}.bias",
+                           fuse_qkv=True),
+            "attn_out_w": stack(
+                "encoder.layer.{i}.attention.output.dense.weight",
+                transpose=True),
+            "attn_out_b": stack(
+                "encoder.layer.{i}.attention.output.dense.bias"),
+            "attn_ln_scale": stack(
+                "encoder.layer.{i}.attention.output.LayerNorm.weight"),
+            "attn_ln_bias": stack(
+                "encoder.layer.{i}.attention.output.LayerNorm.bias"),
+            "inter_w": stack("encoder.layer.{i}.intermediate.dense.weight",
+                             transpose=True),
+            "inter_b": stack("encoder.layer.{i}.intermediate.dense.bias"),
+            "out_w": stack("encoder.layer.{i}.output.dense.weight",
+                           transpose=True),
+            "out_b": stack("encoder.layer.{i}.output.dense.bias"),
+            "out_ln_scale": stack("encoder.layer.{i}.output.LayerNorm.weight"),
+            "out_ln_bias": stack("encoder.layer.{i}.output.LayerNorm.bias"),
+        },
+        "mlm_dense_w": jnp.asarray(
+            get("cls.predictions.transform.dense.weight").T),
+        "mlm_dense_b": jnp.asarray(
+            get("cls.predictions.transform.dense.bias")),
+        "mlm_ln_scale": jnp.asarray(
+            get("cls.predictions.transform.LayerNorm.weight")),
+        "mlm_ln_bias": jnp.asarray(
+            get("cls.predictions.transform.LayerNorm.bias")),
+        "mlm_bias": jnp.asarray(get("cls.predictions.bias")),
+    }
+
+
+def build(cfg: Optional[BertConfig] = None, **overrides) -> ModelSpec:
+    cfg = cfg or BertConfig(**overrides)
+    if cfg.dropout:
+        raise NotImplementedError(
+            "bert: dropout is not implemented yet; set dropout=0")
+
+    def init_fn(rng):
+        return init_params(cfg, rng)
+
+    def loss_fn(params, batch, rng=None, train=True):
+        return loss_from_batch(cfg, params, batch, rng=rng, train=train)
+
+    def apply_fn(params, batch, rng=None):
+        if isinstance(batch, dict):
+            return forward(cfg, params, batch["input_ids"],
+                           batch.get("attention_mask"),
+                           batch.get("token_type_ids"), train=False)
+        return forward(cfg, params, batch, train=False)
+
+    return ModelSpec(init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
+                     tp_rules=lambda ap: tp_rules(cfg, ap),
+                     flops_per_token=6.0 * cfg.num_params(),
+                     name=f"bert-{cfg.num_layers}l-{cfg.hidden_size}d")

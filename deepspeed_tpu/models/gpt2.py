@@ -126,8 +126,16 @@ def _layer_norm(x, scale, bias, eps: float = 1e-5):
 
 
 def _remat_policy(cfg):
-    if getattr(cfg, "remat_policy", "full") == "dots":
-        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    policy = getattr(cfg, "remat_policy", "full")
+    if policy in ("dots", "dots_flash"):
+        dots = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        if policy == "dots":
+            return dots
+        # also pin the flash kernel's residuals (o + lse) so the backward
+        # consumes them instead of re-running the forward kernel
+        return jax.checkpoint_policies.save_from_both_policies(
+            dots, jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse"))
     return None
 
 

@@ -244,14 +244,17 @@ def _block_cached(cfg: LlamaConfig, x, layer, ck, cv, pos, mlp_fn=None):
     return x, ck, cv
 
 
-def forward_cached(cfg: LlamaConfig, params, input_ids, cache, pos):
-    """Incremental forward: logits for the LAST input position + updated cache."""
+def forward_cached(cfg: LlamaConfig, params, input_ids, cache, pos,
+                   mlp_fn=None):
+    """Incremental forward: logits for the LAST input position + updated
+    cache.  ``mlp_fn`` threads through to :func:`_block_cached` (mixtral
+    delegates here with its MoE FFN)."""
     pos = jnp.asarray(pos, jnp.int32)
     x = params["embed"][input_ids].astype(params["embed"].dtype)
 
     def body(x, xs):
         layer, ck, cv = xs
-        x, ck, cv = _block_cached(cfg, x, layer, ck, cv, pos)
+        x, ck, cv = _block_cached(cfg, x, layer, ck, cv, pos, mlp_fn=mlp_fn)
         return x, (ck, cv)
 
     x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"],

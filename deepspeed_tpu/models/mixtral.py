@@ -157,28 +157,13 @@ def _moe_ffn(cfg: MixtralConfig, layer, y, train: bool):
     return moe_apply(cfg.moe_cfg(), moe_params, y, train=train)
 
 
-def _block_cached(cfg: MixtralConfig, x, layer, ck, cv, pos):
-    """Llama cached attention + MoE FFN (reference ``moe_inference.py``:
-    expert routing runs per decode token too)."""
-    return L._block_cached(
-        cfg, x, layer, ck, cv, pos,
-        mlp_fn=lambda lyr, y: _moe_ffn(cfg, lyr, y, train=False)[0])
-
-
 def forward_cached(cfg: MixtralConfig, params, input_ids, cache, pos):
-    """Incremental MoE forward: last-position logits + updated cache."""
-    pos = jnp.asarray(pos, jnp.int32)
-    x = params["embed"][input_ids].astype(params["embed"].dtype)
-
-    def body(x, xs):
-        layer, ck, cv = xs
-        x, ck, cv = _block_cached(cfg, x, layer, ck, cv, pos)
-        return x, (ck, cv)
-
-    x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"],
-                                         cache["v"]))
-    x = L.rms_norm(x[:, -1], params["final_norm"], cfg.rms_eps)
-    return x @ params["lm_head"].astype(x.dtype), {"k": ks, "v": vs}
+    """Incremental MoE forward (reference ``moe_inference.py``: expert
+    routing runs per decode token too) — llama's cached path with the MoE
+    FFN hooked in."""
+    return L.forward_cached(
+        cfg, params, input_ids, cache, pos,
+        mlp_fn=lambda lyr, y: _moe_ffn(cfg, lyr, y, train=False)[0])
 
 
 def tp_rules(cfg: MixtralConfig, abstract_params: PyTree) -> PyTree:

@@ -192,6 +192,22 @@ def _neox_build(cfg):
     return gptneox.build(cfg)
 
 
+def _bert_translate(hf):
+    from ..models.bert import BertConfig
+    return BertConfig.from_hf(hf)
+
+
+def _bert_convert(cfg, sd):
+    from ..models.bert import from_hf_state_dict
+    return from_hf_state_dict(cfg, sd)
+
+
+def _bert_build(cfg):
+    from ..models import bert
+    return bert.build(cfg)
+
+
+_register("BertForMaskedLM", _bert_translate, _bert_convert, _bert_build)
 _register("GPT2LMHeadModel", _gpt2_translate, _gpt2_convert, _gpt2_build)
 _register("OPTForCausalLM", _opt_translate, _opt_convert, _opt_build)
 _register("LlamaForCausalLM", _llama_translate, _llama_convert, _llama_build)

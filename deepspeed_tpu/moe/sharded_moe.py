@@ -22,8 +22,14 @@ import jax.numpy as jnp
 
 def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
               min_capacity: int) -> int:
-    """Reference ``_capacity`` (sharded_moe.py:155): tokens-per-expert budget."""
-    capacity = int(num_tokens // num_experts * capacity_factor)
+    """Reference ``_capacity`` (sharded_moe.py:155): tokens-per-expert budget.
+
+    Ceil like the reference — floor division would under-budget short
+    sequences (num_tokens < num_experts) and break the drop-free guarantee
+    of ``capacity_factor == num_experts``."""
+    import math
+
+    capacity = math.ceil(num_tokens / num_experts * capacity_factor)
     return max(capacity, min_capacity)
 
 

@@ -400,6 +400,13 @@ def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, interpret,
                     true_kv_len, head_rep):
     o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
                   true_kv_len, head_rep)
+    # named so remat policies can pin the kernel's residuals: saving o+lse
+    # means the backward under jax.checkpoint reuses them instead of
+    # re-running the forward kernel (see gpt2._remat_policy)
+    from jax.ad_checkpoint import checkpoint_name
+
+    o = checkpoint_name(o, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return o, (q, k, v, o, lse)
 
 
