@@ -13,6 +13,7 @@ from . import comm
 from . import models
 from . import module_inject
 from . import ops
+from . import zero
 from .runtime import lr_schedules
 from .runtime.config import DeepSpeedConfig
 from .runtime.engine import DeepSpeedEngine

@@ -59,6 +59,15 @@ class BertConfig:
 
     @staticmethod
     def from_hf(hf) -> "BertConfig":
+        act = getattr(hf, "hidden_act", "gelu")
+        if act not in ("gelu", "gelu_new", "gelu_python"):
+            raise NotImplementedError(
+                f"bert: hidden_act={act!r} unsupported (gelu only)")
+        pos_type = getattr(hf, "position_embedding_type", "absolute")
+        if pos_type != "absolute":
+            raise NotImplementedError(
+                f"bert: position_embedding_type={pos_type!r} unsupported "
+                "(absolute only)")
         return BertConfig(
             vocab_size=hf.vocab_size,
             max_seq_len=hf.max_position_embeddings,
@@ -248,6 +257,20 @@ def from_hf_state_dict(cfg: BertConfig, sd: Dict[str, Any]) -> PyTree:
                 w = get(fmt.format(i=i))
             rows.append(w.T if transpose else w)
         return jnp.asarray(np.stack(rows))
+
+    # the MLM decoder must be tied to the word embeddings (our mlm_logits
+    # reuses them); reject silently-wrong untied checkpoints
+    dec = [k for k in sd if k.endswith("cls.predictions.decoder.weight")]
+    if dec:
+        d_w = np.asarray(sd[dec[0]].detach().cpu().numpy()
+                         if hasattr(sd[dec[0]], "detach") else sd[dec[0]],
+                         np.float32)
+        emb = get("embeddings.word_embeddings.weight")
+        if not np.allclose(d_w, emb, atol=1e-6):
+            raise NotImplementedError(
+                "bert: checkpoint has an UNTIED MLM decoder "
+                "(cls.predictions.decoder.weight != word embeddings); "
+                "untied decoders are not supported yet")
 
     return {
         "word_embeddings": jnp.asarray(

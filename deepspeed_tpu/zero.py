@@ -1,0 +1,43 @@
+"""``deepspeed.zero`` public-API compatibility surface.
+
+Reference scripts use ``with deepspeed.zero.Init(): model = Model()`` to
+shard parameters at construction (``runtime/zero/partition_parameters.py:539``)
+and ``zero.GatheredParameters`` to temporarily materialize full params.  In
+this framework params are *born sharded*: ``initialize()`` runs the model's
+``init_fn`` under jit with ZeRO ``out_shardings``, so construction-time
+partitioning is inherent and the contexts are accepted for script
+compatibility (no work to do / gathering is a jitted reshard).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from .runtime.zero.config import (DeepSpeedZeroConfig, OffloadDeviceEnum,
+                                  ZeroStageEnum)
+
+__all__ = ["Init", "GatheredParameters", "DeepSpeedZeroConfig",
+           "ZeroStageEnum", "OffloadDeviceEnum"]
+
+
+@contextmanager
+def Init(*args, **kwargs):
+    """Compat no-op: params are created sharded by ``initialize()`` itself
+    (jit + ZeRO out_shardings); there is no construction-time hook to
+    install.  Accepts and ignores the reference's arguments."""
+    yield
+
+
+@contextmanager
+def GatheredParameters(params=None, engine=None, modifier_rank=None,
+                       fwd_module=None, enabled=True):
+    """Gather ZeRO-sharded params to full values for host-side reads.
+
+    With an ``engine``, yields the fully-gathered fp32 param pytree
+    (``engine.get_fp32_params()`` — the in-memory ``zero_to_fp32``); bare use
+    is a no-op context like the reference's ``enabled=False`` path.
+    """
+    if engine is not None and enabled:
+        yield engine.get_fp32_params()
+    else:
+        yield params
