@@ -1,0 +1,7 @@
+"""Profiling (reference ``deepspeed/profiling``): flops profiler + config."""
+
+from .config import DeepSpeedFlopsProfilerConfig, get_flops_profiler_config
+from .flops_profiler import FlopsProfiler, get_model_profile
+
+__all__ = ["DeepSpeedFlopsProfilerConfig", "get_flops_profiler_config",
+           "FlopsProfiler", "get_model_profile"]
