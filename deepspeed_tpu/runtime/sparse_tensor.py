@@ -1,0 +1,83 @@
+"""Sparse (row-compressed) gradients + sparse all-reduce.
+
+Reference: ``runtime/sparse_tensor.py SparseTensor`` and the engine's
+``sparse_allreduce_*`` (``runtime/engine.py:2461-2476``) — embedding
+gradients touch few vocabulary rows per step, so instead of all-reducing the
+dense [V, D] tensor, each rank ships (row indices, row values) and the
+reduction is an all-gather + scatter-add (the reference concatenates
+per-rank indices/values exactly the same way, leaving duplicate rows to the
+dense conversion).
+
+TPU realisation: row compression with a **static** row budget (jit needs
+fixed shapes — the budget plays the role the reference's bucket size plays),
+``lax.all_gather`` over the dp axis inside ``shard_map``, and a segment-sum
+scatter back to dense.  Wire volume: 2 * world * k * (D + 1) words vs
+2 * V * D for a ring all-reduce — a win whenever rows-touched << V.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SparseTensor(NamedTuple):
+    """Row-sparse view of a dense [V, D] tensor (reference ``SparseTensor``)."""
+
+    indices: jnp.ndarray   # [k] int32 row ids (may repeat; padded rows = V)
+    values: jnp.ndarray    # [k, D]
+    dense_shape: Tuple[int, int]
+
+    @staticmethod
+    def from_dense(dense, k: Optional[int] = None) -> "SparseTensor":
+        """Compress the (at most) ``k`` largest-norm rows; k defaults to the
+        number of nonzero rows rounded up to a power of two (static shapes:
+        pick k once per training setup, like the reference's bucket size)."""
+        v, d = dense.shape
+        norms = jnp.sum(jnp.abs(dense), axis=-1)
+        if k is None:
+            nnz = int(jnp.sum(norms > 0))
+            k = max(1, 1 << (nnz - 1).bit_length())
+        k = min(k, v)
+        _, idx = jax.lax.top_k(norms, k)
+        vals = dense[idx]
+        # rows beyond the true support carry zero values; mark padded ids
+        padded = jnp.where(jnp.sum(jnp.abs(vals), axis=-1) > 0, idx, v)
+        return SparseTensor(padded.astype(jnp.int32), vals, (v, d))
+
+    def to_dense(self) -> jnp.ndarray:
+        """Scatter-add back to dense (duplicate indices accumulate, matching
+        the reference's sparse-to-dense)."""
+        v, d = self.dense_shape
+        out = jnp.zeros((v + 1, d), self.values.dtype)  # +1: padded-row sink
+        out = out.at[self.indices].add(self.values)
+        return out[:v]
+
+    @property
+    def nnz(self) -> int:
+        return self.indices.shape[0]
+
+
+def sparse_allreduce(st: SparseTensor, axis_name: str,
+                     average: bool = True) -> SparseTensor:
+    """All-reduce a row-sparse gradient over ``axis_name`` (inside
+    shard_map/pmap): all-gather per-rank indices+values and concatenate —
+    the reference's ``sparse_allreduce_bucket`` wire pattern.  Duplicate
+    rows across ranks remain and accumulate at ``to_dense``."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.all_gather(st.indices, axis_name).reshape(-1)
+    vals = jax.lax.all_gather(st.values, axis_name)
+    vals = vals.reshape(-1, vals.shape[-1])
+    if average:
+        vals = vals / n
+    return SparseTensor(idx, vals, st.dense_shape)
+
+
+def sparse_allreduce_dense_result(st: SparseTensor, axis_name: str,
+                                  average: bool = True) -> jnp.ndarray:
+    """Convenience: sparse all-reduce then densify (what the engine does
+    with the result before the optimizer step)."""
+    return sparse_allreduce(st, axis_name, average=average).to_dense()
