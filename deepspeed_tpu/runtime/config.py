@@ -217,6 +217,14 @@ class DeepSpeedConfig:
             pd, C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
         self.sparse_gradients_enabled = get_scalar_param(pd, C.SPARSE_GRADIENTS,
                                                          C.SPARSE_GRADIENTS_DEFAULT)
+        if self.sparse_gradients_enabled:
+            from ..utils.logging import logger
+
+            logger.warning(
+                "sparse_gradients: the engine's gradient exchange is dense "
+                "(XLA SPMD); the row-sparse all-reduce utilities live in "
+                "runtime/sparse_tensor.py for custom loops — engine wiring "
+                "is future work")
         self.communication_data_type = get_scalar_param(
             pd, C.COMMUNICATION_DATA_TYPE, C.COMMUNICATION_DATA_TYPE_DEFAULT)
         self.disable_allgather = get_scalar_param(pd, C.DISABLE_ALLGATHER,

@@ -33,19 +33,31 @@ class SparseTensor(NamedTuple):
 
     @staticmethod
     def from_dense(dense, k: Optional[int] = None) -> "SparseTensor":
-        """Compress the (at most) ``k`` largest-norm rows; k defaults to the
-        number of nonzero rows rounded up to a power of two (static shapes:
-        pick k once per training setup, like the reference's bucket size)."""
+        """Compress the (at most) ``k`` largest-norm rows.
+
+        ``k`` is the static row budget (jit needs fixed shapes — pick it
+        from the worst-case unique tokens per batch, like the reference
+        sizes its buckets).  **A budget smaller than the touched-row count
+        silently drops the smallest-norm rows** — size it generously.
+        Under jit ``k`` is REQUIRED; on concrete arrays ``k=None`` derives
+        it from the nonzero-row count (power-of-two rounded).
+        """
         v, d = dense.shape
         norms = jnp.sum(jnp.abs(dense), axis=-1)
         if k is None:
-            nnz = int(jnp.sum(norms > 0))
+            try:
+                nnz = int(jnp.sum(norms > 0))
+            except jax.errors.ConcretizationTypeError as e:
+                raise ValueError(
+                    "SparseTensor.from_dense(k=None) needs a concrete array;"
+                    " inside jit/shard_map pass an explicit static row "
+                    "budget k") from e
             k = max(1, 1 << (nnz - 1).bit_length())
         k = min(k, v)
         _, idx = jax.lax.top_k(norms, k)
         vals = dense[idx]
         # rows beyond the true support carry zero values; mark padded ids
-        padded = jnp.where(jnp.sum(jnp.abs(vals), axis=-1) > 0, idx, v)
+        padded = jnp.where(norms[idx] > 0, idx, v)
         return SparseTensor(padded.astype(jnp.int32), vals, (v, d))
 
     def to_dense(self) -> jnp.ndarray:
@@ -68,11 +80,10 @@ def sparse_allreduce(st: SparseTensor, axis_name: str,
     the reference's ``sparse_allreduce_bucket`` wire pattern.  Duplicate
     rows across ranks remain and accumulate at ``to_dense``."""
     n = jax.lax.psum(1, axis_name)
+    local = st.values / n if average else st.values  # divide pre-gather
     idx = jax.lax.all_gather(st.indices, axis_name).reshape(-1)
-    vals = jax.lax.all_gather(st.values, axis_name)
+    vals = jax.lax.all_gather(local, axis_name)
     vals = vals.reshape(-1, vals.shape[-1])
-    if average:
-        vals = vals / n
     return SparseTensor(idx, vals, st.dense_shape)
 
 
