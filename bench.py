@@ -44,6 +44,12 @@ def main():
     ap.add_argument("--bk", type=int, default=None, help="flash block_k")
     ap.add_argument("--remat-policy", default=None,
                     choices=["full", "dots", "dots_flash"])
+    ap.add_argument("--multi", type=int, default=None,
+                    help="global steps per dispatch (train_batches); "
+                         "1 = per-step dispatch")
+    ap.add_argument("--no-scan-layers", dest="scan_layers",
+                    action="store_false", default=None,
+                    help="unroll the layer loop (no scan residual stacking)")
     args = ap.parse_args()
 
     import jax
@@ -75,6 +81,8 @@ def main():
         cfg.flash_block_k = args.bk
     if args.remat_policy:
         cfg.remat_policy = args.remat_policy
+    if args.scan_layers is not None:
+        cfg.scan_layers = args.scan_layers
     micro_bs = args.micro_bs or micro_bs
     seq = args.seq or seq
     steps = args.steps or steps
@@ -97,12 +105,21 @@ def main():
             0, cfg.vocab_size, size=(engine.train_batch_size(), seq + 1)
         ).astype(np.int32)}
 
-    # warmup / compile
+    # warmup / compile (both the single-step and the multi-step programs)
+    multi = args.multi if args.multi is not None else (5 if on_tpu else 1)
+    multi = max(1, min(multi, steps))
+    steps -= steps % multi
     for _ in range(2):
         _, m = engine.train_batch(batch())
+    if multi > 1:
+        engine.train_batches([batch() for _ in range(multi)])
     t0 = time.perf_counter()
-    for _ in range(steps):
-        _, m = engine.train_batch(batch())
+    if multi > 1:
+        for _ in range(steps // multi):
+            _, m = engine.train_batches([batch() for _ in range(multi)])
+    else:
+        for _ in range(steps):
+            _, m = engine.train_batch(batch())
     jax.block_until_ready(engine.state["params"])
     dt = time.perf_counter() - t0
 

@@ -49,7 +49,8 @@ def parse_args(args=None):
                         default=int(os.environ.get("DLTS_MASTER_PORT", 29500)))
     parser.add_argument("--master_addr", type=str, default="")
     parser.add_argument("--launcher", type=str, default="pdsh",
-                        choices=["pdsh", "openmpi", "slurm", "ssh"])
+                        choices=["pdsh", "openmpi", "mpich", "mvapich",
+                                 "slurm", "ssh"])
     parser.add_argument("--launcher_args", type=str, default="")
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("--detect_nvme", action="store_true")
@@ -211,6 +212,58 @@ class OpenMPIRunner(MultiNodeRunner):
         return cmd
 
 
+class MPICHRunner(MultiNodeRunner):
+    """MPICH/Hydra launch (reference ``multinode_runner.py`` MPICH backend):
+    one process per host, env exported per-variable with ``-genv``."""
+
+    name = "mpich"
+
+    def backend_exists(self) -> bool:
+        import shutil
+
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        total_procs = len(active_resources)
+        hosts = ",".join(active_resources.keys())
+        cmd = ["mpirun", "-n", str(total_procs), "-hosts", hosts,
+               "-ppn", "1"]
+        if self.args.launcher_args:
+            cmd += shlex.split(self.args.launcher_args)
+        for k, v in self.exports.items():
+            cmd += ["-genv", k, v]
+        cmd += [sys.executable, self.user_script] + self.user_arguments
+        return cmd
+
+
+class MVAPICHRunner(MultiNodeRunner):
+    """MVAPICH launch: hostfile-driven mpirun_rsh with env as KEY=VAL args
+    (reference ``multinode_runner.py`` MVAPICH backend writes
+    ``/tmp/deepspeed_mvapich_hostfile``; we keep the same contract)."""
+
+    name = "mvapich"
+    hostfile_path = "/tmp/deepspeed_tpu_mvapich_hostfile"
+
+    def backend_exists(self) -> bool:
+        import shutil
+
+        return shutil.which("mpirun_rsh") is not None
+
+    def get_cmd(self, environment, active_resources):
+        with open(self.hostfile_path, "w") as fh:
+            for host in active_resources:
+                fh.write(f"{host}\n")
+        total_procs = len(active_resources)
+        cmd = ["mpirun_rsh", "-np", str(total_procs),
+               "-hostfile", self.hostfile_path]
+        if self.args.launcher_args:
+            cmd += shlex.split(self.args.launcher_args)
+        for k, v in self.exports.items():
+            cmd += [f"{k}={v}"]
+        cmd += [sys.executable, self.user_script] + self.user_arguments
+        return cmd
+
+
 class SlurmRunner(MultiNodeRunner):
     name = "slurm"
 
@@ -256,7 +309,9 @@ def main(args=None):
 
     world_info = encode_world_info(active)
     runner_cls = {"pdsh": PDSHRunner, "ssh": PDSHRunner,
-                  "openmpi": OpenMPIRunner, "slurm": SlurmRunner}[args.launcher]
+                  "openmpi": OpenMPIRunner, "mpich": MPICHRunner,
+                  "mvapich": MVAPICHRunner,
+                  "slurm": SlurmRunner}[args.launcher]
     runner = runner_cls(args, world_info)
     if not runner.backend_exists():
         raise RuntimeError(f"launcher backend {args.launcher} not installed")

@@ -2,7 +2,7 @@
 
 import dataclasses
 
-from . import bert, bloom, gpt2, gptneox, llama, mixtral, opt
+from . import bert, bloom, gpt2, gptj, gptneo, gptneox, llama, mixtral, opt
 
 
 def _with(cfg, overrides):
@@ -28,6 +28,13 @@ _NAMED = {
                                               kw)),
     "bloom7b1": lambda kw: bloom.build(_with(bloom.BloomConfig.bloom_7b1(),
                                              kw)),
+    "gptneo": lambda kw: gptneo.build(**kw),
+    "gptneo1p3b": lambda kw: gptneo.build(
+        _with(gptneo.GPTNeoConfig.neo_1p3b(), kw)),
+    "gptneo2p7b": lambda kw: gptneo.build(
+        _with(gptneo.GPTNeoConfig.neo_2p7b(), kw)),
+    "gptj": lambda kw: gptj.build(**kw),
+    "gptj6b": lambda kw: gptj.build(_with(gptj.GPTJConfig.gptj_6b(), kw)),
     "gptneox": lambda kw: gptneox.build(**kw),
     "gptneox20b": lambda kw: gptneox.build(
         _with(gptneox.GPTNeoXConfig.neox_20b(), kw)),

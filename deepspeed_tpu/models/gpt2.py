@@ -57,6 +57,13 @@ class GPT2Config:
     flash_block_k: int = 1024
     #: sequence-parallel attention impl when mesh sp>1: auto|ulysses|ring
     sp_impl: str = "auto"
+    #: True (default): execute the layer stack with lax.scan (O(1) compiled
+    #: code size; the remat residuals of every iteration are stacked into
+    #: [L, ...] buffers via dynamic-update-slice — measurable HBM write
+    #: traffic in backward).  False: unroll a python loop over layers —
+    #: residuals stay as L separate buffers (no stacking copies), at the
+    #: cost of L× compile time.  Worth it for small L on the perf path.
+    scan_layers: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -270,15 +277,24 @@ def _trunk(cfg: GPT2Config, params, input_ids, rng=None, train: bool = True):
     x = x.astype(compute_dtype)
     dropout = cfg.dropout if train else 0.0
 
+    block_fn = _block
+    if cfg.remat:
+        block_fn = jax.checkpoint(_block, static_argnums=(0, 5),
+                                  policy=_remat_policy(cfg))
+
+    if not getattr(cfg, "scan_layers", True):
+        for i in range(cfg.num_layers):
+            layer = jax.tree_util.tree_map(lambda p: p[i], params["blocks"])
+            r = (jax.random.fold_in(rng, i)
+                 if (rng is not None and dropout > 0.0) else None)
+            x = block_fn(cfg, x, layer, None, r, dropout)
+        return x
+
     def body(carry, xs):
         x, idx = carry
         layer, = xs
         r = (jax.random.fold_in(rng, idx) if (rng is not None and dropout > 0.0)
              else None)
-        block_fn = _block
-        if cfg.remat:
-            block_fn = jax.checkpoint(_block, static_argnums=(0, 5),
-                                      policy=_remat_policy(cfg))
         x = block_fn(cfg, x, layer, None, r, dropout)
         return (x, idx + 1), None
 

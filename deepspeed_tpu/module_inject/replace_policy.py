@@ -192,6 +192,36 @@ def _neox_build(cfg):
     return gptneox.build(cfg)
 
 
+def _gptj_translate(hf):
+    from ..models.gptj import GPTJConfig
+    return GPTJConfig.from_hf(hf)
+
+
+def _gptj_convert(cfg, sd):
+    from ..models.gptj import from_hf_state_dict
+    return from_hf_state_dict(cfg, sd)
+
+
+def _gptj_build(cfg):
+    from ..models import gptj
+    return gptj.build(cfg)
+
+
+def _gptneo_translate(hf):
+    from ..models.gptneo import GPTNeoConfig
+    return GPTNeoConfig.from_hf(hf)
+
+
+def _gptneo_convert(cfg, sd):
+    from ..models.gptneo import from_hf_state_dict
+    return from_hf_state_dict(cfg, sd)
+
+
+def _gptneo_build(cfg):
+    from ..models import gptneo
+    return gptneo.build(cfg)
+
+
 def _bert_translate(hf):
     from ..models.bert import BertConfig
     return BertConfig.from_hf(hf)
@@ -207,12 +237,99 @@ def _bert_build(cfg):
     return bert.build(cfg)
 
 
+def _distilbert_translate(hf):
+    """DistilBERT is a 6-layer post-LN BERT without token-type embeddings
+    or pooler (reference ``containers/distil_bert.py``); it reuses the BERT
+    encoder with a 1-row zero token-type table."""
+    from ..models.bert import BertConfig
+    act = getattr(hf, "activation", "gelu")
+    if act not in ("gelu", "gelu_new"):
+        raise NotImplementedError(f"distilbert: activation={act!r}")
+    return BertConfig(
+        vocab_size=hf.vocab_size,
+        max_seq_len=hf.max_position_embeddings,
+        type_vocab_size=1,
+        num_layers=hf.n_layers,
+        num_heads=hf.n_heads,
+        hidden_size=hf.dim,
+        intermediate_size=hf.hidden_dim,
+        layer_norm_eps=1e-12)
+
+
+def _distilbert_convert(cfg, sd):
+    def get(name):
+        for prefix in ("distilbert.", ""):
+            if prefix + name in sd:
+                return _np(sd[prefix + name])
+        raise KeyError(name)
+
+    l, d = cfg.num_layers, cfg.hidden_size
+
+    def stack(fmt, fn=lambda x: x):
+        return jnp.asarray(np.stack([fn(get(fmt.format(i=i)))
+                                     for i in range(l)]))
+
+    def fuse_qkv(i):
+        ws = [get(f"transformer.layer.{i}.attention.{p}_lin.weight").T
+              for p in ("q", "k", "v")]
+        return np.concatenate(ws, axis=1)
+
+    def fuse_qkv_b(i):
+        return np.concatenate(
+            [get(f"transformer.layer.{i}.attention.{p}_lin.bias")
+             for p in ("q", "k", "v")])
+
+    t = lambda w: w.T
+    # our BERT mlm head decodes through the (tied) word embeddings; verify
+    # the projector really is tied before dropping its weight
+    try:
+        proj = get("vocab_projector.weight")
+        if not np.allclose(proj, get("embeddings.word_embeddings.weight")):
+            raise NotImplementedError(
+                "distilbert: untied vocab_projector is unsupported "
+                "(tie_word_embeddings=False)")
+    except KeyError:
+        pass  # tied weights may be absent from the serialized dict
+    return {
+        "word_embeddings": jnp.asarray(get("embeddings.word_embeddings.weight")),
+        "position_embeddings": jnp.asarray(
+            get("embeddings.position_embeddings.weight")),
+        "token_type_embeddings": jnp.zeros((1, d), jnp.float32),
+        "emb_ln_scale": jnp.asarray(get("embeddings.LayerNorm.weight")),
+        "emb_ln_bias": jnp.asarray(get("embeddings.LayerNorm.bias")),
+        "blocks": {
+            "qkv_w": jnp.asarray(np.stack([fuse_qkv(i) for i in range(l)])),
+            "qkv_b": jnp.asarray(np.stack([fuse_qkv_b(i) for i in range(l)])),
+            "attn_out_w": stack("transformer.layer.{i}.attention.out_lin.weight", t),
+            "attn_out_b": stack("transformer.layer.{i}.attention.out_lin.bias"),
+            "attn_ln_scale": stack("transformer.layer.{i}.sa_layer_norm.weight"),
+            "attn_ln_bias": stack("transformer.layer.{i}.sa_layer_norm.bias"),
+            "inter_w": stack("transformer.layer.{i}.ffn.lin1.weight", t),
+            "inter_b": stack("transformer.layer.{i}.ffn.lin1.bias"),
+            "out_w": stack("transformer.layer.{i}.ffn.lin2.weight", t),
+            "out_b": stack("transformer.layer.{i}.ffn.lin2.bias"),
+            "out_ln_scale": stack("transformer.layer.{i}.output_layer_norm.weight"),
+            "out_ln_bias": stack("transformer.layer.{i}.output_layer_norm.bias"),
+        },
+        "mlm_dense_w": jnp.asarray(get("vocab_transform.weight").T),
+        "mlm_dense_b": jnp.asarray(get("vocab_transform.bias")),
+        "mlm_ln_scale": jnp.asarray(get("vocab_layer_norm.weight")),
+        "mlm_ln_bias": jnp.asarray(get("vocab_layer_norm.bias")),
+        "mlm_bias": jnp.asarray(get("vocab_projector.bias")),
+    }
+
+
 _register("BertForMaskedLM", _bert_translate, _bert_convert, _bert_build)
+_register("DistilBertForMaskedLM", _distilbert_translate,
+          _distilbert_convert, _bert_build)
 _register("GPT2LMHeadModel", _gpt2_translate, _gpt2_convert, _gpt2_build)
 _register("OPTForCausalLM", _opt_translate, _opt_convert, _opt_build)
 _register("LlamaForCausalLM", _llama_translate, _llama_convert, _llama_build)
 _register("BloomForCausalLM", _bloom_translate, _bloom_convert, _bloom_build)
 _register("GPTNeoXForCausalLM", _neox_translate, _neox_convert, _neox_build)
+_register("GPTJForCausalLM", _gptj_translate, _gptj_convert, _gptj_build)
+_register("GPTNeoForCausalLM", _gptneo_translate, _gptneo_convert,
+          _gptneo_build)
 
 
 def generic_policies():

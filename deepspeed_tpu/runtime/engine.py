@@ -785,6 +785,18 @@ class DeepSpeedEngine:
 
         rep = NamedSharding(self.mesh, P())
         metrics_shardings = self._metrics_shardings()
+        def multi_step(state, batches, base_rng):
+            """k train_steps under one jit; scan length = leading batch dim
+            (jit specializes per shape, so one callable serves every k)."""
+            def body(st, b):
+                return train_step(st, b, base_rng)
+
+            return jax.lax.scan(body, state, batches)
+
+        self._train_multi_fn = jax.jit(
+            multi_step,
+            out_shardings=(self.state_shardings, metrics_shardings),
+            donate_argnums=(0,))
         self._train_step_fn = jax.jit(
             train_step,
             out_shardings=(self.state_shardings, metrics_shardings),
@@ -812,10 +824,14 @@ class DeepSpeedEngine:
             donate_argnums=(0,))
 
     # ---------------------------------------------------------------- batching
-    def _batch_sharding(self, leading_gas_dim: bool, x=None):
+    def _batch_sharding(self, leading_gas_dim, x=None):
         """Batch dim over (dp, ep); if sp>1, the sequence dim over sp too
-        (when it divides — SP attention reshards internally otherwise)."""
-        dims = [None, DATA_AXES] if leading_gas_dim else [DATA_AXES]
+        (when it divides — SP attention reshards internally otherwise).
+
+        ``leading_gas_dim`` counts unsharded leading dims before the batch
+        dim (bool for the historical [gas, micro] case; 2 for the
+        multi-step [steps, gas, micro] layout of ``train_batches``)."""
+        dims = [None] * int(leading_gas_dim) + [DATA_AXES]
         if x is not None:
             seq_dim = len(dims)
             sp = self.topology.sequence_parallel_size
@@ -946,6 +962,71 @@ class DeepSpeedEngine:
             self.flops_profiler = FlopsProfiler(engine=self)
             self.flops_profiler.profile_engine_step(batch, latency=latency)
             self.flops_profiler.print_profile(fp.output_file)
+        return self.state, self._cached_metrics
+
+    def train_batches(self, batches) -> Tuple[Any, Dict]:
+        """Run several consecutive global steps in ONE device dispatch.
+
+        ``batches``: a list of global batches (each as accepted by
+        ``train_batch``) or a pytree already stacked on a leading steps dim
+        ``[k, gas, micro_global, ...]``.  Semantically identical to ``k``
+        ``train_batch`` calls — the update happens every ``gas``
+        microbatches, RNG folds per step — but the k steps execute as one
+        ``lax.scan``, so per-step host dispatch latency (dominant on
+        remote/tunneled backends; the problem the reference solves with
+        CUDA-graph capture, ``inference/engine.py:479``) is paid once per k.
+
+        Falls back to per-step ``train_batch`` when a host-side feature
+        needs to observe every step (offload optimizer, compression
+        schedule offsets, curriculum seqlen, flops profiling).
+        """
+        if isinstance(batches, (list, tuple)):
+            k = len(batches)
+            stacked = None
+        else:
+            k = jax.tree_util.tree_leaves(batches)[0].shape[0]
+            stacked = batches
+        fp = self._config.flops_profiler_config
+        host_side_feature = (
+            self.offload_enabled
+            or getattr(self.model_spec, "_compression_toggle", None) is not None
+            or (self.curriculum_scheduler is not None
+                and self.curriculum_scheduler.curriculum_type == "seqlen")
+            or (fp.enabled
+                and self.global_steps < fp.profile_step <= self.global_steps + k))
+        if host_side_feature or k == 1:
+            if stacked is not None:
+                batches = [jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
+                           for i in range(k)]
+            for b in batches:
+                out = self.train_batch(b)
+            return out
+
+        if stacked is None:
+            reshaped = []
+            for b in batches:
+                first = jax.tree_util.tree_leaves(b)[0]
+                if first.shape[0] == self.train_batch_size() and \
+                        self.gradient_accumulation_steps() * \
+                        self.micro_batch_global() == self.train_batch_size():
+                    b = self._reshape_global_batch(b)
+                reshaped.append(b)
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: np.stack([np.asarray(x) for x in xs]), *reshaped)
+        dev = self._shard_batch(stacked, leading_gas_dim=2)
+
+        self.tput_timer.start()
+        self.state, mstack = self._train_multi_fn(
+            self.state, dev, self._dropout_rng)
+        self.global_steps += k
+        self.micro_steps += k * self.gradient_accumulation_steps()
+        self.global_samples += k * self.train_batch_size()
+        metrics = jax.tree_util.tree_map(lambda a: a[-1], mstack)
+        sync = metrics["loss"] if (self.global_steps %
+                                   max(self.steps_per_print(), 1) == 0) \
+            else None
+        self.tput_timer.stop(global_step=True, sync_arrays=sync, steps=k)
+        self._finalize_metrics(metrics)
         return self.state, self._cached_metrics
 
     def _train_step_offload(self, state, batch):

@@ -147,6 +147,7 @@ class ThroughputTimer:
         self.total_elapsed_time = 0.0
         self.step_elapsed_time = 0.0
         self.steps_per_output = steps_per_output
+        self._steps_since_report = 0
         self.monitor_memory = monitor_memory
         self.logging = logging_fn or (lambda msg: log_dist(msg, ranks=[0]))
         self.initialized = False
@@ -165,13 +166,15 @@ class ThroughputTimer:
             self.start_time = time.perf_counter()
 
     def stop(self, global_step: bool = False, report_speed: bool = True,
-             sync_arrays: Any = None) -> None:
+             sync_arrays: Any = None, steps: int = 1) -> None:
+        """``steps``: number of global steps covered by this start/stop
+        interval (>1 for the engine's multi-step ``train_batches`` path)."""
         if not self.started:
             return
         self.started = False
-        self.micro_step_count += 1
+        self.micro_step_count += steps
         if global_step:
-            self.global_step_count += 1
+            self.global_step_count += steps
         if self.start_time > 0:
             _sync(sync_arrays)
             self.end_time = time.perf_counter()
@@ -180,13 +183,19 @@ class ThroughputTimer:
             self.step_elapsed_time += duration
             self.start_time = 0.0
             if global_step and report_speed and \
-                    self.global_step_count % self.steps_per_output == 0:
+                    self.global_step_count % self.steps_per_output < steps:
+                # steps since the last report (multi-step intervals may not
+                # divide steps_per_output; scale by what was actually timed)
+                covered = self._steps_since_report + steps
                 self.logging(
                     f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
                     f"global_step={self.global_step_count}, "
                     f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.2f}, "
-                    f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time * self.steps_per_output:.2f}")
+                    f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time * covered:.2f}")
                 self.step_elapsed_time = 0.0
+                self._steps_since_report = 0
+            elif global_step:
+                self._steps_since_report += steps
 
     def avg_samples_per_sec(self) -> float:
         if self.global_step_count > self.start_step and self.total_elapsed_time > 0:

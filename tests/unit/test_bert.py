@@ -23,6 +23,21 @@ def _tiny_hf_bert():
     return m
 
 
+def test_distilbert_matches_hf():
+    cfg = transformers.DistilBertConfig(
+        vocab_size=96, dim=32, n_layers=2, n_heads=4, hidden_dim=128,
+        max_position_embeddings=64, dropout=0.0, attention_dropout=0.0)
+    with torch.no_grad():
+        hf = transformers.DistilBertForMaskedLM(cfg)
+    hf.eval()
+    spec, params = deepspeed_tpu.module_inject.replace_module(hf_model=hf)
+    ids = np.random.default_rng(3).integers(2, 96, (2, 12)).astype(np.int32)
+    ours = np.asarray(spec.apply_fn(params, {"input_ids": ids}))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=3e-4, rtol=2e-3)
+
+
 def test_bert_matches_hf_with_padding_mask():
     hf = _tiny_hf_bert()
     spec, params = deepspeed_tpu.module_inject.replace_module(hf_model=hf)

@@ -48,6 +48,46 @@ def test_train_loss_decreases():
     assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
 
 
+def test_train_batches_matches_per_step():
+    """k steps via one train_batches dispatch == k train_batch calls."""
+    deepspeed_tpu.comm.reset_topology()
+    engine_a, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(),
+                                                 config=base_config())
+    rng = np.random.default_rng(7)
+    batches = [make_batch(rng, engine_a.train_batch_size())
+               for _ in range(4)]
+    for b in batches:
+        _, m_a = engine_a.train_batch(b)
+
+    deepspeed_tpu.comm.reset_topology()
+    engine_b, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(),
+                                                 config=base_config())
+    _, m_b = engine_b.train_batches(batches)
+
+    assert engine_b.global_steps == engine_a.global_steps == 4
+    np.testing.assert_allclose(float(m_a["loss"]), float(m_b["loss"]),
+                               rtol=1e-5)
+    pa = jax.tree_util.tree_leaves(engine_a.state["params"])
+    pb = jax.tree_util.tree_leaves(engine_b.state["params"])
+    for a, b in zip(pa, pb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-6)
+
+
+def test_unrolled_layers_match_scan():
+    """cfg.scan_layers=False is numerically identical to the scan path."""
+    cfg = gpt2.GPT2Config.tiny()
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    ids = np.arange(2 * 17, dtype=np.int32).reshape(2, 17) % cfg.vocab_size
+    logits_scan = gpt2.forward(cfg, params, ids, train=False)
+    cfg_u = gpt2.GPT2Config.tiny()
+    cfg_u.scan_layers = False
+    logits_unroll = gpt2.forward(cfg_u, params, ids, train=False)
+    np.testing.assert_allclose(np.asarray(logits_scan),
+                               np.asarray(logits_unroll),
+                               rtol=1e-5, atol=1e-5)
+
+
 @pytest.mark.parametrize("stage", [1, 2, 3])
 def test_zero_stage_matches_baseline(stage):
     _, base_losses = run_steps(base_config(), steps=4)

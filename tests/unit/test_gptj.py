@@ -1,0 +1,70 @@
+"""GPT-J tests: HF parity (interleaved rotary, single-ln parallel residual,
+bias-free attention projections), decode, training."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gptj
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+def _tiny_hf_gptj(**over):
+    kw = dict(vocab_size=96, n_embd=32, n_layer=2, n_head=4, n_inner=None,
+              n_positions=64, rotary_dim=4, activation_function="gelu_new",
+              attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0)
+    kw.update(over)
+    cfg = transformers.GPTJConfig(**kw)
+    with torch.no_grad():
+        m = transformers.GPTJForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_gptj_matches_hf():
+    hf = _tiny_hf_gptj()
+    spec, params = deepspeed_tpu.module_inject.replace_module(hf_model=hf)
+    ids = np.random.default_rng(0).integers(2, 96, (2, 12)).astype(np.int32)
+    ours = np.asarray(spec.apply_fn(params, {"input_ids": ids}))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=3e-4, rtol=2e-3)
+
+
+def test_gptj_kv_cache_decode_matches_forward():
+    import jax
+
+    cfg = gptj.GPTJConfig.tiny()
+    params = gptj.init_params(cfg, jax.random.PRNGKey(0))
+    ids = np.random.default_rng(1).integers(0, 512, (2, 12)).astype(np.int32)
+    full = np.asarray(gptj.forward(cfg, params, ids, train=False))
+
+    cache = gptj.init_cache(cfg, 2, 32, dtype=np.float32)
+    logits, cache = gptj.forward_cached(cfg, params, ids[:, :8], cache, 0)
+    np.testing.assert_allclose(np.asarray(logits), full[:, 7], atol=1e-4)
+    for t in range(8, 12):
+        logits, cache = gptj.forward_cached(cfg, params, ids[:, t:t + 1],
+                                            cache, t)
+        np.testing.assert_allclose(np.asarray(logits), full[:, t], atol=1e-4)
+
+
+def test_gptj_trains():
+    deepspeed_tpu.comm.reset_topology()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gptj.build(gptj.GPTJConfig.tiny()),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+                "mesh": {}})
+    rng = np.random.default_rng(0)
+    # fixed batch: random-uniform tokens start AT the ln(V) entropy floor for
+    # this init (uniform logits), so fresh batches show no decrease —
+    # memorizing one batch does
+    batch = {"input_ids": rng.integers(
+        0, 512, size=(engine.train_batch_size(), 17)).astype(np.int32)}
+    losses = []
+    for _ in range(10):
+        _, m = engine.train_batch(batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] - 0.5, losses

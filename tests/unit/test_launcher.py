@@ -102,6 +102,32 @@ def test_openmpi_cmd_construction():
     assert "train.py" in cmd
 
 
+def test_mpich_cmd_construction():
+    from deepspeed_tpu.launcher.runner import MPICHRunner
+
+    args = _args()
+    runner = MPICHRunner(args, "x")
+    cmd = runner.get_cmd({}, {"a": [0], "b": [0]})
+    assert cmd[0] == "mpirun"
+    assert cmd[cmd.index("-n") + 1] == "2"
+    assert cmd[cmd.index("-ppn") + 1] == "1"
+    assert "train.py" in cmd
+
+
+def test_mvapich_cmd_construction(tmp_path, monkeypatch):
+    from deepspeed_tpu.launcher.runner import MVAPICHRunner
+
+    args = _args()
+    runner = MVAPICHRunner(args, "x")
+    monkeypatch.setattr(MVAPICHRunner, "hostfile_path",
+                        str(tmp_path / "mvapich_hosts"))
+    cmd = runner.get_cmd({}, {"a": [0], "b": [0], "c": [0]})
+    assert cmd[0] == "mpirun_rsh"
+    assert cmd[cmd.index("-np") + 1] == "3"
+    hosts = (tmp_path / "mvapich_hosts").read_text().split()
+    assert hosts == ["a", "b", "c"]
+
+
 def test_slurm_cmd_construction():
     args = _args()
     runner = SlurmRunner(args, "x")
