@@ -38,6 +38,8 @@ def main():
     ap.add_argument("--remat", dest="remat", default=None, action="store_true")
     ap.add_argument("--no-remat", dest="remat", action="store_false")
     ap.add_argument("--micro-bs", type=int, default=None)
+    ap.add_argument("--gas", type=int, default=None,
+                    help="gradient accumulation steps")
     ap.add_argument("--seq", type=int, default=None)
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--bq", type=int, default=None, help="flash block_q")
@@ -62,15 +64,22 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
         cfg = gpt2.GPT2Config.gpt2_125m()
-        # selective remat ("dots" policy): saves projection outputs,
-        # recomputes attention + elementwise — fits 16GB HBM at bs=32
+        # measured-best v5e config (PROFILE.md): selective remat with the
+        # flash kernel's o+lse pinned, unrolled layer loop (no scan
+        # residual-stacking copies), 256x1024 flash blocks, and gas=8 so
+        # the optimizer/step overhead amortizes over 8 microbatches
         cfg.remat = True
         cfg.use_flash = True
-        micro_bs, seq, steps = 32, 1024, 20
+        cfg.remat_policy = "dots_flash"
+        cfg.scan_layers = False
+        cfg.flash_block_q, cfg.flash_block_k = 256, 1024
+        micro_bs, seq, steps = 32, 1024, 8
+        gas = 8
     else:  # CPU smoke mode
         cfg = gpt2.GPT2Config(vocab_size=2048, max_seq_len=256, num_layers=4,
                               num_heads=8, hidden_size=256)
         micro_bs, seq, steps = 2, 128, 5
+        gas = 1
     if args.flash is not None:
         cfg.use_flash = args.flash
     if args.remat is not None:
@@ -88,9 +97,10 @@ def main():
     steps = args.steps or steps
     cfg.max_seq_len = max(cfg.max_seq_len, seq)
 
+    gas = args.gas or gas
     config = {
         "train_micro_batch_size_per_gpu": micro_bs,
-        "gradient_accumulation_steps": 1,
+        "gradient_accumulation_steps": gas,
         "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
         "bf16": {"enabled": True},
         "zero_optimization": {"stage": 1 if n_chips > 1 else 0},
@@ -106,13 +116,19 @@ def main():
         ).astype(np.int32)}
 
     # warmup / compile (both the single-step and the multi-step programs)
-    multi = args.multi if args.multi is not None else (5 if on_tpu else 1)
+    multi = args.multi if args.multi is not None else \
+        (5 if (on_tpu and gas == 1) else 1)
     multi = max(1, min(multi, steps))
     steps -= steps % multi
     for _ in range(2):
         _, m = engine.train_batch(batch())
     if multi > 1:
-        engine.train_batches([batch() for _ in range(multi)])
+        _, m = engine.train_batches([batch() for _ in range(multi)])
+    # NOTE: sync by fetching a metric VALUE, not jax.block_until_ready —
+    # on the tunneled axon backend block_until_ready returns without
+    # waiting, which would time only dispatch.  The final loss depends on
+    # the whole step chain, so fetching it bounds all 20 steps.
+    float(m["loss"])
     t0 = time.perf_counter()
     if multi > 1:
         for _ in range(steps // multi):
@@ -120,7 +136,7 @@ def main():
     else:
         for _ in range(steps):
             _, m = engine.train_batch(batch())
-    jax.block_until_ready(engine.state["params"])
+    float(m["loss"])
     dt = time.perf_counter() - t0
 
     tokens = engine.train_batch_size() * seq * steps

@@ -19,6 +19,7 @@ This is driver config #1's model (GPT-2 125M, reference BASELINE.json).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Dict, Optional
 
@@ -57,6 +58,14 @@ class GPT2Config:
     flash_block_k: int = 1024
     #: sequence-parallel attention impl when mesh sp>1: auto|ulysses|ring
     sp_impl: str = "auto"
+    #: Fused CE head: compute the head's input/weight cotangents during
+    #: forward (3 big head matmuls per step instead of the checkpointed
+    #: head's 4), chunked so at most [T/ce_chunks, V] logits are live.
+    #: Default OFF — measured slower than the checkpointed lse head on v5e
+    #: at GPT-2 size (the extra f32 softmax traffic beats the saved
+    #: matmul); the option remains for large-vocab/small-d models.
+    fused_ce: Optional[bool] = None
+    ce_chunks: int = 4
     #: True (default): execute the layer stack with lax.scan (O(1) compiled
     #: code size; the remat residuals of every iteration are stacked into
     #: [L, ...] buffers via dynamic-update-slice — measurable HBM write
@@ -320,6 +329,8 @@ def loss_from_batch(cfg: GPT2Config, params, batch, rng=None, train: bool = True
         labels = input_ids[:, 1:]
         input_ids = input_ids[:, :-1]
     x = _trunk(cfg, params, input_ids, rng=rng, train=train)
+    if getattr(cfg, "fused_ce", None):
+        return _head_loss_fused(cfg, params, x, labels)
     head = jax.checkpoint(lambda p, x, t: _head_loss(cfg, p, x, t),
                           policy=None)
     return head(params, x, labels)
@@ -363,6 +374,87 @@ def _head_loss(cfg: GPT2Config, params, x, targets):
                                  axis=-1)[..., 0].astype(jnp.float32)
     nll = lse - picked
     return jnp.where(valid, nll, 0.0).sum() / jnp.maximum(valid.sum(), 1)
+
+
+# ------------------------------------------------------------- fused CE head
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused_ce(w, x2d, targets, n_chunks):
+    loss, _ = _fused_ce_fwd(w, x2d, targets, n_chunks)
+    return loss
+
+
+def _fused_ce_fwd(w, x2d, targets, n_chunks):
+    """Chunked CE over a tied head: computes loss AND the (unscaled) input /
+    weight cotangents during the forward pass.
+
+    The checkpointed head (``loss_from_batch``) runs 4 full [T,D]x[D,V]
+    matmuls per train step (fwd logits, bwd recompute, dx, dW); computing
+    ``dlogits = softmax - onehot`` while the chunk's logits are live needs
+    only 3 and never materializes more than [T/n_chunks, V] of logits.  The
+    softmax/one-hot trick is textbook CE backward (cf. the reference's fused
+    logits kernels, ``csrc/transformer/softmax_kernels.cu``); loss scaling
+    happens in the vjp by the (linear) upstream cotangent.
+    """
+    n, d = x2d.shape
+    v = w.shape[1]
+    assert n % n_chunks == 0, (n, n_chunks)
+    c = n // n_chunks
+    xs = x2d.reshape(n_chunks, c, d)
+    ts = targets.reshape(n_chunks, c)
+    valid_all = targets >= 0
+    denom = jnp.maximum(valid_all.sum(), 1).astype(jnp.float32)
+
+    def chunk(xc, tc):
+        logits = (xc @ w).astype(jnp.float32)            # [c, V]
+        valid = tc >= 0
+        safe = jnp.where(valid, tc, 0)
+        lse = jax.nn.logsumexp(logits, axis=-1)           # [c]
+        picked = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+        loss = jnp.where(valid, lse - picked, 0.0).sum() / denom
+        # dlogits of mean-NLL (unscaled by upstream cotangent)
+        p = jnp.exp(logits - lse[:, None])
+        g = p.at[jnp.arange(c), safe].add(-1.0)
+        g = jnp.where(valid[:, None], g, 0.0) / denom     # [c, V] f32
+        gc = g.astype(w.dtype)
+        return loss, gc @ w.T, xc.T @ gc                  # loss, [c,D], [D,V]
+
+    # unrolled chunk loop (a scan's dw carry would copy [D, V] f32 per
+    # iteration and serialize; unrolled, XLA overlaps chunk i+1's logits
+    # with chunk i's grad matmuls)
+    loss = jnp.zeros((), jnp.float32)
+    dw = jnp.zeros((d, v), jnp.float32)
+    dxs = []
+    for i in range(n_chunks):
+        li, dxi, dwi = chunk(xs[i], ts[i])
+        loss += li
+        dw += dwi
+        dxs.append(dxi)
+    dx = jnp.concatenate(dxs, axis=0) if n_chunks > 1 else dxs[0]
+    # cotangent dtypes must match the primals (f32 accumulation, one cast —
+    # same precision as the bf16 matmul grads of the non-fused path)
+    return loss, (dw.astype(w.dtype), dx.astype(x2d.dtype))
+
+
+def _fused_ce_bwd(n_chunks, res, ct):
+    dw, dx = res
+    ct = ct.astype(jnp.float32)
+    return ((ct * dw.astype(jnp.float32)).astype(dw.dtype),
+            (ct * dx.astype(jnp.float32)).astype(dx.dtype), None)
+
+
+_fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
+
+
+def _head_loss_fused(cfg: GPT2Config, params, x, targets):
+    """LN + tied-head CE via the chunked fused-backward formulation."""
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    b, s, d = x.shape
+    n = b * s
+    n_chunks = getattr(cfg, "ce_chunks", 4)
+    while n % n_chunks:
+        n_chunks -= 1
+    return _fused_ce(params["wte"].T.astype(x.dtype), x.reshape(n, d),
+                     targets.reshape(n), n_chunks)
 
 
 def build(cfg: Optional[GPT2Config] = None, **overrides) -> ModelSpec:

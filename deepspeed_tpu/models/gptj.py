@@ -36,6 +36,8 @@ class GPTJConfig:
     rotary_dim: int = 64
     rope_theta: float = 10000.0
     mlp_ratio: int = 4
+    #: explicit FFN width (HF ``n_inner``); None = mlp_ratio * hidden_size
+    ffn_dim: Optional[int] = None
     dropout: float = 0.0
     remat: bool = False
 
@@ -46,7 +48,7 @@ class GPTJConfig:
 
     @property
     def ffn_size(self) -> int:
-        return self.hidden_size * self.mlp_ratio
+        return self.ffn_dim or self.hidden_size * self.mlp_ratio
 
     @staticmethod
     def gptj_6b() -> "GPTJConfig":
@@ -66,12 +68,13 @@ class GPTJConfig:
             num_layers=hf.n_layer,
             num_heads=hf.n_head,
             hidden_size=hf.n_embd,
-            rotary_dim=hf.rotary_dim or (hf.n_embd // hf.n_head))
+            rotary_dim=hf.rotary_dim or (hf.n_embd // hf.n_head),
+            ffn_dim=hf.n_inner or 4 * hf.n_embd)
 
     def num_params(self) -> int:
-        d, l, v, m = self.hidden_size, self.num_layers, self.vocab_size, \
-            self.mlp_ratio
-        per_layer = 4 * d * d + (2 * m * d * d + (m + 1) * d) + 2 * d
+        d, l, v, f = self.hidden_size, self.num_layers, self.vocab_size, \
+            self.ffn_size
+        per_layer = 4 * d * d + (2 * f * d + f + d) + 2 * d
         return v * d + l * per_layer + 2 * d + (v * d + v)
 
 

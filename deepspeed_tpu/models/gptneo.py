@@ -41,6 +41,8 @@ class GPTNeoConfig:
     #: per-layer attention kind, "global" | "local"; defaults to alternating
     attention_layers: Optional[List[str]] = None
     mlp_ratio: int = 4
+    #: explicit FFN width (HF ``intermediate_size``); None = 4 * hidden
+    ffn_dim: Optional[int] = None
     dropout: float = 0.0
     remat: bool = False
 
@@ -58,7 +60,7 @@ class GPTNeoConfig:
 
     @property
     def ffn_size(self) -> int:
-        return self.hidden_size * self.mlp_ratio
+        return self.ffn_dim or self.hidden_size * self.mlp_ratio
 
     @staticmethod
     def neo_1p3b() -> "GPTNeoConfig":
@@ -85,14 +87,13 @@ class GPTNeoConfig:
             hidden_size=hf.hidden_size,
             window_size=hf.window_size,
             attention_layers=list(hf.attention_layers),
-            mlp_ratio=(hf.intermediate_size // hf.hidden_size
-                       if hf.intermediate_size else 4))
+            ffn_dim=hf.intermediate_size or 4 * hf.hidden_size)
 
     def num_params(self) -> int:
-        d, l, v, m = self.hidden_size, self.num_layers, self.vocab_size, \
-            self.mlp_ratio
+        d, l, v, f = self.hidden_size, self.num_layers, self.vocab_size, \
+            self.ffn_size
         per_layer = 3 * d * d + (d * d + d) + \
-            (2 * m * d * d + (m + 1) * d) + 4 * d
+            (2 * f * d + f + d) + 4 * d
         return v * d + self.max_seq_len * d + l * per_layer + 2 * d
 
 

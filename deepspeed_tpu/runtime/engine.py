@@ -715,6 +715,18 @@ class DeepSpeedEngine:
             scale = scaler.cur_scale if fp16 else jnp.asarray(1.0, jnp.float32)
             step_rng = jax.random.fold_in(base_rng, state["step"])
 
+            if gas == 1:
+                # fast path: no accumulator (saves a zero-init + add pass
+                # over a full fp32 grad buffer per step)
+                micro = jax.tree_util.tree_map(lambda x: x[0], batch)
+                loss, grads = grads_of_micro(
+                    params, micro, jax.random.fold_in(step_rng, 0), scale)
+                inv = 1.0 / scale
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32) * inv, grads)
+                grads = constrain(grads, grad_shardings)
+                return grads, loss.astype(jnp.float32)
+
             zero_grads = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params)
             zero_grads = constrain(zero_grads, grad_shardings)
@@ -1022,11 +1034,13 @@ class DeepSpeedEngine:
         self.micro_steps += k * self.gradient_accumulation_steps()
         self.global_samples += k * self.train_batch_size()
         metrics = jax.tree_util.tree_map(lambda a: a[-1], mstack)
+        # mirror the timer's multi-step report condition (% < k, not == 0):
+        # a report without a sync would print dispatch-only throughput
         sync = metrics["loss"] if (self.global_steps %
-                                   max(self.steps_per_print(), 1) == 0) \
+                                   max(self.steps_per_print(), 1) < k) \
             else None
         self.tput_timer.stop(global_step=True, sync_arrays=sync, steps=k)
-        self._finalize_metrics(metrics)
+        self._finalize_metrics(metrics, steps=k)
         return self.state, self._cached_metrics
 
     def _train_step_offload(self, state, batch):
@@ -1132,16 +1146,18 @@ class DeepSpeedEngine:
         # (the getter prefers the live metrics' cumulative counter)
         self._cached_metrics = {}
 
-    def _finalize_metrics(self, metrics) -> None:
+    def _finalize_metrics(self, metrics, steps: int = 1) -> None:
         # Lazy: metrics stay device-side until someone reads them.  A
         # device_get here would force a host round-trip EVERY step (hundreds
         # of ms on remote/tunneled backends), serializing the pipeline; the
         # log/monitor branches below force them only every steps_per_print.
+        # ``steps``: report-window width for multi-step intervals (a k-step
+        # train_batches can jump over the == 0 boundary).
         self._cached_metrics = _LazyMetrics(metrics)
+        report = self.global_steps % max(self.steps_per_print(), 1) < steps
         if self.lr_scheduler is not None:
             self.lr_scheduler.step(self.global_steps)
-        if self.monitor.enabled and self.global_steps % max(
-                self.steps_per_print(), 1) == 0:
+        if self.monitor.enabled and report:
             events = [("Train/Samples/train_loss", self._cached_metrics["loss"],
                        self.global_samples),
                       ("Train/Samples/lr", self.get_lr()[0], self.global_samples)]
@@ -1150,7 +1166,7 @@ class DeepSpeedEngine:
                                self._cached_metrics["loss_scale"],
                                self.global_samples))
             self.monitor.write_events(events)
-        if self.global_steps % max(self.steps_per_print(), 1) == 0:
+        if report:
             log_dist(
                 f"step={self.global_steps} loss={self._cached_metrics['loss']:.4f} "
                 f"lr={self.get_lr()[0]:.3e} "

@@ -33,6 +33,32 @@ def base_config(**over):
     return cfg
 
 
+def test_gpt2_fused_ce_matches_checkpointed_head():
+    """fused_ce computes identical loss AND grads to the lse head,
+    including -100 label masking."""
+    cfg = gpt2.GPT2Config.tiny()
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 512, (4, 33)).astype(np.int32)
+    labels = ids[:, 1:].copy()
+    labels[0, :5] = -100
+    batch = {"input_ids": ids[:, :-1], "labels": labels}
+
+    cfg.fused_ce = False
+    l_ref, g_ref = jax.value_and_grad(
+        lambda p: gpt2.loss_from_batch(cfg, p, batch, train=False))(params)
+    cfg2 = gpt2.GPT2Config.tiny()
+    cfg2.fused_ce = True
+    cfg2.ce_chunks = 4
+    l_f, g_f = jax.value_and_grad(
+        lambda p: gpt2.loss_from_batch(cfg2, p, batch, train=False))(params)
+    np.testing.assert_allclose(float(l_ref), float(l_f), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(g_ref),
+                    jax.tree_util.tree_leaves(g_f)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-6)
+
+
 def test_llama_rope_rotation_identity():
     cfg = llama.LlamaConfig.tiny()
     cos, sin = llama.rope_angles(cfg, 8)
