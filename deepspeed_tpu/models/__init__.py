@@ -2,7 +2,8 @@
 
 import dataclasses
 
-from . import bert, bloom, gpt2, gptj, gptneo, gptneox, llama, mixtral, opt
+from . import (bert, bloom, clip, gpt2, gptj, gptneo, gptneox, llama,
+               mixtral, opt)
 
 
 def _with(cfg, overrides):
@@ -23,6 +24,8 @@ _NAMED = {
     "bertbase": lambda kw: bert.build(_with(bert.BertConfig.bert_base(), kw)),
     "bertlarge": lambda kw: bert.build(_with(bert.BertConfig.bert_large(),
                                              kw)),
+    "clip": lambda kw: clip.build(**kw),
+    "clipvitb32": lambda kw: clip.build(_with(clip.CLIPConfig.vit_b_32(), kw)),
     "bloom": lambda kw: bloom.build(**kw),
     "bloom560m": lambda kw: bloom.build(_with(bloom.BloomConfig.bloom_560m(),
                                               kw)),

@@ -1,0 +1,391 @@
+"""CLIP dual-tower encoder, TPU-native.
+
+Reference parity: the CLIP injection policy (``module_inject/replace_policy.py``
+HFCLIPLayerPolicy, ``containers/clip.py``) covers the pre-LN
+``CLIPEncoderLayer`` used by both towers; this module implements the full
+dual-tower model (text + vision + projections + contrastive logits) so the
+policy ingests complete HF ``CLIPModel`` checkpoints.
+
+Tower notes:
+ - text: causal attention, eot-pooled (argmax token id), quick-gelu MLP
+ - vision: patchify-as-matmul (a stride=patch conv is a reshape + one
+   [p*p*3, D] matmul on TPU — keeps the MXU busy instead of a conv), class
+   token, pre/post layernorms
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.topology import TP_AXIS
+from ..runtime.model import ModelSpec
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class CLIPConfig:
+    # text tower
+    vocab_size: int = 49408
+    text_seq_len: int = 77
+    text_layers: int = 12
+    text_heads: int = 8
+    text_width: int = 512
+    text_ffn: int = 2048
+    # vision tower
+    image_size: int = 224
+    patch_size: int = 32
+    vision_layers: int = 12
+    vision_heads: int = 12
+    vision_width: int = 768
+    vision_ffn: int = 3072
+    # joint space
+    projection_dim: int = 512
+    logit_scale_init: float = 2.6592
+    #: text pooling position: first occurrence of this token id; None =
+    #: highest-id token (argmax — the original CLIP convention)
+    eos_token_id: Optional[int] = 49407
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @staticmethod
+    def vit_b_32() -> "CLIPConfig":
+        return CLIPConfig()
+
+    @staticmethod
+    def tiny() -> "CLIPConfig":
+        return CLIPConfig(vocab_size=96, text_seq_len=16, text_layers=2,
+                          text_heads=4, text_width=32, text_ffn=64,
+                          image_size=32, patch_size=16, vision_layers=2,
+                          vision_heads=4, vision_width=48, vision_ffn=96,
+                          projection_dim=24)
+
+    @staticmethod
+    def from_hf(hf) -> "CLIPConfig":
+        t, v = hf.text_config, hf.vision_config
+        return CLIPConfig(
+            vocab_size=t.vocab_size, text_seq_len=t.max_position_embeddings,
+            text_layers=t.num_hidden_layers, text_heads=t.num_attention_heads,
+            text_width=t.hidden_size, text_ffn=t.intermediate_size,
+            image_size=v.image_size, patch_size=v.patch_size,
+            vision_layers=v.num_hidden_layers,
+            vision_heads=v.num_attention_heads,
+            vision_width=v.hidden_size, vision_ffn=v.intermediate_size,
+            projection_dim=hf.projection_dim,
+            logit_scale_init=hf.logit_scale_init_value,
+            # HF legacy branch: original OpenAI CLIP configs carry
+            # eos_token_id=2 (a bos id never emitted) and pool at
+            # argmax(input_ids) — map that to our argmax convention
+            eos_token_id=None if t.eos_token_id == 2 else t.eos_token_id)
+
+    def num_params(self) -> int:
+        def tower(l, d, f, extra):
+            per = 4 * (d * d + d) + (d * f + f) + (f * d + d) + 4 * d
+            return l * per + extra
+
+        text = tower(self.text_layers, self.text_width, self.text_ffn,
+                     (self.vocab_size + self.text_seq_len) * self.text_width +
+                     2 * self.text_width)
+        d = self.vision_width
+        vision = tower(self.vision_layers, d, self.vision_ffn,
+                       (3 * self.patch_size ** 2) * d + d +
+                       (self.num_patches + 1) * d + 4 * d)
+        proj = (self.text_width + self.vision_width) * self.projection_dim + 1
+        return text + vision + proj
+
+
+def _tower_init(keys, l, d, f, std=0.02):
+    def normal(key, shape, s=std):
+        return (jax.random.normal(key, shape) * s).astype(jnp.float32)
+
+    return {
+        "ln1_scale": jnp.ones((l, d)), "ln1_bias": jnp.zeros((l, d)),
+        "qkv_w": normal(keys[0], (l, d, 3 * d)), "qkv_b": jnp.zeros((l, 3 * d)),
+        "o_w": normal(keys[1], (l, d, d)), "o_b": jnp.zeros((l, d)),
+        "ln2_scale": jnp.ones((l, d)), "ln2_bias": jnp.zeros((l, d)),
+        "fc_w": normal(keys[2], (l, d, f)), "fc_b": jnp.zeros((l, f)),
+        "proj_w": normal(keys[3], (l, f, d)), "proj_b": jnp.zeros((l, d)),
+    }
+
+
+def init_params(cfg: CLIPConfig, rng) -> PyTree:
+    keys = jax.random.split(rng, 16)
+
+    def normal(key, shape, s=0.02):
+        return (jax.random.normal(key, shape) * s).astype(jnp.float32)
+
+    dt, dv = cfg.text_width, cfg.vision_width
+    return {
+        "text": {
+            "tok_emb": normal(keys[0], (cfg.vocab_size, dt)),
+            "pos_emb": normal(keys[1], (cfg.text_seq_len, dt)),
+            "blocks": _tower_init(keys[2:6], cfg.text_layers, dt, cfg.text_ffn),
+            "lnf_scale": jnp.ones((dt,)), "lnf_bias": jnp.zeros((dt,)),
+        },
+        "vision": {
+            "patch_w": normal(keys[6], (3 * cfg.patch_size ** 2, dv)),
+            "class_emb": normal(keys[7], (dv,)),
+            "pos_emb": normal(keys[8], (cfg.num_patches + 1, dv)),
+            "pre_ln_scale": jnp.ones((dv,)), "pre_ln_bias": jnp.zeros((dv,)),
+            "blocks": _tower_init(keys[9:13], cfg.vision_layers, dv,
+                                  cfg.vision_ffn),
+            "post_ln_scale": jnp.ones((dv,)), "post_ln_bias": jnp.zeros((dv,)),
+        },
+        "text_projection": normal(keys[13], (dt, cfg.projection_dim)),
+        "visual_projection": normal(keys[14], (dv, cfg.projection_dim)),
+        "logit_scale": jnp.asarray(cfg.logit_scale_init, jnp.float32),
+    }
+
+
+def _layer_norm(x, scale, bias, eps: float = 1e-5):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return ((x32 - mean) * jax.lax.rsqrt(var + eps) * scale +
+            bias).astype(x.dtype)
+
+
+def _quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def _block(x, layer, heads: int, causal: bool):
+    """Pre-LN CLIPEncoderLayer."""
+    b, s, d = x.shape
+    hd = d // heads
+
+    y = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+    qkv = y @ layer["qkv_w"].astype(y.dtype) + layer["qkv_b"].astype(y.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+    if causal:
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores.astype(jnp.float32), -1e30)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+    attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
+    x = x + attn @ layer["o_w"].astype(x.dtype) + layer["o_b"].astype(x.dtype)
+
+    y = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+    hid = _quick_gelu(y @ layer["fc_w"].astype(y.dtype) +
+                      layer["fc_b"].astype(y.dtype))
+    return x + hid @ layer["proj_w"].astype(x.dtype) + \
+        layer["proj_b"].astype(x.dtype)
+
+
+def _run_tower(x, blocks, heads: int, causal: bool):
+    def body(x, xs):
+        layer, = xs
+        return _block(x, layer, heads, causal), None
+
+    x, _ = jax.lax.scan(body, x, (blocks,))
+    return x
+
+
+def encode_text(cfg: CLIPConfig, params, input_ids):
+    """Pooled + projected text embeddings.  Pooling follows HF
+    ``CLIPTextModel``: the FIRST ``eos_token_id`` position when configured,
+    else the highest-id token (original CLIP argmax convention)."""
+    p = params["text"]
+    s = input_ids.shape[1]
+    x = (p["tok_emb"][input_ids] + p["pos_emb"][:s]).astype(
+        p["tok_emb"].dtype)
+    x = _run_tower(x, p["blocks"], cfg.text_heads, causal=True)
+    x = _layer_norm(x, p["lnf_scale"], p["lnf_bias"])
+    if cfg.eos_token_id is not None:
+        eot = jnp.argmax((input_ids == cfg.eos_token_id).astype(jnp.int32),
+                         axis=-1)
+    else:
+        eot = jnp.argmax(input_ids, axis=-1)
+    pooled = x[jnp.arange(x.shape[0]), eot]
+    return pooled @ params["text_projection"].astype(pooled.dtype)
+
+
+def _patchify(pixel_values, patch: int):
+    """[B, 3, H, W] -> [B, n_patches, 3*patch*patch], matching a
+    Conv2d(stride=patch) unfold with channel-major kernel layout."""
+    b, c, h, w = pixel_values.shape
+    gh, gw = h // patch, w // patch
+    x = pixel_values.reshape(b, c, gh, patch, gw, patch)
+    x = x.transpose(0, 2, 4, 1, 3, 5)          # [B, gh, gw, C, p, p]
+    return x.reshape(b, gh * gw, c * patch * patch)
+
+
+def encode_image(cfg: CLIPConfig, params, pixel_values):
+    """Pooled + projected image embeddings.  pixel_values: [B, 3, H, W]."""
+    p = params["vision"]
+    patches = _patchify(pixel_values, cfg.patch_size)
+    x = patches.astype(p["patch_w"].dtype) @ p["patch_w"]
+    cls = jnp.broadcast_to(p["class_emb"], (x.shape[0], 1, x.shape[-1]))
+    x = jnp.concatenate([cls.astype(x.dtype), x], axis=1)
+    x = x + p["pos_emb"].astype(x.dtype)
+    x = _layer_norm(x, p["pre_ln_scale"], p["pre_ln_bias"])
+    x = _run_tower(x, p["blocks"], cfg.vision_heads, causal=False)
+    pooled = _layer_norm(x[:, 0], p["post_ln_scale"], p["post_ln_bias"])
+    return pooled @ params["visual_projection"].astype(pooled.dtype)
+
+
+def forward(cfg: CLIPConfig, params, batch, rng=None, train: bool = True):
+    """Similarity logits: (logits_per_image, logits_per_text)."""
+    text = encode_text(cfg, params, batch["input_ids"])
+    image = encode_image(cfg, params, batch["pixel_values"])
+    text = text / jnp.linalg.norm(text, axis=-1, keepdims=True)
+    image = image / jnp.linalg.norm(image, axis=-1, keepdims=True)
+    scale = jnp.exp(params["logit_scale"])
+    logits_per_text = (text @ image.T).astype(jnp.float32) * scale
+    return logits_per_text.T, logits_per_text
+
+
+def loss_from_batch(cfg: CLIPConfig, params, batch, rng=None,
+                    train: bool = True):
+    """Symmetric InfoNCE over the in-batch pairs (CLIP pretraining loss)."""
+    logits_per_image, logits_per_text = forward(cfg, params, batch, rng, train)
+    n = logits_per_text.shape[0]
+    labels = jnp.arange(n)
+
+    def ce(logits):
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+        return (lse - picked).mean()
+
+    return 0.5 * (ce(logits_per_text) + ce(logits_per_image))
+
+
+def tp_rules(cfg: CLIPConfig, abstract_params: PyTree) -> PyTree:
+    def tower():
+        return {
+            "ln1_scale": P(), "ln1_bias": P(),
+            "qkv_w": P(None, None, TP_AXIS), "qkv_b": P(None, TP_AXIS),
+            "o_w": P(None, TP_AXIS, None), "o_b": P(),
+            "ln2_scale": P(), "ln2_bias": P(),
+            "fc_w": P(None, None, TP_AXIS), "fc_b": P(None, TP_AXIS),
+            "proj_w": P(None, TP_AXIS, None), "proj_b": P(),
+        }
+
+    return {
+        "text": {
+            "tok_emb": P(TP_AXIS, None), "pos_emb": P(),
+            "blocks": tower(),
+            "lnf_scale": P(), "lnf_bias": P(),
+        },
+        "vision": {
+            "patch_w": P(), "class_emb": P(), "pos_emb": P(),
+            "pre_ln_scale": P(), "pre_ln_bias": P(),
+            "blocks": tower(),
+            "post_ln_scale": P(), "post_ln_bias": P(),
+        },
+        "text_projection": P(), "visual_projection": P(),
+        "logit_scale": P(),
+    }
+
+
+# --------------------------------------------------------------------- HF I/O
+def _np(t) -> np.ndarray:
+    return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach") else t,
+                      dtype=np.float32)
+
+
+def _tower_from_hf(sd, prefix: str, l: int):
+    def get(name):
+        return _np(sd[prefix + name])
+
+    def stack(fmt, fn=lambda x: x):
+        return jnp.asarray(np.stack([fn(get(fmt.format(i=i)))
+                                     for i in range(l)]))
+
+    def fuse_qkv(i):
+        ws = [get(f"layers.{i}.self_attn.{p}_proj.weight").T
+              for p in ("q", "k", "v")]
+        return np.concatenate(ws, axis=1)
+
+    def fuse_qkv_b(i):
+        return np.concatenate([get(f"layers.{i}.self_attn.{p}_proj.bias")
+                               for p in ("q", "k", "v")])
+
+    t = lambda w: w.T
+    return {
+        "ln1_scale": stack("layers.{i}.layer_norm1.weight"),
+        "ln1_bias": stack("layers.{i}.layer_norm1.bias"),
+        "qkv_w": jnp.asarray(np.stack([fuse_qkv(i) for i in range(l)])),
+        "qkv_b": jnp.asarray(np.stack([fuse_qkv_b(i) for i in range(l)])),
+        "o_w": stack("layers.{i}.self_attn.out_proj.weight", t),
+        "o_b": stack("layers.{i}.self_attn.out_proj.bias"),
+        "ln2_scale": stack("layers.{i}.layer_norm2.weight"),
+        "ln2_bias": stack("layers.{i}.layer_norm2.bias"),
+        "fc_w": stack("layers.{i}.mlp.fc1.weight", t),
+        "fc_b": stack("layers.{i}.mlp.fc1.bias"),
+        "proj_w": stack("layers.{i}.mlp.fc2.weight", t),
+        "proj_b": stack("layers.{i}.mlp.fc2.bias"),
+    }
+
+
+def from_hf_state_dict(cfg: CLIPConfig, sd: Dict[str, Any]) -> PyTree:
+    def get(name):
+        return _np(sd[name])
+
+    # HF conv kernel [D, 3, p, p] -> our [3*p*p, D] (channel-major rows,
+    # matching _patchify's [C, p, p] flatten order)
+    conv = get("vision_model.embeddings.patch_embedding.weight")
+    d = conv.shape[0]
+    patch_w = conv.reshape(d, -1).T
+
+    return {
+        "text": {
+            "tok_emb": jnp.asarray(
+                get("text_model.embeddings.token_embedding.weight")),
+            "pos_emb": jnp.asarray(
+                get("text_model.embeddings.position_embedding.weight")),
+            "blocks": _tower_from_hf(sd, "text_model.encoder.",
+                                     cfg.text_layers),
+            "lnf_scale": jnp.asarray(get("text_model.final_layer_norm.weight")),
+            "lnf_bias": jnp.asarray(get("text_model.final_layer_norm.bias")),
+        },
+        "vision": {
+            "patch_w": jnp.asarray(patch_w),
+            "class_emb": jnp.asarray(
+                get("vision_model.embeddings.class_embedding")),
+            "pos_emb": jnp.asarray(
+                get("vision_model.embeddings.position_embedding.weight")),
+            "pre_ln_scale": jnp.asarray(get("vision_model.pre_layrnorm.weight")),
+            "pre_ln_bias": jnp.asarray(get("vision_model.pre_layrnorm.bias")),
+            "blocks": _tower_from_hf(sd, "vision_model.encoder.",
+                                     cfg.vision_layers),
+            "post_ln_scale": jnp.asarray(
+                get("vision_model.post_layernorm.weight")),
+            "post_ln_bias": jnp.asarray(
+                get("vision_model.post_layernorm.bias")),
+        },
+        "text_projection": jnp.asarray(get("text_projection.weight").T),
+        "visual_projection": jnp.asarray(get("visual_projection.weight").T),
+        "logit_scale": jnp.asarray(get("logit_scale")),
+    }
+
+
+def build(cfg: Optional[CLIPConfig] = None, **overrides) -> ModelSpec:
+    cfg = cfg or CLIPConfig(**overrides)
+
+    def init_fn(rng):
+        return init_params(cfg, rng)
+
+    def loss_fn(params, batch, rng=None, train=True):
+        return loss_from_batch(cfg, params, batch, rng=rng, train=train)
+
+    def apply_fn(params, batch, rng=None):
+        return forward(cfg, params, batch, rng=rng, train=False)
+
+    return ModelSpec(init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
+                     tp_rules=lambda ap: tp_rules(cfg, ap),
+                     flops_per_token=6.0 * cfg.num_params(),
+                     name=f"clip-{cfg.vision_layers}l-{cfg.vision_width}d")

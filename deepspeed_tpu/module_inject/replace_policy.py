@@ -332,6 +332,24 @@ _register("GPTNeoForCausalLM", _gptneo_translate, _gptneo_convert,
           _gptneo_build)
 
 
+def _clip_translate(hf):
+    from ..models.clip import CLIPConfig
+    return CLIPConfig.from_hf(hf)
+
+
+def _clip_convert(cfg, sd):
+    from ..models.clip import from_hf_state_dict
+    return from_hf_state_dict(cfg, sd)
+
+
+def _clip_build(cfg):
+    from ..models import clip
+    return clip.build(cfg)
+
+
+_register("CLIPModel", _clip_translate, _clip_convert, _clip_build)
+
+
 def generic_policies():
     return list(_POLICIES.values())
 

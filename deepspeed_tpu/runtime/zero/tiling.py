@@ -1,0 +1,135 @@
+"""Tiled linear for memory-bounded large projections (ZeRO extras).
+
+Reference parity: ``runtime/zero/tiling.py`` (``TiledLinear``) and
+``runtime/zero/linear.py`` (``LinearFunctionForZeroStage3``).  The torch
+version splits one big ``nn.Linear`` into a grid of sub-Linears so ZeRO-3
+partitions/gathers one tile's weights at a time, bounding live gathered
+memory at ``O(tile)`` instead of ``O(in x out)``.
+
+TPU-native design: the weight is stored as a ``[in_splits, out_splits]``
+grid of tiles in the param pytree.  The forward loops over tiles with each
+tile's matmul wrapped in ``jax.checkpoint`` — under ZeRO-3 sharding XLA
+gathers a tile right before its matmul and frees it after (the scan/loop
+structure is the same seam the per-layer gather uses, ``models/gpt2.py``),
+and the backward regathers tiles instead of keeping them live.  The
+memory-efficient-linear half of the reference (don't save gathered weights
+for backward) is exactly ``jax.checkpoint``'s contract, so no separate
+class is needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+
+def _splits(total: int, n: int):
+    """Near-uniform split sizes (reference ``partition_uniform`` semantics:
+    all remainder distributed to the leading splits)."""
+    assert 1 <= n <= total, (total, n)
+    base, rem = divmod(total, n)
+    return [base + (1 if i < rem else 0) for i in range(n)]
+
+
+class TiledLinear:
+    """Functional tiled linear: ``init_params`` + ``__call__``.
+
+    ``in_splits`` tiles the contraction dim (partial products summed),
+    ``out_splits`` tiles the output dim (results concatenated).  Gradients
+    and outputs are bitwise-comparable to the dense linear up to float
+    summation order.
+    """
+
+    def __init__(self, in_features: int, out_features: int, bias: bool = True,
+                 in_splits: int = 1, out_splits: int = 1,
+                 combine_out_splits: bool = True, remat: bool = True):
+        self.in_features = in_features
+        self.out_features = out_features
+        self.use_bias = bias
+        self.in_sizes = _splits(in_features, in_splits)
+        self.out_sizes = _splits(out_features, out_splits)
+        self.combine_out_splits = combine_out_splits
+        self.remat = remat
+
+    def init_params(self, rng, std: float = 0.02, dtype=jnp.float32) -> PyTree:
+        """Weight grid ``tiles[i][j]: [in_sizes[i], out_sizes[j]]`` + bias."""
+        keys = jax.random.split(rng, len(self.in_sizes) * len(self.out_sizes))
+        tiles = []
+        k = 0
+        for ins in self.in_sizes:
+            row = []
+            for outs in self.out_sizes:
+                row.append((jax.random.normal(keys[k], (ins, outs)) *
+                            std).astype(dtype))
+                k += 1
+            tiles.append(row)
+        params = {"tiles": tiles}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.out_features,), dtype)
+        return params
+
+    @staticmethod
+    def from_dense(w, b=None, in_splits: int = 1, out_splits: int = 1,
+                   remat: bool = True) -> "tuple[TiledLinear, PyTree]":
+        """Split an existing dense ``[in, out]`` weight into a tiled layer
+        (the reference's ``copy_params_from``)."""
+        w = np.asarray(w)
+        tl = TiledLinear(w.shape[0], w.shape[1], bias=b is not None,
+                         in_splits=in_splits, out_splits=out_splits,
+                         remat=remat)
+        tiles = []
+        r0 = 0
+        for ins in tl.in_sizes:
+            row = []
+            c0 = 0
+            for outs in tl.out_sizes:
+                row.append(jnp.asarray(w[r0:r0 + ins, c0:c0 + outs]))
+                c0 += outs
+            tiles.append(row)
+            r0 += ins
+        params = {"tiles": tiles}
+        if b is not None:
+            params["bias"] = jnp.asarray(np.asarray(b))
+        return tl, params
+
+    def __call__(self, params: PyTree, x, input_is_already_split: bool = False):
+        """x: [..., in_features] (or a pre-split list when
+        ``input_is_already_split``, reference ``tiling.py`` forward)."""
+        if input_is_already_split:
+            xs = list(x)
+            assert len(xs) == len(self.in_sizes)
+        elif len(self.in_sizes) == 1:
+            xs = [x]
+        else:
+            xs = jnp.split(x, np.cumsum(self.in_sizes)[:-1].tolist(), axis=-1)
+
+        def tile_matmul(w, xi):
+            return xi @ w.astype(xi.dtype)
+
+        if self.remat:
+            tile_matmul = jax.checkpoint(tile_matmul)
+
+        outs = []
+        for j in range(len(self.out_sizes)):
+            acc = None
+            for i, xi in enumerate(xs):
+                y = tile_matmul(params["tiles"][i][j], xi)
+                acc = y if acc is None else acc + y
+            outs.append(acc)
+        if self.use_bias:
+            off = 0
+            with_bias = []
+            for j, o in enumerate(outs):
+                bj = jax.lax.dynamic_slice_in_dim(
+                    params["bias"], off, self.out_sizes[j]).astype(o.dtype)
+                with_bias.append(o + bj)
+                off += self.out_sizes[j]
+            outs = with_bias
+        if self.combine_out_splits:
+            return jnp.concatenate(outs, axis=-1) if len(outs) > 1 else outs[0]
+        return outs

@@ -15,9 +15,10 @@ from contextlib import contextmanager
 
 from .runtime.zero.config import (DeepSpeedZeroConfig, OffloadDeviceEnum,
                                   ZeroStageEnum)
+from .runtime.zero.tiling import TiledLinear
 
 __all__ = ["Init", "GatheredParameters", "DeepSpeedZeroConfig",
-           "ZeroStageEnum", "OffloadDeviceEnum"]
+           "ZeroStageEnum", "OffloadDeviceEnum", "TiledLinear"]
 
 
 @contextmanager

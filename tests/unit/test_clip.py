@@ -1,0 +1,98 @@
+"""CLIP tests: HF parity for both towers + the similarity logits, and
+contrastive training."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import clip
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+def _tiny_hf_clip():
+    text_cfg = transformers.CLIPTextConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=16, eos_token_id=95)
+    vision_cfg = transformers.CLIPVisionConfig(
+        hidden_size=48, num_hidden_layers=2, num_attention_heads=4,
+        intermediate_size=96, image_size=32, patch_size=16)
+    cfg = transformers.CLIPConfig.from_text_vision_configs(
+        text_cfg, vision_cfg, projection_dim=24)
+    with torch.no_grad():
+        m = transformers.CLIPModel(cfg)
+    m.eval()
+    return m
+
+
+def test_clip_matches_hf():
+    hf = _tiny_hf_clip()
+    spec, params = deepspeed_tpu.module_inject.replace_module(hf_model=hf)
+    rng = np.random.default_rng(0)
+    # eot token (argmax pooling) = highest id, placed mid-sequence
+    ids = rng.integers(1, 90, (3, 12)).astype(np.int32)
+    ids[:, 7] = 95
+    pixels = rng.normal(size=(3, 3, 32, 32)).astype(np.float32)
+    ours_img, ours_txt = spec.apply_fn(
+        params, {"input_ids": ids, "pixel_values": pixels})
+    with torch.no_grad():
+        out = hf(input_ids=torch.tensor(ids.astype(np.int64)),
+                 pixel_values=torch.tensor(pixels))
+    # logit_scale (e^2.66 ~ 14x) amplifies the towers' f32 rounding
+    np.testing.assert_allclose(np.asarray(ours_img),
+                               out.logits_per_image.numpy(),
+                               atol=5e-2, rtol=5e-3)
+    np.testing.assert_allclose(np.asarray(ours_txt),
+                               out.logits_per_text.numpy(),
+                               atol=5e-2, rtol=5e-3)
+
+
+def test_clip_legacy_eos2_pools_argmax():
+    """OpenAI CLIP configs ship eos_token_id=2 (HF's legacy branch pools at
+    argmax(input_ids)); from_hf must map that to our argmax convention."""
+    text_cfg = transformers.CLIPTextConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=16, eos_token_id=2)
+    vision_cfg = transformers.CLIPVisionConfig(
+        hidden_size=48, num_hidden_layers=2, num_attention_heads=4,
+        intermediate_size=96, image_size=32, patch_size=16)
+    cfg = transformers.CLIPConfig.from_text_vision_configs(
+        text_cfg, vision_cfg, projection_dim=24)
+    with torch.no_grad():
+        hf = transformers.CLIPModel(cfg)
+    hf.eval()
+    spec, params = deepspeed_tpu.module_inject.replace_module(hf_model=hf)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(3, 90, (2, 12)).astype(np.int32)
+    ids[:, 5] = 95  # highest id mid-sequence: the argmax pooling position
+    from deepspeed_tpu.models.clip import CLIPConfig, encode_text
+    ccfg = CLIPConfig.from_hf(hf.config)
+    assert ccfg.eos_token_id is None
+    ours = np.asarray(encode_text(ccfg, params, ids))
+    with torch.no_grad():
+        theirs = hf.get_text_features(
+            input_ids=torch.tensor(ids.astype(np.int64))).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=5e-3)
+
+
+def test_clip_contrastive_training():
+    deepspeed_tpu.comm.reset_topology()
+    cfg = clip.CLIPConfig.tiny()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=clip.build(cfg),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "mesh": {}})
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 90, (engine.train_batch_size(), 12)).astype(np.int32)
+    pixels = rng.normal(size=(engine.train_batch_size(), 3, 32, 32)).astype(
+        np.float32)
+    batch = {"input_ids": ids, "pixel_values": pixels}
+    losses = []
+    for _ in range(8):
+        _, m = engine.train_batch(batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] - 0.3, losses

@@ -1,0 +1,73 @@
+"""TiledLinear tests (reference tests/unit/runtime/zero/test_zero_tiled.py):
+tiled forward/backward must match the dense linear."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.zero.tiling import TiledLinear, _splits
+
+
+def test_splits_uniform_and_remainder():
+    assert _splits(12, 3) == [4, 4, 4]
+    assert _splits(13, 3) == [5, 4, 4]
+    with pytest.raises(AssertionError):
+        _splits(2, 3)
+
+
+@pytest.mark.parametrize("in_splits,out_splits", [(1, 1), (2, 3), (3, 2),
+                                                  (4, 4)])
+def test_tiled_matches_dense(in_splits, out_splits):
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(24, 36)).astype(np.float32)
+    b = rng.normal(size=(36,)).astype(np.float32)
+    x = rng.normal(size=(5, 24)).astype(np.float32)
+
+    tl, params = TiledLinear.from_dense(w, b, in_splits=in_splits,
+                                        out_splits=out_splits)
+    y = np.asarray(tl(params, jnp.asarray(x)))
+    np.testing.assert_allclose(y, x @ w + b, rtol=1e-5, atol=1e-5)
+
+
+def test_tiled_gradients_match_dense():
+    rng = np.random.default_rng(1)
+    w = rng.normal(size=(16, 10)).astype(np.float32)
+    x = rng.normal(size=(4, 16)).astype(np.float32)
+
+    tl, params = TiledLinear.from_dense(w, None, in_splits=2, out_splits=2)
+
+    def tiled_loss(p):
+        return (tl(p, jnp.asarray(x)) ** 2).sum()
+
+    def dense_loss(wd):
+        return ((jnp.asarray(x) @ wd) ** 2).sum()
+
+    g_tiled = jax.grad(tiled_loss)(params)
+    g_dense = np.asarray(jax.grad(dense_loss)(jnp.asarray(w)))
+
+    # reassemble the tile grads into the dense layout
+    rows = []
+    r0 = 0
+    for i, ins in enumerate(tl.in_sizes):
+        cols = [np.asarray(g_tiled["tiles"][i][j])
+                for j in range(len(tl.out_sizes))]
+        rows.append(np.concatenate(cols, axis=1))
+        r0 += ins
+    g_re = np.concatenate(rows, axis=0)
+    np.testing.assert_allclose(g_re, g_dense, rtol=1e-5, atol=1e-5)
+
+
+def test_tiled_presplit_input_and_uncombined_output():
+    rng = np.random.default_rng(2)
+    w = rng.normal(size=(12, 8)).astype(np.float32)
+    x = rng.normal(size=(3, 12)).astype(np.float32)
+    tl, params = TiledLinear.from_dense(w, None, in_splits=3, out_splits=2)
+    tl.combine_out_splits = False
+    xs = np.split(x, np.cumsum(tl.in_sizes)[:-1], axis=-1)
+    outs = tl(params, [jnp.asarray(p) for p in xs],
+              input_is_already_split=True)
+    assert len(outs) == 2
+    np.testing.assert_allclose(np.concatenate([np.asarray(o) for o in outs],
+                                              axis=-1),
+                               x @ w, rtol=1e-5, atol=1e-5)
