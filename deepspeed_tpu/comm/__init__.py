@@ -4,5 +4,6 @@ from .comm import (ReduceOp, all_gather, all_gather_host, all_reduce,
                    get_mesh, get_process_rank, get_process_world_size, get_rank,
                    get_topology, get_world_size, get_data_parallel_world_size,
                    get_expert_parallel_world_size, get_model_parallel_world_size,
-                   init_distributed, is_initialized, log_summary, pmean, ppermute,
-                   reduce_scatter, reset_topology, set_topology)
+                   host_all_reduce_sum, init_distributed, is_initialized,
+                   log_summary, pmean, ppermute, reduce_scatter,
+                   reset_topology, set_topology)

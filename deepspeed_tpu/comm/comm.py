@@ -24,6 +24,7 @@ from typing import Any, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..utils import comms_logging
@@ -270,6 +271,27 @@ def barrier(log_name: str = "barrier") -> None:
         multihost_utils.sync_global_devices(log_name)
     _record("barrier", jnp.zeros(()), None,
             latency=(time.perf_counter() - t0) * 1000.0, log_name=log_name)
+
+
+def host_all_reduce_sum(arrays, log_name: str = "host_all_reduce"):
+    """Sum a list of host numpy arrays across PROCESSES (outside jit).
+
+    The host-side analog of the reference's NCCL allreduce on CPU tensors —
+    used by the multi-host param-streaming tier to combine per-process block
+    gradients before the host optimizer step.  Single-process: identity.
+    """
+    t0 = time.perf_counter()
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        arrays = [np.asarray(multihost_utils.process_allgather(a)).sum(0)
+                  for a in arrays]
+    for a in arrays:
+        _record("all_reduce", a, None,
+                latency=(time.perf_counter() - t0) * 1000.0,
+                log_name=log_name)
+        t0 = time.perf_counter()
+    return arrays
 
 
 def broadcast(tensor, src: int = 0, log_name: str = "broadcast"):

@@ -16,9 +16,10 @@ see :func:`resume_notes` for the operational recipe.
 """
 
 from .config import ElasticityConfig, ElasticityConfigError, ElasticityError
+from .elastic_agent import ElasticAgent
 from .elasticity import (compute_elastic_config, elasticity_enabled,
                          get_compatible_accelerator_counts, resume_notes)
 
-__all__ = ["ElasticityConfig", "ElasticityConfigError", "ElasticityError",
-           "compute_elastic_config", "elasticity_enabled",
+__all__ = ["ElasticAgent", "ElasticityConfig", "ElasticityConfigError",
+           "ElasticityError", "compute_elastic_config", "elasticity_enabled",
            "get_compatible_accelerator_counts", "resume_notes"]

@@ -60,11 +60,41 @@ class InferenceEngine:
                 is_leaf=lambda x: isinstance(x, P))
         else:
             shardings = jax.tree_util.tree_map(lambda _: rep, params)
+
+        # INT8 weight-only storage (reference GroupQuantizer /
+        # ZeRO-Inference): weights live in HBM as int8 + per-group scales.
+        # quant-aware models (ModelSpec.quant_aware) dequantize lazily at
+        # point of use — per-LAYER peak memory; for others the engine
+        # dequantizes the whole tree at jit entry (int8 halves RESTING
+        # weight memory but the forward's peak holds a full-precision copy)
+        self._quantized = config.quant.enabled
+        if self._quantized:
+            from ..ops import quantization as quant
+
+            params = quant.quantize_pytree(
+                params, num_bits=config.quant.num_bits,
+                group_size=config.quant.group_size)
+            shardings = jax.tree_util.tree_map(
+                lambda x, s: ({k: (s if k == "q" else rep) for k in x}
+                              if quant.is_quantized(x) else s),
+                params, shardings, is_leaf=quant.is_quantized)
+            if model.quant_aware:
+                self._prepare = lambda p: p
+            else:
+                log_dist(
+                    f"quant: model {model.name} is not quant_aware — "
+                    "dequantizing the full tree at jit entry (peak memory "
+                    "includes a full-precision copy)", ranks=[0])
+                self._prepare = lambda p: quant.dequantize_pytree(
+                    p, config.jnp_dtype)
+        else:
+            self._prepare = lambda p: p
         self.params = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, s), params, shardings)
 
+        prepare = self._prepare
         self._forward_fn = jax.jit(
-            lambda p, batch: model.apply_fn(p, batch, None))
+            lambda p, batch: model.apply_fn(prepare(p), batch, None))
         self._generate_fns: Dict[Any, Any] = {}
         log_dist(f"InferenceEngine: mesh={self.topology}, dtype={config.dtype}",
                  ranks=[0])
@@ -137,8 +167,10 @@ class InferenceEngine:
         """Full-recompute fallback for models without decode hooks."""
         apply_fn = self.module.apply_fn
         pick = _make_token_picker(sample_cfg)
+        prepare = self._prepare
 
         def gen(params, ids, rng):
+            params = prepare(params)
             buf = jnp.zeros((b, total), jnp.int32)
             buf = buf.at[:, :prompt_len].set(ids)
 
@@ -162,8 +194,10 @@ class InferenceEngine:
         cache_len = -(-total // 128) * 128
         cache_dtype = self._config.jnp_dtype
         pick = _make_token_picker(sample_cfg)
+        prepare = self._prepare
 
         def gen(params, ids, rng):
+            params = prepare(params)
             cache = init_cache(b, cache_len, cache_dtype)
             buf = jnp.zeros((b, total), jnp.int32)
             buf = buf.at[:, :prompt_len].set(ids)

@@ -158,12 +158,44 @@ def _remat_policy(cfg):
 _warned_sp_dropout = False
 
 
+def _maybe_dequant(layer, dtype):
+    """Expand INT8 weight records (ops/quantization) for ONE layer slice —
+    the point-of-use dequant that keeps peak memory at one layer of
+    full-precision weights when the engine stores blocks as int8."""
+    from ..ops import quantization as quant
+
+    return jax.tree_util.tree_map(
+        lambda v: quant.dequantize(v, dtype) if quant.is_quantized(v) else v,
+        layer, is_leaf=quant.is_quantized)
+
+
+def _dequant_resident(params, dtype=None):
+    """Dequantize the small resident params (embeddings, final LN) up front;
+    the stacked ``blocks`` stay int8 and expand per layer in ``_block``."""
+    from ..ops import quantization as quant
+
+    leaves = jax.tree_util.tree_leaves(params, is_leaf=quant.is_quantized)
+    if not any(quant.is_quantized(v) for v in leaves):
+        return params
+    if dtype is None:
+        # compute dtype = dtype of the small unquantized float leaves
+        # (norm scales stay below quantize_pytree's min_size filter)
+        dtype = next((v.dtype for v in leaves
+                      if not quant.is_quantized(v)
+                      and jnp.issubdtype(v.dtype, jnp.floating)),
+                     jnp.bfloat16)
+    out = {k: (_maybe_dequant(v, dtype) if k != "blocks" else v)
+           for k, v in params.items()}
+    return out
+
+
 def _block(cfg: GPT2Config, x, layer, mask, rng, dropout: float):
     """One transformer block. x: [B, S, D]; layer: per-layer param slice.
     ``mask=None`` means pure causal; the flash/SP fast paths require it (they
     implement causality internally and would silently drop a custom mask)."""
     b, s, d = x.shape
     h, hd = cfg.num_heads, cfg.head_dim
+    layer = _maybe_dequant(layer, x.dtype)
 
     y = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
     qkv = y @ layer["qkv_w"].astype(y.dtype) + layer["qkv_b"].astype(y.dtype)
@@ -218,6 +250,7 @@ def _block(cfg: GPT2Config, x, layer, mask, rng, dropout: float):
 def forward(cfg: GPT2Config, params: PyTree, input_ids, rng=None,
             train: bool = True):
     """Token logits. input_ids: [B, S] int32."""
+    params = _dequant_resident(params)
     x = _trunk(cfg, params, input_ids, rng=rng, train=train)
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
     logits = x @ params["wte"].T.astype(x.dtype)
@@ -238,6 +271,7 @@ def _block_cached(cfg: GPT2Config, x, layer, ck, cv, pos):
 
     b, t, d = x.shape
     h, hd = cfg.num_heads, cfg.head_dim
+    layer = _maybe_dequant(layer, x.dtype)
 
     y = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
     qkv = y @ layer["qkv_w"].astype(y.dtype) + layer["qkv_b"].astype(y.dtype)
@@ -260,6 +294,7 @@ def _block_cached(cfg: GPT2Config, x, layer, ck, cv, pos):
 
 def forward_cached(cfg: GPT2Config, params, input_ids, cache, pos):
     """Incremental forward: logits for the LAST input position + updated cache."""
+    params = _dequant_resident(params)
     b, t = input_ids.shape
     d = cfg.hidden_size
     pos = jnp.asarray(pos, jnp.int32)
@@ -497,4 +532,5 @@ def build(cfg: Optional[GPT2Config] = None, **overrides) -> ModelSpec:
                      flops_per_token=6.0 * cfg.num_params(),
                      pipeline_hooks=pipeline_hooks,
                      decode_hooks=decode_hooks,
+                     quant_aware=True,
                      name=f"gpt2-{cfg.num_layers}l-{cfg.hidden_size}d")

@@ -1,0 +1,107 @@
+"""Group-wise INT8 weight quantization (storage-level, not fake-quant).
+
+Reference parity: ``csrc/quantization/{quantize.cu,dequantize.cu,
+pt_binding.cpp}`` (symmetric/asymmetric group (de)quantization) and the
+INT8 weight path of DS-Inference (``module_inject/replace_module.py:152
+GroupQuantizer``) / ZeRO-Inference weight quantization
+(``docs/_posts/2022-09-10-zero-inference.md``).
+
+TPU design: weights are stored in HBM as int8 plus per-group f32 scales
+(groups along the LAST axis); dequantization happens inside the jitted
+forward right at the point of use, where XLA fuses the
+``(q - zero) * scale`` expansion into the consumer — there is no separate
+kernel to launch, so the "kernel" here is the storage format + the fused
+expansion.  The training-time fake-quant STE lives in
+:mod:`deepspeed_tpu.compression.ops`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+_QKEYS = frozenset({"q", "scale", "zero"})
+
+
+def is_quantized(leaf) -> bool:
+    """True for a quantized-weight record produced by :func:`quantize`."""
+    return isinstance(leaf, dict) and _QKEYS.issuperset(leaf) and "q" in leaf
+
+
+def quantize(w, num_bits: int = 8, group_size: int = 64,
+             symmetric: bool = True) -> dict:
+    """w: float array, last dim divisible by ``group_size`` ->
+    ``{"q": int8 (w.shape), "scale": f32 [..., G], ["zero": f32 [..., G]]}``.
+    """
+    assert num_bits == 8, "int8 storage only (num_bits=8)"
+    shape = w.shape
+    assert shape[-1] % group_size == 0, (shape, group_size)
+    g = w.astype(jnp.float32).reshape(shape[:-1] + (-1, group_size))
+    if symmetric:
+        amax = jnp.max(jnp.abs(g), axis=-1)
+        scale = jnp.where(amax == 0, 1.0, amax / 127.0)
+        q = jnp.clip(jnp.round(g / scale[..., None]), -127, 127)
+        return {"q": q.astype(jnp.int8).reshape(shape), "scale": scale}
+    lo = jnp.min(g, axis=-1)
+    hi = jnp.max(g, axis=-1)
+    scale = jnp.where(hi == lo, 1.0, (hi - lo) / 255.0)
+    zero = lo
+    q = jnp.clip(jnp.round((g - zero[..., None]) / scale[..., None]),
+                 0, 255) - 128
+    return {"q": q.astype(jnp.int8).reshape(shape), "scale": scale,
+            "zero": zero}
+
+
+def dequantize(rec: dict, dtype=jnp.bfloat16):
+    """Group size is implicit: q.shape[-1] // scale.shape[-1] (keeps the
+    record free of non-array leaves so device_put/tree_map stay trivial)."""
+    q = rec["q"]
+    gs = q.shape[-1] // rec["scale"].shape[-1]
+    shape = q.shape
+    g = q.astype(jnp.float32).reshape(shape[:-1] + (-1, gs))
+    if "zero" in rec:
+        w = (g + 128.0) * rec["scale"][..., None] + rec["zero"][..., None]
+    else:
+        w = g * rec["scale"][..., None]
+    return w.reshape(shape).astype(dtype)
+
+
+def quantize_pytree(params: PyTree, num_bits: int = 8, group_size: int = 64,
+                    symmetric: bool = True, min_size: int = 4096) -> PyTree:
+    """Quantize every float leaf with >= ``min_size`` elements, >= 2 dims,
+    and a last dim divisible by ``group_size``; others pass through."""
+    def one(x):
+        if (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+                and getattr(x, "ndim", 0) >= 2 and x.size >= min_size
+                and x.shape[-1] % group_size == 0):
+            return quantize(x, num_bits, group_size, symmetric)
+        return x
+
+    return jax.tree_util.tree_map(one, params)
+
+
+def dequantize_pytree(params: PyTree, dtype=jnp.bfloat16) -> PyTree:
+    """Inverse of :func:`quantize_pytree` (called INSIDE jit so XLA fuses
+    the expansion into consumers)."""
+    return jax.tree_util.tree_map(
+        lambda x: dequantize(x, dtype) if is_quantized(x) else x,
+        params, is_leaf=is_quantized)
+
+
+def quantized_nbytes(params: PyTree) -> int:
+    """Storage accounting (int8 + scales), for memory reports."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(
+            params, is_leaf=is_quantized):
+        if is_quantized(leaf):
+            total += leaf["q"].size + leaf["scale"].size * 4
+            if "zero" in leaf:
+                total += leaf["zero"].size * 4
+        elif hasattr(leaf, "nbytes"):
+            total += leaf.nbytes
+    return total

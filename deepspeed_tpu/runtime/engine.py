@@ -180,7 +180,11 @@ class DeepSpeedEngine:
 
         # ZeRO plan
         self.zero_stage = self._config.zero_optimization_stage
-        self.zero_plan = ZeroShardingPlan(self.zero_stage, self.mesh)
+        self.zero_plan = ZeroShardingPlan(
+            self.zero_stage, self.mesh,
+            param_persistence_threshold=(
+                self._config.zero_config.param_persistence_threshold
+                if self.zero_stage >= 3 else 0))
 
         # ZeRO-Offload / ZeRO-Infinity: optimizer state lives on host
         # (DRAM or NVMe) and steps through the C++ CPU optimizer
@@ -213,9 +217,18 @@ class DeepSpeedEngine:
                     "offload_param needs a block-structured model "
                     "(ModelSpec.pipeline_hooks) so layers can stream "
                     "one scan step at a time")
-            assert jax.process_count() == 1, (
-                "param streaming is single-controller for now (multi-host "
-                "needs a host-side grad reduction)")
+            if jax.process_count() > 1 and not os.environ.get(
+                    "DS_PARAM_STREAM_MULTIHOST_UNVALIDATED"):
+                # the grad-push io_callback's per-process cotangent semantics
+                # (partial vs already-reduced) have NOT been validated on a
+                # real pod; a wrong guess silently double-counts streamed
+                # block grads.  The host reduction path exists
+                # (comm.host_all_reduce_sum in _host_apply) — opt in with
+                # DS_PARAM_STREAM_MULTIHOST_UNVALIDATED=1 to exercise it.
+                raise RuntimeError(
+                    "offload_param is single-controller until the multi-host "
+                    "grad-push semantics are pod-validated; set "
+                    "DS_PARAM_STREAM_MULTIHOST_UNVALIDATED=1 to opt in")
             if self.topology.pipe_parallel_size > 1:
                 raise ValueError(
                     "offload_param with pp>1 is unsupported: the pipeline "
@@ -1078,6 +1091,10 @@ class DeepSpeedEngine:
             block_grads = self._param_store.pop_grads()
             for g in block_grads:
                 g *= factor
+            if jax.process_count() > 1:
+                # combine per-process contributions (each process's grad-push
+                # callbacks saw only its addressable devices' cotangents)
+                block_grads = dist.host_all_reduce_sum(block_grads)
             if self.fp16_enabled and not overflow:
                 block_overflow = not all(np.isfinite(g).all()
                                          for g in block_grads)

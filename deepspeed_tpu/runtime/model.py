@@ -45,6 +45,11 @@ class ModelSpec:
     #: ``pos`` is the (traced) global position of input_ids[:, 0]; the same
     #: function serves prefill (T=prompt) and decode (T=1).
     decode_hooks: Optional[dict] = None
+    #: True = the model's forwards dequantize INT8 weight records
+    #: (ops/quantization) lazily at point of use, so the inference engine
+    #: passes the quantized pytree straight through — per-layer peak memory
+    #: instead of a whole-tree dequantized copy.
+    quant_aware: bool = False
 
     def init(self, rng) -> PyTree:
         return self.init_fn(rng)

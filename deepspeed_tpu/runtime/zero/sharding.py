@@ -95,17 +95,26 @@ class ZeroShardingPlan:
     """
 
     def __init__(self, stage: int, mesh: Mesh,
-                 zero_axes: Tuple[str, ...] = ZERO_AXES):
+                 zero_axes: Tuple[str, ...] = ZERO_AXES,
+                 param_persistence_threshold: int = 0):
         assert 0 <= stage <= 3
         self.stage = stage
         self.mesh = mesh
         self.zero_axes = zero_axes
+        #: stage-3 persistent params (reference
+        #: ``parameter_offload.py:316 mark_persistent_parameters``): arrays
+        #: with <= this many elements stay replicated instead of
+        #: ZeRO-sharded, so small tensors (norms, biases) are never
+        #: all-gathered per use
+        self.param_persistence_threshold = param_persistence_threshold
 
     def _named(self, spec: P) -> NamedSharding:
         return NamedSharding(self.mesh, spec)
 
     def param_spec(self, shape: Tuple[int, ...], tp_spec: Optional[P]) -> P:
         if self.stage >= 3:
+            if int(np.prod(shape)) <= self.param_persistence_threshold:
+                return tp_spec if tp_spec is not None else P()
             return shard_over_zero_axes(shape, tp_spec, self.mesh, self.zero_axes)
         return tp_spec if tp_spec is not None else P()
 

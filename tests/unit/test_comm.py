@@ -107,6 +107,12 @@ def test_host_ops():
     np.testing.assert_allclose(out["a"], x["a"])
     gathered = comm.all_gather_host(np.arange(3.0))
     assert np.asarray(gathered).shape == (1, 3)
+    # host all-reduce: single-process identity (multi-host sums over
+    # process_allgather — the param-streaming grad-combine path)
+    arrs = [np.arange(4.0), np.ones((2, 3))]
+    out = comm.host_all_reduce_sum(arrs)
+    for a, b in zip(out, arrs):
+        np.testing.assert_allclose(a, b)
 
 
 def test_comms_logger_records(mesh8):

@@ -1,0 +1,82 @@
+"""ElasticAgent tests (reference elastic_agent.py DSElasticAgent):
+supervision, restart-on-failure, membership-change restart, world election.
+Workers are tiny subprocesses — no jax involved."""
+
+import json
+import sys
+
+import pytest
+
+from deepspeed_tpu.elasticity.elastic_agent import ElasticAgent
+
+CFG = {"elasticity": {"enabled": True, "max_train_batch_size": 16,
+                      "micro_batch_sizes": [1, 2], "min_gpus": 1,
+                      "max_gpus": 16, "min_time": 0,
+                      "prefer_larger_batch": True, "version": 0.2},
+       "train_micro_batch_size_per_gpu": 2,
+       "gradient_accumulation_steps": 1}
+
+
+def _agent(probe, launch, **kw):
+    kw.setdefault("monitor_interval", 0.1)
+    return ElasticAgent(CFG, probe, launch, **kw)
+
+
+def test_elect_world_picks_largest_valid():
+    agent = _agent(lambda: [], lambda h, e: [])
+    hosts = [f"h{i}" for i in range(5)]
+    # valid chip counts include 4 (16/4=4 micro 2 gas 2 etc.); 5 is not a
+    # divisor-friendly count for batch 16 -> largest valid <= 5 is 4
+    elected = agent.elect_world(hosts)
+    assert len(elected) == 4
+    assert elected == hosts[:4]
+
+
+def test_elect_world_incompatible_raises():
+    agent = _agent(lambda: [], lambda h, e: [], chips_per_host=32)
+    with pytest.raises(RuntimeError):
+        agent.elect_world(["h0"])
+
+
+def test_run_succeeds_when_workers_exit_zero():
+    agent = _agent(lambda: ["a", "b"],
+                   lambda host, env: [sys.executable, "-c", "pass"])
+    assert agent.run() == 0
+    assert agent.restart_count == 0
+
+
+def test_run_restarts_on_failure(tmp_path):
+    """First generation fails; after the flag file exists workers succeed."""
+    flag = tmp_path / "ok"
+    prog = (f"import os,sys;"
+            f"sys.exit(0 if os.path.exists({str(flag)!r}) else "
+            f"(open({str(flag)!r},'w').close() or 1))")
+    agent = _agent(lambda: ["a", "b"],
+                   lambda host, env: [sys.executable, "-c", prog])
+    assert agent.run() == 0
+    assert agent.restart_count >= 1
+    # restart count surfaced to workers via env
+    env = agent._env_for("a", 0, ["a", "b"])
+    assert env["DS_ELASTIC_RESTART_COUNT"] == str(agent.restart_count)
+
+
+def test_membership_change_triggers_restart(tmp_path):
+    """Hosts shrink 4 -> 2 mid-run: the group restarts on 2 hosts."""
+    state = {"calls": 0}
+    log = tmp_path / "worlds.jsonl"
+
+    def probe():
+        state["calls"] += 1
+        return ["a", "b", "c", "d"] if state["calls"] <= 1 else ["a", "b"]
+
+    prog = ("import os,time,json;"
+            f"f=open({str(log)!r},'a');"
+            "json.dump({'n': os.environ['JAX_NUM_PROCESSES']}, f);"
+            "f.write('\\n');f.close();"
+            "time.sleep(16.0)")  # must outlive startup of 4 workers on 1 cpu
+    agent = _agent(probe, lambda host, env: [sys.executable, "-c", prog],
+                   monitor_interval=8.0)
+    assert agent.run() == 0
+    worlds = [json.loads(l)["n"] for l in log.read_text().splitlines()]
+    assert "4" in worlds and "2" in worlds
+    assert agent.restart_count >= 1

@@ -96,10 +96,21 @@ def test_zero_stage_matches_baseline(stage):
     np.testing.assert_allclose(base_losses, z_losses, rtol=2e-4, atol=1e-5)
 
 
-def test_zero3_state_is_sharded(eight_devices):
+def test_zero3_small_params_stay_persistent(eight_devices):
+    """Default stage3_param_persistence_threshold (1e5, reference
+    ``parameter_offload.py:316``) keeps tiny params replicated."""
     deepspeed_tpu.comm.reset_topology()
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=tiny_model(), config=base_config(zero_optimization={"stage": 3}))
+    qkv = engine.state["params"]["blocks"]["qkv_w"]  # 24k elems < 1e5
+    assert qkv.addressable_shards[0].data.size == qkv.size
+
+
+def test_zero3_state_is_sharded(eight_devices):
+    deepspeed_tpu.comm.reset_topology()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=tiny_model(), config=base_config(zero_optimization={
+            "stage": 3, "stage3_param_persistence_threshold": 0}))
     qkv = engine.state["params"]["blocks"]["qkv_w"]
     # 8-way dp: each device holds 1/8 of the tensor
     shard_size = qkv.addressable_shards[0].data.size
