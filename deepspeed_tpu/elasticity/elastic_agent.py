@@ -61,8 +61,11 @@ class ElasticAgent:
         self._hosts: List[str] = []
 
     # ------------------------------------------------------------------ sizing
-    def elect_world(self, hosts: Sequence[str]) -> List[str]:
-        """Largest prefix of ``hosts`` whose chip count is elastic-valid."""
+    def elect_world(self, hosts: Sequence[str],
+                    verbose: bool = True) -> List[str]:
+        """Largest prefix of ``hosts`` whose chip count is elastic-valid.
+        ``verbose=False`` for the steady-state monitor probe (the election
+        log belongs to starts/restarts, not every tick)."""
         final_batch, valid_counts = compute_elastic_config(
             self.ds_config, world_size=0)
         best: Optional[int] = None
@@ -76,9 +79,26 @@ class ElasticAgent:
                 f"no elastic-compatible world size for {len(hosts)} hosts x "
                 f"{self.chips_per_host} chips (valid chip counts: "
                 f"{valid_counts})")
-        logger.info(f"elastic: electing {best}/{len(hosts)} hosts "
-                    f"(global batch {final_batch})")
+        if verbose:
+            logger.info(f"elastic: electing {best}/{len(hosts)} hosts "
+                        f"(global batch {final_batch})")
         return list(hosts)[:best]
+
+    def _elect_retrying(self) -> Optional[List[str]]:
+        """Probe + elect, waiting out transient capacity loss: retries every
+        ``monitor_interval`` until a compatible world exists or the restart
+        budget is spent.  Returns None when the budget runs out."""
+        attempts = 0
+        while True:
+            try:
+                return self.elect_world(self.probe_hosts())
+            except RuntimeError as e:
+                attempts += 1
+                if self.restart_count + attempts > self.max_restarts:
+                    logger.error(f"elastic: {e}; giving up")
+                    return None
+                logger.warning(f"elastic: {e}; waiting for capacity")
+                time.sleep(self.monitor_interval)
 
     # ------------------------------------------------------------------ launch
     def _env_for(self, host: str, rank: int, hosts: List[str]) -> Dict[str, str]:
@@ -117,12 +137,16 @@ class ElasticAgent:
 
     # ----------------------------------------------------------------- monitor
     def _group_state(self) -> str:
-        """SUCCEEDED (all 0), FAILED (any non-zero), HEALTHY (running)."""
+        """SUCCEEDED (all 0), FAILED (any non-zero), PARTIAL (some exited 0
+        while peers run — the survivors will hang in collectives waiting for
+        the missing process, so the group must restart), HEALTHY."""
         codes = [p.poll() for p in self._procs.values()]
         if any(c is not None and c != 0 for c in codes):
             return "FAILED"
         if all(c == 0 for c in codes) and codes:
             return "SUCCEEDED"
+        if any(c == 0 for c in codes):
+            return "PARTIAL"
         return "HEALTHY"
 
     def run(self) -> int:
@@ -138,7 +162,8 @@ class ElasticAgent:
             membership = None
             if state == "HEALTHY":
                 try:
-                    membership = self.elect_world(self.probe_hosts())
+                    membership = self.elect_world(self.probe_hosts(),
+                                                  verbose=False)
                 except RuntimeError:
                     membership = self._hosts  # keep running with who we have
                 if membership == self._hosts:
@@ -147,11 +172,14 @@ class ElasticAgent:
                     f"elastic: membership change {len(self._hosts)} -> "
                     f"{len(membership)} hosts; restarting group")
             else:
-                logger.warning("elastic: worker failure; restarting group")
+                logger.warning(
+                    f"elastic: worker group {state}; restarting")
             self._stop_group()
             self.restart_count += 1
             if self.restart_count > self.max_restarts:
                 logger.error("elastic: restart budget exhausted")
                 return 1
-            hosts = membership or self.elect_world(self.probe_hosts())
+            hosts = membership or self._elect_retrying()
+            if hosts is None:
+                return 1
             self._start_group(hosts)

@@ -72,13 +72,22 @@ def dequantize(rec: dict, dtype=jnp.bfloat16):
 
 
 def quantize_pytree(params: PyTree, num_bits: int = 8, group_size: int = 64,
-                    symmetric: bool = True, min_size: int = 4096) -> PyTree:
-    """Quantize every float leaf with >= ``min_size`` elements, >= 2 dims,
-    and a last dim divisible by ``group_size``; others pass through."""
+                    symmetric: bool = True, min_size: int = 4096,
+                    min_penultimate: int = 64) -> PyTree:
+    """Quantize WEIGHT-MATRIX-like float leaves; others pass through.
+
+    A leaf qualifies when it has >= ``min_size`` elements, >= 2 dims, a
+    last dim divisible by ``group_size``, and ``shape[-2] >=
+    min_penultimate``.  The penultimate-dim test is what separates real
+    matrices ([.., d_in, d_out], embeddings [V, d]) from per-layer-STACKED
+    norm scales and biases ([L, d] with small L) — quantizing those would
+    inject multiplicative error into every normalization while saving
+    almost nothing (the weight-only posture of the reference INT8 path)."""
     def one(x):
         if (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
                 and getattr(x, "ndim", 0) >= 2 and x.size >= min_size
-                and x.shape[-1] % group_size == 0):
+                and x.shape[-1] % group_size == 0
+                and x.shape[-2] >= min_penultimate):
             return quantize(x, num_bits, group_size, symmetric)
         return x
 

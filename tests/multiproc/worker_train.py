@@ -1,0 +1,65 @@
+"""Multi-process test worker: train tiny GPT-2 under a 2-device-per-process
+mesh and dump per-step losses.  Launched by test_multiprocess.py with
+``argv = pid nprocs port steps outfile`` (the DistributedExec analog,
+reference tests/unit/common.py:71 — real cross-process collectives, no GPU).
+"""
+
+import json
+import os
+import sys
+
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=2")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+pid, nprocs, port, steps = (int(sys.argv[1]), int(sys.argv[2]),
+                            int(sys.argv[3]), int(sys.argv[4]))
+outfile = sys.argv[5]
+
+if nprocs > 1:
+    jax.distributed.initialize(coordinator_address=f"localhost:{port}",
+                               num_processes=nprocs, process_id=pid)
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt2
+
+GLOBAL_BS = 4
+
+engine, _, _, _ = deepspeed_tpu.initialize(
+    model=gpt2.build(gpt2.GPT2Config.tiny()),
+    config={"train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "steps_per_print": 100,
+            "mesh": {}})
+assert engine.train_batch_size() == GLOBAL_BS, engine.train_batch_size()
+
+rng = np.random.default_rng(0)  # same batches in every process
+rows_per_proc = GLOBAL_BS // nprocs
+losses = []
+for _ in range(steps):
+    full = rng.integers(0, 512, size=(GLOBAL_BS, 17)).astype(np.int32)
+    local = full[pid * rows_per_proc:(pid + 1) * rows_per_proc]
+    # multi-process contract (DeepSpeedDataLoader process_shard): each
+    # controller passes its LOCAL rows, stacked [gas, local_rows, ...]
+    _, m = engine.train_batch({"input_ids": local[None]})
+    losses.append(float(m["loss"]))
+
+# exercise the host-level collective surface too
+deepspeed_tpu.comm.barrier("test")
+red = deepspeed_tpu.comm.host_all_reduce_sum([np.ones(3) * (pid + 1)])
+with open(outfile, "w") as f:
+    json.dump({"losses": losses, "host_sum": red[0].tolist(),
+               "world": jax.device_count(),
+               "procs": jax.process_count()}, f)

@@ -28,12 +28,18 @@ def test_quantize_roundtrip_error_bounded(symmetric):
 def test_quantize_pytree_filters():
     params = {"big": jnp.ones((64, 128)), "small": jnp.ones((4, 4)),
               "ints": jnp.ones((64, 128), jnp.int32),
-              "odd": jnp.ones((64, 100))}  # 100 % 64 != 0
+              "odd": jnp.ones((64, 100)),  # 100 % 64 != 0
+              "stacked_norms": jnp.ones((12, 768)),  # [L, d] — not a matrix
+              "stacked_weights": jnp.ones((12, 768, 256))}
     q = quant.quantize_pytree(params, group_size=64, min_size=1024)
     assert quant.is_quantized(q["big"])
     assert not quant.is_quantized(q["small"])
     assert not quant.is_quantized(q["ints"])
     assert not quant.is_quantized(q["odd"])
+    # weight-only: stacked per-layer norm scales/biases ([L, d], small
+    # penultimate dim) must NOT be quantized; stacked matrices must
+    assert not quant.is_quantized(q["stacked_norms"])
+    assert quant.is_quantized(q["stacked_weights"])
     assert quant.quantized_nbytes(q) < sum(
         x.nbytes for x in params.values())
 
