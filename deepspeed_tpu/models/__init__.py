@@ -3,7 +3,7 @@
 import dataclasses
 
 from . import (bert, bloom, clip, gpt2, gptj, gptneo, gptneox, llama,
-               mixtral, opt)
+               mixtral, opt, unet, vae)
 
 
 def _with(cfg, overrides):
@@ -24,6 +24,10 @@ _NAMED = {
     "bertbase": lambda kw: bert.build(_with(bert.BertConfig.bert_base(), kw)),
     "bertlarge": lambda kw: bert.build(_with(bert.BertConfig.bert_large(),
                                              kw)),
+    "vae": lambda kw: vae.build(**kw),
+    "sdvae": lambda kw: vae.build(_with(vae.VAEConfig.sd_vae(), kw)),
+    "unet": lambda kw: unet.build(**kw),
+    "sdunet": lambda kw: unet.build(_with(unet.UNetConfig.sd_unet(), kw)),
     "clip": lambda kw: clip.build(**kw),
     "clipvitb32": lambda kw: clip.build(_with(clip.CLIPConfig.vit_b_32(), kw)),
     "bloom": lambda kw: bloom.build(**kw),
