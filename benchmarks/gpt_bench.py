@@ -37,6 +37,8 @@ def main():
     ap.add_argument("--trials", type=int, default=10)
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--int8", action="store_true",
+                    help="INT8 weight-only storage (quant.enabled)")
     args = ap.parse_args()
 
     import jax
@@ -50,7 +52,8 @@ def main():
     engine = deepspeed_tpu.init_inference(
         model=model,
         config={"dtype": args.dtype,
-                "tensor_parallel": {"tp_size": args.tp}})
+                "tensor_parallel": {"tp_size": args.tp},
+                "quant": {"enabled": args.int8}})
 
     rng = np.random.default_rng(0)
     vocab = 1000  # prompt token range; any real vocab exceeds this
