@@ -84,6 +84,43 @@ class SchedulerConfigBlock(DeepSpeedConfigModel):
     params: Dict[str, Any] = Field(default_factory=dict)
 
 
+#: "auto"-resolvable keys with hidden-size formulas (the values the HF
+#: integration fills in, ``reference docs integrations``); without a model
+#: hidden size the key is dropped so the schema default applies
+_AUTO_HIDDEN_FORMULAS = {
+    "reduce_bucket_size": lambda h: h * h,
+    "stage3_prefetch_bucket_size": lambda h: int(0.9 * h * h),
+    "stage3_param_persistence_threshold": lambda h: 10 * h,
+}
+
+
+def resolve_auto_config(pd: dict, hidden_size: Optional[int] = None) -> dict:
+    """Resolve reference-style ``"auto"`` values (``config.py`` "auto"
+    contract: the autotuner / HF integration substitutes concrete values;
+    standalone, "auto" means "derive or default").
+
+    - batch-triple keys: ``"auto"`` -> unset (the triple derivation fills
+      them, ``_configure_train_batch_size``)
+    - ZeRO bucket/threshold keys: hidden-size formulas when ``hidden_size``
+      is known, else schema defaults
+    - anything else ``"auto"``: dropped -> schema default
+    """
+    def walk(d):
+        out = {}
+        for k, v in d.items():
+            if isinstance(v, dict):
+                out[k] = walk(v)
+            elif isinstance(v, str) and v == "auto":
+                if hidden_size and k in _AUTO_HIDDEN_FORMULAS:
+                    out[k] = _AUTO_HIDDEN_FORMULAS[k](hidden_size)
+                # else: drop the key -> default/derivation applies
+            else:
+                out[k] = v
+        return out
+
+    return walk(pd)
+
+
 class DeepSpeedConfig:
     """Parsed + validated master config.
 
@@ -106,6 +143,7 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError(
                 f"expected a dict or json path, got {type(config)}")
 
+        self._param_dict = resolve_auto_config(self._param_dict)
         self.mesh_config: Dict[str, int] = dict(self._param_dict.get(C.MESH, {}))
         if world_size is not None:
             self.world_size = world_size

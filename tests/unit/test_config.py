@@ -156,3 +156,29 @@ def test_gradient_clipping():
     cfg = DeepSpeedConfig({"train_batch_size": 8, "gradient_clipping": 1.0},
                           world_size=1)
     assert cfg.gradient_clipping == 1.0
+
+
+def test_auto_values_resolve():
+    """Reference "auto" contract: batch keys derive, ZeRO buckets use the
+    hidden-size formulas when known, unknown autos fall to defaults."""
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                              resolve_auto_config)
+
+    pd = {"train_batch_size": 16,
+          "train_micro_batch_size_per_gpu": "auto",
+          "gradient_accumulation_steps": "auto",
+          "gradient_clipping": "auto",
+          "zero_optimization": {"stage": 3, "reduce_bucket_size": "auto",
+                                "stage3_prefetch_bucket_size": "auto",
+                                "stage3_param_persistence_threshold": "auto"}}
+    cfg = DeepSpeedConfig(dict(pd), world_size=4)
+    assert cfg.train_batch_size == 16
+    assert cfg.train_micro_batch_size_per_gpu * 4 * \
+        cfg.gradient_accumulation_steps == 16
+    # schema defaults applied for the dropped autos
+    assert cfg.zero_config.param_persistence_threshold == int(1e5)
+
+    resolved = resolve_auto_config(pd, hidden_size=768)
+    z = resolved["zero_optimization"]
+    assert z["reduce_bucket_size"] == 768 * 768
+    assert z["stage3_param_persistence_threshold"] == 7680
