@@ -38,6 +38,15 @@ class AsyncIOHandle:
     def has_native(self) -> bool:
         return self._handle is not None
 
+    @property
+    def backend(self) -> str:
+        """"io_uring" (kernel-async ring, the libaio analog), "threads"
+        (worker-pool fallback), or "python" (no toolchain)."""
+        if self._handle is None:
+            return "python"
+        return "io_uring" if self._lib.ds_aio_backend(self._handle) else \
+            "threads"
+
     def async_pread(self, buf: np.ndarray, path: str, offset: int = 0) -> None:
         assert buf.flags.c_contiguous
         if self._handle is not None:

@@ -186,6 +186,8 @@ class AsyncIOBuilder(OpBuilder):
         lib.ds_aio_pwrite.argtypes = [vp, ctypes.c_char_p, vp, i64, i64]
         lib.ds_aio_wait.argtypes = [vp]
         lib.ds_aio_wait.restype = i64
+        lib.ds_aio_backend.argtypes = [vp]
+        lib.ds_aio_backend.restype = ctypes.c_int
         return lib
 
 
