@@ -234,7 +234,8 @@ class InferenceEngine:
             import time
             t0 = time.perf_counter()
             out = orig(p, batch)
-            jax.tree_util.tree_leaves(out)[0].block_until_ready()
+            # fetch a value: block_until_ready no-ops on tunneled backends
+            jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0])
             self._model_times.append(time.perf_counter() - t0)
             return out
 

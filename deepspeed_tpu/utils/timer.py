@@ -27,12 +27,18 @@ TRAIN_BATCH_TIMER = "train_batch"
 
 
 def _sync(arrays) -> None:
+    """Force completion by FETCHING a value — on tunneled/remote backends
+    (axon) ``jax.block_until_ready`` returns at enqueue time, which would
+    make every timer here measure dispatch only (see PROFILE.md)."""
     if arrays is None:
         return
     try:
         import jax
 
-        jax.block_until_ready(arrays)
+        for leaf in jax.tree_util.tree_leaves(arrays):
+            jax.device_get(leaf.ravel()[0] if getattr(leaf, "ndim", 0) > 0
+                           else leaf)
+            break  # one value bounds the whole program
     except Exception:
         pass
 
