@@ -149,16 +149,29 @@ class ElasticAgent:
             return "PARTIAL"
         return "HEALTHY"
 
+    #: monitor ticks a 0-exited/still-running mix may persist before the
+    #: group restarts — normal completion skew (workers finish seconds
+    #: apart) must NOT trigger a restart; only survivors genuinely hung in
+    #: collectives waiting for an exited peer should
+    partial_grace_ticks: int = 3
+
     def run(self) -> int:
         """Supervise until success or restart budget exhaustion (the
         reference's ``_invoke_run`` loop)."""
         self._start_group(self.elect_world(self.probe_hosts()))
+        partial_ticks = 0
         while True:
             time.sleep(self.monitor_interval)
             state = self._group_state()
             if state == "SUCCEEDED":
                 logger.info("elastic: worker group finished")
                 return 0
+            if state == "PARTIAL":
+                partial_ticks += 1
+                if partial_ticks <= self.partial_grace_ticks:
+                    continue  # completion skew; give peers time to finish
+            else:
+                partial_ticks = 0
             membership = None
             if state == "HEALTHY":
                 try:
@@ -183,3 +196,4 @@ class ElasticAgent:
             if hosts is None:
                 return 1
             self._start_group(hosts)
+            partial_ticks = 0
