@@ -48,7 +48,8 @@ class ElasticAgent:
                  chips_per_host: int = 1,
                  master_port: int = 29500,
                  monitor_interval: float = 5.0,
-                 max_restarts: int = 100):
+                 max_restarts: int = 100,
+                 partial_grace_ticks: int = 3):
         self.ds_config = ds_config
         self.probe_hosts = probe_hosts
         self.launch_cmd = launch_cmd
@@ -56,6 +57,10 @@ class ElasticAgent:
         self.master_port = master_port
         self.monitor_interval = monitor_interval
         self.max_restarts = max_restarts
+        #: monitor ticks a 0-exited/still-running mix may persist before a
+        #: restart — completion skew must not restart the group; only
+        #: survivors genuinely hung waiting on an exited peer should
+        self.partial_grace_ticks = partial_grace_ticks
         self.restart_count = 0
         self._procs: Dict[str, subprocess.Popen] = {}
         self._hosts: List[str] = []
@@ -148,12 +153,6 @@ class ElasticAgent:
         if any(c == 0 for c in codes):
             return "PARTIAL"
         return "HEALTHY"
-
-    #: monitor ticks a 0-exited/still-running mix may persist before the
-    #: group restarts — normal completion skew (workers finish seconds
-    #: apart) must NOT trigger a restart; only survivors genuinely hung in
-    #: collectives waiting for an exited peer should
-    partial_grace_ticks: int = 3
 
     def run(self) -> int:
         """Supervise until success or restart budget exhaustion (the

@@ -1,7 +1,9 @@
 """Multi-process test worker: train tiny GPT-2 under a 2-device-per-process
 mesh and dump per-step losses.  Launched by test_multiprocess.py with
-``argv = pid nprocs port steps outfile`` (the DistributedExec analog,
-reference tests/unit/common.py:71 — real cross-process collectives, no GPU).
+``argv = pid nprocs port steps outfile [save_dir] [load_dir]`` (the
+DistributedExec/DistributedFixture analog, reference tests/unit/common.py:71
+and :202 — real cross-process collectives, no GPU; checkpoints written
+under one world shape are resumed under another).
 """
 
 import json
@@ -21,6 +23,8 @@ jax.config.update("jax_platforms", "cpu")
 pid, nprocs, port, steps = (int(sys.argv[1]), int(sys.argv[2]),
                             int(sys.argv[3]), int(sys.argv[4]))
 outfile = sys.argv[5]
+save_dir = sys.argv[6] if len(sys.argv) > 6 and sys.argv[6] != "-" else None
+load_dir = sys.argv[7] if len(sys.argv) > 7 and sys.argv[7] != "-" else None
 
 if nprocs > 1:
     jax.distributed.initialize(coordinator_address=f"localhost:{port}",
@@ -45,6 +49,10 @@ engine, _, _, _ = deepspeed_tpu.initialize(
             "mesh": {}})
 assert engine.train_batch_size() == GLOBAL_BS, engine.train_batch_size()
 
+if load_dir:
+    path, _ = engine.load_checkpoint(load_dir)
+    assert path is not None, f"checkpoint load silently no-oped: {load_dir}"
+
 rng = np.random.default_rng(0)  # same batches in every process
 rows_per_proc = GLOBAL_BS // nprocs
 losses = []
@@ -55,6 +63,9 @@ for _ in range(steps):
     # controller passes its LOCAL rows, stacked [gas, local_rows, ...]
     _, m = engine.train_batch({"input_ids": local[None]})
     losses.append(float(m["loss"]))
+
+if save_dir:
+    engine.save_checkpoint(save_dir)
 
 # exercise the host-level collective surface too
 deepspeed_tpu.comm.barrier("test")
