@@ -53,6 +53,14 @@ def main(argv=None):
         print(f"{name:20} {GREEN_OK if compatible else RED_NO:12} "
               f"{GREEN_OK if loaded else RED_NO}")
     print(f"g++ {DOT} {shutil.which('g++') or 'not found'}")
+    try:
+        from .ops.aio import AsyncIOHandle
+
+        h = AsyncIOHandle(num_threads=1)
+        print(f"aio engine {DOT} {h.backend}")
+        h.close()
+    except Exception as e:  # report must never crash on a probe
+        print(f"aio engine {DOT} probe failed ({type(e).__name__})")
 
     print("-" * 60)
     print("DeepSpeed-TPU general environment info")

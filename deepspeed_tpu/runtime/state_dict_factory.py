@@ -39,6 +39,23 @@ def _load_safetensors(path: str) -> Dict[str, Any]:
         return load_torch(path)
 
 
+def get_sd_loader(ckpt_list, sd_type: str = "Megatron", version=None):
+    """SDLoaderFactory dispatch (reference ``state_dict_factory.py:42``):
+    returns a loader callable for the checkpoint family.  The Megatron
+    branch delegates to :mod:`deepspeed_tpu.models.megatron_gpt` (TP-shard
+    merge across all three qkv layout versions)."""
+    if str(sd_type).lower() != "megatron":
+        raise ValueError(f"unsupported sd_type {sd_type!r} (Megatron only; "
+                         "HF checkpoints load via load_hf_weights)")
+    from ..models import megatron_gpt
+
+    def load(cfg=None):
+        return megatron_gpt.load(list(ckpt_list), cfg=cfg,
+                                 ckpt_version=version)
+
+    return load
+
+
 def get_sd_loader_json(ckpt_dir: str) -> List[str]:
     """Resolve the shard file list for a checkpoint directory.
 

@@ -154,3 +154,27 @@ def test_megatron_inferred_config_multi_shard():
     with torch.no_grad():
         theirs = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
     np.testing.assert_allclose(ours, theirs, atol=3e-4, rtol=2e-3)
+
+
+def test_get_sd_loader_dispatch(tmp_path):
+    """SDLoaderFactory analog: get_sd_loader('Megatron') returns a loader
+    that merges shard files (reference state_dict_factory.py:42)."""
+    from deepspeed_tpu.runtime.state_dict_factory import get_sd_loader
+
+    hf = _tiny_hf()
+    shards = _megatron_shards(hf, tp=1, version=2.0)
+    f = tmp_path / "rank0.pt"
+    torch.save({"model": {"language_model": shards[0]},
+                "checkpoint_version": 2.0}, str(f))
+    loader = get_sd_loader([str(f)], sd_type="Megatron")
+    cfg = gpt2.GPT2Config(vocab_size=V, max_seq_len=S, num_layers=L,
+                          num_heads=NH, hidden_size=H)
+    spec, params = loader(cfg)
+    ids = np.random.default_rng(3).integers(0, V, (1, 8)).astype(np.int32)
+    ours = np.asarray(spec.apply_fn(params, {"input_ids": ids}))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=3e-4, rtol=2e-3)
+
+    with pytest.raises(ValueError):
+        get_sd_loader([], sd_type="HF")
