@@ -38,7 +38,7 @@ def _use_interpret() -> bool:
 # ---------------------------------------------------------------------------
 def _fwd_kernel(*refs, sm_scale: float, causal: bool, block_q: int,
                 block_k: int, kv_len: int, num_k_blocks: int,
-                has_layout: bool = False, layout_heads: int = 0):
+                has_layout: bool = False):
     if has_layout:
         (q_ref, k_ref, v_ref, layout_ref, o_ref, lse_ref,
          m_scr, l_scr, acc_scr) = refs
@@ -57,10 +57,11 @@ def _fwd_kernel(*refs, sm_scale: float, causal: bool, block_q: int,
     # a sparsity layout gates blocks on top (ops/sparse_attention)
     run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
     if has_layout:
-        # layout lives whole in SMEM (a (1,1,1) VMEM block would violate
-        # Mosaic's (8,128) tiling floor — surfaced on hardware only)
-        head = pl.program_id(0) % layout_heads
-        run = jnp.logical_and(run, layout_ref[head, qi, ki] != 0)
+        # per-head layout slice in SMEM (a (1,1,1) VMEM block would violate
+        # Mosaic's (8,128) tiling floor — surfaced on hardware only; a
+        # whole-array SMEM operand would hit scalar-memory limits at
+        # H x (S/block)^2 scale)
+        run = jnp.logical_and(run, layout_ref[0, qi, ki] != 0)
 
     @pl.when(run)
     def _compute():
@@ -118,9 +119,7 @@ def _fwd(q, k, v, sm_scale: float, causal: bool, block_q: int, block_k: int,
 
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                                block_q=block_q, block_k=block_k, kv_len=kv_len,
-                               num_k_blocks=nk, has_layout=layout is not None,
-                               layout_heads=0 if layout is None
-                               else layout.shape[0])
+                               num_k_blocks=nk, has_layout=layout is not None)
     out_shape = [
         jax.ShapeDtypeStruct((bh, q_len, d), q.dtype),          # o
         jax.ShapeDtypeStruct((bh, q_len, LANES), jnp.float32),  # lse (lane-bcast)
@@ -132,7 +131,9 @@ def _fwd(q, k, v, sm_scale: float, causal: bool, block_q: int, block_k: int,
     ]
     inputs = [q, k, v]
     if layout is not None:
-        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        h, lq, lk = layout.shape
+        in_specs.append(pl.BlockSpec((1, lq, lk), lambda b, i, j: (b % h, 0, 0),
+                                     memory_space=pltpu.SMEM))
         inputs.append(layout.astype(jnp.int32))
     o, lse = pl.pallas_call(
         kernel,
@@ -160,7 +161,7 @@ def _fwd(q, k, v, sm_scale: float, causal: bool, block_q: int, block_k: int,
 # ---------------------------------------------------------------------------
 def _bwd_dq_kernel(*refs, sm_scale: float, causal: bool, block_q: int,
                    block_k: int, kv_len: int, num_k_blocks: int,
-                   has_layout: bool = False, layout_heads: int = 0):
+                   has_layout: bool = False):
     if has_layout:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, layout_ref,
          dq_ref, dq_scr) = refs
@@ -176,10 +177,11 @@ def _bwd_dq_kernel(*refs, sm_scale: float, causal: bool, block_q: int,
 
     run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
     if has_layout:
-        # layout lives whole in SMEM (a (1,1,1) VMEM block would violate
-        # Mosaic's (8,128) tiling floor — surfaced on hardware only)
-        head = pl.program_id(0) % layout_heads
-        run = jnp.logical_and(run, layout_ref[head, qi, ki] != 0)
+        # per-head layout slice in SMEM (a (1,1,1) VMEM block would violate
+        # Mosaic's (8,128) tiling floor — surfaced on hardware only; a
+        # whole-array SMEM operand would hit scalar-memory limits at
+        # H x (S/block)^2 scale)
+        run = jnp.logical_and(run, layout_ref[0, qi, ki] != 0)
 
     @pl.when(run)
     def _compute():
@@ -215,8 +217,7 @@ def _bwd_dq_kernel(*refs, sm_scale: float, causal: bool, block_q: int,
 
 def _bwd_dkv_kernel(*refs, sm_scale: float, causal: bool,
                     block_q: int, block_k: int, kv_len: int, num_q_blocks: int,
-                    rep: int = 1, has_layout: bool = False,
-                    layout_heads: int = 0):
+                    rep: int = 1, has_layout: bool = False):
     """Inner grid dim 2 runs over (head_rep, q_blocks) flattened: for GQA the
     dk/dv of one KV head accumulates contributions from all ``rep`` query
     heads without materializing repeated K/V."""
@@ -237,10 +238,11 @@ def _bwd_dkv_kernel(*refs, sm_scale: float, causal: bool,
 
     run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
     if has_layout:
-        # layout lives whole in SMEM (a (1,1,1) VMEM block would violate
-        # Mosaic's (8,128) tiling floor — surfaced on hardware only)
-        head = pl.program_id(0) % layout_heads
-        run = jnp.logical_and(run, layout_ref[head, qi, ki] != 0)
+        # per-head layout slice in SMEM (a (1,1,1) VMEM block would violate
+        # Mosaic's (8,128) tiling floor — surfaced on hardware only; a
+        # whole-array SMEM operand would hit scalar-memory limits at
+        # H x (S/block)^2 scale)
+        run = jnp.logical_and(run, layout_ref[0, qi, ki] != 0)
 
     @pl.when(run)
     def _compute():
@@ -293,9 +295,7 @@ def _bwd_dq_call(q, k, v, do, lse_b, delta_b, *, sm_scale, causal, block_q,
                                   causal=causal, block_q=block_q,
                                   block_k=block_k, kv_len=kv_len,
                                   num_k_blocks=nk,
-                                  has_layout=layout is not None,
-                                  layout_heads=0 if layout is None
-                                  else layout.shape[0])
+                                  has_layout=layout is not None)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // rep, j, 0)),
@@ -306,7 +306,9 @@ def _bwd_dq_call(q, k, v, do, lse_b, delta_b, *, sm_scale, causal, block_q,
     ]
     inputs = [q, k, v, do, lse_b, delta_b]
     if layout is not None:
-        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        h, lq, lk = layout.shape
+        in_specs.append(pl.BlockSpec((1, lq, lk), lambda b, i, j: (b % h, 0, 0),
+                                     memory_space=pltpu.SMEM))
         inputs.append(layout.astype(jnp.int32))
     dq = pl.pallas_call(
         dq_kernel,
@@ -339,9 +341,7 @@ def _bwd_dkv_call(q, k, v, do, lse_b, delta_b, *, sm_scale, causal, block_q,
                                    causal=causal, block_q=block_q,
                                    block_k=block_k, kv_len=kv_len,
                                    num_q_blocks=nq, rep=rep,
-                                   has_layout=layout is not None,
-                                   layout_heads=0 if layout is None
-                                   else layout.shape[0])
+                                   has_layout=layout is not None)
     q_map = lambda b, j, i: (b * rep + i // nq, i % nq, 0)
     in_specs = [
         pl.BlockSpec((1, block_q, d), q_map),
@@ -352,7 +352,9 @@ def _bwd_dkv_call(q, k, v, do, lse_b, delta_b, *, sm_scale, causal, block_q,
         pl.BlockSpec((1, block_q, LANES), q_map),
     ]
     if layout is not None:
-        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        h, lq, lk = layout.shape
+        in_specs.append(pl.BlockSpec((1, lq, lk), lambda b, j, i: (b % h, 0, 0),
+                                     memory_space=pltpu.SMEM))
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bh_kv, nk, rep * nq),
