@@ -136,8 +136,16 @@ def get_world_size(group: Optional[Union[str, Sequence[str]]] = None) -> int:
     return size
 
 def get_rank() -> int:
-    """Rank of the first local device (process_index-scoped, like local rank 0)."""
-    return jax.process_index() * jax.local_device_count()
+    """Caller's rank = the controller process index (reference comm.py:570).
+
+    The reference runs one process per ACCELERATOR, so its rank counts
+    accelerators; under SPMD one controller drives all local devices, so
+    the process index is the only well-defined "my rank".  Ported
+    rank-0-only guards (``if dist.get_rank() == 0``) behave identically.
+    Use ``get_world_size()`` for device counts — it intentionally differs
+    from ``get_process_world_size()``.
+    """
+    return jax.process_index()
 
 
 def get_local_rank() -> int:
