@@ -18,9 +18,9 @@ Architecture (SD 1.x UNet2DConditionModel):
 
 No diffusers package exists in this image, so parity is structural and
 tests are self-consistent (shapes incl. the ~860M SD-1.x param count,
-conditioning sensitivity, denoising training); checkpoint ingestion
-follows once a diffusers state dict is available to diff against (the VAE
-sibling ships its converter, validated by a naming-roundtrip test).
+conditioning sensitivity, denoising training) and the checkpoint
+converter (``from_hf_state_dict``) follows the published diffusers naming,
+validated by a fabricated-dict roundtrip test like the VAE sibling's.
 """
 
 from __future__ import annotations
@@ -339,3 +339,105 @@ def build(cfg: Optional[UNetConfig] = None, **overrides) -> ModelSpec:
 
     return ModelSpec(init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
                      name=f"unet-{cfg.block_channels[0]}c")
+
+
+# --------------------------------------------------------------------- HF I/O
+def _np(t) -> np.ndarray:
+    return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach") else t,
+                      dtype=np.float32)
+
+
+def from_hf_state_dict(cfg: UNetConfig, sd: Dict[str, Any]) -> PyTree:
+    """diffusers ``UNet2DConditionModel`` state dict -> param pytree
+    (published naming: time_embedding.linear_{1,2}, down_blocks.N.resnets.M
+    .{norm1,conv1,time_emb_proj,...}, .attentions.M.transformer_blocks.0
+    .{attn1,attn2,ff.net.0.proj,ff.net.2}, mid_block, up_blocks,
+    conv_norm_out/conv_out).  Validated by a fabricated-naming roundtrip
+    test (no diffusers package in this image to diff against)."""
+    def get(name):
+        return _np(sd[name])
+
+    def conv(name):
+        return {"w": jnp.asarray(get(name + ".weight")),
+                "b": jnp.asarray(get(name + ".bias"))}
+
+    def gn(name):
+        return {"scale": jnp.asarray(get(name + ".weight")),
+                "bias": jnp.asarray(get(name + ".bias"))}
+
+    def dense(name, bias=True):
+        p = {"w": jnp.asarray(get(name + ".weight").T)}
+        if bias:
+            p["b"] = jnp.asarray(get(name + ".bias"))
+        return p
+
+    def resnet(prefix):
+        p = {"norm1": gn(prefix + ".norm1"), "conv1": conv(prefix + ".conv1"),
+             "time_emb": dense(prefix + ".time_emb_proj"),
+             "norm2": gn(prefix + ".norm2"), "conv2": conv(prefix + ".conv2")}
+        if prefix + ".conv_shortcut.weight" in sd:
+            p["shortcut"] = conv(prefix + ".conv_shortcut")
+        return p
+
+    def tx(prefix):
+        b = prefix + ".transformer_blocks.0"
+        return {
+            "norm": gn(prefix + ".norm"),
+            "proj_in": conv(prefix + ".proj_in"),
+            "block": {
+                "ln1": {"scale": jnp.asarray(get(b + ".norm1.weight")),
+                        "bias": jnp.asarray(get(b + ".norm1.bias"))},
+                "attn1": {"q": dense(b + ".attn1.to_q", bias=False),
+                          "k": dense(b + ".attn1.to_k", bias=False),
+                          "v": dense(b + ".attn1.to_v", bias=False),
+                          "out": dense(b + ".attn1.to_out.0")},
+                "ln2": {"scale": jnp.asarray(get(b + ".norm2.weight")),
+                        "bias": jnp.asarray(get(b + ".norm2.bias"))},
+                "attn2": {"q": dense(b + ".attn2.to_q", bias=False),
+                          "k": dense(b + ".attn2.to_k", bias=False),
+                          "v": dense(b + ".attn2.to_v", bias=False),
+                          "out": dense(b + ".attn2.to_out.0")},
+                "ln3": {"scale": jnp.asarray(get(b + ".norm3.weight")),
+                        "bias": jnp.asarray(get(b + ".norm3.bias"))},
+                "geglu": dense(b + ".ff.net.0.proj"),
+                "ff_out": dense(b + ".ff.net.2"),
+            },
+            "proj_out": conv(prefix + ".proj_out"),
+        }
+
+    chans = list(cfg.block_channels)
+    p: Dict[str, Any] = {
+        "time_mlp1": dense("time_embedding.linear_1"),
+        "time_mlp2": dense("time_embedding.linear_2"),
+        "conv_in": conv("conv_in"),
+    }
+    down = []
+    for i in range(len(chans)):
+        blk: Dict[str, Any] = {"resnets": [
+            resnet(f"down_blocks.{i}.resnets.{j}")
+            for j in range(cfg.layers_per_block)]}
+        if cfg.block_has_attn[i]:
+            blk["attns"] = [tx(f"down_blocks.{i}.attentions.{j}")
+                            for j in range(cfg.layers_per_block)]
+        if f"down_blocks.{i}.downsamplers.0.conv.weight" in sd:
+            blk["down"] = conv(f"down_blocks.{i}.downsamplers.0.conv")
+        down.append(blk)
+    p["down"] = down
+    p["mid"] = {"res1": resnet("mid_block.resnets.0"),
+                "attn": tx("mid_block.attentions.0"),
+                "res2": resnet("mid_block.resnets.1")}
+    up = []
+    for i in range(len(chans)):
+        has_attn = list(reversed(cfg.block_has_attn))[i]
+        blk = {"resnets": [resnet(f"up_blocks.{i}.resnets.{j}")
+                           for j in range(cfg.layers_per_block + 1)]}
+        if has_attn:
+            blk["attns"] = [tx(f"up_blocks.{i}.attentions.{j}")
+                            for j in range(cfg.layers_per_block + 1)]
+        if f"up_blocks.{i}.upsamplers.0.conv.weight" in sd:
+            blk["up"] = conv(f"up_blocks.{i}.upsamplers.0.conv")
+        up.append(blk)
+    p["up"] = up
+    p["norm_out"] = gn("conv_norm_out")
+    p["conv_out"] = conv("conv_out")
+    return p

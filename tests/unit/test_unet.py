@@ -68,6 +68,87 @@ def test_unet_conditioning_matters():
     assert np.abs(np.asarray(out1) - np.asarray(out3)).max() > 1e-4
 
 
+def test_unet_hf_naming_roundtrip():
+    """from_hf_state_dict consumes the published diffusers naming:
+    fabricate the dict FROM our params, reload, require identical output."""
+    cfg = unet.UNetConfig.tiny()
+    params = unet.init_params(cfg, jax.random.PRNGKey(4))
+
+    sd = {}
+
+    def put_conv(name, p):
+        sd[name + ".weight"] = np.asarray(p["w"])
+        sd[name + ".bias"] = np.asarray(p["b"])
+
+    def put_gn(name, p):
+        sd[name + ".weight"] = np.asarray(p["scale"])
+        sd[name + ".bias"] = np.asarray(p["bias"])
+
+    def put_dense(name, p):
+        sd[name + ".weight"] = np.asarray(p["w"]).T
+        if "b" in p:
+            sd[name + ".bias"] = np.asarray(p["b"])
+
+    def put_resnet(prefix, p):
+        put_gn(prefix + ".norm1", p["norm1"])
+        put_conv(prefix + ".conv1", p["conv1"])
+        put_dense(prefix + ".time_emb_proj", p["time_emb"])
+        put_gn(prefix + ".norm2", p["norm2"])
+        put_conv(prefix + ".conv2", p["conv2"])
+        if "shortcut" in p:
+            put_conv(prefix + ".conv_shortcut", p["shortcut"])
+
+    def put_tx(prefix, p):
+        put_gn(prefix + ".norm", p["norm"])
+        put_conv(prefix + ".proj_in", p["proj_in"])
+        b = prefix + ".transformer_blocks.0"
+        blk = p["block"]
+        put_gn(b + ".norm1", blk["ln1"])
+        put_gn(b + ".norm2", blk["ln2"])
+        put_gn(b + ".norm3", blk["ln3"])
+        for attn in ("attn1", "attn2"):
+            for proj in ("q", "k", "v"):
+                put_dense(f"{b}.{attn}.to_{proj}", blk[attn][proj])
+            put_dense(f"{b}.{attn}.to_out.0", blk[attn]["out"])
+        put_dense(b + ".ff.net.0.proj", blk["geglu"])
+        put_dense(b + ".ff.net.2", blk["ff_out"])
+        put_conv(prefix + ".proj_out", p["proj_out"])
+
+    put_dense("time_embedding.linear_1", params["time_mlp1"])
+    put_dense("time_embedding.linear_2", params["time_mlp2"])
+    put_conv("conv_in", params["conv_in"])
+    for i, blk in enumerate(params["down"]):
+        for j, r in enumerate(blk["resnets"]):
+            put_resnet(f"down_blocks.{i}.resnets.{j}", r)
+        for j, t in enumerate(blk.get("attns", [])):
+            put_tx(f"down_blocks.{i}.attentions.{j}", t)
+        if "down" in blk:
+            put_conv(f"down_blocks.{i}.downsamplers.0.conv", blk["down"])
+    put_resnet("mid_block.resnets.0", params["mid"]["res1"])
+    put_tx("mid_block.attentions.0", params["mid"]["attn"])
+    put_resnet("mid_block.resnets.1", params["mid"]["res2"])
+    for i, blk in enumerate(params["up"]):
+        for j, r in enumerate(blk["resnets"]):
+            put_resnet(f"up_blocks.{i}.resnets.{j}", r)
+        for j, t in enumerate(blk.get("attns", [])):
+            put_tx(f"up_blocks.{i}.attentions.{j}", t)
+        if "up" in blk:
+            put_conv(f"up_blocks.{i}.upsamplers.0.conv", blk["up"])
+    put_gn("conv_norm_out", params["norm_out"])
+    put_conv("conv_out", params["conv_out"])
+
+    reloaded = unet.from_hf_state_dict(cfg, sd)
+    rng = np.random.default_rng(5)
+    b = _batch(rng, 1, cfg)
+    o1 = unet.forward(cfg, params, jnp.asarray(b["noisy_latents"]),
+                      jnp.asarray(b["timesteps"]),
+                      jnp.asarray(b["encoder_hidden_states"]), train=False)
+    o2 = unet.forward(cfg, reloaded, jnp.asarray(b["noisy_latents"]),
+                      jnp.asarray(b["timesteps"]),
+                      jnp.asarray(b["encoder_hidden_states"]), train=False)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=1e-6)
+
+
 def test_unet_denoising_trains():
     deepspeed_tpu.comm.reset_topology()
     cfg = unet.UNetConfig.tiny()
