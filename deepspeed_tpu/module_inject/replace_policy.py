@@ -113,7 +113,9 @@ def _llama_translate(hf):
         rope_theta=getattr(hf, "rope_theta", 10000.0))
 
 
-def _llama_convert(cfg, sd) -> PyTree:
+def _llama_convert(cfg, sd, include_mlp: bool = True) -> PyTree:
+    """Llama-family trunk (embed/attention/norms/head); ``include_mlp=False``
+    for Mixtral, whose FFN keys live under block_sparse_moe instead."""
     def get(name):
         for prefix in ("model.", ""):
             if prefix + name in sd:
@@ -130,21 +132,23 @@ def _llama_convert(cfg, sd) -> PyTree:
         lm_head = jnp.asarray(_np(sd["lm_head.weight"]).T)
     else:  # tied
         lm_head = jnp.asarray(get("embed_tokens.weight").T)
+    blocks = {
+        "attn_norm": stack("layers.{i}.input_layernorm.weight",
+                           transpose=False),
+        "q_w": stack("layers.{i}.self_attn.q_proj.weight"),
+        "k_w": stack("layers.{i}.self_attn.k_proj.weight"),
+        "v_w": stack("layers.{i}.self_attn.v_proj.weight"),
+        "o_w": stack("layers.{i}.self_attn.o_proj.weight"),
+        "mlp_norm": stack("layers.{i}.post_attention_layernorm.weight",
+                          transpose=False),
+    }
+    if include_mlp:
+        blocks["w1"] = stack("layers.{i}.mlp.gate_proj.weight")
+        blocks["w3"] = stack("layers.{i}.mlp.up_proj.weight")
+        blocks["w2"] = stack("layers.{i}.mlp.down_proj.weight")
     return {
         "embed": jnp.asarray(get("embed_tokens.weight")),
-        "blocks": {
-            "attn_norm": stack("layers.{i}.input_layernorm.weight",
-                               transpose=False),
-            "q_w": stack("layers.{i}.self_attn.q_proj.weight"),
-            "k_w": stack("layers.{i}.self_attn.k_proj.weight"),
-            "v_w": stack("layers.{i}.self_attn.v_proj.weight"),
-            "o_w": stack("layers.{i}.self_attn.o_proj.weight"),
-            "mlp_norm": stack("layers.{i}.post_attention_layernorm.weight",
-                              transpose=False),
-            "w1": stack("layers.{i}.mlp.gate_proj.weight"),
-            "w3": stack("layers.{i}.mlp.up_proj.weight"),
-            "w2": stack("layers.{i}.mlp.down_proj.weight"),
-        },
+        "blocks": blocks,
         "final_norm": jnp.asarray(get("norm.weight")),
         "lm_head": lm_head,
     }
@@ -153,6 +157,53 @@ def _llama_convert(cfg, sd) -> PyTree:
 def _llama_build(cfg):
     from ..models import llama
     return llama.build(cfg)
+
+
+# ----------------------------------------------------------------- Mixtral
+def _mixtral_translate(hf):
+    from ..models.mixtral import MixtralConfig
+    return MixtralConfig(
+        vocab_size=hf.vocab_size, max_seq_len=hf.max_position_embeddings,
+        num_layers=hf.num_hidden_layers, num_heads=hf.num_attention_heads,
+        num_kv_heads=hf.num_key_value_heads, hidden_size=hf.hidden_size,
+        ffn_size=hf.intermediate_size,
+        rope_theta=getattr(hf, "rope_theta", 1e6),
+        num_experts=hf.num_local_experts, top_k=hf.num_experts_per_tok,
+        # drop-free routing = HF semantics (see MixtralConfig docstring)
+        eval_capacity_factor=float(hf.num_local_experts))
+
+
+def _mixtral_convert(cfg, sd) -> PyTree:
+    base = _llama_convert(cfg, sd, include_mlp=False)
+    blocks = base["blocks"]
+
+    def get(name):
+        for prefix in ("model.", ""):
+            if prefix + name in sd:
+                return _np(sd[prefix + name])
+        raise KeyError(name)
+
+    l, e = cfg.num_layers, cfg.num_experts
+
+    def stack_experts(w_name):
+        # HF expert Linear stores [out, in]; ours is [l, e, in, out]
+        return jnp.asarray(np.stack([
+            np.stack([get(f"layers.{i}.block_sparse_moe.experts.{j}."
+                          f"{w_name}.weight").T for j in range(e)])
+            for i in range(l)]))
+
+    blocks["gate_w"] = jnp.asarray(np.stack(
+        [get(f"layers.{i}.block_sparse_moe.gate.weight").T
+         for i in range(l)]))
+    blocks["experts_w1"] = stack_experts("w1")
+    blocks["experts_w2"] = stack_experts("w2")
+    blocks["experts_w3"] = stack_experts("w3")
+    return base
+
+
+def _mixtral_build(cfg):
+    from ..models import mixtral
+    return mixtral.build(cfg)
 
 
 _POLICIES: Dict[str, HFPolicy] = {}
@@ -325,6 +376,8 @@ _register("DistilBertForMaskedLM", _distilbert_translate,
 _register("GPT2LMHeadModel", _gpt2_translate, _gpt2_convert, _gpt2_build)
 _register("OPTForCausalLM", _opt_translate, _opt_convert, _opt_build)
 _register("LlamaForCausalLM", _llama_translate, _llama_convert, _llama_build)
+_register("MixtralForCausalLM", _mixtral_translate, _mixtral_convert,
+          _mixtral_build)
 _register("BloomForCausalLM", _bloom_translate, _bloom_convert, _bloom_build)
 _register("GPTNeoXForCausalLM", _neox_translate, _neox_convert, _neox_build)
 _register("GPTJForCausalLM", _gptj_translate, _gptj_convert, _gptj_build)

@@ -134,6 +134,30 @@ def test_mixtral_experts_sharded(eight_devices):
     assert w1.addressable_shards[0].data.shape[1] == 1  # 4 experts / ep=4
 
 
+def test_mixtral_matches_hf():
+    """HF MixtralForCausalLM ingestion: drop-free eval routing must
+    reproduce HF's top-2 expert mixing (policy sets eval_capacity_factor
+    = num_experts)."""
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+
+    cfg = transformers.MixtralConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=64, rope_theta=10000.0,
+        attention_dropout=0.0)
+    with torch.no_grad():
+        hf = transformers.MixtralForCausalLM(cfg)
+    hf.eval()
+    spec, params = deepspeed_tpu.module_inject.replace_module(hf_model=hf)
+    ids = np.random.default_rng(0).integers(2, 96, (2, 12)).astype(np.int32)
+    ours = np.asarray(spec.apply_fn(params, {"input_ids": ids}))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=5e-3, rtol=5e-3)
+
+
 def test_get_model_registry():
     assert get_model("gpt2", **{"vocab_size": 128, "max_seq_len": 32,
                                 "num_layers": 1, "num_heads": 2,
