@@ -178,7 +178,10 @@ class ElasticAgent:
                                                   verbose=False)
                 except RuntimeError:
                     membership = self._hosts  # keep running with who we have
-                if membership == self._hosts:
+                # Order-insensitive: a probe returning the same host SET in
+                # a different order is not a capacity change (elected order
+                # is still used for rank assignment on a real restart).
+                if sorted(membership) == sorted(self._hosts):
                     continue
                 logger.warning(
                     f"elastic: membership change {len(self._hosts)} -> "

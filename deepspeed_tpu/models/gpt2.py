@@ -446,12 +446,21 @@ def _fused_ce_fwd(w, x2d, targets, n_chunks):
         lse = jax.nn.logsumexp(logits, axis=-1)           # [c]
         picked = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
         loss = jnp.where(valid, lse - picked, 0.0).sum() / denom
-        # dlogits of mean-NLL (unscaled by upstream cotangent)
+        # dlogits of mean-NLL (unscaled by upstream cotangent).  gc is cast
+        # to the param dtype for the MXU matmuls: fine for bf16 (f32
+        # exponent range), lossy for fp16 where tiny unscaled entries land
+        # in the subnormal range — prefer bf16 training with fused_ce.
         p = jnp.exp(logits - lse[:, None])
         g = p.at[jnp.arange(c), safe].add(-1.0)
         g = jnp.where(valid[:, None], g, 0.0) / denom     # [c, V] f32
         gc = g.astype(w.dtype)
-        return loss, gc @ w.T, xc.T @ gc                  # loss, [c,D], [D,V]
+        # MXU inputs stay in param dtype; outputs come out f32 so unscaled
+        # fp16 grads don't flush to subnormals before the bwd ct multiply
+        dxi = jax.lax.dot_general(gc, w, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        dwi = jax.lax.dot_general(xc, gc, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        return loss, dxi, dwi                             # loss, [c,D], [D,V]
 
     # unrolled chunk loop (a scan's dw carry would copy [D, V] f32 per
     # iteration and serialize; unrolled, XLA overlaps chunk i+1's logits
@@ -465,16 +474,22 @@ def _fused_ce_fwd(w, x2d, targets, n_chunks):
         dw += dwi
         dxs.append(dxi)
     dx = jnp.concatenate(dxs, axis=0) if n_chunks > 1 else dxs[0]
-    # cotangent dtypes must match the primals (f32 accumulation, one cast —
-    # same precision as the bf16 matmul grads of the non-fused path)
-    return loss, (dw.astype(w.dtype), dx.astype(x2d.dtype))
+    # Residuals stay f32: under fp16 loss scaling the upstream cotangent
+    # (the scale) is applied in _fused_ce_bwd, and casting the UNSCALED
+    # grads to fp16 here would underflow exactly the small values the
+    # scaler exists to preserve.  One f32 [D,V] + [N,D] residual is the
+    # price; the cast to param dtype happens after the ct multiply.  The
+    # target dtypes ride as zero-size arrays (a dtype object is not a
+    # valid jax residual leaf).
+    return loss, (jnp.zeros((0,), w.dtype), jnp.zeros((0,), x2d.dtype),
+                  dw, dx)
 
 
 def _fused_ce_bwd(n_chunks, res, ct):
-    dw, dx = res
+    w_proto, x_proto, dw, dx = res
     ct = ct.astype(jnp.float32)
-    return ((ct * dw.astype(jnp.float32)).astype(dw.dtype),
-            (ct * dx.astype(jnp.float32)).astype(dx.dtype), None)
+    return ((ct * dw).astype(w_proto.dtype), (ct * dx).astype(x_proto.dtype),
+            None)
 
 
 _fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)

@@ -82,16 +82,26 @@ def quantize_pytree(params: PyTree, num_bits: int = 8, group_size: int = 64,
     matrices ([.., d_in, d_out], embeddings [V, d]) from per-layer-STACKED
     norm scales and biases ([L, d] with small L) — quantizing those would
     inject multiplicative error into every normalization while saving
-    almost nothing (the weight-only posture of the reference INT8 path)."""
-    def one(x):
+    almost nothing (the weight-only posture of the reference INT8 path).
+    Because a deep stack ([L, d] with L >= min_penultimate, e.g. 80-layer
+    Llama) defeats the shape test alone, any leaf whose key path names a
+    norm/bias/scale parameter is excluded outright."""
+    def is_norm_path(path) -> bool:
+        flat = "/".join(str(getattr(k, "key", getattr(k, "name", k)))
+                        for k in path).lower()
+        return any(t in flat for t in
+                   ("ln", "norm", "bias", "scale", "gamma", "beta"))
+
+    def one(path, x):
         if (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
                 and getattr(x, "ndim", 0) >= 2 and x.size >= min_size
                 and x.shape[-1] % group_size == 0
-                and x.shape[-2] >= min_penultimate):
+                and x.shape[-2] >= min_penultimate
+                and not is_norm_path(path)):
             return quantize(x, num_bits, group_size, symmetric)
         return x
 
-    return jax.tree_util.tree_map(one, params)
+    return jax.tree_util.tree_map_with_path(one, params)
 
 
 def dequantize_pytree(params: PyTree, dtype=jnp.bfloat16) -> PyTree:

@@ -1009,8 +1009,13 @@ class DeepSpeedEngine:
             k = len(batches)
             stacked = None
         else:
-            k = jax.tree_util.tree_leaves(batches)[0].shape[0]
+            leaves = jax.tree_util.tree_leaves(batches)
+            k = leaves[0].shape[0] if leaves else 0
             stacked = batches
+        if k < 1:
+            raise ValueError(
+                "train_batches requires at least one batch (got an empty "
+                f"{'list' if stacked is None else 'stacked pytree'})")
         fp = self._config.flops_profiler_config
         host_side_feature = (
             self.offload_enabled
