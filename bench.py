@@ -65,16 +65,17 @@ def main():
     if on_tpu:
         cfg = gpt2.GPT2Config.gpt2_125m()
         # measured-best v5e config (PROFILE.md): selective remat with the
-        # flash kernel's o+lse pinned, unrolled layer loop (no scan
-        # residual-stacking copies), 256x1024 flash blocks, and gas=8 so
-        # the optimizer/step overhead amortizes over 8 microbatches
+        # flash kernel's o pinned, unrolled layer loop (no scan
+        # residual-stacking copies), the fused v2 flash backward with
+        # 1024-row q blocks, and gas=16 so the optimizer/step overhead
+        # amortizes over 16 microbatches
         cfg.remat = True
         cfg.use_flash = True
         cfg.remat_policy = "dots_flash"
         cfg.scan_layers = False
-        cfg.flash_block_q, cfg.flash_block_k = 256, 1024
-        micro_bs, seq, steps = 32, 1024, 8
-        gas = 8
+        cfg.flash_block_q, cfg.flash_block_k = 1024, 1024
+        micro_bs, seq, steps = 32, 1024, 16
+        gas = 16
     else:  # CPU smoke mode
         cfg = gpt2.GPT2Config(vocab_size=2048, max_seq_len=256, num_layers=4,
                               num_heads=8, hidden_size=256)

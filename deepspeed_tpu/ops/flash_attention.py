@@ -398,11 +398,207 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, true_kv_len, head_rep,
 
 
 # ---------------------------------------------------------------------------
+# v2 kernels: full-row forward + ONE fused backward (dq+dk+dv in one pass)
+#
+# Profiling showed the v1 kernels are VPU/overhead-bound, not MXU-bound: the
+# online-softmax rescale machinery, the separate dq/dkv backward kernels that
+# EACH recompute the score matrix (9 S^2-matmuls where 6 suffice), and the
+# [bh, S, LANES]-broadcast lse/delta operands (200MB of f32 HBM traffic per
+# layer at bench shapes) dominate.  When the whole K/V sequence fits VMEM
+# (S <= _V2_MAX_KV), a single-row-block design removes all of it:
+#  - forward: one [bq, S] score pass, plain softmax (no cross-block rescale),
+#    exp2 with the softmax scale folded into q, OUTPUT IS O ONLY — the
+#    backward recomputes row max/sum in-kernel, so no lse is ever written.
+#  - backward: one kernel computes dq (written once per q block) and
+#    accumulates dk/dv in VMEM scratch over the sequential q-block grid
+#    dimension; delta = rowsum(do*o) is computed in-kernel and 1/l is folded
+#    into do, so no [bq, S] divide and no broadcast operands exist.
+# The v1 kernels remain for long sequences, the sparse-layout path, and the
+# ring-attention building blocks (parallel/sequence.py).
+# ---------------------------------------------------------------------------
+_LOG2E = math.log2(math.e)
+_LN2 = math.log(2.0)
+_V2_MAX_KV = 2048
+
+
+def _v2_eligible(kv_pad: int, d: int) -> bool:
+    import os
+
+    if os.environ.get("DS_FLASH_V2", "1") == "0":  # A/B kill switch
+        return False
+    return kv_pad <= _V2_MAX_KV and kv_pad % 8 == 0 and d <= 256
+
+
+def _fwd_v2_kernel(q_ref, k_ref, v_ref, o_ref, *, scale2: float, causal: bool,
+                   block_q: int, kv_pad: int, kv_len: int):
+    qi = pl.program_id(1)
+    # fold softmax scale AND log2(e) into q (one [bq, d] pass instead of a
+    # [bq, S] one); exp2 is the native transcendental
+    q = q_ref[0, ...]
+    qs = (q.astype(jnp.float32) * scale2).astype(q.dtype)
+    k = k_ref[0, ...]
+    v = v_ref[0, ...]
+    s2 = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # [bq, S]
+    col = jax.lax.broadcasted_iota(jnp.int32, (block_q, kv_pad), 1)
+    if causal:
+        row = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, kv_pad), 0)
+        mask = col <= row
+        if kv_len != kv_pad:
+            mask = jnp.logical_and(mask, col < kv_len)
+        s2 = jnp.where(mask, s2, DEFAULT_MASK_VALUE)
+    elif kv_len != kv_pad:
+        s2 = jnp.where(col < kv_len, s2, DEFAULT_MASK_VALUE)
+    m = jnp.max(s2, axis=1, keepdims=True)          # [bq, 1]
+    p = jnp.exp2(s2 - m)                            # masked lanes -> 0
+    l = jnp.sum(p, axis=1, keepdims=True)           # >= 1 for any valid row
+    acc = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    o_ref[0, ...] = (acc / l).astype(o_ref.dtype)
+
+
+def _fwd_v2(q, k, v, sm_scale, causal, block_q, interpret, true_kv_len,
+            head_rep):
+    bh, q_len, d = q.shape
+    kv_pad = k.shape[1]
+    nq = pl.cdiv(q_len, block_q)
+    kernel = functools.partial(
+        _fwd_v2_kernel, scale2=sm_scale * _LOG2E, causal=causal,
+        block_q=block_q, kv_pad=kv_pad, kv_len=true_kv_len)
+    rep = head_rep
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, kv_pad, d), lambda b, i: (b // rep, 0, 0)),
+            pl.BlockSpec((1, kv_pad, d), lambda b, i: (b // rep, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, q_len, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _bwd_v2_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, dq_ref, dk_ref, dv_ref,
+                   dk_scr, dv_scr, *, scale2: float, sm_scale: float,
+                   causal: bool, block_q: int, kv_pad: int, kv_len: int,
+                   num_q_blocks: int, rep: int):
+    inner = pl.program_id(1)
+    qi = inner % num_q_blocks
+
+    @pl.when(inner == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    q = q_ref[0, ...]
+    qs = (q.astype(jnp.float32) * scale2).astype(q.dtype)
+    k = k_ref[0, ...]
+    v = v_ref[0, ...]
+    o = o_ref[0, ...]
+    do = do_ref[0, ...]
+
+    s2 = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # [bq, S]
+    col = jax.lax.broadcasted_iota(jnp.int32, (block_q, kv_pad), 1)
+    if causal:
+        row = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, kv_pad), 0)
+        mask = col <= row
+        if kv_len != kv_pad:
+            mask = jnp.logical_and(mask, col < kv_len)
+        s2 = jnp.where(mask, s2, DEFAULT_MASK_VALUE)
+    elif kv_len != kv_pad:
+        s2 = jnp.where(col < kv_len, s2, DEFAULT_MASK_VALUE)
+    m = jnp.max(s2, axis=1, keepdims=True)
+    p0 = jnp.exp2(s2 - m)                           # l * softmax(s)
+    l = jnp.sum(p0, axis=1, keepdims=True)
+    linv = 1.0 / l                                  # [bq, 1]
+
+    do32 = do.astype(jnp.float32)
+    delta_s = jnp.sum(do32 * o.astype(jnp.float32), axis=1,
+                      keepdims=True) * linv         # delta / l, [bq, 1]
+    do_s = (do32 * linv).astype(do.dtype)           # do / l (folded softmax div)
+    # dp/l = (do/l) @ v^T ; ds = softmax*(dp-delta) = p0*(dp - delta)/l
+    dp_s = jax.lax.dot_general(do_s, v, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+    ds = p0 * (dp_s - delta_s)
+    ds_b = ds.astype(q.dtype)
+    # dv += softmax^T @ do = p0^T @ (do/l)
+    dv_scr[...] += jax.lax.dot_general(
+        p0.astype(do.dtype), do_s, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    # dk_true = ds^T @ (sm_scale*q) = (ds^T @ qs) * ln2   (qs = q*scale*log2e)
+    dk_scr[...] += jax.lax.dot_general(
+        ds_b, qs, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    # dq = sm_scale * (ds @ k)
+    dq = jax.lax.dot_general(ds_b, k, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    dq_ref[0, ...] = (dq * sm_scale).astype(dq_ref.dtype)
+
+    @pl.when(inner == rep * num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0, ...] = (dk_scr[...] * _LN2).astype(dk_ref.dtype)
+        dv_ref[0, ...] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_v2(q, k, v, o, do, sm_scale, causal, block_q, interpret, true_kv_len,
+            head_rep):
+    bh, q_len, d = q.shape
+    bh_kv, kv_pad, _ = k.shape
+    nq = pl.cdiv(q_len, block_q)
+    rep = head_rep
+    kernel = functools.partial(
+        _bwd_v2_kernel, scale2=sm_scale * _LOG2E, sm_scale=sm_scale,
+        causal=causal, block_q=block_q, kv_pad=kv_pad, kv_len=true_kv_len,
+        num_q_blocks=nq, rep=rep)
+    q_map = lambda b, i: (b * rep + i // nq, i % nq, 0)
+    kv_map = lambda b, i: (b, 0, 0)
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        grid=(bh_kv, rep * nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_map),       # q
+            pl.BlockSpec((1, kv_pad, d), kv_map),       # k
+            pl.BlockSpec((1, kv_pad, d), kv_map),       # v
+            pl.BlockSpec((1, block_q, d), q_map),       # o
+            pl.BlockSpec((1, block_q, d), q_map),       # do
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, kv_pad, d), kv_map),
+            pl.BlockSpec((1, kv_pad, d), kv_map),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((kv_pad, d), jnp.float32),
+            pltpu.VMEM((kv_pad, d), jnp.float32),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, o, do)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
 # public op
 # ---------------------------------------------------------------------------
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash_attention_bh(q, k, v, sm_scale, causal, block_q, block_k, interpret,
                         true_kv_len, head_rep):
+    if _v2_eligible(k.shape[1], q.shape[2]):
+        return _fwd_v2(q, k, v, sm_scale, causal, block_q, interpret,
+                       true_kv_len, head_rep)
     o, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
                 true_kv_len, head_rep)
     return o
@@ -410,13 +606,19 @@ def _flash_attention_bh(q, k, v, sm_scale, causal, block_q, block_k, interpret,
 
 def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, interpret,
                     true_kv_len, head_rep):
+    from jax.ad_checkpoint import checkpoint_name
+
+    if _v2_eligible(k.shape[1], q.shape[2]):
+        o = _fwd_v2(q, k, v, sm_scale, causal, block_q, interpret,
+                    true_kv_len, head_rep)
+        # no lse residual: the fused backward recomputes row stats in-kernel
+        o = checkpoint_name(o, "flash_out")
+        return o, (q, k, v, o)
     o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
                   true_kv_len, head_rep)
     # named so remat policies can pin the kernel's residuals: saving o+lse
     # means the backward under jax.checkpoint reuses them instead of
     # re-running the forward kernel (see gpt2._remat_policy)
-    from jax.ad_checkpoint import checkpoint_name
-
     o = checkpoint_name(o, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
     return o, (q, k, v, o, lse)
@@ -424,6 +626,10 @@ def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, interpret,
 
 def _flash_bwd_rule(sm_scale, causal, block_q, block_k, interpret, true_kv_len,
                     head_rep, res, g):
+    if len(res) == 4:  # v2 path (see _flash_fwd_rule)
+        q, k, v, o = res
+        return _bwd_v2(q, k, v, o, g, sm_scale, causal, block_q, interpret,
+                       true_kv_len, head_rep)
     return _bwd(sm_scale, causal, block_q, block_k, interpret, true_kv_len,
                 head_rep, res, g)
 

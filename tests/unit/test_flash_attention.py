@@ -89,6 +89,41 @@ def test_backward_unaligned():
                                    rtol=5e-4, err_msg=f"d{name} mismatch")
 
 
+def test_backward_gqa():
+    # exercises the fused-v2 backward's rep-grid dk/dv accumulation
+    q, k, v = rand_qkv(jax.random.PRNGKey(7), b=1, h=8, hkv=2, s=128)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, interpret=True)**2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True)**2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4,
+                                   rtol=5e-4, err_msg=f"d{name} mismatch")
+
+
+def test_long_seq_v1_fallback():
+    # kv > _V2_MAX_KV falls back to the v1 two-kernel backward
+    q, k, v = rand_qkv(jax.random.PRNGKey(8), b=1, h=1, s=4096, d=64)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, interpret=True,
+                                       block_q=512, block_k=512)**2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True)**2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4,
+                                   rtol=5e-4, err_msg=f"d{name} mismatch")
+
+
 def test_bf16_runs():
     q, k, v = rand_qkv(jax.random.PRNGKey(6), s=128, dtype=jnp.bfloat16)
     out = flash_attention(q, k, v, causal=True, interpret=True)
