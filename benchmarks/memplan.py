@@ -1,0 +1,202 @@
+"""Scale dress-rehearsal: memory/sharding audit of the big driver configs.
+
+No TPU compute — ``jax.eval_shape`` + sharding math on a VIRTUAL v5p-64
+mesh (64 CPU devices), verifying that every sharding spec actually divides
+every parameter and that the per-chip HBM budget closes.  Emits the tables
+MEMPLAN.md records.
+
+Configs (SURVEY.md driver configs #2-#4):
+  A: Llama-3-8B,  ZeRO-3 (+Infinity posture), dp=64, S=8192
+  B: Llama-3-70B, 3D: pp=4 x tp=8 x dp=2,     S=8192
+  C: Mixtral-8x7B, EP: ep=8 x dp=8,           S=4096
+
+Usage: python benchmarks/memplan.py [--dryrun]   (--dryrun additionally
+trains one GPT-2-125M ZeRO-3 step on an 8-device CPU mesh.)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=64"
+
+if "--dryrun-only" in sys.argv:  # subprocess entry: 8 devices, not 64
+    os.environ["XLA_FLAGS"] = os.environ["XLA_FLAGS"].replace(
+        "device_count=64", "device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+V5P_HBM = 95e9  # bytes per chip
+GB = 1e9
+
+
+def shard_bytes(abstract, shardings, itemsize=None, pp=1, n_layers=None):
+    """Per-device bytes of a pytree under NamedShardings; raises if any spec
+    does not divide its array (exactly the bug this audit exists to catch).
+
+    ``pp``: pipeline stages — [L, ...]-stacked block leaves (leading dim ==
+    n_layers) live on one stage each, so their bytes divide by pp (the
+    pipe engine partitions blocks outside the ZeRO plan)."""
+    total = 0
+    for leaf, sh in zip(jax.tree_util.tree_leaves(abstract),
+                        jax.tree_util.tree_leaves(
+                            shardings, is_leaf=lambda x: hasattr(x, "spec"))):
+        shape = sh.shard_shape(leaf.shape)  # raises on non-divisible
+        n = int(np.prod(shape))
+        if pp > 1 and n_layers and leaf.shape and leaf.shape[0] == n_layers:
+            n //= pp
+        total += n * (itemsize or leaf.dtype.itemsize)
+    return total
+
+
+def audit(name, model_cfg_build, topo, zero_stage, micro_bs, seq,
+          persistence=32768, act_factor=2, notes=()):
+    from deepspeed_tpu.runtime.zero.sharding import ZeroShardingPlan
+
+    cfg, model = model_cfg_build()
+    topo_str = "x".join(f"{a}{n}" for a, n in topo.axis_sizes.items() if n > 1)
+    abstract = jax.eval_shape(model.init_fn, jax.random.PRNGKey(0))
+    tp_specs = model.tp_rules(abstract) if model.tp_rules else None
+    plan = ZeroShardingPlan(zero_stage, topo.mesh,
+                            param_persistence_threshold=persistence)
+    p_shard = plan.param_shardings(abstract, tp_specs)
+    g_shard = plan.grad_shardings(abstract, tp_specs)
+    tp_tree = plan._resolve_tp(abstract, tp_specs)
+    o_shard = jax.tree_util.tree_map(
+        lambda p, tp: plan._named(plan.opt_spec(tuple(p.shape), tp)),
+        abstract, tp_tree)
+
+    n_params = sum(int(np.prod(x.shape))
+                   for x in jax.tree_util.tree_leaves(abstract))
+    pp = topo.axis_sizes.get("pp", 1)
+    L = cfg.num_layers
+    # engine memory model: fp32 master params + adam m/v fp32 (opt layout),
+    # grads fp32 (grad layout), bf16 compute copy materialized per use
+    master = shard_bytes(abstract, p_shard, 4, pp=pp, n_layers=L)
+    opt = 2 * shard_bytes(abstract, o_shard, 4, pp=pp, n_layers=L)
+    grads = shard_bytes(abstract, g_shard, 4, pp=pp, n_layers=L)
+    # activation estimate per microbatch (selective remat: ~act_factor
+    # bf16 copies of [B, S, d] per layer + attention workspace)
+    d = cfg.hidden_size
+    L = cfg.num_layers
+    sp = topo.axis_sizes.get("sp", 1)
+    acts = act_factor * L * micro_bs * (seq // max(sp, 1)) * d * 2
+    pp = topo.axis_sizes.get("pp", 1)
+    acts = acts // pp
+    total = master + opt + grads + acts
+    print(f"\n== {name} ({topo_str}, zero{zero_stage}, "
+          f"bs/chip={micro_bs}, S={seq}) ==")
+    print(f"params {n_params/1e9:.2f}B | per-chip: master {master/GB:.2f} GB"
+          f" + adam {opt/GB:.2f} + grads {grads/GB:.2f}"
+          f" + acts~{acts/GB:.2f} = {total/GB:.2f} GB"
+          f" ({100*total/V5P_HBM:.0f}% of v5p HBM)")
+    for nline in notes:
+        print("   " + nline)
+    assert total < V5P_HBM, f"{name} does not fit v5p HBM"
+    return dict(name=name, params=n_params, per_chip_bytes=total)
+
+
+def main():
+    from deepspeed_tpu.parallel.topology import MeshTopology
+
+    # ---- A: Llama-3-8B ZeRO-3 (+Infinity posture), dp=64 ----------------
+    def build_8b():
+        from deepspeed_tpu.models import llama
+
+        cfg = llama.LlamaConfig.llama3_8b()
+        return cfg, llama.build(cfg)
+
+    topo_a = MeshTopology(dp=64)
+    n_chips = 64
+    audit("Llama-3-8B ZeRO-3", build_8b, topo_a, zero_stage=3,
+          micro_bs=1, seq=8192, act_factor=2, notes=[
+              "ZeRO-3 comm/step: 2x all-gather of bf16 params (fwd+bwd, "
+              "16.1 GB over ICI, pipelined per scan step) + reduce-scatter "
+              "of f32 grads (32.1 GB/64 chips = 0.5 GB/chip)",
+              "Infinity tier: optimizer state (m+v+master, 96.5 GB global) "
+              "can move to host DRAM via offload_optimizer; param tier "
+              "streams blocks (zero/param_stream.py)"])
+
+    # ---- B: Llama-3-70B 3D pp4 x tp8 x dp2 -------------------------------
+    def build_70b():
+        from deepspeed_tpu.models import llama
+
+        cfg = llama.LlamaConfig.llama3_70b()
+        return cfg, llama.build(cfg)
+
+    topo_b = MeshTopology(pp=4, tp=8, dp=2)
+    audit("Llama-3-70B 3D", build_70b, topo_b, zero_stage=1,
+          micro_bs=1, seq=8192, act_factor=2, notes=[
+              "block leaves divide by pp=4 (pipe/engine partitions the "
+              "[L, ...] stacks per stage, outside the ZeRO plan)",
+              "tp comm/step/layer: 4 all-reduces of [B, S/sp, d] bf16 "
+              "(0.13 GB each at bs=1) over the innermost-axis ICI",
+              "dp comm/step: grad all-reduce of the per-stage tp shard "
+              "(~8.8 GB f32 at pp4 x tp8)"])
+
+    # ---- C: Mixtral-8x7B EP ep8 x dp8 ------------------------------------
+    def build_mixtral():
+        from deepspeed_tpu.models import mixtral
+
+        cfg = mixtral.MixtralConfig()
+        return cfg, mixtral.build(cfg)
+
+    topo_c = MeshTopology(ep=8, dp=8)
+    audit("Mixtral-8x7B EP", build_mixtral, topo_c, zero_stage=2,
+          micro_bs=1, seq=4096, act_factor=2, notes=[
+              "experts shard over ep (8 experts -> 1/chip); zero-2 "
+              "shards opt+grads over (dp, ep) = all 64 chips",
+              "ep comm/step/layer: 2 all-to-alls of the routed token "
+              "activations (top-2 of [B, S, d] bf16)"])
+
+    if "--dryrun" in sys.argv:
+        # fresh process with an 8-device platform (this one holds 64)
+        import subprocess
+
+        r = subprocess.run([sys.executable, os.path.abspath(__file__),
+                            "--dryrun-only"])
+        sys.exit(r.returncode)
+
+
+def dryrun_125m():
+    """One REAL ZeRO-3 train step of GPT-2 125M (124M params) on an
+    8-device CPU mesh — the >=100M-param execution check."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    deepspeed_tpu.comm.reset_topology()
+    cfg = gpt2.GPT2Config.gpt2_125m()
+    cfg.max_seq_len = 128  # tiny sequence: the check is the 124M-param
+    cfg.remat = True       # sharded execution, not throughput
+    model = gpt2.build(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 3},
+    })
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, cfg.vocab_size,
+        size=(engine.train_batch_size(), 129)).astype(np.int32)}
+    _, m = engine.train_batch(batch)
+    loss = float(m["loss"])
+    n = sum(x.size for x in jax.tree_util.tree_leaves(engine.state["params"]))
+    print(f"\n== dryrun: GPT-2 125M zero3 on 8-dev CPU mesh ==")
+    print(f"params {n/1e6:.1f}M, one train step OK, loss={loss:.3f}")
+    assert np.isfinite(loss)
+
+
+if __name__ == "__main__":
+    if "--dryrun-only" in sys.argv:
+        dryrun_125m()
+    else:
+        main()

@@ -89,13 +89,21 @@ class Autotuner:
 
         config = dict(self.base_config)
         config.pop("autotuning", None)
-        config = _merge_overrides(config, overrides)
+        model_overrides = dict(overrides.get("_model", {}))
+        config = _merge_overrides(
+            config, {k: v for k, v in overrides.items() if k != "_model"})
         rec: Dict[str, Any] = {"config": overrides}
         deepspeed_tpu.comm.reset_topology()
         engine = None
         try:
+            model = self.model_factory()
+            mc = getattr(model, "model_config", None)
+            for k, v in model_overrides.items():
+                if mc is None or not hasattr(mc, k):
+                    raise ValueError(f"model does not expose knob {k!r}")
+                setattr(mc, k, v)
             engine, _, _, _ = deepspeed_tpu.initialize(
-                model=self.model_factory(), config=config)
+                model=model, config=config)
             warm = self.cfg.start_profile_step
             steps = max(self.cfg.end_profile_step - warm, 1)
             for _ in range(warm):
@@ -121,13 +129,138 @@ class Autotuner:
             gc.collect()
         return rec
 
+    # -------------------------------------------------- staged search (v2)
+    def _model_knob_space(self, stage_name: str) -> List[dict]:
+        """Candidate ``_model`` overrides for one staged knob group.  These
+        are the knobs that actually set TPU throughput (PROFILE.md's
+        measured winners: remat policy, layer-loop unrolling, gas, flash
+        block sizes) — the reference's fast mode never touches them."""
+        probe = self.model_factory()
+        mc = getattr(probe, "model_config", None)
+
+        def has(k):
+            return mc is not None and hasattr(mc, k)
+
+        if stage_name == "remat":
+            cands = []
+            for pol in (self.cfg.remat_policies if has("remat_policy")
+                        else [None]):
+                for scan in ([True, False] if has("scan_layers") else [None]):
+                    m = {}
+                    if pol is not None:
+                        m["remat"] = pol != "off"
+                        m["remat_policy"] = pol
+                    if scan is not None:
+                        m["scan_layers"] = scan
+                    if m:
+                        cands.append({"_model": m})
+            return cands
+        if stage_name == "gas":
+            return [{"gradient_accumulation_steps": g}
+                    for g in self.cfg.gas_candidates]
+        if stage_name == "flash":
+            if not (has("flash_block_q") and has("flash_block_k")):
+                return []
+            return [{"_model": {"flash_block_q": bq, "flash_block_k": bk}}
+                    for bq, bk in self.cfg.flash_blocks]
+        raise ValueError(stage_name)
+
+    def _predict(self, features: List[float],
+                 measured: List[tuple]) -> Optional[float]:
+        """Model-based trial ordering (reference
+        ``tuner/model_based_tuner.py``): inverse-distance-weighted
+        prediction of throughput from the experiments measured so far."""
+        if len(measured) < 2:
+            return None
+        num = den = 0.0
+        for f, y in measured:
+            d = sum((a - b) ** 2 for a, b in zip(features, f)) ** 0.5
+            w = 1.0 / (d + 1e-3)
+            num += w * y
+            den += w
+        return num / den
+
+    def _features(self, overrides: dict) -> List[float]:
+        m = overrides.get("_model", {})
+        return [
+            float(overrides.get("train_micro_batch_size_per_gpu", 0)),
+            float(overrides.get("zero_optimization", {}).get("stage", -1)),
+            float(overrides.get("gradient_accumulation_steps", 0)),
+            {"full": 0, "dots": 1, "dots_flash": 2}.get(
+                m.get("remat_policy"), -1),
+            1.0 if m.get("scan_layers") else 0.0,
+            float(m.get("flash_block_q", 0)),
+            float(m.get("flash_block_k", 0)),
+        ]
+
+    def _tune_staged(self) -> Dict[str, Any]:
+        """Greedy coordinate descent over knob groups: tune batch geometry
+        first (memory-dominant), then remat policy, then gas, then flash
+        blocks — each stage keeps the winners of the previous ones.  A
+        model-based tuner orders within-stage candidates and early-stops
+        when its prediction falls far behind the incumbent."""
+        best_over: Dict[str, Any] = {}
+        best_rec: Optional[Dict[str, Any]] = None
+        measured: List[tuple] = []
+        for stage_name in self.cfg.stages:
+            if stage_name == "batch":
+                cands = self.experiment_space()
+            else:
+                cands = self._model_knob_space(stage_name)
+            cands = [c for c in cands if c]
+            if not cands:
+                continue
+            # model-based ordering: try predicted-best first
+            if self.cfg.tuner_type == "model_based" and len(measured) >= 2:
+                cands.sort(key=lambda c: -(self._predict(
+                    self._features(_merge_overrides(best_over, c)), measured)
+                    or 0.0))
+            stage_best: Optional[Dict[str, Any]] = None
+            stale = 0
+            for cand in cands:
+                overrides = _merge_overrides(best_over, cand)
+                rec = self._run_experiment(overrides)
+                rec["stage"] = stage_name
+                self.results.append(rec)
+                log_dist(
+                    f"autotuning[{stage_name}] {cand}: "
+                    f"{'%.1f tok/s' % rec['throughput'] if rec.get('feasible') else 'infeasible'}",
+                    ranks=[0])
+                if not rec.get("feasible"):
+                    continue
+                measured.append((self._features(overrides),
+                                 rec["throughput"]))
+                if stage_best is None or \
+                        rec["throughput"] > stage_best["throughput"]:
+                    stage_best, stale = rec, 0
+                else:
+                    stale += 1
+                    if stale >= self.cfg.tuner_early_stopping:
+                        break
+            if stage_best is not None and (
+                    best_rec is None or
+                    stage_best["throughput"] >= best_rec["throughput"]):
+                best_rec = stage_best
+                best_over = stage_best["config"]
+        if best_rec is None:
+            raise RuntimeError(
+                "autotuning found no feasible configuration; "
+                f"records: {self.results}")
+        self._write_results(best_rec)
+        return best_rec
+
     # ---------------------------------------------------------------- tune
     def tune(self) -> Dict[str, Any]:
         """Run the space; returns the best record (reference ``tune``:423).
 
-        Pruning: an OOM at micro batch m skips larger micros for the same
-        stage; ``tuner_early_stopping`` consecutive non-improving trials end
-        the search."""
+        ``tuner_type``: "staged"/"model_based" run the v2 coordinate
+        search over batch -> remat -> gas -> flash blocks; "gridsearch"
+        keeps the reference-style stage x micro-batch grid.  Pruning: an
+        OOM at micro batch m skips larger micros for the same stage;
+        ``tuner_early_stopping`` consecutive non-improving trials end the
+        search."""
+        if self.cfg.tuner_type in ("staged", "model_based"):
+            return self._tune_staged()
         best: Optional[Dict[str, Any]] = None
         stale = 0
         pruned_stage_micro: Dict[int, int] = {}
@@ -167,7 +300,28 @@ class Autotuner:
                                "best_config.json"), "w") as f:
             cfg = dict(self.base_config)
             cfg.pop("autotuning", None)
-            json.dump(_merge_overrides(cfg, best["config"]), f, indent=2)
+            model_over = best["config"].get("_model")
+            cfg = _merge_overrides(
+                cfg, {k: v for k, v in best["config"].items()
+                      if k != "_model"})
+            if model_over:
+                cfg["_model"] = model_over  # builder knobs (GPT2Config etc.)
+            json.dump(cfg, f, indent=2)
+        # ranked report (reference emits a summary table per experiment set)
+        ranked = sorted((r for r in self.results if r.get("feasible")),
+                        key=lambda r: -r["throughput"])
+        with open(os.path.join(self.cfg.results_dir, "report.md"), "w") as f:
+            f.write("# Autotuning report\n\n"
+                    "| rank | stage | overrides | tok/s | step ms |\n"
+                    "|---|---|---|---|---|\n")
+            for i, r in enumerate(ranked, 1):
+                f.write(f"| {i} | {r.get('stage', '-')} | "
+                        f"`{json.dumps(r['config'], default=str)}` | "
+                        f"{r['throughput']:.0f} | {1e3*r['step_s']:.1f} |\n")
+            infeasible = [r for r in self.results if not r.get("feasible")]
+            if infeasible:
+                f.write(f"\n{len(infeasible)} infeasible experiment(s) "
+                        "(OOM/invalid) — see exps.json.\n")
         log_dist(f"autotuning: best {best['config']} at "
                  f"{best['throughput']:.1f} tok/s -> "
                  f"{self.cfg.results_dir}/best_config.json", ranks=[0])

@@ -335,7 +335,8 @@ def build(cfg: Optional[BertConfig] = None, **overrides) -> ModelSpec:
                            batch.get("token_type_ids"), train=False)
         return forward(cfg, params, batch, train=False)
 
-    return ModelSpec(init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
+    return ModelSpec(
+        init_fn=init_fn, model_config=cfg, loss_fn=loss_fn, apply_fn=apply_fn,
                      tp_rules=lambda ap: tp_rules(cfg, ap),
                      flops_per_token=6.0 * cfg.num_params(),
                      name=f"bert-{cfg.num_layers}l-{cfg.hidden_size}d")

@@ -345,7 +345,8 @@ def build(cfg: Optional[BloomConfig] = None, **overrides) -> ModelSpec:
         "dropout": 0.0,  # dropout unimplemented (build() rejects > 0)
     }
 
-    return ModelSpec(init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
+    return ModelSpec(
+        init_fn=init_fn, model_config=cfg, loss_fn=loss_fn, apply_fn=apply_fn,
                      tp_rules=lambda ap: tp_rules(cfg, ap),
                      flops_per_token=6.0 * cfg.num_params(),
                      pipeline_hooks=pipeline_hooks,

@@ -385,7 +385,8 @@ def build(cfg: Optional[CLIPConfig] = None, **overrides) -> ModelSpec:
     def apply_fn(params, batch, rng=None):
         return forward(cfg, params, batch, rng=rng, train=False)
 
-    return ModelSpec(init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
+    return ModelSpec(
+        init_fn=init_fn, model_config=cfg, loss_fn=loss_fn, apply_fn=apply_fn,
                      tp_rules=lambda ap: tp_rules(cfg, ap),
                      flops_per_token=6.0 * cfg.num_params(),
                      name=f"clip-{cfg.vision_layers}l-{cfg.vision_width}d")

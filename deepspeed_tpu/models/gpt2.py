@@ -48,6 +48,9 @@ class GPT2Config:
     #: the attention map + elementwise ops (selective checkpointing —
     #: ~13% extra flops instead of ~33%, still O(S) memory)
     remat_policy: str = "dots"
+    #: offload saved remat residuals to pinned host memory (the reference's
+    #: activation_checkpointing.cpu_checkpointing; see runtime/remat.py)
+    remat_offload: bool = False
     tie_embeddings: bool = True
     #: None = auto (Pallas flash attention on TPU, einsum elsewhere);
     #: flash path requires attention-dropout == 0
@@ -66,6 +69,19 @@ class GPT2Config:
     #: matmul); the option remains for large-vocab/small-d models.
     fused_ce: Optional[bool] = None
     ce_chunks: int = 4
+    #: random-LTD kept-token count (None/>=S = dense).  Set by the engine's
+    #: RandomLTDScheduler (runtime/engine.py _advance_random_ltd); middle
+    #: layers process a random ordered subset of this many tokens
+    #: (data_pipeline/random_ltd.py).
+    random_ltd_keep: Optional[int] = None
+    #: which layers drop tokens (reference random_ltd_layer_id_start /
+    #: random_ltd_layer_num); default = all middle layers [1, L-1)
+    random_ltd_layer_start: int = 1
+    random_ltd_layer_num: Optional[int] = None
+    #: Route the wte lookup through sparse_embedding_lookup so the DP
+    #: gradient exchange ships only touched rows (engine sets this from the
+    #: ``sparse_gradients`` config key; see runtime/sparse_tensor.py)
+    sparse_embedding_grad: bool = False
     #: True (default): execute the layer stack with lax.scan (O(1) compiled
     #: code size; the remat residuals of every iteration are stacked into
     #: [L, ...] buffers via dynamic-update-slice — measurable HBM write
@@ -142,17 +158,10 @@ def _layer_norm(x, scale, bias, eps: float = 1e-5):
 
 
 def _remat_policy(cfg):
-    policy = getattr(cfg, "remat_policy", "full")
-    if policy in ("dots", "dots_flash"):
-        dots = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-        if policy == "dots":
-            return dots
-        # also pin the flash kernel's residuals (o + lse) so the backward
-        # consumes them instead of re-running the forward kernel
-        return jax.checkpoint_policies.save_from_both_policies(
-            dots, jax.checkpoint_policies.save_only_these_names(
-                "flash_out", "flash_lse"))
-    return None
+    from ..runtime.remat import remat_policy
+
+    return remat_policy(getattr(cfg, "remat_policy", "full"),
+                        getattr(cfg, "remat_offload", False))
 
 
 _warned_sp_dropout = False
@@ -313,11 +322,19 @@ def forward_cached(cfg: GPT2Config, params, input_ids, cache, pos):
     return logits, {"k": ks, "v": vs}
 
 
+def _wte_lookup(cfg: GPT2Config, params, input_ids):
+    if getattr(cfg, "sparse_embedding_grad", False):
+        from ..runtime.sparse_tensor import sparse_embedding_lookup
+
+        return sparse_embedding_lookup(params["wte"], input_ids)
+    return params["wte"][input_ids]
+
+
 def _trunk(cfg: GPT2Config, params, input_ids, rng=None, train: bool = True):
     """Embeddings + all blocks; returns pre-final-LN activations [B, S, D]."""
     b, s = input_ids.shape
     compute_dtype = params["wte"].dtype
-    x = params["wte"][input_ids] + params["wpe"][:s]
+    x = _wte_lookup(cfg, params, input_ids) + params["wpe"][:s]
     x = x.astype(compute_dtype)
     dropout = cfg.dropout if train else 0.0
 
@@ -326,12 +343,33 @@ def _trunk(cfg: GPT2Config, params, input_ids, rng=None, train: bool = True):
         block_fn = jax.checkpoint(_block, static_argnums=(0, 5),
                                   policy=_remat_policy(cfg))
 
-    if not getattr(cfg, "scan_layers", True):
+    # random-LTD: middle layers process a random ordered token subset
+    # (reference data_routing/basic_layer.py:13); the kept count is a
+    # static shape, and it differs between boundary and middle layers, so
+    # the layer loop must unroll (scan needs one uniform body)
+    ltd_keep = getattr(cfg, "random_ltd_keep", None)
+    use_ltd = (train and rng is not None and ltd_keep is not None
+               and ltd_keep < s and cfg.num_layers > 2)
+
+    ltd_lo = getattr(cfg, "random_ltd_layer_start", 1)
+    ltd_n = getattr(cfg, "random_ltd_layer_num", None)
+    ltd_hi = ltd_lo + ltd_n if ltd_n is not None else cfg.num_layers - 1
+
+    if use_ltd or not getattr(cfg, "scan_layers", True):
+        from ..runtime.data_pipeline.random_ltd import (token_drop,
+                                                        token_restore)
+
         for i in range(cfg.num_layers):
             layer = jax.tree_util.tree_map(lambda p: p[i], params["blocks"])
             r = (jax.random.fold_in(rng, i)
                  if (rng is not None and dropout > 0.0) else None)
-            x = block_fn(cfg, x, layer, None, r, dropout)
+            if use_ltd and ltd_lo <= i < ltd_hi:
+                kept, idx = token_drop(
+                    x, jax.random.fold_in(rng, 0x17D + i), ltd_keep)
+                kept = block_fn(cfg, kept, layer, None, r, dropout)
+                x = token_restore(x, kept, idx)
+            else:
+                x = block_fn(cfg, x, layer, None, r, dropout)
         return x
 
     def body(carry, xs):
@@ -392,7 +430,7 @@ def tp_rules(cfg: GPT2Config, abstract_params: PyTree) -> PyTree:
 
 def _embed(cfg: GPT2Config, params, input_ids):
     s = input_ids.shape[1]
-    x = params["wte"][input_ids] + params["wpe"][:s]
+    x = _wte_lookup(cfg, params, input_ids) + params["wpe"][:s]
     return x.astype(params["wte"].dtype)
 
 
@@ -542,7 +580,8 @@ def build(cfg: Optional[GPT2Config] = None, **overrides) -> ModelSpec:
         "max_seq_len": cfg.max_seq_len,
     }
 
-    return ModelSpec(init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
+    return ModelSpec(
+        init_fn=init_fn, model_config=cfg, loss_fn=loss_fn, apply_fn=apply_fn,
                      tp_rules=lambda ap: tp_rules(cfg, ap),
                      flops_per_token=6.0 * cfg.num_params(),
                      pipeline_hooks=pipeline_hooks,

@@ -318,7 +318,8 @@ def build(cfg: Optional[GPTJConfig] = None, **overrides) -> ModelSpec:
         "max_seq_len": cfg.max_seq_len,
     }
 
-    return ModelSpec(init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
+    return ModelSpec(
+        init_fn=init_fn, model_config=cfg, loss_fn=loss_fn, apply_fn=apply_fn,
                      tp_rules=lambda ap: tp_rules(cfg, ap),
                      flops_per_token=6.0 * cfg.num_params(),
                      decode_hooks=decode_hooks,

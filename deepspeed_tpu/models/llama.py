@@ -331,7 +331,7 @@ def build(cfg: Optional[LlamaConfig] = None, **overrides) -> ModelSpec:
         return jnp.where(valid, nll, 0.0).sum() / jnp.maximum(valid.sum(), 1)
 
     return ModelSpec(
-        init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
+        init_fn=init_fn, model_config=cfg, loss_fn=loss_fn, apply_fn=apply_fn,
         tp_rules=lambda ap: tp_rules(cfg, ap),
         flops_per_token=6.0 * cfg.num_params(),
         pipeline_hooks={

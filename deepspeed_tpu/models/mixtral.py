@@ -200,7 +200,7 @@ def build(cfg: Optional[MixtralConfig] = None, **overrides) -> ModelSpec:
     }
 
     return ModelSpec(
-        init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
+        init_fn=init_fn, model_config=cfg, loss_fn=loss_fn, apply_fn=apply_fn,
         tp_rules=lambda ap: tp_rules(cfg, ap),
         flops_per_token=6.0 * (cfg.num_params() / cfg.num_experts *
                                (cfg.top_k + 1)),

@@ -337,7 +337,8 @@ def build(cfg: Optional[UNetConfig] = None, **overrides) -> ModelSpec:
         return forward(cfg, params, batch["sample"], batch["timesteps"],
                        batch["encoder_hidden_states"], train=False)
 
-    return ModelSpec(init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
+    return ModelSpec(
+        init_fn=init_fn, model_config=cfg, loss_fn=loss_fn, apply_fn=apply_fn,
                      name=f"unet-{cfg.block_channels[0]}c")
 
 

@@ -336,5 +336,6 @@ def build(cfg: Optional[VAEConfig] = None, **overrides) -> ModelSpec:
         mean, logvar = encode(cfg, params, x)
         return decode(cfg, params, mean)
 
-    return ModelSpec(init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
+    return ModelSpec(
+        init_fn=init_fn, model_config=cfg, loss_fn=loss_fn, apply_fn=apply_fn,
                      name=f"vae-{cfg.base_channels}c")

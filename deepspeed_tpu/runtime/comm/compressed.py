@@ -56,6 +56,11 @@ def compressed_allreduce(x, worker_error, server_error, axis_name: str):
     # stage 1: worker-side compression + all-to-all
     comp = chunks + worker_error
     signs, scales, new_worker_error = _compress(comp)
+    # trace-time wire accounting: the comms logger records the int8 payloads
+    # (the dense equivalent would be 4 bytes/elem both rounds)
+    from ...comm.comm import _record
+
+    _record("all_to_all", signs, axis_name, log_name="compressed_allreduce")
     # worker j receives row j of every peer: [n, c] rows ordered by source
     recv_signs = jax.lax.all_to_all(signs, axis_name, split_axis=0,
                                     concat_axis=0, tiled=True)
@@ -68,6 +73,8 @@ def compressed_allreduce(x, worker_error, server_error, axis_name: str):
     comp2 = (chunk_mean + server_error)[None, :]
     signs2, scales2, server_residual = _compress(comp2)
     new_server_error = server_residual[0]
+    _record("all_gather", signs2[0], axis_name,
+            log_name="compressed_allreduce")
     out_signs = jax.lax.all_gather(signs2[0], axis_name)      # [n, c] int8
     out_scales = jax.lax.all_gather(scales2[0], axis_name)    # [n]
     out = (out_signs.astype(jnp.float32) *

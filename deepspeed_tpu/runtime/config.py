@@ -47,6 +47,16 @@ class Bf16Config(DeepSpeedConfigModel):
 
 
 class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    """Reference ``activation_checkpointing`` block (checkpointing.py:749).
+
+    Any set key switches the model's remat on via ``runtime/remat.py``;
+    ``cpu_checkpointing`` additionally offloads saved residuals to pinned
+    host memory.  TPU extensions: ``enabled`` (explicit switch) and
+    ``policy`` ("full" | "dots" | "dots_flash") selecting WHAT is saved.
+    """
+
+    enabled: bool = False
+    policy: Optional[str] = None
     partition_activations: bool = False
     contiguous_memory_optimization: bool = False
     cpu_checkpointing: bool = False
@@ -153,6 +163,7 @@ class DeepSpeedConfig:
             self.world_size = 1
         self._initialize_params(self._param_dict)
         self._init_curriculum(self._param_dict)
+        self._init_random_ltd(self._param_dict)
         self._apply_elasticity(self._param_dict)
         self._configure_train_batch_size()
         self._do_sanity_check()
@@ -168,6 +179,16 @@ class DeepSpeedConfig:
         self.curriculum_params = dict(block or {})
         self.curriculum_enabled = bool(
             self.curriculum_params.get("enabled", False))
+
+    def _init_random_ltd(self, pd: dict) -> None:
+        """Random layerwise token dropping block (reference
+        ``data_efficiency.data_routing.random_ltd``,
+        ``data_pipeline/data_routing/basic_layer.py:13``)."""
+        routing = pd.get(C.DATA_EFFICIENCY, {}).get("data_routing", {})
+        ltd = dict(routing.get("random_ltd", {}))
+        self.random_ltd_params = ltd
+        self.random_ltd_enabled = bool(ltd.get("enabled", False)) and \
+            bool(routing.get("enabled", ltd.get("enabled", False)))
 
     def _apply_elasticity(self, pd: dict) -> None:
         """Elastic batch adoption + world-size validation (reference
@@ -253,16 +274,11 @@ class DeepSpeedConfig:
                                                    C.PRESCALE_GRADIENTS_DEFAULT)
         self.gradient_predivide_factor = get_scalar_param(
             pd, C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+        # consumed by the engine: models that opt in route their embedding
+        # lookup through sparse_embedding_lookup (runtime/sparse_tensor.py)
+        # so the backward exchanges row-sparse grads over the data axes
         self.sparse_gradients_enabled = get_scalar_param(pd, C.SPARSE_GRADIENTS,
                                                          C.SPARSE_GRADIENTS_DEFAULT)
-        if self.sparse_gradients_enabled:
-            from ..utils.logging import logger
-
-            logger.warning(
-                "sparse_gradients: the engine's gradient exchange is dense "
-                "(XLA SPMD); the row-sparse all-reduce utilities live in "
-                "runtime/sparse_tensor.py for custom loops — engine wiring "
-                "is future work")
         self.communication_data_type = get_scalar_param(
             pd, C.COMMUNICATION_DATA_TYPE, C.COMMUNICATION_DATA_TYPE_DEFAULT)
         self.disable_allgather = get_scalar_param(pd, C.DISABLE_ALLGATHER,

@@ -71,6 +71,11 @@ class RandomLTDScheduler:
     def get_keep_count(self, global_step: int, seq_len: int) -> int:
         return min(self._sched.get_difficulty(global_step), seq_len)
 
+    @property
+    def max_value(self) -> int:
+        """Schedule endpoint (kept count when fully ramped)."""
+        return self._sched.max_difficulty
+
     def applies_to_layer(self, layer_idx: int, num_layers: int) -> bool:
         """First and last layer always see the full sequence (reference
         keeps boundary layers dense)."""

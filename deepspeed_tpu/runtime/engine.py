@@ -244,9 +244,93 @@ class DeepSpeedEngine:
             self.curriculum_scheduler = CurriculumScheduler(
                 self._config.curriculum_params)
 
+        # activation_checkpointing config -> model remat selection
+        # (reference activation_checkpointing/checkpointing.py:749) — must
+        # run before _build_state so the first trace sees the new knobs
+        from .remat import apply_config_to_model as _apply_ac
+
+        _apply_ac(self._config.activation_checkpointing_config,
+                  self.model_spec,
+                  log=lambda m: log_dist(m, ranks=[0]),
+                  n_devices=self.mesh.size if self.mesh is not None else 1)
+
+        # sparse_gradients -> row-sparse embedding-grad exchange (reference
+        # engine sparse allreduce, runtime/engine.py:2461-2476)
+        if self._config.sparse_gradients_enabled:
+            mc = getattr(self.model_spec, "model_config", None)
+            if mc is not None and hasattr(mc, "sparse_embedding_grad"):
+                mc.sparse_embedding_grad = True
+                log_dist("sparse_gradients: embedding grads exchange "
+                         "row-sparse over the data axes "
+                         "(runtime/sparse_tensor.py)", ranks=[0])
+            else:
+                logger.warning(
+                    "sparse_gradients: true, but the model does not expose "
+                    "a sparse_embedding_grad knob; exchange stays dense")
+
+        # random-LTD: scheduler drives the per-layer kept-token count; the
+        # count is a trace-time constant, so crossing a schedule value
+        # rebuilds the step fns (same retrace pattern as compression
+        # schedule_offsets).  Reference data_routing/basic_layer.py:13.
+        self.random_ltd_scheduler = None
+        self._ltd_keep = None
+        self._ltd_saturated = False
+        if self._config.random_ltd_enabled:
+            from .data_pipeline.random_ltd import RandomLTDScheduler
+
+            mc = getattr(self.model_spec, "model_config", None)
+            if mc is None or not hasattr(mc, "random_ltd_keep"):
+                raise ValueError(
+                    "data_routing.random_ltd requires a model that exposes "
+                    "a random_ltd_keep knob (ModelSpec.model_config, e.g. "
+                    "models/gpt2.GPT2Config)")
+            self.random_ltd_scheduler = RandomLTDScheduler(
+                self._config.random_ltd_params)
+            # reference layer-range keys (random_ltd_layer_id_start /
+            # random_ltd_layer_num) narrow WHICH layers drop tokens
+            p = self._config.random_ltd_params
+            if "random_ltd_layer_id_start" in p and \
+                    hasattr(mc, "random_ltd_layer_start"):
+                mc.random_ltd_layer_start = int(p["random_ltd_layer_id_start"])
+            if "random_ltd_layer_num" in p and \
+                    hasattr(mc, "random_ltd_layer_num"):
+                mc.random_ltd_layer_num = int(p["random_ltd_layer_num"])
+
         # schedules and optimizer
         self._configure_lr_schedule()
         self._configure_optimizer()
+
+        # 1-bit optimizers: past freeze_step the DP gradient exchange runs
+        # through the error-compensated compressed all-reduce
+        # (runtime/comm/compressed.py; reference runtime/comm/nccl.py:52).
+        # Warmup stays dense, as the reference does.
+        onebit_names = ("onebitadam", "onebitlamb", "zerooneadam")
+        self.onebit_comm_enabled = bool(
+            self._config.optimizer_name in onebit_names
+            and self.topology.data_parallel_size > 1
+            and self.topology.expert_parallel_size == 1
+            and self.topology.model_parallel_size == 1
+            and self.topology.pipe_parallel_size == 1
+            and self.topology.sequence_parallel_size == 1
+            and self.zero_stage == 0
+            and not self.offload_enabled
+            and not self.param_stream_enabled
+            # sparse_embedding_lookup's backward opens its own shard_map;
+            # nesting it inside the onebit step's shard_map is rejected by
+            # jax (and the flattened compressed exchange covers the
+            # embedding grads anyway)
+            and not self._config.sparse_gradients_enabled)
+        self._onebit_compressed = False
+        self._onebit_freeze = int(
+            (self._config.optimizer_params or {}).get("freeze_step", 100))
+        if self._config.optimizer_name in onebit_names and \
+                not self.onebit_comm_enabled and \
+                self.topology.data_parallel_size > 1:
+            logger.warning(
+                "1-bit optimizer: compressed gradient exchange needs a pure "
+                "dp mesh with zero_stage=0 and no offload; the exchange "
+                "stays dense (the optimizer's frozen-variance semantics "
+                "still apply)")
 
         # sharded state
         self._init_rng = jax.random.PRNGKey(self._config.seed or 42)
@@ -374,6 +458,22 @@ class DeepSpeedEngine:
             self._init_offload_optimizer()
             return
 
+        def onebit_errors(params):
+            """Per-worker/server error-feedback buffers for the compressed
+            exchange, [dp, ...]-stacked so they shard over dp.  Created at
+            init (zeros are a no-op through the dense warmup) so the state
+            pytree is stable across the freeze_step transition."""
+            if not self.onebit_comm_enabled:
+                return ()
+            from .comm.compressed import error_shapes
+
+            n = self.topology.data_parallel_size
+            total = sum(int(np.prod(x.shape))
+                        for x in jax.tree_util.tree_leaves(params))
+            we_s, se_s = error_shapes((total,), n)
+            return {"we": jnp.zeros((n,) + we_s, jnp.float32),
+                    "se": jnp.zeros((n,) + se_s, jnp.float32)}
+
         def init_state(rng):
             params = self.model_spec.init(rng)
             params = _cast_floating(params, jnp.float32)  # fp32 master weights
@@ -384,6 +484,7 @@ class DeepSpeedEngine:
                 "params": params,
                 "opt_state": opt_state,
                 "scaler": self._scaler_init(),
+                "onebit": onebit_errors(params),
             }
 
         abstract = jax.eval_shape(init_state, self._init_rng)
@@ -391,6 +492,8 @@ class DeepSpeedEngine:
         self.tp_specs = (self.model_spec.tp_rules(self._abstract_params)
                          if self.model_spec.tp_rules else None)
         rep = NamedSharding(self.mesh, P())
+        from ..parallel.topology import DP_AXIS as _DP
+
         self.state_shardings = {
             "step": rep,
             "params": self.zero_plan.param_shardings(self._abstract_params,
@@ -398,6 +501,8 @@ class DeepSpeedEngine:
             "opt_state": self.zero_plan.opt_shardings_like(
                 self._abstract_params, abstract["opt_state"], self.tp_specs),
             "scaler": jax.tree_util.tree_map(lambda _: rep, abstract["scaler"]),
+            "onebit": jax.tree_util.tree_map(
+                lambda _: NamedSharding(self.mesh, P(_DP)), abstract["onebit"]),
         }
         self.grad_shardings = self.zero_plan.grad_shardings(
             self._abstract_params, self.tp_specs)
@@ -451,6 +556,7 @@ class DeepSpeedEngine:
             "opt_state": (),
             "scaler": jax.tree_util.tree_map(
                 lambda _: rep, jax.eval_shape(self._scaler_init)),
+            "onebit": (),
         }
         self.grad_shardings = self.zero_plan.grad_shardings(abstract, None)
         with self.mesh:
@@ -459,6 +565,7 @@ class DeepSpeedEngine:
                 "params": resident,
                 "opt_state": (),
                 "scaler": self._scaler_init(),
+                "onebit": (),
             }
             self.state = jax.device_put(state_host, self.state_shardings)
         n_res = sum(x.size for x in
@@ -694,6 +801,7 @@ class DeepSpeedEngine:
                 new_params, new_opt = do_update(None)
             new_scaler = next_scaler(scaler, overflow)
             new_state = {
+                **state,  # pass through aux entries (e.g. onebit errors)
                 "step": state["step"] + 1,
                 "params": new_params,
                 "opt_state": new_opt,
@@ -826,6 +934,8 @@ class DeepSpeedEngine:
             train_step,
             out_shardings=(self.state_shardings, metrics_shardings),
             donate_argnums=(0,))
+        if self.onebit_comm_enabled and self._onebit_compressed:
+            self._install_onebit_step(metrics_shardings)
         if self.offload_enabled:
             scaler_rep = jax.tree_util.tree_map(
                 lambda _: rep, self.state_shardings["scaler"])
@@ -846,6 +956,108 @@ class DeepSpeedEngine:
         self._eval_step_fn = jax.jit(eval_step)
         self._tree_add_fn = jax.jit(
             lambda a, b: jax.tree_util.tree_map(jnp.add, a, b),
+            donate_argnums=(0,))
+
+    def _install_onebit_step(self, metrics_shardings) -> None:
+        """Replace the train step with one whose DP gradient exchange runs
+        through the 1-bit compressed all-reduce (reference
+        ``runtime/comm/nccl.py:52``).
+
+        The default step computes grads under global-jit semantics, where
+        XLA inserts the dense psum implicitly — there is no seam to
+        compress.  This variant runs the whole fwd/bwd inside ``shard_map``
+        over dp, so each device holds its LOCAL gas-accumulated gradient,
+        flattens it, and exchanges int8 signs + per-chunk scales
+        (~4x wire reduction) with persistent worker/server error feedback
+        carried in ``state["onebit"]``.  Installed only past freeze_step;
+        warmup uses the dense path (``_advance_onebit`` retraces at the
+        boundary, the same pattern as compression schedule_offsets).
+        """
+        from jax.experimental.shard_map import shard_map
+        from jax.flatten_util import ravel_pytree
+
+        from ..parallel.topology import DP_AXIS
+        from .comm.compressed import compressed_allreduce
+
+        gas = self.gradient_accumulation_steps()
+        fp16 = self.fp16_enabled
+        micro_loss = self._micro_loss_closure()
+        apply_update = self._make_apply_update()
+        mesh = self.mesh
+
+        leaves, treedef = jax.tree_util.tree_flatten(self._abstract_params)
+        sizes = [int(np.prod(x.shape)) for x in leaves]
+        offsets = np.concatenate([[0], np.cumsum(sizes)])
+
+        def unflatten(flat):
+            parts = [flat[int(o):int(o) + s].reshape(l.shape)
+                     for o, s, l in zip(offsets, sizes, leaves)]
+            return jax.tree_util.tree_unflatten(treedef, parts)
+
+        def local_grads(params, scaler, step, batch, base_rng, we, se):
+            """Runs per-device inside shard_map: batch is the LOCAL
+            [gas, micro_local, ...] shard; we/se lose their stacking dim."""
+            we, se = we[0], se[0]
+            scale = (scaler.cur_scale if fp16
+                     else jnp.asarray(1.0, jnp.float32))
+            step_rng = jax.random.fold_in(
+                jax.random.fold_in(base_rng, step),
+                jax.lax.axis_index(DP_AXIS))
+
+            def body(carry, xs):
+                acc, loss_sum = carry
+                micro, idx = xs
+                rng = jax.random.fold_in(step_rng, idx)
+                (_, loss), grads = jax.value_and_grad(
+                    micro_loss, has_aux=True)(params, micro, rng, scale)
+                acc = acc + ravel_pytree(grads)[0].astype(jnp.float32)
+                return (acc, loss_sum + loss.astype(jnp.float32)), None
+
+            total = int(offsets[-1])
+            (flat, loss_sum), _ = jax.lax.scan(
+                body, (jnp.zeros((total,), jnp.float32),
+                       jnp.zeros((), jnp.float32)),
+                (batch, jnp.arange(gas)))
+            flat = flat / (gas * scale)
+            mean_flat, nwe, nse = compressed_allreduce(flat, we, se, DP_AXIS)
+            loss = jax.lax.pmean(loss_sum / gas, DP_AXIS)
+            return mean_flat, loss, nwe[None], nse[None]
+
+        P_ = P
+        sm = shard_map(
+            local_grads, mesh=mesh,
+            in_specs=(P_(), P_(), P_(), P_(None, DP_AXIS), P_(),
+                      P_(DP_AXIS), P_(DP_AXIS)),
+            out_specs=(P_(), P_(), P_(DP_AXIS), P_(DP_AXIS)),
+            check_rep=False)
+
+        def train_step(state, batch, base_rng):
+            mean_flat, mean_loss, nwe, nse = sm(
+                state["params"], state["scaler"], state["step"], batch,
+                base_rng, state["onebit"]["we"], state["onebit"]["se"])
+            grads = unflatten(mean_flat)
+            new_state, metrics = apply_update(state, grads, mean_loss)
+            # fp16 overflow: an inf gradient turns the compression scales
+            # inf and the residuals NaN — the param update is skipped by
+            # apply_update, and the error buffers must roll back with it or
+            # every later step inherits the NaN
+            overflow = metrics["overflow"]
+            new_state = {**new_state, "onebit": jax.tree_util.tree_map(
+                lambda old, new: jnp.where(overflow, old, new),
+                state["onebit"], {"we": nwe, "se": nse})}
+            return new_state, metrics
+
+        def multi_step(state, batches, base_rng):
+            return jax.lax.scan(
+                lambda st, b: train_step(st, b, base_rng), state, batches)
+
+        self._train_step_fn = jax.jit(
+            train_step,
+            out_shardings=(self.state_shardings, metrics_shardings),
+            donate_argnums=(0,))
+        self._train_multi_fn = jax.jit(
+            multi_step,
+            out_shardings=(self.state_shardings, metrics_shardings),
             donate_argnums=(0,))
 
     # ---------------------------------------------------------------- batching
@@ -918,6 +1130,43 @@ class DeepSpeedEngine:
         return jax.tree_util.tree_map(trunc, batch)
 
     # ------------------------------------------------------------------- train
+    def _advance_random_ltd(self, batch) -> None:
+        """Move the model's kept-token count to this step's schedule value;
+        retrace when it changes (the count is a static shape).  The count is
+        clamped to the batch sequence length so a schedule whose max_value
+        exceeds the trained sequence cannot trigger rebuilds of semantically
+        identical dense programs; once the clamped value can no longer
+        change, the scheduler is marked saturated (train_batches then takes
+        the fused multi-step dispatch again)."""
+        if self._ltd_saturated:
+            return
+        # trained sequence length: input_ids minus the shift-by-one ONLY
+        # when targets come from shifting (no explicit labels)
+        if isinstance(batch, dict) and "input_ids" in batch:
+            seq = batch["input_ids"].shape[-1]
+            if batch.get("labels") is None:
+                seq -= 1
+        else:
+            seq = jax.tree_util.tree_leaves(batch)[0].shape[-1] - 1
+        keep = self.random_ltd_scheduler.get_keep_count(
+            self.global_steps, seq)
+        if keep != self._ltd_keep:
+            self._ltd_keep = keep
+            self.model_spec.model_config.random_ltd_keep = keep
+            log_dist(f"random-LTD: kept-token count -> {keep} at step "
+                     f"{self.global_steps}", ranks=[0])
+            self._build_step_fns()
+        # latch ONLY when the schedule is fully ramped AND the model holds
+        # the unclamped endpoint: a seq-clamped value must keep following
+        # the batch (curriculum seqlen can grow later)
+        if keep >= self.random_ltd_scheduler.max_value and \
+                self.random_ltd_scheduler.get_keep_count(
+                    self.global_steps, 1 << 30) >= \
+                self.random_ltd_scheduler.max_value:
+            self._ltd_saturated = True
+            log_dist(f"random-LTD: schedule saturated at {keep} kept tokens; "
+                     "no further retraces", ranks=[0])
+
     def train_batch(self, batch=None, data_iter=None) -> Tuple[Any, Dict]:
         """Run one full global step (all GAS microbatches + update) in one jit.
 
@@ -953,6 +1202,21 @@ class DeepSpeedEngine:
                     f"compression: mechanisms with schedule_offset in "
                     f"{crossed} activate after {completed} steps", ranks=[0])
                 self._build_step_fns()
+
+        if self.random_ltd_scheduler is not None:
+            self._advance_random_ltd(batch)
+
+        # 1-bit: dense warmup until freeze_step, compressed exchange after
+        # (reference keeps the variance-adaptation phase uncompressed)
+        if self.onebit_comm_enabled and not self._onebit_compressed and \
+                self.global_steps >= self._onebit_freeze:
+            self._onebit_compressed = True
+            log_dist(
+                f"1-bit: freeze_step {self._onebit_freeze} reached — "
+                "gradient exchange switches to the compressed all-reduce "
+                "(int8 signs + per-chunk scales, ~4x wire reduction)",
+                ranks=[0])
+            self._build_step_fns()
 
         fp = self._config.flops_profiler_config
         profiling_now = fp.enabled and \
@@ -1020,6 +1284,9 @@ class DeepSpeedEngine:
         host_side_feature = (
             self.offload_enabled
             or getattr(self.model_spec, "_compression_toggle", None) is not None
+            or (self.random_ltd_scheduler is not None
+                and not self._ltd_saturated)
+            or (self.onebit_comm_enabled and not self._onebit_compressed)
             or (self.curriculum_scheduler is not None
                 and self.curriculum_scheduler.curriculum_type == "seqlen")
             or (fp.enabled
@@ -1137,6 +1404,7 @@ class DeepSpeedEngine:
                                                 lr=self._host_lr())
             new_params = self._offload_rebuild_params(new_pieces)
         new_state = {
+            **state,  # pass through aux entries (e.g. onebit errors)
             "step": partial["step"],
             "params": new_params,
             "opt_state": state["opt_state"],

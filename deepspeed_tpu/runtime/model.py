@@ -45,6 +45,11 @@ class ModelSpec:
     #: ``pos`` is the (traced) global position of input_ids[:, 0]; the same
     #: function serves prefill (T=prompt) and decode (T=1).
     decode_hooks: Optional[dict] = None
+    #: The builder's config object (e.g. GPT2Config).  The engine mutates its
+    #: remat knobs when the json config carries an ``activation_checkpointing``
+    #: block (runtime/remat.py) — builders close over the config, so changes
+    #: made before the first jit trace take effect.
+    model_config: Any = None
     #: True = the model's forwards dequantize INT8 weight records
     #: (ops/quantization) lazily at point of use, so the inference engine
     #: passes the quantized pytree straight through — per-layer peak memory

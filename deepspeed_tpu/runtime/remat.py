@@ -1,0 +1,105 @@
+"""Activation-checkpointing (remat) policy selection.
+
+TPU-native analog of the reference's activation checkpointing subsystem
+(``deepspeed/runtime/activation_checkpointing/checkpointing.py:749``
+``configure()``): instead of wrapping module forwards in a checkpoint
+autograd Function, models wrap their block body in ``jax.checkpoint`` and
+this module maps the ``activation_checkpointing`` config block (plus the
+per-model ``remat_policy`` knob) to a jax checkpoint policy.
+
+Key mapping from the reference config block:
+ - ``partition_activations`` — subsumed: under ``jit`` saved residuals
+   inherit the activation sharding, so they are already partitioned across
+   the mesh (no gather/scatter pass is needed).
+ - ``cpu_checkpointing`` — maps to XLA host offload of the saved dot
+   outputs (``offload_dot_with_no_batch_dims``): residuals live in pinned
+   host memory between forward and backward.
+ - ``number_checkpoints / contiguous_memory_optimization /
+   synchronize_checkpoint_boundary`` — allocator/stream knobs with no TPU
+   analog (XLA owns scheduling); accepted and ignored.
+"""
+
+from __future__ import annotations
+
+import jax
+
+#: offload target for cpu_checkpointing (XLA memories API)
+_OFFLOAD_SRC, _OFFLOAD_DST = "device", "pinned_host"
+
+
+def remat_policy(policy: str | None, offload: bool = False):
+    """Resolve a policy name to a ``jax.checkpoint`` policy callable.
+
+    ``policy``: ``"full"`` (recompute everything, reference default),
+    ``"dots"`` (save projection/matmul outputs, recompute attention and
+    elementwise), ``"dots_flash"`` (dots + pin the flash kernel's o/lse so
+    the backward reuses them).  ``offload=True`` moves the saved residuals
+    to pinned host memory (reference ``cpu_checkpointing``).
+    """
+    if policy in (None, "full"):
+        # nothing saved -> nothing to offload
+        return None
+    if policy not in ("dots", "dots_flash"):
+        raise ValueError(f"unknown remat policy {policy!r} "
+                         "(expected full|dots|dots_flash)")
+    if offload:
+        dots = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            _OFFLOAD_SRC, _OFFLOAD_DST)
+    else:
+        dots = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if policy == "dots":
+        return dots
+    if offload:
+        names = jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=["flash_out", "flash_lse"],
+            offload_src=_OFFLOAD_SRC, offload_dst=_OFFLOAD_DST)
+    else:
+        names = jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse")
+    return jax.checkpoint_policies.save_from_both_policies(dots, names)
+
+
+def apply_config_to_model(ac_config, model_spec, log=None,
+                          n_devices: int = 1) -> bool:
+    """Apply an ``activation_checkpointing`` config block to a model.
+
+    Returns True when the model's remat knobs were switched.  The model must
+    expose its config object via ``ModelSpec.model_config`` with ``remat``
+    (bool) and optionally ``remat_policy`` / ``remat_offload`` attributes —
+    all ``models/`` builders do.
+
+    ``cpu_checkpointing`` host offload is honored only on a single-device
+    program: XLA's SPMD partitioner currently rejects the offload
+    placement custom-calls under a >1-device mesh ("Side-effect HLO must
+    have sharding"); remat itself still applies there.
+    """
+    requested = (ac_config.enabled or ac_config.partition_activations
+                 or ac_config.cpu_checkpointing
+                 or ac_config.policy is not None
+                 or ac_config.number_checkpoints is not None)
+    if not requested:
+        return False
+    mc = getattr(model_spec, "model_config", None)
+    if mc is None or not hasattr(mc, "remat"):
+        if log is not None:
+            log("activation_checkpointing is configured but the model does "
+                "not expose remat knobs (ModelSpec.model_config); ignoring")
+        return False
+    mc.remat = True
+    if ac_config.policy is not None and hasattr(mc, "remat_policy"):
+        mc.remat_policy = ac_config.policy
+    if ac_config.cpu_checkpointing:
+        if n_devices > 1:
+            if log is not None:
+                log("activation_checkpointing.cpu_checkpointing: host "
+                    "offload is single-device-only under current XLA SPMD; "
+                    "keeping remat WITHOUT host offload on this "
+                    f"{n_devices}-device mesh")
+        else:
+            mc.remat_offload = True
+    if log is not None:
+        log(f"activation checkpointing: remat=True "
+            f"policy={getattr(mc, 'remat_policy', 'full')} "
+            f"cpu_offload={ac_config.cpu_checkpointing}")
+    return True

@@ -56,16 +56,19 @@ def shard_over_zero_axes(shape: Tuple[int, ...], base_spec: Optional[P], mesh: M
     world size and which leaves existing axes intact; returns ``base_spec``
     unchanged if nothing fits.
     """
-    zero_ws = _axes_size(mesh, zero_axes)
-    if zero_ws == 1 or len(shape) == 0:
-        return base_spec if base_spec is not None else P()
     base = tuple(base_spec) if base_spec is not None else ()
     base = base + (None,) * (len(shape) - len(base))
     used = set()
     for entry in base:
         used.update(_flatten_spec_entry(entry))
-    if any(a in used for a in zero_axes):
-        return P(*base)
+    # shard over whichever zero axes the TP spec leaves free: an expert
+    # leaf already sharded over ep still gets its opt/grad shards divided
+    # over dp (found by the memplan audit — the old early-return left
+    # dp-redundant optimizer copies for every expert parameter)
+    remaining = tuple(a for a in zero_axes if a not in used)
+    zero_ws = _axes_size(mesh, remaining)
+    if zero_ws == 1 or len(shape) == 0:
+        return P(*base) if base else P()
 
     # candidate dims: free (unsharded) with size divisible by zero world size,
     # or already-sharded dims whose residual size is divisible
@@ -81,7 +84,7 @@ def shard_over_zero_axes(shape: Tuple[int, ...], base_spec: Optional[P], mesh: M
         return P(*base)
     new = list(base)
     existing = _flatten_spec_entry(new[best_dim])
-    new[best_dim] = tuple(existing) + tuple(zero_axes)
+    new[best_dim] = tuple(existing) + tuple(remaining)
     if len(new[best_dim]) == 1:
         new[best_dim] = new[best_dim][0]
     return P(*[tuple(e) if isinstance(e, tuple) else e for e in new])

@@ -24,6 +24,7 @@ def _base(**over):
         "autotuning": {"enabled": True, "start_profile_step": 1,
                        "end_profile_step": 2,
                        "num_tuning_micro_batch_sizes": 2,
+                       "tuner_type": "gridsearch",
                        "zero_stages": [0, 1]},
     }
     cfg.update(over)
@@ -68,3 +69,54 @@ def test_infeasible_configs_recorded_not_fatal(tmp_path, eight_devices):
     best = at.tune()
     assert best["feasible"]
     assert any(not r.get("feasible") for r in at.results)
+
+
+# ------------------------------------------------------------ staged (v2)
+def test_staged_tunes_model_knobs(tmp_path, eight_devices):
+    """The v2 staged search must sweep the knobs that actually set TPU
+    throughput (remat policy, scan_layers, gas, flash blocks) and keep
+    per-stage winners (VERDICT r2 #5: the old tuner could not rediscover
+    the hand-found bench config because it never touched them)."""
+    base = _base()
+    base["autotuning"].update({
+        "tuner_type": "staged",
+        "results_dir": str(tmp_path / "results"),
+        "gas_candidates": [1, 2],
+        "remat_policies": ["full", "dots"],
+        "flash_blocks": [[64, 64]],
+        "stages": ["batch", "remat", "gas", "flash"],
+    })
+    at = Autotuner(_factory, base, _batch, seq_len=16)
+    best = at.tune()
+    assert best["feasible"]
+    stages_run = {r.get("stage") for r in at.results}
+    assert {"batch", "remat", "gas", "flash"} <= stages_run
+    # model knobs were exercised
+    model_knobs = [r["config"].get("_model", {}) for r in at.results]
+    assert any("remat_policy" in m for m in model_knobs)
+    assert any("scan_layers" in m for m in model_knobs)
+    assert any("flash_block_q" in m for m in model_knobs)
+    assert any(r["config"].get("gradient_accumulation_steps") == 2
+               for r in at.results)
+    # ranked report emitted
+    import os
+    report = open(os.path.join(str(tmp_path / "results"), "report.md")).read()
+    assert "| rank |" in report and "tok/s" in report
+    # the winner carries the merged per-stage choices
+    assert "_model" in best["config"] or \
+        "gradient_accumulation_steps" in best["config"]
+
+
+def test_model_based_ordering(tmp_path, eight_devices):
+    base = _base()
+    base["autotuning"].update({
+        "tuner_type": "model_based",
+        "results_dir": str(tmp_path / "results"),
+        "gas_candidates": [1, 2],
+        "remat_policies": ["dots"],
+        "flash_blocks": [],
+        "stages": ["batch", "gas"],
+    })
+    at = Autotuner(_factory, base, _batch, seq_len=16)
+    best = at.tune()
+    assert best["feasible"] and best["throughput"] > 0

@@ -2,6 +2,7 @@
 ``tests/unit/runtime/test_data_efficiency.py`` + Megatron indexed-dataset
 round-trips)."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -204,3 +205,152 @@ def test_sampler_state_roundtrip():
     b.load_state_dict(sd)
     np.testing.assert_array_equal(a.next_batch_indices(),
                                   b.next_batch_indices())
+
+
+# ------------------------------------------------- engine wiring (round 3)
+def test_random_ltd_engine_wiring():
+    """data_efficiency.data_routing.random_ltd drives the model's kept-token
+    count through the schedule, retracing at boundaries; loss stays finite
+    and the knob provably changes the traced program."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    deepspeed_tpu.comm.reset_topology()
+    cfg = gpt2.GPT2Config(vocab_size=128, max_seq_len=32, num_layers=4,
+                          num_heads=2, hidden_size=32)
+    model = gpt2.build(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "data_efficiency": {"data_routing": {"enabled": True, "random_ltd": {
+            "enabled": True,
+            "random_ltd_schedule": {
+                "min_value": 8, "max_value": 32,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 4,
+                                    "difficulty_step": 8},
+            }}}},
+    })
+    rng = np.random.default_rng(0)
+    batch = lambda: {"input_ids": rng.integers(
+        0, cfg.vocab_size,
+        size=(engine.train_batch_size(), 17)).astype(np.int32)}
+    keeps = []
+    for _ in range(5):
+        _, m = engine.train_batch(batch())
+        keeps.append(cfg.random_ltd_keep)
+        assert np.isfinite(float(m["loss"]))
+    # schedule grew the kept-token count from 8 toward full
+    assert keeps[0] == 8
+    assert keeps[-1] > keeps[0]
+    assert sorted(keeps) == keeps
+
+
+def test_random_ltd_changes_token_count_in_trace():
+    """Behavioral effect at the trace level: with keep=K the middle layers
+    see [B, K, D] activations (the reference's gather semantics)."""
+    from deepspeed_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config(vocab_size=64, max_seq_len=16, num_layers=3,
+                          num_heads=2, hidden_size=16)
+    cfg.random_ltd_keep = 4
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    batch = {"input_ids": np.zeros((2, 9), np.int32)}
+    jaxpr = jax.make_jaxpr(
+        lambda p: gpt2.loss_from_batch(cfg, p, batch,
+                                       rng=jax.random.PRNGKey(1)))(params)
+    txt = str(jaxpr)
+    assert "(2, 4, 16)" in txt or "2,4,16" in txt  # kept-subset activations
+    # dense baseline has no 4-token activations
+    cfg.random_ltd_keep = None
+    txt_dense = str(jax.make_jaxpr(
+        lambda p: gpt2.loss_from_batch(cfg, p, batch,
+                                       rng=jax.random.PRNGKey(1)))(params))
+    assert "(2, 4, 16)" not in txt_dense
+
+
+def test_random_ltd_saturation_and_layer_range():
+    """Schedule saturation stops retraces (no per-step rebuild churn), and
+    the reference layer-range keys narrow which layers drop tokens."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    deepspeed_tpu.comm.reset_topology()
+    cfg = gpt2.GPT2Config(vocab_size=128, max_seq_len=32, num_layers=4,
+                          num_heads=2, hidden_size=32)
+    model = gpt2.build(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "data_efficiency": {"data_routing": {"enabled": True, "random_ltd": {
+            "enabled": True,
+            "random_ltd_layer_id_start": 2,
+            "random_ltd_layer_num": 1,
+            "random_ltd_schedule": {
+                "min_value": 8, "max_value": 16,  # = trained seq of 16
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 2,
+                                    "difficulty_step": 8},
+            }}}},
+    })
+    assert cfg.random_ltd_layer_start == 2
+    assert cfg.random_ltd_layer_num == 1
+    rng = np.random.default_rng(0)
+    batch = lambda: {"input_ids": rng.integers(
+        0, cfg.vocab_size,
+        size=(engine.train_batch_size(), 17)).astype(np.int32)}
+    rebuild_steps = []
+    orig = engine._build_step_fns
+
+    def spy():
+        rebuild_steps.append(engine.global_steps)
+        orig()
+    engine._build_step_fns = spy
+    for _ in range(6):
+        engine.train_batch(batch())
+    # rebuilds happen only while ramping (8 -> 16), never after the
+    # schedule endpoint is reached
+    assert engine._ltd_saturated
+    assert all(s <= 2 for s in rebuild_steps), rebuild_steps
+
+
+def test_random_ltd_seq_clamp_does_not_latch():
+    """A schedule whose max_value exceeds the trained sequence must NOT
+    latch saturated on the clamped value — a later (curriculum-grown)
+    longer sequence has to pick the schedule back up."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    deepspeed_tpu.comm.reset_topology()
+    cfg = gpt2.GPT2Config(vocab_size=128, max_seq_len=64, num_layers=3,
+                          num_heads=2, hidden_size=32)
+    model = gpt2.build(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "data_efficiency": {"data_routing": {"enabled": True, "random_ltd": {
+            "enabled": True,
+            "random_ltd_schedule": {
+                "min_value": 8, "max_value": 32,  # > short seq of 16
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 2,
+                                    "difficulty_step": 8},
+            }}}},
+    })
+    rng = np.random.default_rng(0)
+
+    def batch(s):
+        return {"input_ids": rng.integers(
+            0, cfg.vocab_size,
+            size=(engine.train_batch_size(), s + 1)).astype(np.int32)}
+
+    for _ in range(4):
+        engine.train_batch(batch(16))   # clamped at 16 < max 32
+    assert not engine._ltd_saturated
+    assert cfg.random_ltd_keep == 16
+    engine.train_batch(batch(48))       # longer seq: schedule resumes
+    assert cfg.random_ltd_keep == 32    # full (unclamped) endpoint
+    assert engine._ltd_saturated

@@ -1,0 +1,178 @@
+"""1-bit compressed DP gradient exchange (engine mode).
+
+Reference: ``runtime/comm/nccl.py:52 compressed_allreduce`` — past
+freeze_step, OneBitAdam's gradient all-reduce ships int8 signs + per-chunk
+scales with error feedback.  Here the engine swaps its train step for a
+shard_map variant at the freeze boundary (engine._install_onebit_step).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt2
+
+
+def _engine(freeze_step, opt_type="OneBitAdam", gas=1):
+    deepspeed_tpu.comm.reset_topology()
+    cfg = gpt2.GPT2Config.tiny()
+    model = gpt2.build(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": opt_type,
+                      "params": {"lr": 1e-3, "freeze_step": freeze_step}},
+    })
+    return cfg, engine
+
+
+def _batches(cfg, engine, n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"input_ids": rng.integers(
+        0, cfg.vocab_size,
+        size=(engine.train_batch_size(), 17)).astype(np.int32)}
+        for _ in range(n)]
+
+
+def test_mode_enabled_and_switches_at_freeze():
+    cfg, engine = _engine(freeze_step=2)
+    assert engine.onebit_comm_enabled
+    assert not engine._onebit_compressed
+    for b in _batches(cfg, engine, 2):
+        engine.train_batch(b)
+    assert not engine._onebit_compressed  # steps 0,1 are warmup
+    engine.train_batch(_batches(cfg, engine, 1)[0])
+    assert engine._onebit_compressed
+
+
+def test_convergence_parity_vs_dense():
+    """Compressed exchange with error feedback must track the dense run:
+    identical during warmup, and within a loose band after freeze (the
+    exchange is lossy per step but unbiased across steps)."""
+    cfg, e1 = _engine(freeze_step=3)
+    batches = _batches(cfg, e1, 1) * 12  # fixed batch: loss must descend
+    lc = [float(e1.train_batch(b)[1]["loss"]) for b in batches]
+
+    # dense baseline: same optimizer semantics, freeze far beyond the run
+    cfg2, e2 = _engine(freeze_step=10_000)
+    ld = [float(e2.train_batch(b)[1]["loss"]) for b in batches]
+
+    np.testing.assert_allclose(lc[:3], ld[:3], rtol=1e-5)  # warmup identical
+    # after freeze: the compressed run keeps descending on the same trend
+    # (lossy per step; error feedback keeps it unbiased across steps —
+    # measured ~0.28 of a 1.14 total descent behind dense at 12 steps on
+    # this 8-worker toy, so the band is 0.35)
+    assert abs(lc[-1] - ld[-1]) < 0.35 * abs(ld[0] - ld[-1]) + 0.02, (lc, ld)
+    assert lc[-1] < lc[0]
+    assert lc[-1] < lc[3]  # descent continues through the compressed phase
+
+
+def test_wire_bytes_drop_in_comms_logger():
+    """The comms logger's trace-time records must show the compressed
+    exchange shipping ~1/4 the dense bytes."""
+    cfg, engine = _engine(freeze_step=1)
+    total = sum(x.size for x in
+                jax.tree_util.tree_leaves(engine.state["params"]))
+    logger = deepspeed_tpu.comm.comms_logger
+    logger.enabled = True
+    logger.prof_all = True
+    try:
+        logger.reset()
+        for b in _batches(cfg, engine, 3):
+            engine.train_batch(b)
+        recs = logger.comms_dict
+        comp = {name: recs[name] for name in recs
+                if "compressed_allreduce" in name}
+        assert comp, f"no compressed records in {list(recs)}"
+        # per-device payload per exchange round: [n, c] int8 (~1 byte/param)
+        # vs the 4-byte dense words a fp32 all-reduce would ship
+        byte_counts = [sz for by_size in comp.values() for sz in by_size]
+        assert max(byte_counts) <= total * 1.1
+        assert max(byte_counts) < total * 4  # strictly below dense volume
+    finally:
+        logger.enabled = False
+        logger.prof_all = False
+        logger.reset()
+
+
+def test_multi_step_dispatch_after_freeze():
+    cfg, engine = _engine(freeze_step=1)
+    engine.train_batch(_batches(cfg, engine, 1)[0])  # warmup step 0
+    engine.train_batch(_batches(cfg, engine, 1)[0])  # switches, step 1
+    assert engine._onebit_compressed
+    _, m = engine.train_batches(_batches(cfg, engine, 3, seed=1))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_gated_off_with_zero_stage():
+    deepspeed_tpu.comm.reset_topology()
+    cfg = gpt2.GPT2Config.tiny()
+    model = gpt2.build(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "OneBitAdam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+    })
+    assert not engine.onebit_comm_enabled
+    b = {"input_ids": np.random.default_rng(0).integers(
+        0, cfg.vocab_size,
+        size=(engine.train_batch_size(), 17)).astype(np.int32)}
+    _, m = engine.train_batch(b)  # dense path still trains
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_fp16_overflow_rolls_back_error_feedback():
+    """An fp16 overflow must not poison the error-feedback buffers: the
+    skipped step's we/se roll back with the param update (a NaN residual
+    would otherwise make every later step NaN)."""
+    deepspeed_tpu.comm.reset_topology()
+    cfg = gpt2.GPT2Config.tiny()
+    model = gpt2.build(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "OneBitAdam",
+                      "params": {"lr": 1e-3, "freeze_step": 0}},
+        # huge initial scale forces overflow on the first step(s)
+        "fp16": {"enabled": True, "initial_scale_power": 32},
+    })
+    assert engine.onebit_comm_enabled
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, cfg.vocab_size,
+        size=(engine.train_batch_size(), 17)).astype(np.int32)}
+    saw_overflow = False
+    for _ in range(6):
+        _, m = engine.train_batch(batch)
+        saw_overflow = saw_overflow or bool(m["overflow"])
+        we = np.asarray(engine.state["onebit"]["we"])
+        assert np.isfinite(we).all(), "error feedback poisoned by overflow"
+    assert saw_overflow  # the scenario actually exercised an overflow
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_sparse_gradients_excludes_compressed_mode():
+    """sparse_embedding_lookup opens its own shard_map; nesting inside the
+    onebit step is rejected by jax, so the engine must keep the dense
+    exchange when both are configured."""
+    deepspeed_tpu.comm.reset_topology()
+    cfg = gpt2.GPT2Config.tiny()
+    cfg.tie_embeddings = False
+    model = gpt2.build(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "OneBitAdam",
+                      "params": {"lr": 1e-3, "freeze_step": 1}},
+        "sparse_gradients": True,
+    })
+    assert not engine.onebit_comm_enabled
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, cfg.vocab_size,
+        size=(engine.train_batch_size(), 17)).astype(np.int32)}
+    for _ in range(3):  # crosses freeze_step without crashing
+        _, m = engine.train_batch(batch)
+    assert np.isfinite(float(m["loss"]))
