@@ -1,0 +1,71 @@
+"""Autotuner v2 acceptance: rediscover the hand-found bench config.
+
+Runs the staged tuner on the REAL bench model (GPT-2 125M, S=1024) on the
+TPU and prints the winning config.  Round-2's hand search found
+remat_policy=dots_flash + scan_layers=False + gas>=8 + flash blocks
+1024x1024 (PROFILE.md); the tuner explores exactly those knob groups and
+must land on an equivalent-throughput point.
+
+Usage: python benchmarks/autotune_bench.py  (~15 min on the chip)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    from deepspeed_tpu.autotuning import Autotuner
+    from deepspeed_tpu.models import gpt2
+
+    def factory():
+        cfg = gpt2.GPT2Config.gpt2_125m()
+        cfg.use_flash = True
+        cfg.remat = True  # baseline; the remat stage varies the policy
+        return gpt2.build(cfg)
+
+    rng = np.random.default_rng(0)
+
+    def batch(global_batch, seq_len):
+        return {"input_ids": rng.integers(
+            0, 50257, (global_batch, seq_len + 1)).astype(np.int32)}
+
+    base = {
+        "train_micro_batch_size_per_gpu": 32,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "autotuning": {
+            "enabled": True,
+            "tuner_type": "staged",
+            "results_dir": "autotuning_results_bench",
+            # the tunneled dev chip needs a long warm window: big unrolled
+            # executables keep paying first-execution costs for several
+            # steps, and per-dispatch jitter is 1-2s — short windows
+            # systematically penalize exactly the configs that win
+            "start_profile_step": 4,
+            "end_profile_step": 12,
+            # micro batch is pinned at 32 (bs>32 is blocked by the dev
+            # tunnel's compile service; zero stages are moot on one chip)
+            "num_tuning_micro_batch_sizes": 1,
+            "zero_stages": [0],
+            "stages": ["batch", "remat", "gas", "flash"],
+            "remat_policies": ["dots", "dots_flash"],
+            "gas_candidates": [1, 16],
+            "flash_blocks": [[512, 1024], [1024, 1024]],
+        },
+    }
+    at = Autotuner(factory, base, batch, seq_len=1024)
+    best = at.tune()
+    print(json.dumps({"best": best["config"],
+                      "tok_s": round(best["throughput"], 1)}))
+
+
+if __name__ == "__main__":
+    main()

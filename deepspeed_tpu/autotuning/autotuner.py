@@ -109,6 +109,11 @@ class Autotuner:
             for _ in range(warm):
                 engine.train_batch(self.batch_factory(
                     engine.train_batch_size(), self.seq_len))
+            # drain the async warm-step backlog BEFORE starting the clock
+            # (dispatch returns at enqueue; without this the measured
+            # window absorbs the warm steps' device time)
+            jax.device_get(jax.tree_util.tree_leaves(
+                engine.state["params"])[0].sum())
             t0 = time.perf_counter()
             for _ in range(steps):
                 _, m = engine.train_batch(self.batch_factory(

@@ -1,0 +1,265 @@
+"""Ingest TORCH-DeepSpeed checkpoints (the migration path for existing
+DeepSpeed users).
+
+Reads a checkpoint directory written by the reference engine
+(``deepspeed/checkpoint/deepspeed_checkpoint.py:39 DeepSpeedCheckpoint``,
+``deepspeed/utils/zero_to_fp32.py``) and reconstructs a full fp32 module
+state dict:
+
+ - ``mp_rank_XX_model_states.pt`` — per-TP-rank module weights (fp16/bf16
+   under ZeRO), ``param_shapes`` (per-group name -> shape, in flattening
+   order), buffers; TP shards merge along per-name cat dims.
+ - ``zero_pp_rank_P_mp_rank_XX_optim_states.pt`` — per-DP-rank flat fp32
+   partitions (``single_partition_of_fp32_groups`` / ``fp32_flat_groups``).
+   ZeRO-2: concatenate rank partitions per param group and unflatten by
+   ``param_shapes`` (2*world alignment padding tolerated, reference
+   zero_to_fp32.py:253).  ZeRO-3: partitions zip at each param boundary
+   with per-param padding (reference ``zero3_partitioned_param_info``).
+
+The fp32 master (when ZeRO files exist) takes precedence over the module
+file's half-precision weights — same as ``zero_to_fp32``.
+
+Torch is only needed to deserialize ``.pt`` files (CPU).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.logging import logger
+
+MODEL_FILE_PREFIX = "mp_rank_"
+ZERO_FILE_PREFIX = "zero_pp_rank_"
+OPTIM_FILE_SUFFIX = "_optim_states.pt"
+MODEL_FILE_SUFFIX = "_model_states.pt"
+
+#: TP merge axes for HF GPT-2 (Conv1D = [in, out]: column-parallel weights
+#: concat on the OUT dim, row-parallel on the IN dim; embeddings on vocab)
+GPT2_CAT_DIMS = [
+    (re.compile(r"(transformer\.)?h\.\d+\.attn\.c_attn\.(weight|bias)"), -1),
+    (re.compile(r"(transformer\.)?h\.\d+\.mlp\.c_fc\.(weight|bias)"), -1),
+    (re.compile(r"(transformer\.)?h\.\d+\.attn\.c_proj\.weight"), 0),
+    (re.compile(r"(transformer\.)?h\.\d+\.mlp\.c_proj\.weight"), 0),
+    (re.compile(r"(transformer\.)?wte\.weight"), 0),
+]
+#: replicated across TP (take rank 0): norms, row-parallel biases, wpe
+GPT2_REPLICATED = [
+    re.compile(r"(transformer\.)?h\.\d+\.ln_[12]\.(weight|bias)"),
+    re.compile(r"(transformer\.)?ln_f\.(weight|bias)"),
+    re.compile(r"(transformer\.)?h\.\d+\.(attn|mlp)\.c_proj\.bias"),
+    re.compile(r"(transformer\.)?wpe\.weight"),
+]
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, np.float32)
+
+
+def _torch_load(path):
+    import torch
+
+    return torch.load(path, map_location="cpu", weights_only=False)
+
+
+class DeepSpeedNativeCheckpoint:
+    """Parsed view of a reference-engine checkpoint directory."""
+
+    def __init__(self, ckpt_dir: str):
+        if os.path.isfile(os.path.join(ckpt_dir, "latest")):
+            with open(os.path.join(ckpt_dir, "latest")) as f:
+                ckpt_dir = os.path.join(ckpt_dir, f.read().strip())
+        self.dir = ckpt_dir
+        files = sorted(os.listdir(ckpt_dir))
+        self.model_files = [f for f in files
+                            if f.startswith(MODEL_FILE_PREFIX)
+                            and f.endswith(MODEL_FILE_SUFFIX)]
+        self.zero_files = [f for f in files
+                           if ZERO_FILE_PREFIX in f
+                           and f.endswith(OPTIM_FILE_SUFFIX)]
+        if not self.model_files:
+            raise FileNotFoundError(
+                f"no {MODEL_FILE_PREFIX}*{MODEL_FILE_SUFFIX} in {ckpt_dir} — "
+                "not a DeepSpeed checkpoint directory")
+        self.tp_degree = len(self.model_files)
+        # zero files: zero_pp_rank_{dp}_mp_rank_{tp}_optim_states.pt
+        self.dp_degree = max(
+            (int(re.search(r"zero_pp_rank_(\d+)", f).group(1))
+             for f in self.zero_files), default=0) + 1 \
+            if self.zero_files else 1
+        self._model_states = [None] * self.tp_degree
+        logger.info(f"DS-native checkpoint: tp={self.tp_degree} "
+                    f"dp={self.dp_degree} zero_files={len(self.zero_files)}")
+
+    # ------------------------------------------------------------- raw reads
+    def model_state(self, tp_rank: int = 0) -> Dict[str, Any]:
+        if self._model_states[tp_rank] is None:
+            self._model_states[tp_rank] = _torch_load(
+                os.path.join(self.dir, self.model_files[tp_rank]))
+        return self._model_states[tp_rank]
+
+    def client_state(self) -> Dict[str, Any]:
+        sd = self.model_state(0)
+        return {k: sd.get(k) for k in
+                ("global_steps", "global_samples", "skipped_steps",
+                 "iteration", "lr_scheduler", "ds_version") if k in sd}
+
+    # ------------------------------------------------------- module weights
+    def _merge_tp(self, name: str, shards: List[np.ndarray],
+                  cat_dims=GPT2_CAT_DIMS, replicated=GPT2_REPLICATED):
+        if len(shards) == 1:
+            return shards[0]
+        for pat in replicated:
+            if pat.fullmatch(name):
+                return shards[0]
+        for pat, dim in cat_dims:
+            if pat.fullmatch(name):
+                return np.concatenate(shards, axis=dim)
+        logger.warning(f"DS-native: no TP merge rule for {name!r}; "
+                       "taking rank 0")
+        return shards[0]
+
+    def module_state_dict(self, dtype=np.float32) -> Dict[str, np.ndarray]:
+        """TP-merged module weights (half precision under ZeRO — prefer
+        :meth:`fp32_state_dict` when ZeRO files exist)."""
+        per_rank = [self.model_state(r)["module"]
+                    for r in range(self.tp_degree)]
+        out = {}
+        for name in per_rank[0]:
+            shards = [_np(sd[name]) for sd in per_rank]
+            out[name] = self._merge_tp(name, shards).astype(dtype)
+        return out
+
+    # ------------------------------------------------------------ zero fp32
+    def _param_shapes(self, tp_rank: int):
+        """Normalized: list of per-group OrderedDict name -> np shape."""
+        ps = self.model_state(tp_rank)["param_shapes"]
+        if isinstance(ps, dict):
+            ps = [ps]
+        return [{k: tuple(int(d) for d in
+                          (v.shape if hasattr(v, "shape") else
+                           (v if isinstance(v, (tuple, list)) else
+                            v.size())))
+                 for k, v in group.items()} for group in ps]
+
+    def _flat_groups(self, tp_rank: int):
+        """[dp][group] flat fp32 partitions + the zero stage."""
+        groups, stage = [], 2
+        for dp in range(self.dp_degree):
+            fname = None
+            for f in self.zero_files:
+                if (f"zero_pp_rank_{dp}_" in f
+                        and f"mp_rank_{tp_rank:02d}" in f):
+                    fname = f
+                    break
+            if fname is None:
+                raise FileNotFoundError(
+                    f"missing zero partition dp={dp} tp={tp_rank}")
+            osd = _torch_load(os.path.join(self.dir, fname))
+            osd = osd.get("optimizer_state_dict", osd)
+            stage = int(osd.get("zero_stage", 2))
+            flats = osd.get("single_partition_of_fp32_groups",
+                            osd.get("fp32_flat_groups"))
+            if flats is None:
+                raise KeyError(
+                    "no single_partition_of_fp32_groups/fp32_flat_groups in "
+                    f"{fname}")
+            if not isinstance(flats, (list, tuple)):
+                flats = [flats]
+            groups.append([_np(t).reshape(-1) for t in flats])
+        return groups, stage
+
+    def fp32_state_dict(self, tp_rank: int = 0) -> Dict[str, np.ndarray]:
+        """Reconstruct the full fp32 weights of one TP rank from the ZeRO
+        partitions (``zero_to_fp32`` protocol)."""
+        if not self.zero_files:
+            return {k: _np(v) for k, v in
+                    self.model_state(tp_rank)["module"].items()}
+        shapes = self._param_shapes(tp_rank)
+        flat_by_dp, stage = self._flat_groups(tp_rank)
+        out: Dict[str, np.ndarray] = {}
+        if stage == 3:
+            # partitions zip at EACH param boundary, per-param padding
+            world = self.dp_degree
+            merged_shapes = {k: v for g in shapes for k, v in g.items()}
+            # stage-3 checkpoints hold ONE flat group per rank
+            flats = [np.concatenate(f) if len(f) > 1 else f[0]
+                     for f in flat_by_dp]
+            offset = 0
+            for name, shape in merged_shapes.items():
+                numel = int(np.prod(shape)) if shape else 1
+                part = math.ceil(numel / world)
+                pieces = [f[offset:offset + part] for f in flats]
+                full = np.concatenate(pieces)[:numel]
+                out[name] = full.reshape(shape)
+                offset += part
+        else:
+            # stage 1/2: concat rank partitions per group, then unflatten
+            ngroups = len(flat_by_dp[0])
+            for gi in range(ngroups):
+                full = np.concatenate([flat_by_dp[dp][gi]
+                                       for dp in range(self.dp_degree)])
+                offset = 0
+                for name, shape in shapes[gi].items():
+                    numel = int(np.prod(shape)) if shape else 1
+                    out[name] = full[offset:offset + numel].reshape(shape)
+                    offset += numel
+                # 2*world alignment padding is legal residue
+                align = 2 * self.dp_degree
+                if math.ceil(offset / align) * align < full.size and \
+                        full.size - offset >= align:
+                    logger.warning(
+                        f"DS-native: group {gi} leaves {full.size - offset} "
+                        "unconsumed elements (beyond alignment padding)")
+        # buffers ride in the module state
+        module = self.model_state(tp_rank)["module"]
+        for name in self.model_state(tp_rank).get("buffer_names", ()):
+            if name in module:
+                out[name] = _np(module[name])
+        return out
+
+    def merged_fp32_state_dict(self) -> Dict[str, np.ndarray]:
+        """fp32 weights merged across TP ranks."""
+        per_rank = [self.fp32_state_dict(r) for r in range(self.tp_degree)]
+        return {name: self._merge_tp(name, [sd[name] for sd in per_rank])
+                for name in per_rank[0]}
+
+
+def load_ds_checkpoint_into(ckpt_dir: str, cfg=None,
+                            convert: Optional[Callable] = None):
+    """One-call ingestion: reference checkpoint dir -> our param pytree.
+
+    ``convert(cfg, state_dict) -> params`` defaults to the GPT-2 family's
+    HF-name converter (module_inject policy table).  Returns
+    ``(params, cfg, client_state)`` — the (possibly inferred) config is
+    returned so the caller can ``gpt2.build(cfg)`` a matching model
+    (NOTE: a cfg inferred from shapes guesses ``num_heads = d // 64``;
+    pass an explicit cfg for other head dims).
+    """
+    ck = DeepSpeedNativeCheckpoint(ckpt_dir)
+    sd = ck.merged_fp32_state_dict()
+    if convert is None:
+        from ..models.gpt2 import GPT2Config
+        from ..module_inject.replace_policy import _gpt2_convert
+
+        if cfg is None:
+            n_layer = 1 + max(int(m.group(1)) for m in
+                              (re.search(r"h\.(\d+)\.", k) for k in sd)
+                              if m)
+            wte = next(v for k, v in sd.items() if k.endswith("wte.weight"))
+            wpe = next(v for k, v in sd.items() if k.endswith("wpe.weight"))
+            qkv = next(v for k, v in sd.items()
+                       if k.endswith("h.0.attn.c_attn.weight"))
+            d = wte.shape[1]
+            cfg = GPT2Config(vocab_size=wte.shape[0], max_seq_len=wpe.shape[0],
+                             num_layers=n_layer, hidden_size=d,
+                             num_heads=max(1, d // 64))
+            assert qkv.shape == (d, 3 * d), "not a GPT-2-family checkpoint"
+        convert = _gpt2_convert
+    return convert(cfg, sd), cfg, ck.client_state()

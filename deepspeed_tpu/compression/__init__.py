@@ -4,9 +4,12 @@ reduction for distillation — all config-driven via ``init_compression``."""
 
 from .compress import init_compression, redundancy_clean
 from .config import get_compression_config
-from .ops import (fake_quantize, head_pruning_mask, quantize_activation,
-                  row_pruning_mask, sparse_pruning_mask)
+from .distill import (distillation_loss, init_distillation,
+                      student_initialization)
+from .ops import (channel_pruning_mask, fake_quantize, head_pruning_mask,
+                  quantize_activation, row_pruning_mask, sparse_pruning_mask)
 
 __all__ = ["init_compression", "redundancy_clean", "get_compression_config",
            "fake_quantize", "quantize_activation", "sparse_pruning_mask",
-           "row_pruning_mask", "head_pruning_mask"]
+           "row_pruning_mask", "head_pruning_mask", "channel_pruning_mask",
+           "distillation_loss", "init_distillation", "student_initialization"]

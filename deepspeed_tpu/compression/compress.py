@@ -23,8 +23,8 @@ import numpy as np
 from ..runtime.model import ModelSpec
 from ..utils.logging import log_dist
 from .config import CompressionConfig, get_compression_config
-from .ops import (fake_quantize, head_pruning_mask, row_pruning_mask,
-                  sparse_pruning_mask)
+from .ops import (channel_pruning_mask, fake_quantize, head_pruning_mask,
+                  row_pruning_mask, sparse_pruning_mask)
 
 PyTree = Any
 
@@ -90,6 +90,16 @@ def _build_transform(cfg: CompressionConfig, num_heads: Optional[int]):
             rules.append(("head", grp.modules,
                           lambda w, r=ratio: w * head_pruning_mask(
                               w, r, num_heads), off))
+
+    cp = cfg.channel_pruning
+    if cp.shared_parameters.enabled:
+        off = cp.shared_parameters.schedule_offset
+        for gname, grp in cp.different_groups.items():
+            ratio = grp.dense_ratio
+            rules.append(("channel", grp.modules,
+                          lambda w, r=ratio:
+                          w * channel_pruning_mask(w, r) if w.ndim >= 3
+                          else w, off))
     return rules
 
 
@@ -124,14 +134,34 @@ def init_compression(model: ModelSpec, deepspeed_config,
         getattr(deepspeed_config, "_param_dict", {})
     cfg = get_compression_config(pd)
     rules = _build_transform(cfg, num_heads)
-    if not rules:
+
+    # activation quantization: there is no module tree to wrap, so models
+    # expose an ``act_quant_bits`` knob (matmul inputs fake-quantize when
+    # set — models/gpt2._block); the wrapper flips it at trace time so
+    # schedule_offset composes with the engine's retrace-at-offset
+    aq = cfg.activation_quantization
+    act_bits = act_off = None
+    mc = getattr(model, "model_config", None)
+    if aq.shared_parameters.enabled:
+        if mc is None or not hasattr(mc, "act_quant_bits"):
+            log_dist("activation_quantization: model exposes no "
+                     "act_quant_bits knob; ignoring", ranks=[0])
+        else:
+            grp = next(iter(aq.different_groups.values()), None)
+            act_bits = grp.target_bits if grp is not None else 8
+            act_off = aq.shared_parameters.schedule_offset
+            if hasattr(mc, "act_quant_type"):
+                mc.act_quant_type = aq.shared_parameters.quantization_type
+
+    if not rules and act_bits is None:
         log_dist("init_compression: no compression groups enabled", ranks=[0])
         return model
 
     import dataclasses
 
     orig_loss, orig_apply = model.loss_fn, model.apply_fn
-    offsets = sorted({off for _, _, _, off in rules})
+    offsets = sorted({off for _, _, _, off in rules} |
+                     ({act_off} if act_bits is not None else set()))
 
     class _Toggle:
         """Trace-time step marker: the engine advances ``step`` as offsets
@@ -143,11 +173,19 @@ def init_compression(model: ModelSpec, deepspeed_config,
         def active(cls):
             return any(off <= cls.step for off in offsets)
 
+    def _sync_act_quant():
+        # runs at TRACE time, before the model traces: the knob the model
+        # reads reflects this trace's step marker
+        if act_bits is not None:
+            mc.act_quant_bits = act_bits if _Toggle.step >= act_off else None
+
     def loss_fn(params, batch, rng=None, train=True):
+        _sync_act_quant()
         p = compress_params(params, rules, step=_Toggle.step)
         return orig_loss(p, batch, rng, train)
 
     def apply_fn(params, batch, rng=None):
+        _sync_act_quant()
         p = compress_params(params, rules, step=_Toggle.step)
         return orig_apply(p, batch, rng)
 

@@ -87,6 +87,7 @@ class CompressionConfig(DeepSpeedConfigModel):
     sparse_pruning: PruneConfig = PruneConfig()
     row_pruning: PruneConfig = PruneConfig()
     head_pruning: PruneConfig = PruneConfig()
+    channel_pruning: PruneConfig = PruneConfig()
     layer_reduction: LayerReductionConfig = LayerReductionConfig()
 
 

@@ -1,0 +1,107 @@
+"""Knowledge-distillation glue for compression-aware training.
+
+Reference: ``compression/helper.py student_initialization`` initializes a
+reduced student from teacher layers, and the compression examples combine
+the task loss with a temperature-scaled KL to the teacher's logits.  Here:
+
+ - :func:`student_initialization` — student params from selected teacher
+   layers (wraps ``apply_layer_reduction``; non-block leaves copy over).
+ - :func:`distillation_loss` — (1-alpha)*hard + alpha*T^2*KL(teacher||student).
+ - :func:`init_distillation` — wrap a student :class:`ModelSpec` so its
+   ``loss_fn`` trains against a FROZEN teacher (teacher params are closed
+   over and stop_gradient'd; the teacher forward shares the step's jit).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..runtime.model import ModelSpec
+from .compress import apply_layer_reduction
+
+PyTree = Any
+
+
+def student_initialization(teacher_params: PyTree, blocks_key,
+                           teacher_layers: List[int]) -> PyTree:
+    """Student params whose stacked blocks are the selected teacher layers
+    (reference ``student_initialization``: layer_reduction.teacher_layer)."""
+    return apply_layer_reduction(teacher_params, blocks_key, teacher_layers)
+
+
+def distillation_loss(student_logits, teacher_logits, hard_loss,
+                      alpha: float = 0.5, temperature: float = 1.0):
+    """Soft-target KD: ``(1-alpha) * hard + alpha * T^2 * KL(t || s)``.
+
+    The ``T^2`` factor keeps soft-gradient magnitudes comparable across
+    temperatures (Hinton et al. 2015 — the convention the reference's
+    example configs assume)."""
+    t = jnp.asarray(temperature, jnp.float32)
+    s = jax.nn.log_softmax(student_logits.astype(jnp.float32) / t, axis=-1)
+    p = jax.nn.softmax(
+        jax.lax.stop_gradient(teacher_logits).astype(jnp.float32) / t,
+        axis=-1)
+    kl = jnp.sum(p * (jnp.log(jnp.maximum(p, 1e-20)) - s), axis=-1).mean()
+    return (1.0 - alpha) * hard_loss + alpha * t * t * kl
+
+
+def init_distillation(student: ModelSpec, teacher_params: PyTree,
+                      alpha: float = 0.5, temperature: float = 2.0,
+                      teacher_apply=None) -> ModelSpec:
+    """Wrap ``student`` so training distills from a frozen teacher.
+
+    ``teacher_apply(params, batch, rng) -> logits`` defaults to the
+    student's own ``apply_fn`` (reduced-layer student of the same family —
+    the layer_reduction workflow).  The teacher's params ride as a closure
+    constant: XLA folds them in as weights, no optimizer state grows.
+
+    The student runs ONE forward per step: the hard CE derives from the
+    same logits the KD term uses (next-token shift or explicit ``labels``,
+    the GPT-2-family convention).  ``apply_fn`` runs eval-mode, so KD
+    training here is dropout-free — both loss terms see the same network.
+    """
+    import dataclasses
+
+    if teacher_apply is None:
+        teacher_apply = student.apply_fn
+    assert teacher_apply is not None, "teacher needs an apply_fn"
+    assert student.apply_fn is not None, "student needs an apply_fn"
+    student_apply = student.apply_fn
+    frozen_teacher = jax.tree_util.tree_map(jax.lax.stop_gradient,
+                                            teacher_params)
+
+    def _targets_of(batch):
+        if isinstance(batch, (tuple, list)):
+            ids, labels = batch
+        else:
+            ids = batch["input_ids"]
+            labels = batch.get("labels")
+        return labels  # None = shift convention
+
+    def _ce(logits, batch):
+        labels = _targets_of(batch)
+        if labels is None:
+            ids = batch["input_ids"] if isinstance(batch, dict) else batch[0]
+            logits, labels = logits[:, :-1], ids[:, 1:]
+        valid = labels >= 0
+        safe = jnp.where(valid, labels, 0)
+        lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(
+            logits, safe[..., None], axis=-1)[..., 0].astype(jnp.float32)
+        nll = jnp.where(valid, lse - picked, 0.0)
+        return nll.sum() / jnp.maximum(valid.sum(), 1), logits
+
+    def loss_fn(params, batch, rng=None, train=True):
+        s_logits_full = student_apply(params, batch, rng)
+        t_logits_full = teacher_apply(frozen_teacher, batch, None)
+        hard, s_logits = _ce(s_logits_full, batch)
+        if _targets_of(batch) is None:
+            t_logits_full = t_logits_full[:, :-1]
+        return distillation_loss(s_logits, t_logits_full, hard,
+                                 alpha=alpha, temperature=temperature)
+
+    return dataclasses.replace(student, loss_fn=loss_fn,
+                               name=student.name + "+distill")

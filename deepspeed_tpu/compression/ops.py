@@ -97,6 +97,18 @@ def row_pruning_mask(w, dense_ratio: float):
     return (norms >= thresh)[..., None].astype(w.dtype) * jnp.ones_like(w)
 
 
+def channel_pruning_mask(w, dense_ratio: float):
+    """Structured output-channel mask for conv kernels by per-channel l1
+    norm (reference ``Conv2dLayer_Compress.fix_channel_pruning``);
+    w: [kh, kw, cin, cout] (our VAE/UNet layout) — masks the cout dim."""
+    if w.ndim < 3:
+        return jnp.ones_like(w)
+    norms = jnp.sum(jnp.abs(w), axis=tuple(range(w.ndim - 1)))  # [cout]
+    k = max(1, int(norms.shape[-1] * dense_ratio))
+    thresh = jnp.sort(norms)[-k]
+    return (norms >= thresh).astype(w.dtype) * jnp.ones_like(w)
+
+
 def head_pruning_mask(w, dense_ratio: float, num_heads: int):
     """Attention-head mask by per-head l1 norm on an output-projection-shaped
     weight [in(=H*hd), out] (reference ``fix_head_pruning``)."""

@@ -69,6 +69,11 @@ class GPT2Config:
     #: matmul); the option remains for large-vocab/small-d models.
     fused_ce: Optional[bool] = None
     ce_chunks: int = 4
+    #: activation fake-quantization bits (compression_training
+    #: ``activation_quantization``; None = off).  Matmul inputs in the
+    #: block quantize-dequantize with straight-through gradients.
+    act_quant_bits: Optional[int] = None
+    act_quant_type: str = "symmetric"
     #: random-LTD kept-token count (None/>=S = dense).  Set by the engine's
     #: RandomLTDScheduler (runtime/engine.py _advance_random_ltd); middle
     #: layers process a random ordered subset of this many tokens
@@ -206,7 +211,18 @@ def _block(cfg: GPT2Config, x, layer, mask, rng, dropout: float):
     h, hd = cfg.num_heads, cfg.head_dim
     layer = _maybe_dequant(layer, x.dtype)
 
-    y = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+    aq_bits = getattr(cfg, "act_quant_bits", None)
+
+    def _aq(t):
+        if aq_bits is None:
+            return t
+        from ..compression.ops import quantize_activation
+
+        return quantize_activation(t, aq_bits,
+                                   getattr(cfg, "act_quant_type",
+                                           "symmetric"))
+
+    y = _aq(_layer_norm(x, layer["ln1_scale"], layer["ln1_bias"]))
     qkv = y @ layer["qkv_w"].astype(y.dtype) + layer["qkv_b"].astype(y.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
@@ -246,12 +262,12 @@ def _block(cfg: GPT2Config, x, layer, mask, rng, dropout: float):
             keep = jax.random.bernoulli(rng, 1.0 - dropout, probs.shape)
             probs = probs * keep / (1.0 - dropout)
         attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
-    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
+    attn = _aq(attn.transpose(0, 2, 1, 3).reshape(b, s, d))
     x = x + attn @ layer["o_w"].astype(x.dtype) + layer["o_b"].astype(x.dtype)
 
-    y = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
-    hid = jax.nn.gelu(y @ layer["fc_w"].astype(y.dtype) +
-                      layer["fc_b"].astype(y.dtype))
+    y = _aq(_layer_norm(x, layer["ln2_scale"], layer["ln2_bias"]))
+    hid = _aq(jax.nn.gelu(y @ layer["fc_w"].astype(y.dtype) +
+                          layer["fc_b"].astype(y.dtype)))
     x = x + hid @ layer["proj_w"].astype(x.dtype) + layer["proj_b"].astype(x.dtype)
     return x
 

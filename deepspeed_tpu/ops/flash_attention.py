@@ -334,7 +334,6 @@ def _bwd_dkv_call(q, k, v, do, lse_b, delta_b, *, sm_scale, causal, block_q,
     bh_kv = k.shape[0]
     q_len, d = q.shape[1], q.shape[2]
     rep = head_rep
-    assert layout is None or rep == 1, "sparse layout + GQA not supported"
     nq = pl.cdiv(q_len, block_q)
     nk = pl.cdiv(kv_len, block_k)
     dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
@@ -352,9 +351,13 @@ def _bwd_dkv_call(q, k, v, do, lse_b, delta_b, *, sm_scale, causal, block_q,
         pl.BlockSpec((1, block_q, LANES), q_map),
     ]
     if layout is not None:
+        # the layout is per Q-head: follow the q index map through the
+        # (rep, q_blocks) inner grid so GQA composes with sparsity
         h, lq, lk = layout.shape
-        in_specs.append(pl.BlockSpec((1, lq, lk), lambda b, j, i: (b % h, 0, 0),
-                                     memory_space=pltpu.SMEM))
+        in_specs.append(pl.BlockSpec(
+            (1, lq, lk),
+            lambda b, j, i: ((b * rep + i // nq) % h, 0, 0),
+            memory_space=pltpu.SMEM))
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bh_kv, nk, rep * nq),
@@ -637,22 +640,23 @@ def _flash_bwd_rule(sm_scale, causal, block_q, block_k, interpret, true_kv_len,
 _flash_attention_bh.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
 def _sparse_attention_bh(q, k, v, layout, sm_scale, causal, block_q, block_k,
-                         interpret):
+                         interpret, head_rep=1):
     o, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
-                k.shape[1], 1, layout)
+                k.shape[1], head_rep, layout)
     return o
 
 
 def _sparse_fwd_rule(q, k, v, layout, sm_scale, causal, block_q, block_k,
-                     interpret):
+                     interpret, head_rep=1):
     o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
-                  k.shape[1], 1, layout)
+                  k.shape[1], head_rep, layout)
     return o, (q, k, v, layout, o, lse)
 
 
-def _sparse_bwd_rule(sm_scale, causal, block_q, block_k, interpret, res, g):
+def _sparse_bwd_rule(sm_scale, causal, block_q, block_k, interpret, head_rep,
+                     res, g):
     q, k, v, layout, o, lse = res
     kv_len = k.shape[1]
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
@@ -660,7 +664,7 @@ def _sparse_bwd_rule(sm_scale, causal, block_q, block_k, interpret, res, g):
     delta_b = jnp.broadcast_to(delta[..., None], delta.shape + (LANES,))
     kw = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
               block_k=block_k, kv_len=kv_len, interpret=interpret,
-              layout=layout)
+              head_rep=head_rep, layout=layout)
     dq = _bwd_dq_call(q, k, v, g, lse_b, delta_b, **kw)
     dk, dv = _bwd_dkv_call(q, k, v, g, lse_b, delta_b, **kw)
     return dq, dk, dv, jnp.zeros_like(layout)

@@ -25,12 +25,16 @@ def sparse_attention(q, k, v, layout, block: int,
                      sm_scale: Optional[float] = None,
                      causal: bool = False,
                      interpret: Optional[bool] = None):
-    """q/k/v: [B, H, S, D]; layout: [H, S/block, S/block] 0/1.
+    """q: [B, H, S, D]; k/v: [B, Hkv, S, D] (GQA: Hkv | H); layout:
+    [H, S/block, S/block] 0/1.
 
     Returns [B, H, S, D].  ``causal=True`` additionally lower-triangularizes
     inside diagonal blocks (configs built with ``attention="unidirectional"``
     already gate strictly-upper blocks off)."""
     b, h, s, d = q.shape
+    hkv = k.shape[1]
+    assert h % hkv == 0, f"GQA needs num_heads {h} % kv_heads {hkv} == 0"
+    rep = h // hkv
     n = layout.shape[-1]
     assert s % block == 0 and s // block == n, (
         f"seq {s} != layout blocks {n} x block {block}")
@@ -44,10 +48,10 @@ def sparse_attention(q, k, v, layout, block: int,
         layout = jnp.broadcast_to(layout, (h,) + layout.shape[1:])
 
     qf = q.reshape(b * h, s, d)
-    kf = k.reshape(b * h, s, d)
-    vf = v.reshape(b * h, s, d)
+    kf = k.reshape(b * hkv, s, d)
+    vf = v.reshape(b * hkv, s, d)
     o = _sparse_attention_bh(qf, kf, vf, layout, sm_scale, causal, block,
-                             block, interpret)
+                             block, interpret, rep)
     return o.reshape(b, h, s, d)
 
 
@@ -81,6 +85,10 @@ def sparse_attention_reference(q, k, v, layout, block: int,
                                causal: bool = False):
     """Dense einsum reference honoring the block layout (for tests)."""
     b, h, s, d = q.shape
+    if k.shape[1] != h:  # GQA: repeat KV heads for the dense math
+        rep = h // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     mask = np.kron(np.asarray(layout, bool),
                    np.ones((block, block), bool))  # [H, S, S]
     if causal:

@@ -29,7 +29,6 @@ bf16_optimizer.py:38).
 
 from __future__ import annotations
 
-import os
 import time
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple, Union
 
@@ -217,18 +216,12 @@ class DeepSpeedEngine:
                     "offload_param needs a block-structured model "
                     "(ModelSpec.pipeline_hooks) so layers can stream "
                     "one scan step at a time")
-            if jax.process_count() > 1 and not os.environ.get(
-                    "DS_PARAM_STREAM_MULTIHOST_UNVALIDATED"):
-                # the grad-push io_callback's per-process cotangent semantics
-                # (partial vs already-reduced) have NOT been validated on a
-                # real pod; a wrong guess silently double-counts streamed
-                # block grads.  The host reduction path exists
-                # (comm.host_all_reduce_sum in _host_apply) — opt in with
-                # DS_PARAM_STREAM_MULTIHOST_UNVALIDATED=1 to exercise it.
-                raise RuntimeError(
-                    "offload_param is single-controller until the multi-host "
-                    "grad-push semantics are pod-validated; set "
-                    "DS_PARAM_STREAM_MULTIHOST_UNVALIDATED=1 to opt in")
+            # multi-controller validated (round 3): callbacks pin to the
+            # GLOBAL first device, so process 0's store serves loads and
+            # receives the full psum'd grad push; _host_apply's
+            # host_all_reduce_sum distributes it.  2-process x 2-device
+            # loss parity vs the single-process run is asserted by
+            # tests/unit/test_multiprocess.py::test_two_process_param_streaming_matches_single_process.
             if self.topology.pipe_parallel_size > 1:
                 raise ValueError(
                     "offload_param with pp>1 is unsupported: the pipeline "
@@ -528,7 +521,9 @@ class DeepSpeedEngine:
         from .zero.param_stream import StreamedParamStore
 
         path = self._pp_blocks_path()
-        cpu = jax.devices("cpu")[0]
+        # local_devices: under multi-controller, jax.devices()[0] can be
+        # another process's device — device_get of the init would fail there
+        cpu = jax.local_devices(backend="cpu")[0]
         with jax.default_device(cpu):
             params_full = jax.jit(
                 lambda r: _cast_floating(self.model_spec.init(r),

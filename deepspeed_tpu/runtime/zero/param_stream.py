@@ -18,8 +18,10 @@ the stacked blocks) stay on device and follow the normal offload path.
 
 The host optimizer step for streamed blocks runs on the fp32 master with the
 same C++ CPU Adam as the optimizer-offload tier; the bf16 compute copy is
-refreshed after each applied step.  Single-controller for now (multi-host
-streaming needs a host-side grad reduction).
+refreshed after each applied step.  Multi-controller works: callbacks pin
+to the global first device (see ``_cb_sharding``), process 0 receives the
+full reduced grad push, and the engine's host all-reduce distributes it to
+every process's optimizer.
 """
 
 from __future__ import annotations
@@ -71,13 +73,26 @@ class StreamedParamStore:
     # ------------------------------------------------------------- jit-side
     @property
     def _cb_sharding(self):
-        """Pin callbacks to one device: with >1 local device (dp>1 in one
-        process) unpinned io_callback invocation count is implementation-
-        defined — the grad push must fire exactly once per bwd step or the
-        host accumulator double-counts."""
+        """Pin callbacks to the GLOBAL first device.
+
+        One device so the invocation count is exactly one per step (with
+        >1 local device an unpinned io_callback's count is implementation-
+        defined and the grad accumulator would double-count), and the
+        *global* first device so every controller compiles the SAME
+        program: per-process pins (``local_devices()[0]``) made the
+        processes disagree on the callback's broadcast source, which
+        silently delivered mixed layer tensors under multi-controller
+        execution (caught by the 2-process parity probe, round 3).
+
+        Consequences under multi-controller: layer loads are served by
+        process 0's host store and broadcast; the backward push delivers
+        the FULL (already psum'd) weight cotangent to process 0 only —
+        other processes accumulate zeros, and the engine's
+        ``host_all_reduce_sum`` then distributes the total to every
+        process's optimizer (``engine._host_apply``)."""
         import jax.sharding as jsh
 
-        return jsh.SingleDeviceSharding(jax.local_devices()[0])
+        return jsh.SingleDeviceSharding(jax.devices()[0])
 
     def _load(self, i):
         """Layer ``i``'s params via (re-executable) host callback."""

@@ -39,14 +39,29 @@ import deepspeed_tpu
 from deepspeed_tpu.models import gpt2
 
 GLOBAL_BS = 4
+mode = sys.argv[8] if len(sys.argv) > 8 and sys.argv[8] != "-" else "dense"
+
+if mode == "stream":
+    # ZeRO-Infinity param streaming: block params host-resident, host CPU
+    # optimizer; exercises the multi-host grad-push combine
+    config = {"train_micro_batch_size_per_gpu": 1,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+              "zero_optimization": {
+                  "stage": 0,
+                  "offload_optimizer": {"device": "cpu"},
+                  "offload_param": {"device": "cpu"},
+              },
+              "steps_per_print": 100,
+              "mesh": {}}
+else:
+    config = {"train_micro_batch_size_per_gpu": 1,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+              "zero_optimization": {"stage": 2},
+              "steps_per_print": 100,
+              "mesh": {}}
 
 engine, _, _, _ = deepspeed_tpu.initialize(
-    model=gpt2.build(gpt2.GPT2Config.tiny()),
-    config={"train_micro_batch_size_per_gpu": 1,
-            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
-            "zero_optimization": {"stage": 2},
-            "steps_per_print": 100,
-            "mesh": {}})
+    model=gpt2.build(gpt2.GPT2Config.tiny()), config=config)
 assert engine.train_batch_size() == GLOBAL_BS, engine.train_batch_size()
 
 if load_dir:

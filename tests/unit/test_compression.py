@@ -212,3 +212,146 @@ def test_stochastic_rounding_rejected():
                                  "enabled": True, "rounding": "stochastic"},
                              "different_groups": {
                                  "g": {"modules": ["*"]}}}}})
+
+
+# ------------------------------------------------ round-3 depth mechanisms
+def test_channel_pruning_mask_and_rule():
+    from deepspeed_tpu.compression import channel_pruning_mask
+    from deepspeed_tpu.compression.compress import (_build_transform,
+                                                    compress_params)
+    from deepspeed_tpu.compression.config import get_compression_config
+
+    w = jnp.asarray(np.random.default_rng(0).standard_normal((3, 3, 4, 8)),
+                    jnp.float32)
+    mask = channel_pruning_mask(w, 0.5)
+    kept = np.unique(np.asarray(mask).reshape(-1, 8).sum(0))
+    # structured: a channel is fully kept or fully zero
+    per_channel = np.asarray(mask).any(axis=(0, 1, 2))
+    assert per_channel.sum() == 4
+    assert np.all((np.asarray(mask).sum(axis=(0, 1, 2)) == 0) |
+                  (np.asarray(mask).sum(axis=(0, 1, 2)) == 36))
+
+    cfg = get_compression_config({"compression_training": {
+        "channel_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 5},
+            "different_groups": {"cp1": {"params": {"dense_ratio": 0.5},
+                                         "modules": ["conv"]}}}}})
+    rules = _build_transform(cfg, None)
+    params = {"conv_w": w, "dense": jnp.ones((4, 4))}
+    # before the offset: untouched; after: channels zeroed
+    before = compress_params(params, rules, step=0)
+    np.testing.assert_array_equal(np.asarray(before["conv_w"]),
+                                  np.asarray(w))
+    after = compress_params(params, rules, step=5)
+    zeroed = (np.asarray(after["conv_w"]).sum(axis=(0, 1, 2)) == 0).sum()
+    assert zeroed == 4
+    np.testing.assert_array_equal(np.asarray(after["dense"]), 1.0)
+
+
+def test_embedding_quantization_via_weight_group():
+    """Embedding quantization = a weight_quantization group targeting the
+    embedding leaves (reference Embedding_Compress)."""
+    from deepspeed_tpu.compression import init_compression
+
+    cfg = gpt2.GPT2Config.tiny()
+    model = gpt2.build(cfg)
+    wrapped = init_compression(model, {"compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "quantize_groups": 4},
+            "different_groups": {"emb": {"params": {"target_bits": 4},
+                                         "modules": ["wte"]}}}}})
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    from deepspeed_tpu.compression.compress import compress_params
+
+    q = compress_params(params, wrapped._compression_rules, step=0)
+    wte_q = np.asarray(q["wte"])
+    # quantized to a 4-bit grid: few unique values per group
+    assert len(np.unique(wte_q)) < len(np.unique(np.asarray(params["wte"])))
+    # other leaves untouched
+    np.testing.assert_array_equal(np.asarray(q["blocks"]["qkv_w"]),
+                                  np.asarray(params["blocks"]["qkv_w"]))
+
+
+def test_activation_quantization_behavioral():
+    """activation_quantization flips the model's act_quant_bits knob:
+    losses differ vs the dense model, grads stay finite (STE)."""
+    from deepspeed_tpu.compression import init_compression
+
+    cfg = gpt2.GPT2Config.tiny()
+    model = gpt2.build(cfg)
+    wrapped = init_compression(model, {"compression_training": {
+        "activation_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"aq": {"params": {"target_bits": 4}}}}}})
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (2, 17)).astype(np.int32)}
+    dense = float(model.loss_fn(params, batch, None, True))
+    # wrapped loss: act quant active at step 0
+    lq, grads = jax.value_and_grad(
+        lambda p: wrapped.loss_fn(p, batch, None, True))(params)
+    assert cfg.act_quant_bits == 4
+    assert abs(float(lq) - dense) > 1e-4  # 4-bit acts change the math
+    assert all(np.isfinite(np.asarray(g)).all()
+               for g in jax.tree_util.tree_leaves(grads))
+
+    # schedule_offset honored: a fresh wrap with offset 100 stays dense
+    cfg2 = gpt2.GPT2Config.tiny()
+    model2 = gpt2.build(cfg2)
+    wrapped2 = init_compression(model2, {"compression_training": {
+        "activation_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 100},
+            "different_groups": {"aq": {"params": {"target_bits": 4}}}}}})
+    l0 = float(wrapped2.loss_fn(params, batch, None, True))
+    assert cfg2.act_quant_bits is None
+    np.testing.assert_allclose(l0, dense, rtol=1e-6)
+    wrapped2._compression_toggle.step = 100
+    l100 = float(wrapped2.loss_fn(params, batch, None, True))
+    assert cfg2.act_quant_bits == 4
+    assert abs(l100 - dense) > 1e-4
+
+
+def test_distillation_loss_and_wrapper():
+    from deepspeed_tpu.compression import (distillation_loss,
+                                           init_distillation,
+                                           student_initialization)
+
+    # math check: alpha=0 -> hard loss; alpha=1, same logits -> ~0 KL
+    logits = jnp.asarray(np.random.default_rng(0).standard_normal((2, 5, 8)),
+                         jnp.float32)
+    hard = jnp.asarray(1.7)
+    np.testing.assert_allclose(
+        float(distillation_loss(logits, logits, hard, alpha=0.0)), 1.7,
+        rtol=1e-6)
+    assert float(distillation_loss(logits, logits, jnp.asarray(0.0),
+                                   alpha=1.0, temperature=2.0)) < 1e-5
+
+    # wrapper: student trained against a frozen teacher converges toward
+    # the teacher's predictions on a fixed batch
+    tcfg = gpt2.GPT2Config(vocab_size=64, max_seq_len=16, num_layers=2,
+                           num_heads=2, hidden_size=16)
+    teacher = gpt2.build(tcfg)
+    tparams = gpt2.init_params(tcfg, jax.random.PRNGKey(0))
+
+    scfg = gpt2.GPT2Config(vocab_size=64, max_seq_len=16, num_layers=1,
+                           num_heads=2, hidden_size=16)
+    student = gpt2.build(scfg)
+    sparams = student_initialization(tparams, "blocks", [0])
+    assert sparams["blocks"]["qkv_w"].shape[0] == 1  # 1-layer student
+    distilled = init_distillation(student, tparams, alpha=0.7,
+                                  temperature=2.0, teacher_apply=teacher.apply_fn)
+    batch = {"input_ids": np.random.default_rng(1).integers(
+        0, 64, (2, 9)).astype(np.int32)}
+    import optax
+
+    tx = optax.adam(5e-3)
+    opt = tx.init(sparams)
+    losses = []
+    fn = jax.jit(jax.value_and_grad(
+        lambda p: distilled.loss_fn(p, batch, None, True)))
+    for _ in range(20):
+        l, g = fn(sparams)
+        upd, opt = tx.update(g, opt, sparams)
+        sparams = optax.apply_updates(sparams, upd)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] - 0.05, losses

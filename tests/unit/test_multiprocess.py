@@ -31,7 +31,7 @@ def _free_port():
 
 
 def _run_world(nprocs, steps, tmp_path, timeout=600, save=None, load=None,
-               tag=""):
+               tag="", mode=None):
     port = _free_port()
     outs = [str(tmp_path / f"out_{tag}{nprocs}p_{i}.json")
             for i in range(nprocs)]
@@ -39,7 +39,8 @@ def _run_world(nprocs, steps, tmp_path, timeout=600, save=None, load=None,
            if not k.startswith(("JAX_", "XLA_"))}
     procs = [subprocess.Popen(
         [sys.executable, WORKER, str(i), str(nprocs), str(port),
-         str(steps), outs[i], save or "-", load or "-"], env=env)
+         str(steps), outs[i], save or "-", load or "-", mode or "-"],
+        env=env)
         for i in range(nprocs)]
     for p in procs:
         assert p.wait(timeout=timeout) == 0, f"worker failed (rc={p.returncode})"
@@ -103,3 +104,30 @@ def test_checkpoint_saved_on_two_processes_resumes_on_one(tmp_path):
     # below the fresh run's first loss (same seed-0 batches)
     assert two_b[0]["losses"][0] < two_a[0]["losses"][0] - 0.05, \
         (two_b[0]["losses"], two_a[0]["losses"])
+
+
+@pytest.mark.slow
+def test_two_process_param_streaming_matches_single_process(tmp_path):
+    """ZeRO-Infinity param streaming under 2 controllers: block params are
+    host-resident, layer loads/grad pushes flow through io_callbacks pinned
+    to the GLOBAL first device, and the host grad combine
+    (comm.host_all_reduce_sum in engine._host_apply) must reproduce the
+    single-process run exactly.  This validated (and the per-process pin
+    bug it caught fixed) the formerly env-gated multi-host leg."""
+    steps = 3
+    two = _run_world(2, steps, tmp_path, mode="stream", tag="s")
+    assert two[0]["procs"] == 2 and two[0]["world"] == 4
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_"))}
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    ref_out = str(tmp_path / "ref_stream.json")
+    rc = subprocess.run(
+        [sys.executable, WORKER, "0", "1", "0", str(steps), ref_out,
+         "-", "-", "stream"],
+        env=env, timeout=900).returncode
+    assert rc == 0
+    ref = json.load(open(ref_out))
+    for d in two:
+        np.testing.assert_allclose(d["losses"], ref["losses"],
+                                   rtol=2e-5, atol=1e-6)

@@ -154,3 +154,30 @@ def test_sparsity_saves_compute_vs_dense():
     lo = cfg.make_layout(16 * 32)
     causal_blocks = 32 * 33 / 2
     assert lo.sum() < 0.2 * causal_blocks
+
+
+def test_sparse_kernel_gqa_gradients_match():
+    """GQA x sparse layout (round-3: the dkv kernel's layout map now
+    follows the Q head through the rep grid — formerly asserted out)."""
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    b, h, hkv, s, d = 1, 4, 2, 48, 16
+    q = jax.random.normal(ks[0], (b, h, s, d))
+    k = jax.random.normal(ks[1], (b, hkv, s, d))
+    v = jax.random.normal(ks[2], (b, hkv, s, d))
+    cfg = LocalSlidingWindowSparsityConfig(num_heads=h, block=16,
+                                           num_sliding_window_blocks=3)
+    layout = cfg.make_layout(s)
+
+    def loss_kernel(q, k, v):
+        return (sparse_attention(q, k, v, layout, 16, causal=True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (sparse_attention_reference(q, k, v, layout, 16,
+                                           causal=True) ** 2).sum()
+
+    gk = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    assert gk[1].shape == (b, hkv, s, d)
+    for a, r, name in zip(gk, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r), atol=3e-4,
+                                   err_msg=f"d{name} mismatch")
