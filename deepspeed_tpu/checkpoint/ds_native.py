@@ -41,11 +41,17 @@ MODEL_FILE_SUFFIX = "_model_states.pt"
 #: TP merge axes for HF GPT-2 (Conv1D = [in, out]: column-parallel weights
 #: concat on the OUT dim, row-parallel on the IN dim; embeddings on vocab)
 GPT2_CAT_DIMS = [
-    (re.compile(r"(transformer\.)?h\.\d+\.attn\.c_attn\.(weight|bias)"), -1),
     (re.compile(r"(transformer\.)?h\.\d+\.mlp\.c_fc\.(weight|bias)"), -1),
     (re.compile(r"(transformer\.)?h\.\d+\.attn\.c_proj\.weight"), 0),
     (re.compile(r"(transformer\.)?h\.\d+\.mlp\.c_proj\.weight"), 0),
     (re.compile(r"(transformer\.)?wte\.weight"), 0),
+]
+#: fused QKV: each TP rank holds its head-slice of q|k|v CONCATENATED —
+#: a naive last-dim concat would interleave q0|k0|v0|q1|k1|v1; the merge
+#: must split each shard in 3 and reassemble q|k|v (reference AutoTP
+#: fused-qkv handling, module_inject ``_replace`` qkv path)
+GPT2_QKV_FUSED = [
+    re.compile(r"(transformer\.)?h\.\d+\.attn\.c_attn\.(weight|bias)"),
 ]
 #: replicated across TP (take rank 0): norms, row-parallel biases, wpe
 GPT2_REPLICATED = [
@@ -112,12 +118,20 @@ class DeepSpeedNativeCheckpoint:
 
     # ------------------------------------------------------- module weights
     def _merge_tp(self, name: str, shards: List[np.ndarray],
-                  cat_dims=GPT2_CAT_DIMS, replicated=GPT2_REPLICATED):
+                  cat_dims=GPT2_CAT_DIMS, replicated=GPT2_REPLICATED,
+                  qkv_fused=GPT2_QKV_FUSED):
         if len(shards) == 1:
             return shards[0]
         for pat in replicated:
             if pat.fullmatch(name):
                 return shards[0]
+        for pat in qkv_fused:
+            if pat.fullmatch(name):
+                # per-rank q_i|k_i|v_i -> q|k|v
+                thirds = [np.split(s, 3, axis=-1) for s in shards]
+                return np.concatenate(
+                    [np.concatenate([t[j] for t in thirds], axis=-1)
+                     for j in range(3)], axis=-1)
         for pat, dim in cat_dims:
             if pat.fullmatch(name):
                 return np.concatenate(shards, axis=dim)

@@ -97,9 +97,8 @@ def _build_transform(cfg: CompressionConfig, num_heads: Optional[int]):
         for gname, grp in cp.different_groups.items():
             ratio = grp.dense_ratio
             rules.append(("channel", grp.modules,
-                          lambda w, r=ratio:
-                          w * channel_pruning_mask(w, r) if w.ndim >= 3
-                          else w, off))
+                          lambda w, r=ratio: w * channel_pruning_mask(w, r),
+                          off))
     return rules
 
 

@@ -33,18 +33,25 @@ def student_initialization(teacher_params: PyTree, blocks_key,
 
 
 def distillation_loss(student_logits, teacher_logits, hard_loss,
-                      alpha: float = 0.5, temperature: float = 1.0):
+                      alpha: float = 0.5, temperature: float = 1.0,
+                      valid=None):
     """Soft-target KD: ``(1-alpha) * hard + alpha * T^2 * KL(t || s)``.
 
     The ``T^2`` factor keeps soft-gradient magnitudes comparable across
     temperatures (Hinton et al. 2015 — the convention the reference's
-    example configs assume)."""
+    example configs assume).  ``valid`` (bool, logits' leading dims) masks
+    positions out of the KL mean — pass the same mask the hard CE uses so
+    padding positions don't dilute/skew the soft term."""
     t = jnp.asarray(temperature, jnp.float32)
     s = jax.nn.log_softmax(student_logits.astype(jnp.float32) / t, axis=-1)
     p = jax.nn.softmax(
         jax.lax.stop_gradient(teacher_logits).astype(jnp.float32) / t,
         axis=-1)
-    kl = jnp.sum(p * (jnp.log(jnp.maximum(p, 1e-20)) - s), axis=-1).mean()
+    kl = jnp.sum(p * (jnp.log(jnp.maximum(p, 1e-20)) - s), axis=-1)
+    if valid is not None:
+        kl = jnp.where(valid, kl, 0.0).sum() / jnp.maximum(valid.sum(), 1)
+    else:
+        kl = kl.mean()
     return (1.0 - alpha) * hard_loss + alpha * t * t * kl
 
 
@@ -92,16 +99,18 @@ def init_distillation(student: ModelSpec, teacher_params: PyTree,
         picked = jnp.take_along_axis(
             logits, safe[..., None], axis=-1)[..., 0].astype(jnp.float32)
         nll = jnp.where(valid, lse - picked, 0.0)
-        return nll.sum() / jnp.maximum(valid.sum(), 1), logits
+        return nll.sum() / jnp.maximum(valid.sum(), 1), logits, valid
 
     def loss_fn(params, batch, rng=None, train=True):
         s_logits_full = student_apply(params, batch, rng)
         t_logits_full = teacher_apply(frozen_teacher, batch, None)
-        hard, s_logits = _ce(s_logits_full, batch)
+        hard, s_logits, valid = _ce(s_logits_full, batch)
         if _targets_of(batch) is None:
             t_logits_full = t_logits_full[:, :-1]
+        # the KL shares the CE's position mask: padding never trains
         return distillation_loss(s_logits, t_logits_full, hard,
-                                 alpha=alpha, temperature=temperature)
+                                 alpha=alpha, temperature=temperature,
+                                 valid=valid)
 
     return dataclasses.replace(student, loss_fn=loss_fn,
                                name=student.name + "+distill")

@@ -20,23 +20,192 @@ from .curriculum_scheduler import CurriculumScheduler
 
 
 class DataAnalyzer:
-    """Compute + persist per-sample difficulty metrics
-    (reference ``data_analyzer.py``; the 'seqlen' metric is the one the
-    curriculum uses by default)."""
+    """Map-reduce per-sample difficulty metrics (reference
+    ``data_analyzer.py:18``): the MAP phase shards the dataset across
+    workers, each computing its metrics and persisting a per-worker file;
+    the REDUCE phase merges them into ``sample_to_metric`` (per-sample
+    values), ``metric_to_sample`` (value -> sorted sample indices — what
+    the curriculum consumes), and value percentiles.
+
+    Two metric kinds, as in the reference:
+     - ``single_value_per_sample`` — ``fn(sample) -> float`` (e.g. seqlen);
+     - ``accumulate_value_over_samples`` — ``fn(sample) -> np.ndarray``
+       accumulated elementwise over the WHOLE dataset first (e.g. token
+       counts), then ``finalize(accumulated, sample) -> float`` maps each
+       sample against the global statistic (e.g. vocab rarity: the
+       reference's ``total_vocab_freq`` curriculum metric).
+    """
 
     def __init__(self, dataset: Sequence,
-                 metric_fns: Optional[Dict[str, Callable[[Any], float]]] = None):
+                 metric_fns: Optional[Dict[str, Callable[[Any], float]]] = None,
+                 accumulate_fns: Optional[Dict[str, tuple]] = None):
         self.dataset = dataset
-        self.metric_fns = metric_fns or {"seqlen": _seqlen_metric}
+        self.metric_fns = metric_fns if metric_fns is not None else \
+            ({"seqlen": _seqlen_metric} if accumulate_fns is None else {})
+        #: name -> (accumulate_fn, finalize_fn)
+        self.accumulate_fns = accumulate_fns or {}
 
-    def run(self) -> Dict[str, np.ndarray]:
-        out = {name: np.empty(len(self.dataset), np.float64)
-               for name in self.metric_fns}
-        for i in range(len(self.dataset)):
+    # ---------------------------------------------------------------- map
+    def _worker_range(self, worker_id: int, num_workers: int) -> range:
+        n = len(self.dataset)
+        per = -(-n // num_workers)
+        return range(worker_id * per, min((worker_id + 1) * per, n))
+
+    def run_map(self, worker_id: int = 0, num_workers: int = 1,
+                save_dir: Optional[str] = None) -> Dict[str, Any]:
+        """One worker's shard of the metric computation (reference
+        ``run_map_helper``).  Returns (and optionally persists) the
+        worker's partial results."""
+        idx = self._worker_range(worker_id, num_workers)
+        part: Dict[str, Any] = {
+            "_range": np.asarray([idx.start, idx.stop], np.int64)}
+        for name in self.metric_fns:
+            part[name] = np.empty(len(idx), np.float64)
+        acc: Dict[str, Any] = {name: None for name in self.accumulate_fns}
+        for j, i in enumerate(idx):
             sample = self.dataset[i]
             for name, fn in self.metric_fns.items():
-                out[name][i] = fn(sample)
+                part[name][j] = fn(sample)
+            for name, (accf, _) in self.accumulate_fns.items():
+                v = np.asarray(accf(sample), np.float64)
+                acc[name] = v if acc[name] is None else acc[name] + v
+        for name, v in acc.items():
+            part["_acc_" + name] = v if v is not None else np.zeros(0)
+        if save_dir is not None:
+            os.makedirs(save_dir, exist_ok=True)
+            np.savez(os.path.join(save_dir, f"map_{worker_id:05d}.npz"),
+                     **part)
+        return part
+
+    def run_finalize_map(self, totals: Dict[str, np.ndarray],
+                         worker_id: int = 0, num_workers: int = 1,
+                         save_dir: Optional[str] = None) -> Dict[str, Any]:
+        """Second SHARDED map for accumulate metrics (reference runs
+        finalize as another distributed map pass): given the reduced global
+        statistics, each worker scores its own dataset shard — the reducer
+        never touches the dataset.  ``totals`` comes from
+        :meth:`reduce_totals`."""
+        idx = self._worker_range(worker_id, num_workers)
+        part: Dict[str, Any] = {
+            "_range": np.asarray([idx.start, idx.stop], np.int64)}
+        for name, (_, finalize) in self.accumulate_fns.items():
+            vals = np.empty(len(idx), np.float64)
+            for j, i in enumerate(idx):
+                vals[j] = finalize(totals[name], self.dataset[i])
+            part[name] = vals
+        if save_dir is not None:
+            os.makedirs(save_dir, exist_ok=True)
+            np.savez(os.path.join(save_dir, f"fin_{worker_id:05d}.npz"),
+                     **part)
+        return part
+
+    def reduce_totals(self, parts: List[Dict[str, Any]]
+                      ) -> Dict[str, np.ndarray]:
+        """Merge the map phase's accumulated statistics (cheap: O(workers))."""
+        totals: Dict[str, np.ndarray] = {}
+        for name in self.accumulate_fns:
+            total = None
+            for p in parts:
+                v = p["_acc_" + name]
+                if v.size:
+                    total = v if total is None else total + v
+            totals[name] = total
+        return totals
+
+    # ------------------------------------------------------------- reduce
+    @staticmethod
+    def _load_parts(save_dir: str, prefix: str) -> List[Dict[str, Any]]:
+        files = sorted(f for f in os.listdir(save_dir)
+                       if f.startswith(prefix) and f.endswith(".npz"))
+        parts = []
+        for f in files:
+            with np.load(os.path.join(save_dir, f)) as z:
+                parts.append({k: z[k] for k in z.files})
+        return parts
+
+    def run_reduce(self, parts: Optional[List[Dict[str, Any]]] = None,
+                   save_dir: Optional[str] = None,
+                   finalize_parts: Optional[List[Dict[str, Any]]] = None,
+                   n_buckets: int = 100) -> Dict[str, Any]:
+        """Merge worker partials (reference ``run_reduce``/``merge_*``):
+        per-metric ``sample_to_metric``, value-sorted ``metric_to_sample``
+        index, and percentile table.
+
+        For accumulate metrics, pass ``finalize_parts`` from a second
+        sharded :meth:`run_finalize_map` pass (or leave ``fin_*`` files in
+        ``save_dir``); the reduce is then O(workers).  With neither, the
+        reducer finalizes serially — fine for small datasets only."""
+        if parts is None:
+            assert save_dir is not None, "need parts or a save_dir to load"
+            parts = self._load_parts(save_dir, "map_")
+        if finalize_parts is None and save_dir is not None:
+            finalize_parts = self._load_parts(save_dir, "fin_") or None
+        parts = sorted(parts, key=lambda p: int(p["_range"][0]))
+        n = int(parts[-1]["_range"][1])
+        out: Dict[str, Any] = {}
+        for name in self.metric_fns:
+            s2m = np.empty(n, np.float64)
+            for p in parts:
+                lo, hi = (int(x) for x in p["_range"])
+                s2m[lo:hi] = p[name]
+            out[name] = s2m
+        # accumulate metrics: merge the second (sharded) finalize pass, or
+        # fall back to a serial pass on the reducer
+        if self.accumulate_fns and finalize_parts is not None:
+            fin = sorted(finalize_parts, key=lambda p: int(p["_range"][0]))
+            for name in self.accumulate_fns:
+                s2m = np.empty(n, np.float64)
+                for p in fin:
+                    lo, hi = (int(x) for x in p["_range"])
+                    s2m[lo:hi] = p[name]
+                out[name] = s2m
+        elif self.accumulate_fns:
+            totals = self.reduce_totals(parts)
+            for name, (_, finalize) in self.accumulate_fns.items():
+                s2m = np.empty(n, np.float64)
+                for i in range(n):
+                    s2m[i] = finalize(totals[name], self.dataset[i])
+                out[name] = s2m
+        result: Dict[str, Any] = {}
+        for name, s2m in out.items():
+            order = np.argsort(s2m, kind="stable")
+            qs = np.linspace(0.0, 1.0, n_buckets + 1)
+            result[name] = {
+                "sample_to_metric": s2m,
+                "metric_to_sample": order,         # ascending difficulty
+                "percentiles": np.quantile(s2m, qs),
+            }
+        if save_dir is not None:
+            os.makedirs(save_dir, exist_ok=True)
+            flat = {}
+            for name, d in result.items():
+                for k, v in d.items():
+                    flat[f"{name}.{k}"] = v
+            np.savez(os.path.join(save_dir, "reduce.npz"), **flat)
+        return result
+
+    def get_metric_value_percentiles(self, name: str, result=None,
+                                     save_dir=None) -> np.ndarray:
+        """Reference ``get_metric_value_percentiles``: the value at each
+        percentile bucket, for mapping curriculum difficulty (a percentile)
+        to a metric threshold."""
+        if result is None:
+            result = self.load_reduced(save_dir)
+        return result[name]["percentiles"]
+
+    @staticmethod
+    def load_reduced(save_dir: str) -> Dict[str, Dict[str, np.ndarray]]:
+        with np.load(os.path.join(save_dir, "reduce.npz")) as z:
+            out: Dict[str, Dict[str, np.ndarray]] = {}
+            for k in z.files:
+                name, key = k.rsplit(".", 1)
+                out.setdefault(name, {})[key] = z[k]
         return out
+
+    # ------------------------------------------- single-call conveniences
+    def run(self) -> Dict[str, np.ndarray]:
+        red = self.run_reduce([self.run_map()])
+        return {k: v["sample_to_metric"] for k, v in red.items()}
 
     def save(self, path: str) -> Dict[str, np.ndarray]:
         metrics = self.run()
@@ -48,6 +217,24 @@ class DataAnalyzer:
     def load(path: str) -> Dict[str, np.ndarray]:
         with np.load(path) as z:
             return {k: z[k] for k in z.files}
+
+
+def vocab_rarity_metric(vocab_size: int):
+    """The reference curriculum's ``total_vocab_freq``-style metric as an
+    (accumulate, finalize) pair: global token counts, then per-sample mean
+    negative log frequency (rarer tokens = harder samples)."""
+    def accumulate(sample):
+        ids = sample["input_ids"] if isinstance(sample, dict) else sample
+        return np.bincount(np.asarray(ids).reshape(-1),
+                           minlength=vocab_size).astype(np.float64)
+
+    def finalize(total_counts, sample):
+        ids = np.asarray(sample["input_ids"] if isinstance(sample, dict)
+                         else sample).reshape(-1)
+        freq = total_counts[ids] / max(total_counts.sum(), 1.0)
+        return float(-np.log(np.maximum(freq, 1e-12)).mean())
+
+    return accumulate, finalize
 
 
 def _seqlen_metric(sample) -> float:

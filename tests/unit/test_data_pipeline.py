@@ -354,3 +354,80 @@ def test_random_ltd_seq_clamp_does_not_latch():
     engine.train_batch(batch(48))       # longer seq: schedule resumes
     assert cfg.random_ltd_keep == 32    # full (unclamped) endpoint
     assert engine._ltd_saturated
+
+
+def test_data_analyzer_map_reduce_multi_worker():
+    """Reference map-reduce protocol: 3-worker map + reduce must equal the
+    single-worker run; metric_to_sample sorts by difficulty; percentiles
+    map curriculum difficulty to thresholds."""
+    from deepspeed_tpu.runtime.data_pipeline.data_sampler import DataAnalyzer
+
+    rng = np.random.default_rng(0)
+    data = [{"input_ids": rng.integers(0, 32, size=(rng.integers(4, 20),))}
+            for _ in range(23)]
+    an = DataAnalyzer(data)
+    parts = [an.run_map(w, 3) for w in range(3)]
+    red = an.run_reduce(parts)["seqlen"]
+    single = an.run()["seqlen"]
+    np.testing.assert_array_equal(red["sample_to_metric"], single)
+    order = red["metric_to_sample"]
+    vals = red["sample_to_metric"][order]
+    assert np.all(np.diff(vals) >= 0)  # ascending difficulty index
+    pct = red["percentiles"]
+    assert pct[0] == vals[0] and pct[-1] == vals[-1]
+
+
+def test_data_analyzer_accumulate_metric_and_files(tmp_path):
+    """accumulate_value_over_samples: vocab-rarity needs GLOBAL counts
+    first; worker files + reduce.npz roundtrip (reference writes per-worker
+    files then merges)."""
+    from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
+        DataAnalyzer, vocab_rarity_metric)
+
+    rng = np.random.default_rng(1)
+    common = rng.integers(0, 4, size=(12,))           # frequent tokens
+    rare = np.full(12, 31)                            # one rare-token sample
+    data = [{"input_ids": common} for _ in range(7)] + [{"input_ids": rare}]
+    an = DataAnalyzer(data, metric_fns={},
+                      accumulate_fns={"rarity": vocab_rarity_metric(32)})
+    d = str(tmp_path / "ana")
+    for w in range(2):
+        an.run_map(w, 2, save_dir=d)
+    # second SHARDED finalize pass (reference protocol): reduce totals,
+    # score shards, then an O(workers) reduce
+    totals = an.reduce_totals(an._load_parts(d, "map_"))
+    for w in range(2):
+        an.run_finalize_map(totals, w, 2, save_dir=d)
+    red = an.run_reduce(save_dir=d)
+    rarity = red["rarity"]["sample_to_metric"]
+    assert rarity[-1] > rarity[0]  # the rare-token sample is hardest
+    # serial fallback (no fin_ files) must agree
+    serial = an.run_reduce(parts=an._load_parts(d, "map_"))
+    np.testing.assert_array_equal(
+        serial["rarity"]["sample_to_metric"], rarity)
+    # persisted reduce roundtrip + percentile API
+    loaded = DataAnalyzer.load_reduced(d)
+    np.testing.assert_array_equal(loaded["rarity"]["sample_to_metric"],
+                                  rarity)
+    pct = an.get_metric_value_percentiles("rarity", save_dir=d)
+    assert pct.shape == (101,)
+
+
+def test_sampler_consumes_analyzer_output(tmp_path):
+    """End-to-end: analyzer metric file -> difficulty-gated sampler only
+    draws below-threshold samples."""
+    from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
+        DataAnalyzer, DeepSpeedDataSampler)
+
+    rng = np.random.default_rng(2)
+    data = [{"input_ids": rng.integers(0, 32, size=(l,))}
+            for l in ([4] * 10 + [16] * 10)]
+    metrics = DataAnalyzer(data).run()
+    sched = CurriculumScheduler({
+        "curriculum_type": "seqlen", "min_difficulty": 4,
+        "max_difficulty": 16, "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 4,
+                            "difficulty_step": 4}})
+    s = DeepSpeedDataSampler(metrics["seqlen"], sched, global_batch_size=4)
+    first = s.next_batch_indices()
+    assert all(metrics["seqlen"][i] <= 4 for i in first)

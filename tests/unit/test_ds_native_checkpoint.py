@@ -105,15 +105,22 @@ def _write_zero3_ckpt(dirpath, sd, dp=2):
 def _write_tp2_ckpt(dirpath, sd):
     """tp=2 module-only checkpoint: column weights split on the out dim,
     row weights on the in dim, norms replicated."""
-    import re as _re
-
     from deepspeed_tpu.checkpoint.ds_native import (GPT2_CAT_DIMS,
+                                                    GPT2_QKV_FUSED,
                                                     GPT2_REPLICATED)
 
     dirpath.mkdir(parents=True, exist_ok=True)
     for r in range(2):
         shard = OrderedDict()
         for name, v in sd.items():
+            if any(p.fullmatch(name) for p in GPT2_QKV_FUSED):
+                # Megatron/AutoTP fused-qkv sharding: each rank gets its
+                # head-slice of EACH of q, k, v, concatenated q_r|k_r|v_r
+                q, k_, v_ = torch.chunk(v, 3, dim=-1)
+                shard[name] = torch.cat(
+                    [torch.chunk(t, 2, dim=-1)[r] for t in (q, k_, v_)],
+                    dim=-1)
+                continue
             dim = None
             for pat, d in GPT2_CAT_DIMS:
                 if pat.fullmatch(name):
