@@ -127,11 +127,9 @@ class DeepSpeedNativeCheckpoint:
                 return shards[0]
         for pat in qkv_fused:
             if pat.fullmatch(name):
-                # per-rank q_i|k_i|v_i -> q|k|v
-                thirds = [np.split(s, 3, axis=-1) for s in shards]
-                return np.concatenate(
-                    [np.concatenate([t[j] for t in thirds], axis=-1)
-                     for j in range(3)], axis=-1)
+                from ..runtime.state_dict_factory import merge_qkv_shards
+
+                return merge_qkv_shards(shards, -1)
         for pat, dim in cat_dims:
             if pat.fullmatch(name):
                 return np.concatenate(shards, axis=dim)

@@ -140,8 +140,21 @@ class DataAnalyzer:
             parts = self._load_parts(save_dir, "map_")
         if finalize_parts is None and save_dir is not None:
             finalize_parts = self._load_parts(save_dir, "fin_") or None
-        parts = sorted(parts, key=lambda p: int(p["_range"][0]))
-        n = int(parts[-1]["_range"][1])
+
+        def check_tiling(ps, what):
+            ps = sorted(ps, key=lambda p: int(p["_range"][0]))
+            cursor = 0
+            for p in ps:
+                lo, hi = (int(x) for x in p["_range"])
+                if lo != cursor:
+                    raise ValueError(
+                        f"{what} shards do not tile the dataset: expected "
+                        f"range starting at {cursor}, got [{lo}, {hi}) — "
+                        "missing/stale worker files in save_dir?")
+                cursor = hi
+            return ps, cursor
+
+        parts, n = check_tiling(parts, "map")
         out: Dict[str, Any] = {}
         for name in self.metric_fns:
             s2m = np.empty(n, np.float64)
@@ -152,7 +165,10 @@ class DataAnalyzer:
         # accumulate metrics: merge the second (sharded) finalize pass, or
         # fall back to a serial pass on the reducer
         if self.accumulate_fns and finalize_parts is not None:
-            fin = sorted(finalize_parts, key=lambda p: int(p["_range"][0]))
+            fin, n_fin = check_tiling(finalize_parts, "finalize")
+            if n_fin != n:
+                raise ValueError(
+                    f"finalize shards cover {n_fin} samples, map covers {n}")
             for name in self.accumulate_fns:
                 s2m = np.empty(n, np.float64)
                 for p in fin:
@@ -228,11 +244,19 @@ def vocab_rarity_metric(vocab_size: int):
         return np.bincount(np.asarray(ids).reshape(-1),
                            minlength=vocab_size).astype(np.float64)
 
+    # the -log(freq) table is invariant per totals: build it once, not per
+    # sample (memoized on the totals array's identity)
+    table_cache: dict = {}
+
     def finalize(total_counts, sample):
+        key = id(total_counts)
+        if key not in table_cache:
+            freq = total_counts / max(total_counts.sum(), 1.0)
+            table_cache.clear()
+            table_cache[key] = -np.log(np.maximum(freq, 1e-12))
         ids = np.asarray(sample["input_ids"] if isinstance(sample, dict)
                          else sample).reshape(-1)
-        freq = total_counts[ids] / max(total_counts.sum(), 1.0)
-        return float(-np.log(np.maximum(freq, 1e-12)).mean())
+        return float(table_cache[key][ids].mean())
 
     return accumulate, finalize
 
