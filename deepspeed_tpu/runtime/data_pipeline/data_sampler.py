@@ -245,18 +245,19 @@ def vocab_rarity_metric(vocab_size: int):
                            minlength=vocab_size).astype(np.float64)
 
     # the -log(freq) table is invariant per totals: build it once, not per
-    # sample (memoized on the totals array's identity)
-    table_cache: dict = {}
+    # sample.  The cache HOLDS the totals array and compares identity with
+    # ``is`` — an id()-keyed cache could alias a freed array's reused
+    # address and silently serve a stale table.
+    cache = {"totals": None, "table": None}
 
     def finalize(total_counts, sample):
-        key = id(total_counts)
-        if key not in table_cache:
+        if cache["totals"] is not total_counts:
             freq = total_counts / max(total_counts.sum(), 1.0)
-            table_cache.clear()
-            table_cache[key] = -np.log(np.maximum(freq, 1e-12))
+            cache["totals"] = total_counts
+            cache["table"] = -np.log(np.maximum(freq, 1e-12))
         ids = np.asarray(sample["input_ids"] if isinstance(sample, dict)
                          else sample).reshape(-1)
-        return float(table_cache[key][ids].mean())
+        return float(cache["table"][ids].mean())
 
     return accumulate, finalize
 
