@@ -431,3 +431,58 @@ def test_sampler_consumes_analyzer_output(tmp_path):
     s = DeepSpeedDataSampler(metrics["seqlen"], sched, global_batch_size=4)
     first = s.next_batch_indices()
     assert all(metrics["seqlen"][i] <= 4 for i in first)
+
+
+def test_random_ltd_composes_with_curriculum_seqlen():
+    """Both schedules active: the curriculum truncates the sequence, the
+    LTD keep-count clamps to the truncated length and resumes when the
+    curriculum grows it."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    deepspeed_tpu.comm.reset_topology()
+    cfg = gpt2.GPT2Config(vocab_size=128, max_seq_len=64, num_layers=3,
+                          num_heads=2, hidden_size=32)
+    model = gpt2.build(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "curriculum_learning": {
+            "enabled": True, "curriculum_type": "seqlen",
+            "min_difficulty": 16, "max_difficulty": 48,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4,
+                                "difficulty_step": 16}},
+        "data_efficiency": {"data_routing": {"enabled": True, "random_ltd": {
+            "enabled": True,
+            # the LTD ramp OUTRUNS the curriculum (full 48 by step 2 while
+            # the sequence is still 32) so the seq clamp actually binds —
+            # and must release once the curriculum grows the sequence
+            "random_ltd_schedule": {
+                "min_value": 8, "max_value": 48,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 2,
+                                    "difficulty_step": 8},
+            }}}},
+    })
+    rng = np.random.default_rng(0)
+    batch = lambda: {"input_ids": rng.integers(
+        0, cfg.vocab_size,
+        size=(engine.train_batch_size(), 49)).astype(np.int32)}
+    keeps, seqs = [], []
+    for _ in range(7):
+        _, m = engine.train_batch(batch())
+        assert np.isfinite(float(m["loss"]))
+        keeps.append(cfg.random_ltd_keep)
+        seqs.append(engine.curriculum_scheduler.current_difficulty)
+    # keep never exceeds the curriculum's (truncated) sequence
+    for kp, sq in zip(keeps, seqs):
+        assert kp <= sq, (keeps, seqs)
+    # the clamp BOUND at least once (schedule outran the sequence)...
+    assert any(kp < min(48, sq) or (kp == sq < 48)
+               for kp, sq in zip(keeps, seqs)), (keeps, seqs)
+    assert max(keeps) == 48 or keeps[-1] == 48, (keeps, seqs)
+    # ...and released: both ramps complete, and only then does LTD latch
+    assert seqs[-1] == 48 and keeps[-1] == 48
+    assert engine._ltd_saturated
