@@ -17,7 +17,7 @@ from . import zero
 from .runtime import lr_schedules
 from .runtime.config import DeepSpeedConfig
 from .runtime.engine import DeepSpeedEngine
-from .runtime.model import ModelSpec, from_flax, from_functions
+from .runtime.model import ModelSpec, OnDevice, from_flax, from_functions
 from .parallel.topology import (MeshTopology, PipeModelDataParallelTopology,
                                 ProcessTopology, topology_from_config)
 from .utils.logging import log_dist, logger

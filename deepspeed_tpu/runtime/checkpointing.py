@@ -102,10 +102,23 @@ class CheckpointManager:
                 raise RuntimeError(msg)
             logger.warning(msg)
 
+    def _default_dir(self, save_dir):
+        """``nebula.persistent_storage_path`` is the default checkpoint
+        root when no directory is passed (reference nebula tier)."""
+        if save_dir is not None:
+            return save_dir
+        neb = getattr(self.engine._config, "nebula_config", None)
+        if neb is not None and neb.persistent_storage_path:
+            return neb.persistent_storage_path
+        raise ValueError(
+            "save_checkpoint/load_checkpoint need a directory (or set "
+            "nebula.persistent_storage_path as the default root)")
+
     def save(self, save_dir: str, tag: Optional[str] = None,
              client_state: Optional[Dict[str, Any]] = None,
              save_latest: bool = True) -> str:
         engine = self.engine
+        save_dir = self._default_dir(save_dir)
         if tag is None:
             tag = f"global_step{engine.global_steps}"
         self._validate_tag(tag)
@@ -155,6 +168,7 @@ class CheckpointManager:
     def load(self, load_dir: str, tag: Optional[str] = None,
              load_optimizer_states: bool = True, load_module_only: bool = False):
         engine = self.engine
+        load_dir = self._default_dir(load_dir)
         if tag is None:
             latest_path = os.path.join(load_dir, "latest")
             if not os.path.isfile(latest_path):

@@ -79,6 +79,21 @@ class CheckpointConfig(DeepSpeedConfigModel):
                 f"{C.CHECKPOINT_TAG_VALIDATION_MODES}, got {self.tag_validation}")
 
 
+class NebulaConfig(DeepSpeedConfigModel):
+    """Reference ``nebula`` block (nebula/config.py) — the async
+    checkpoint tier.  Here the orbax engine IS async (and multi-host), so
+    the block is accepted for config compatibility; ``enabled`` just
+    confirms the behavior the engine already has, and
+    ``persistent_storage_path`` provides a default save root."""
+
+    enabled: bool = False
+    persistent_storage_path: Optional[str] = None
+    persistent_time_interval: int = 100
+    num_of_version_in_retention: int = 2
+    enable_nebula_load: bool = True
+    load_path: Optional[str] = None
+
+
 class DataTypesConfig(DeepSpeedConfigModel):
     grad_accum_dtype: Optional[str] = None
 
@@ -317,6 +332,14 @@ class DeepSpeedConfig:
         self.comms_config = DeepSpeedCommsConfig(pd)
         self.activation_checkpointing_config = ActivationCheckpointingConfig(
             **pd.get(C.ACTIVATION_CHECKPOINTING, {}))
+        self.nebula_config = NebulaConfig(**pd.get("nebula", {}))
+        if self.nebula_config.enabled:
+            from ..utils.logging import logger
+
+            logger.info(
+                "nebula: async checkpointing maps to the orbax engine "
+                "(always async + multi-host here); persistent_storage_path "
+                f"= {self.nebula_config.persistent_storage_path}")
         self.checkpoint_config = CheckpointConfig(**pd.get(C.CHECKPOINT, {}))
         self.load_universal_checkpoint = self.checkpoint_config.load_universal
         self.use_node_local_storage = self.checkpoint_config.use_node_local_storage

@@ -468,7 +468,10 @@ class DeepSpeedEngine:
                     "se": jnp.zeros((n,) + se_s, jnp.float32)}
 
         def init_state(rng):
-            params = self.model_spec.init(rng)
+            # init_fn directly: a user-side OnDevice("meta") context must
+            # not turn the ENGINE's init into abstract params (the engine
+            # already materializes sharded-at-birth under jit)
+            params = self.model_spec.init_fn(rng)
             params = _cast_floating(params, jnp.float32)  # fp32 master weights
             # offload: optimizer state is host-side (HostOffloadOptimizer)
             opt_state = () if self.offload_enabled else self.tx.init(params)
@@ -526,7 +529,7 @@ class DeepSpeedEngine:
         cpu = jax.local_devices(backend="cpu")[0]
         with jax.default_device(cpu):
             params_full = jax.jit(
-                lambda r: _cast_floating(self.model_spec.init(r),
+                lambda r: _cast_floating(self.model_spec.init_fn(r),
                                          jnp.float32))(self._init_rng)
         params_full = jax.device_get(params_full)
         node = params_full
@@ -1547,13 +1550,13 @@ class DeepSpeedEngine:
             process_count=dist.get_process_world_size())
 
     # ------------------------------------------------------------- checkpoints
-    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+    def save_checkpoint(self, save_dir=None, tag=None, client_state=None,
                         save_latest=True):
         return self.checkpoint_manager.save(save_dir, tag=tag,
                                             client_state=client_state or {},
                                             save_latest=save_latest)
 
-    def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
+    def load_checkpoint(self, load_dir=None, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False):
         return self.checkpoint_manager.load(

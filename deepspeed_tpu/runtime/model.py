@@ -57,10 +57,58 @@ class ModelSpec:
     quant_aware: bool = False
 
     def init(self, rng) -> PyTree:
+        if _ON_DEVICE_STACK:
+            ctx = _ON_DEVICE_STACK[-1]
+            if ctx.device == "meta":
+                import jax
+
+                abstract = jax.eval_shape(self.init_fn, rng)
+                if ctx.dtype is not None:
+                    abstract = jax.tree_util.tree_map(
+                        lambda x: jax.ShapeDtypeStruct(
+                            x.shape,
+                            ctx.dtype if jax.numpy.issubdtype(
+                                x.dtype, jax.numpy.floating) else x.dtype),
+                        abstract)
+                return abstract
         return self.init_fn(rng)
 
     def loss(self, params, batch, rng=None, train: bool = True):
         return self.loss_fn(params, batch, rng, train)
+
+
+#: active OnDevice contexts (innermost last)
+_ON_DEVICE_STACK: list = []
+
+
+class OnDevice:
+    """Reference ``deepspeed.OnDevice`` (utils/init_on_device.py:10): build
+    a model without allocating its weights.
+
+    ``device="meta"`` makes :meth:`ModelSpec.init` return ABSTRACT params
+    (``jax.eval_shape`` — shapes/dtypes only, no memory), optionally with
+    float leaves recast to ``dtype``.  The engine's own init path is
+    unaffected: it already materializes params sharded-at-birth under jit
+    with ``out_shardings`` (the zero.Init analog), so this context exists
+    for user-side model inspection and memory planning at 70B scale.
+    """
+
+    def __init__(self, dtype=None, device: str = "meta", enabled: bool = True):
+        if enabled and device != "meta":
+            raise ValueError(
+                f"OnDevice(device={device!r}): only 'meta' is supported on "
+                "TPU — materialized init is already placed/sharded by the "
+                "engine; for a specific dtype, cast after init")
+        self.dtype = dtype
+        self.device = device if enabled else "none"
+
+    def __enter__(self):
+        _ON_DEVICE_STACK.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _ON_DEVICE_STACK.pop()
+        return False
 
 
 def from_functions(init_fn, loss_fn, apply_fn=None, tp_rules=None,

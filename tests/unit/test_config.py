@@ -182,3 +182,93 @@ def test_auto_values_resolve():
     z = resolved["zero_optimization"]
     assert z["reduce_bucket_size"] == 768 * 768
     assert z["stage3_param_persistence_threshold"] == 7680
+
+
+# ------------------------------------------------------ round-3 API shims
+def test_nebula_config_block_parses():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    c = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 1,
+        "nebula": {"enabled": True,
+                   "persistent_storage_path": "/tmp/nebula",
+                   "persistent_time_interval": 50}})
+    assert c.nebula_config.enabled
+    assert c.nebula_config.persistent_storage_path == "/tmp/nebula"
+
+
+def test_on_device_meta_init():
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    model = gpt2.build(gpt2.GPT2Config.tiny())
+    with deepspeed_tpu.OnDevice(dtype=jax.numpy.bfloat16, device="meta"):
+        abstract = model.init(jax.random.PRNGKey(0))
+    leaves = jax.tree_util.tree_leaves(abstract)
+    assert all(isinstance(x, jax.ShapeDtypeStruct) for x in leaves)
+    assert all(x.dtype == jax.numpy.bfloat16 for x in leaves
+               if jax.numpy.issubdtype(x.dtype, jax.numpy.floating))
+    # outside the context: real arrays again
+    real = model.init(jax.random.PRNGKey(0))
+    assert all(hasattr(x, "addressable_shards") or hasattr(x, "devices")
+               for x in jax.tree_util.tree_leaves(real))
+
+
+def test_nebula_path_is_default_checkpoint_root(tmp_path):
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    deepspeed_tpu.comm.reset_topology()
+    cfg = gpt2.GPT2Config.tiny()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt2.build(cfg),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "nebula": {"enabled": True,
+                           "persistent_storage_path": str(tmp_path / "neb")}})
+    rng = np.random.default_rng(0)
+    engine.train_batch({"input_ids": rng.integers(
+        0, cfg.vocab_size,
+        size=(engine.train_batch_size(), 17)).astype(np.int32)})
+    path = engine.save_checkpoint()  # no dir: nebula root is the default
+    assert str(tmp_path / "neb") in path
+    engine.load_checkpoint()
+    # without any default configured, a missing dir raises clearly
+    deepspeed_tpu.comm.reset_topology()
+    engine2, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt2.build(gpt2.GPT2Config.tiny()),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}})
+    with pytest.raises(ValueError, match="persistent_storage_path"):
+        engine2.save_checkpoint()
+
+
+def test_on_device_rejects_non_meta():
+    import deepspeed_tpu
+
+    with pytest.raises(ValueError, match="only 'meta'"):
+        deepspeed_tpu.OnDevice(device="cpu")
+
+
+def test_engine_init_unaffected_by_on_device():
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    deepspeed_tpu.comm.reset_topology()
+    cfg = gpt2.GPT2Config.tiny()
+    with deepspeed_tpu.OnDevice(device="meta"):
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=gpt2.build(cfg),
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}})
+        rng = np.random.default_rng(0)
+        _, m = engine.train_batch({"input_ids": rng.integers(
+            0, cfg.vocab_size,
+            size=(engine.train_batch_size(), 17)).astype(np.int32)})
+    assert np.isfinite(float(m["loss"]))
