@@ -49,7 +49,8 @@ class InferenceEngine:
         self.mesh = self.topology.mesh
 
         if params is None:
-            params = model.init(jax.random.PRNGKey(0))
+            # init_fn: immune to a user-held OnDevice('meta') context
+            params = model.init_fn(jax.random.PRNGKey(0))
         params = _cast_floating(params, config.jnp_dtype)
         tp_specs = model.tp_rules(jax.eval_shape(lambda: params)) \
             if model.tp_rules else None

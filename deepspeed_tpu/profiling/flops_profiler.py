@@ -182,7 +182,8 @@ def get_model_profile(model_spec, batch, rng=None) -> Dict[str, float]:
 
     Returns {"flops", "macs", "params"} for one forward pass.
     """
-    params = model_spec.init(jax.random.PRNGKey(0))
+    # init_fn: immune to a user-held OnDevice('meta') context
+    params = model_spec.init_fn(jax.random.PRNGKey(0))
     c = _cost(lambda p, b: model_spec.loss_fn(p, b, None, False), params,
               batch)
     return {"flops": c["flops"], "macs": c["flops"] / 2,

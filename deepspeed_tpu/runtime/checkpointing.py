@@ -102,14 +102,19 @@ class CheckpointManager:
                 raise RuntimeError(msg)
             logger.warning(msg)
 
-    def _default_dir(self, save_dir):
+    def _default_dir(self, save_dir, for_load: bool = False):
         """``nebula.persistent_storage_path`` is the default checkpoint
-        root when no directory is passed (reference nebula tier)."""
+        root when no directory is passed (reference nebula tier); loads
+        prefer ``nebula.load_path`` when set (the reference's warm-start
+        redirection, gated on ``enable_nebula_load``)."""
         if save_dir is not None:
             return save_dir
         neb = getattr(self.engine._config, "nebula_config", None)
-        if neb is not None and neb.persistent_storage_path:
-            return neb.persistent_storage_path
+        if neb is not None:
+            if for_load and neb.enable_nebula_load and neb.load_path:
+                return neb.load_path
+            if neb.persistent_storage_path:
+                return neb.persistent_storage_path
         raise ValueError(
             "save_checkpoint/load_checkpoint need a directory (or set "
             "nebula.persistent_storage_path as the default root)")
@@ -168,7 +173,7 @@ class CheckpointManager:
     def load(self, load_dir: str, tag: Optional[str] = None,
              load_optimizer_states: bool = True, load_module_only: bool = False):
         engine = self.engine
-        load_dir = self._default_dir(load_dir)
+        load_dir = self._default_dir(load_dir, for_load=True)
         if tag is None:
             latest_path = os.path.join(load_dir, "latest")
             if not os.path.isfile(latest_path):

@@ -73,7 +73,8 @@ class PipelineEngine(DeepSpeedEngine):
         orig_rules = self.model_spec.tp_rules
         blocks_key = self._pp_blocks_key()
 
-        abstract = jax.eval_shape(self.model_spec.init, jax.random.PRNGKey(0))
+        # init_fn: immune to a user-held OnDevice('meta') context
+        abstract = jax.eval_shape(self.model_spec.init_fn, jax.random.PRNGKey(0))
         node = abstract
         for k in blocks_key:
             node = node[k]

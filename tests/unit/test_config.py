@@ -272,3 +272,66 @@ def test_engine_init_unaffected_by_on_device():
             0, cfg.vocab_size,
             size=(engine.train_batch_size(), 17)).astype(np.int32)})
     assert np.isfinite(float(m["loss"]))
+
+
+def test_nebula_load_path_redirects_loads(tmp_path):
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    warm = str(tmp_path / "warmstart")
+    fresh = str(tmp_path / "fresh")
+    deepspeed_tpu.comm.reset_topology()
+    cfg = gpt2.GPT2Config.tiny()
+    e1, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt2.build(cfg),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}})
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, cfg.vocab_size, size=(e1.train_batch_size(), 17)).astype(np.int32)}
+    e1.train_batch(batch)
+    e1.save_checkpoint(warm)
+
+    deepspeed_tpu.comm.reset_topology()
+    e2, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt2.build(gpt2.GPT2Config.tiny()),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "nebula": {"enabled": True,
+                           "persistent_storage_path": fresh,
+                           "load_path": warm}})
+    path, _ = e2.load_checkpoint()  # no dir: load_path wins for loads
+    assert path is not None and warm in path
+    assert e2.global_steps == 1
+    # saves still go to the persistent root
+    out = e2.save_checkpoint()
+    assert fresh in out
+
+
+def test_pipeline_and_profiler_init_immune_to_on_device():
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.profiling.flops_profiler import get_model_profile
+
+    deepspeed_tpu.comm.reset_topology()
+    cfg = gpt2.GPT2Config.tiny()
+    model = gpt2.build(cfg)
+    batch = {"input_ids": np.zeros((1, 17), np.int32)}
+    with deepspeed_tpu.OnDevice(device="meta"):
+        prof = get_model_profile(model, batch)
+        deepspeed_tpu.comm.reset_topology()
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=gpt2.build(cfg),
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "mesh": {"pp": 2, "tp": 2}})
+        rng = np.random.default_rng(0)
+        _, m = engine.train_batch({"input_ids": rng.integers(
+            0, cfg.vocab_size,
+            size=(engine.train_batch_size(), 17)).astype(np.int32)})
+    assert prof["params"] > 0
+    assert np.isfinite(float(m["loss"]))
