@@ -182,8 +182,10 @@ def get_model_profile(model_spec, batch, rng=None) -> Dict[str, float]:
 
     Returns {"flops", "macs", "params"} for one forward pass.
     """
-    # init_fn: immune to a user-held OnDevice('meta') context
-    params = model_spec.init_fn(jax.random.PRNGKey(0))
+    # abstract params: cost analysis only LOWERS the loss (never runs it)
+    # and param counting reads shapes — so nothing materializes, 70B specs
+    # profile for free, and a user-held OnDevice('meta') context is moot
+    params = jax.eval_shape(model_spec.init_fn, jax.random.PRNGKey(0))
     c = _cost(lambda p, b: model_spec.loss_fn(p, b, None, False), params,
               batch)
     return {"flops": c["flops"], "macs": c["flops"] / 2,
