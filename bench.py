@@ -93,6 +93,9 @@ def main():
         cfg.remat_policy = args.remat_policy
     if args.scan_layers is not None:
         cfg.scan_layers = args.scan_layers
+    if args.seq and args.micro_bs is None and on_tpu:
+        # keep tokens/microbatch constant so long sequences fit HBM
+        micro_bs = max(1, micro_bs * seq // args.seq)
     micro_bs = args.micro_bs or micro_bs
     seq = args.seq or seq
     steps = args.steps or steps

@@ -1,0 +1,123 @@
+"""Flash-kernel version A/B at long sequence lengths (round-4 harness).
+
+Pins the kernel selection via the DS_FLASH_V2 / DS_FLASH_V3 env switches
+(read at trace time) and measures attention fwd and fwd+bwd per layer for
+each version at the north-star sequence lengths (driver configs #2-#4 run
+S=4096-8192; BASELINE.md).  Interleaves rounds because single measurements
+through the tunnel vary by 10-40%.
+
+Usage: python benchmarks/flash_ab.py [--seqs 2048,4096,8192] [--d 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, *args, iters=16, calls=4):
+    """Scan ``iters`` in-jit AND pipeline ``calls`` back-to-back dispatches
+    with a single value fetch at the end: per-call tunnel latency (~60ms on
+    axon) overlaps with device execution instead of serializing into the
+    measurement (attn_microbench's single-call variant showed fwd+bwd
+    measuring FASTER than fwd at these sizes — pure dispatch artifact)."""
+    q0 = args[0]
+
+    @jax.jit
+    def runner(*a):
+        def body(carry, _):
+            out = fn(carry, *a[1:])
+            lead = jax.tree_util.tree_leaves(out)[0]
+            return (carry + 0.001 * lead.reshape(carry.shape).astype(
+                carry.dtype)), None
+        final, _ = jax.lax.scan(body, q0, None, length=iters)
+        return jnp.sum(final.astype(jnp.float32))
+
+    float(runner(*args))  # warmup/compile
+    float(runner(*args))  # second call: past first-execution costs
+    t0 = time.perf_counter()
+    r = None
+    for _ in range(calls):
+        r = runner(*args)
+    float(r)
+    return (time.perf_counter() - t0) / (iters * calls) * 1e3  # ms
+
+
+def pin_env(ver: str):
+    """The version switches are read at TRACE time — pin them immediately
+    before each measurement (the jit below re-traces per timeit call)."""
+    os.environ["DS_FLASH_V2"] = "1" if ver == "v2" else "0"
+    os.environ["DS_FLASH_V3"] = "1" if ver == "v3" else "0"
+    os.environ["DS_FLASH_V3_MIN_KV"] = "1" if ver == "v3" else "999999"
+
+
+def build(bq: int, bk: int):
+    from deepspeed_tpu.ops import flash_attention as fa
+
+    attn = functools.partial(fa.flash_attention, causal=True,
+                             block_q=bq, block_k=bk)
+
+    def f(q, k, v):
+        return (attn(q, k, v) * v).sum(dtype=jnp.float32)
+
+    return attn, jax.grad(f, argnums=(0, 1, 2))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", default="2048,4096,8192")
+    ap.add_argument("--d", type=int, default=64)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--rounds", type=int, default=3)
+    args = ap.parse_args()
+
+    d, h = args.d, args.heads
+    for s in (int(x) for x in args.seqs.split(",")):
+        b = max(1, (2 * 12 * 8192) // (h * s))  # ~constant token count
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(kq, (b, h, s, d), jnp.bfloat16)
+        k = jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
+        v = jax.random.normal(kv, (b, h, s, d), jnp.bfloat16)
+        fwd_flops = 2 * 2 * b * h * s * s * d * 0.5
+        fb_flops = fwd_flops * 3.5
+
+        variants = [("v1", 512, 1024), ("v1", 1024, 1024),
+                    ("v3", 512, 1024), ("v3", 1024, 1024)]
+        if s <= 1024:
+            variants.append(("v2", 1024, 1024))
+        fns = {f"{ver}_{bq}x{bk}": (ver,) + build(bq, bk)
+               for ver, bq, bk in variants}
+        results = {name: [] for name in fns}
+        def attempt(fn):
+            try:
+                return timeit(fn, q, k, v, iters=8)
+            except Exception as e:   # tunnel compile flakes: retry once
+                print(f"  (retrying after: {str(e)[:80]})")
+                return timeit(fn, q, k, v, iters=8)
+
+        for _ in range(args.rounds):   # interleaved rounds
+            for name, (ver, fwd, grad) in fns.items():
+                pin_env(ver)
+                ms_f = attempt(lambda *a: fwd(*a))
+                ms_fb = attempt(lambda *a: grad(*a)[0])
+                results[name].append((ms_f, ms_fb))
+        print(f"B={b} H={h} S={s} D={d} (min of {args.rounds} rounds)")
+        for name, rs in results.items():
+            ms_f = min(r[0] for r in rs)
+            ms_fb = min(r[1] for r in rs)
+            print(f"  {name:12s} fwd {ms_f:7.3f} ms ({fwd_flops/ms_f/1e9:5.1f}"
+                  f" TF/s)   fwd+bwd {ms_fb:7.3f} ms"
+                  f" ({fb_flops/ms_fb/1e9:5.1f} TF/s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,10 @@ def main():
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--int8", action="store_true",
                     help="INT8 weight-only storage (quant.enabled)")
+    ap.add_argument("--host-init", action="store_true",
+                    help="initialize params on host CPU (required for "
+                         "multi-billion models: on-device init materializes "
+                         "an f32 copy that can exceed HBM)")
     args = ap.parse_args()
 
     import jax
@@ -49,8 +53,15 @@ def main():
         model = args.hf_dir
     else:
         model = deepspeed_tpu.models.get_model(args.model)
+    params = None
+    if args.host_init and not args.hf_dir:
+        cpu = jax.local_devices(backend="cpu")[0]
+        with jax.default_device(cpu):
+            params = jax.jit(model.init_fn, backend="cpu")(
+                jax.random.PRNGKey(0))
+        params = jax.device_get(params)
     engine = deepspeed_tpu.init_inference(
-        model=model,
+        model=model, params=params,
         config={"dtype": args.dtype,
                 "tensor_parallel": {"tp_size": args.tp},
                 "quant": {"enabled": args.int8}})

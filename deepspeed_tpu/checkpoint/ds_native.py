@@ -35,8 +35,14 @@ from ..utils.logging import logger
 
 MODEL_FILE_PREFIX = "mp_rank_"
 ZERO_FILE_PREFIX = "zero_pp_rank_"
+LAYER_FILE_PREFIX = "layer_"
 OPTIM_FILE_SUFFIX = "_optim_states.pt"
 MODEL_FILE_SUFFIX = "_model_states.pt"
+#: pipeline-module layer shards: layer_{global_idx}-model_{tp}-model_states.pt
+#: (reference ``runtime/pipe/module.py:551 ckpt_layer_path`` — the rank repr
+#: omits the data and pipe axes, so only the model/tp coordinate appears)
+_LAYER_FILE_RE = re.compile(
+    r"layer_(\d+)-model_(\d+)-model_states\.pt")
 
 #: TP merge axes for HF GPT-2 (Conv1D = [in, out]: column-parallel weights
 #: concat on the OUT dim, row-parallel on the IN dim; embeddings on vocab)
@@ -89,19 +95,35 @@ class DeepSpeedNativeCheckpoint:
         self.zero_files = [f for f in files
                            if ZERO_FILE_PREFIX in f
                            and f.endswith(OPTIM_FILE_SUFFIX)]
-        if not self.model_files:
+        # pipeline-staged layout: {global_layer_idx: {tp_rank: filename}}
+        self.layer_files: Dict[int, Dict[int, str]] = {}
+        for f in files:
+            m = _LAYER_FILE_RE.fullmatch(f)
+            if m:
+                self.layer_files.setdefault(
+                    int(m.group(1)), {})[int(m.group(2))] = f
+        if not self.model_files and not self.layer_files:
             raise FileNotFoundError(
-                f"no {MODEL_FILE_PREFIX}*{MODEL_FILE_SUFFIX} in {ckpt_dir} — "
-                "not a DeepSpeed checkpoint directory")
-        self.tp_degree = len(self.model_files)
+                f"no {MODEL_FILE_PREFIX}*{MODEL_FILE_SUFFIX} or "
+                f"{LAYER_FILE_PREFIX}* shards in {ckpt_dir} — not a "
+                "DeepSpeed checkpoint directory")
+        if self.layer_files:
+            tp_sets = {frozenset(d) for d in self.layer_files.values()}
+            assert len(tp_sets) == 1, (
+                f"inconsistent TP shards across layer files: {tp_sets}")
+            self.tp_degree = len(next(iter(tp_sets)))
+        else:
+            self.tp_degree = len(self.model_files)
         # zero files: zero_pp_rank_{dp}_mp_rank_{tp}_optim_states.pt
         self.dp_degree = max(
             (int(re.search(r"zero_pp_rank_(\d+)", f).group(1))
              for f in self.zero_files), default=0) + 1 \
             if self.zero_files else 1
-        self._model_states = [None] * self.tp_degree
+        self._model_states = [None] * max(self.tp_degree,
+                                          len(self.model_files))
         logger.info(f"DS-native checkpoint: tp={self.tp_degree} "
-                    f"dp={self.dp_degree} zero_files={len(self.zero_files)}")
+                    f"dp={self.dp_degree} zero_files={len(self.zero_files)} "
+                    f"pipeline_layers={len(self.layer_files) or None}")
 
     # ------------------------------------------------------------- raw reads
     def model_state(self, tp_rank: int = 0) -> Dict[str, Any]:
@@ -111,10 +133,51 @@ class DeepSpeedNativeCheckpoint:
         return self._model_states[tp_rank]
 
     def client_state(self) -> Dict[str, Any]:
+        if not self.model_files:
+            return {}
         sd = self.model_state(0)
         return {k: sd.get(k) for k in
                 ("global_steps", "global_samples", "skipped_steps",
                  "iteration", "lr_scheduler", "ds_version") if k in sd}
+
+    # ---------------------------------------------------- pipeline layouts
+    @staticmethod
+    def gpt2_pipeline_name_map(layer_indices):
+        """Default global-name mapping for a GPT-2-family ``PipelineModule``:
+        the first layer file holds the embeddings (local names ``wte.weight``
+        / ``wpe.weight``), the last holds the final norm (``ln_f.*`` — or a
+        tied lm head), and middle file i holds transformer block
+        ``h.{i-1}.*``.  Custom stacks pass their own
+        ``name_map(global_layer_idx, local_name) -> global_name``."""
+        lo, hi = min(layer_indices), max(layer_indices)
+
+        def name_map(idx: int, local: str) -> str:
+            if idx == lo or idx == hi:
+                return local
+            return f"h.{idx - lo - 1}.{local}"
+
+        return name_map
+
+    def pipeline_module_state_dict(self, name_map=None,
+                                   dtype=np.float32) -> Dict[str, np.ndarray]:
+        """Reassemble a pipeline-staged checkpoint (``layer_*`` shards,
+        reference ``pipe/module.py save_state_dict``) into one flat module
+        state dict, TP-merging each layer's shards (reference
+        ``checkpoint/reshape_3d_utils.py`` handles the same layout as a 3D
+        reshape; here the target is always the full unsharded module)."""
+        assert self.layer_files, "not a pipeline-staged checkpoint"
+        if name_map is None:
+            name_map = self.gpt2_pipeline_name_map(self.layer_files)
+        out: Dict[str, np.ndarray] = {}
+        for idx in sorted(self.layer_files):
+            by_tp = self.layer_files[idx]
+            shards_sd = [_torch_load(os.path.join(self.dir, by_tp[tp]))
+                         for tp in sorted(by_tp)]
+            for local in shards_sd[0]:
+                gname = name_map(idx, local)
+                shards = [_np(sd[local]) for sd in shards_sd]
+                out[gname] = self._merge_tp(gname, shards).astype(dtype)
+        return out
 
     # ------------------------------------------------------- module weights
     def _merge_tp(self, name: str, shards: List[np.ndarray],
@@ -237,7 +300,17 @@ class DeepSpeedNativeCheckpoint:
         return out
 
     def merged_fp32_state_dict(self) -> Dict[str, np.ndarray]:
-        """fp32 weights merged across TP ranks."""
+        """fp32 weights merged across TP ranks (and reassembled across
+        pipeline stages for ``layer_*`` layouts)."""
+        if self.layer_files:
+            if self.zero_files:
+                raise NotImplementedError(
+                    "fp32-master reconstruction from a 3D (pipeline + ZeRO) "
+                    "torch-DeepSpeed checkpoint is not supported — convert "
+                    "with the reference's ds_to_universal first, or load "
+                    "the half-precision module weights via "
+                    "pipeline_module_state_dict()")
+            return self.pipeline_module_state_dict()
         per_rank = [self.fp32_state_dict(r) for r in range(self.tp_degree)]
         return {name: self._merge_tp(name, [sd[name] for sd in per_rank])
                 for name in per_rank[0]}

@@ -143,8 +143,17 @@ def init_compression(model: ModelSpec, deepspeed_config,
     mc = getattr(model, "model_config", None)
     if aq.shared_parameters.enabled:
         if mc is None or not hasattr(mc, "act_quant_bits"):
-            log_dist("activation_quantization: model exposes no "
-                     "act_quant_bits knob; ignoring", ranks=[0])
+            from ..runtime import constants as C
+            from ..runtime.config_utils import get_scalar_param
+
+            msg = ("activation_quantization is enabled but the model "
+                   "exposes no act_quant_bits knob — the setting would be "
+                   "silently ignored. Use a model that supports it, or set "
+                   '"strict": false to proceed without activation '
+                   "quantization.")
+            if get_scalar_param(pd, C.STRICT, C.STRICT_DEFAULT):
+                raise ValueError(msg)
+            log_dist(msg + " (strict=false: ignoring)", ranks=[0])
         else:
             grp = next(iter(aq.different_groups.values()), None)
             act_bits = grp.target_bits if grp is not None else 8

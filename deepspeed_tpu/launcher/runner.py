@@ -50,10 +50,21 @@ def parse_args(args=None):
     parser.add_argument("--master_addr", type=str, default="")
     parser.add_argument("--launcher", type=str, default="pdsh",
                         choices=["pdsh", "openmpi", "mpich", "mvapich",
-                                 "slurm", "ssh"])
+                                 "slurm", "ssh", "local"])
     parser.add_argument("--launcher_args", type=str, default="")
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("--detect_nvme", action="store_true")
+    parser.add_argument("--enable_elastic_training", action="store_true",
+                        help="supervise workers with the elastic agent: "
+                        "re-elect the world and restart on failure or "
+                        "hostfile membership change (reference "
+                        "launcher/launch.py:257-310)")
+    parser.add_argument("--elastic_config", type=str, default="",
+                        help="ds config json with the elasticity block; "
+                        "defaults to the --deepspeed_config in the script "
+                        "args")
+    parser.add_argument("--elastic_monitor_interval", type=float, default=5.0)
+    parser.add_argument("--elastic_max_restarts", type=int, default=100)
     parser.add_argument("user_script", type=str, help="training script")
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args=args)
@@ -286,12 +297,82 @@ class SlurmRunner(MultiNodeRunner):
         return cmd
 
 
+def _find_ds_config(args) -> str:
+    if args.elastic_config:
+        return args.elastic_config
+    ua = list(args.user_args)
+    for i, a in enumerate(ua):
+        if a in ("--deepspeed_config", "--deepspeed-config") and i + 1 < len(ua):
+            return ua[i + 1]
+        for prefix in ("--deepspeed_config=", "--deepspeed-config="):
+            if a.startswith(prefix):
+                return a[len(prefix):]
+    raise ValueError(
+        "--enable_elastic_training needs --elastic_config or a "
+        "--deepspeed_config in the training-script arguments")
+
+
+def _elastic_main(args) -> int:
+    """``deepspeed --enable_elastic_training``: run the training script under
+    the ElasticAgent instead of a one-shot multinode launch (reference
+    ``launcher/launch.py:257-310`` starts DSElasticAgent the same way).
+
+    The agent probes the HOSTFILE each monitor tick — editing the hostfile
+    is the membership-change signal (slice resize / preemption on TPU) —
+    elects the largest elastic-compatible world, launches one worker per
+    host with the JAX rendezvous env, and restarts the group on worker
+    death or membership change.
+    """
+    import socket
+
+    from ..elasticity.elastic_agent import ElasticAgent
+
+    with open(_find_ds_config(args)) as fh:
+        ds_config = json.load(fh)
+    local_names = {"localhost", "127.0.0.1", socket.gethostname()}
+
+    def probe_hosts():
+        pool = fetch_hostfile(args.hostfile)
+        if not pool:
+            return [socket.gethostname()]
+        return list(parse_resource_filter(pool, args.include,
+                                          args.exclude).keys())
+
+    pool0 = fetch_hostfile(args.hostfile)
+    chips = min(pool0.values()) if pool0 else 1
+
+    def launch_cmd(host, env):
+        inner = [sys.executable, "-u", args.user_script] + list(args.user_args)
+        if args.launcher == "local" or host in local_names:
+            return inner  # env rides through Popen(env=...)
+        rendezvous = {k: v for k, v in env.items()
+                      if k.startswith(("JAX_", "DS_ELASTIC_"))
+                      or k in ("WORLD_SIZE", "RANK")
+                      or any(k == e or (e.endswith("_") and k.startswith(e))
+                             for e in EXPORT_ENVS)}
+        exports = "".join(f"export {k}={shlex.quote(str(v))}; "
+                          for k, v in rendezvous.items())
+        remote = (f"cd {os.path.abspath('.')}; {exports}"
+                  + " ".join(map(shlex.quote, inner)))
+        return ["ssh", host, remote]
+
+    agent = ElasticAgent(
+        ds_config, probe_hosts, launch_cmd, chips_per_host=chips,
+        master_port=args.master_port,
+        monitor_interval=args.elastic_monitor_interval,
+        max_restarts=args.elastic_max_restarts)
+    return agent.run()
+
+
 def main(args=None):
     args = parse_args(args)
+    if args.enable_elastic_training:
+        sys.exit(_elastic_main(args))
     resource_pool = fetch_hostfile(args.hostfile)
 
-    if not resource_pool:
+    if not resource_pool or args.launcher == "local":
         # single-host path: exec the script locally, no rendezvous needed
+        # ("local" without elastic training means exactly this)
         env = os.environ.copy()
         cmd = [sys.executable, args.user_script] + args.user_args
         logger.info(f"cmd = {' '.join(cmd)}")

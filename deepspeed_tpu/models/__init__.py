@@ -50,6 +50,11 @@ _NAMED = {
     "opt": lambda kw: opt.build(**kw),
     "opt125m": lambda kw: opt.build(_with(opt.OPTConfig.opt_125m(), kw)),
     "opt350m": lambda kw: opt.build(_with(opt.OPTConfig.opt_350m(), kw)),
+    # 1p3b/2p7b spelling (like gptneo1p3b): "opt-1.3b" would normalize to
+    # the same key as "opt-13b"
+    "opt1p3b": lambda kw: opt.build(_with(opt.OPTConfig.opt_1_3b(), kw)),
+    "opt2p7b": lambda kw: opt.build(_with(opt.OPTConfig.opt_2_7b(), kw)),
+    "opt6p7b": lambda kw: opt.build(_with(opt.OPTConfig.opt_6_7b(), kw)),
     "opt13b": lambda kw: opt.build(_with(opt.OPTConfig.opt_13b(), kw)),
     "opt30b": lambda kw: opt.build(_with(opt.OPTConfig.opt_30b(), kw)),
     "opt66b": lambda kw: opt.build(_with(opt.OPTConfig.opt_66b(), kw)),

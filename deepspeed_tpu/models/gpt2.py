@@ -57,7 +57,9 @@ class GPT2Config:
     use_flash: Optional[bool] = None
     #: flash kernel block sizes; larger blocks amortize grid overhead when
     #: head_dim is small (d=64 -> half-width MXU ops)
-    flash_block_q: int = 512
+    #: 1024x1024 is the measured best for both the v2 (S<=1024) and v3
+    #: (S>=2048) kernel paths on v5e (PROFILE.md rounds 3-4)
+    flash_block_q: int = 1024
     flash_block_k: int = 1024
     #: sequence-parallel attention impl when mesh sp>1: auto|ulysses|ring
     sp_impl: str = "auto"
@@ -94,6 +96,9 @@ class GPT2Config:
     #: residuals stay as L separate buffers (no stacking copies), at the
     #: cost of L× compile time.  Worth it for small L on the perf path.
     scan_layers: bool = True
+    #: ZeRO-3 liveness: gather this many layers per scan step (engine sets
+    #: it from stage3_prefetch_bucket_size / stage3_max_live_parameters)
+    scan_group_size: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -388,16 +393,20 @@ def _trunk(cfg: GPT2Config, params, input_ids, rng=None, train: bool = True):
                 x = block_fn(cfg, x, layer, None, r, dropout)
         return x
 
-    def body(carry, xs):
+    def step(carry, layer):
         x, idx = carry
-        layer, = xs
         r = (jax.random.fold_in(rng, idx) if (rng is not None and dropout > 0.0)
              else None)
         x = block_fn(cfg, x, layer, None, r, dropout)
-        return (x, idx + 1), None
+        return (x, idx + 1)
 
-    (x, _), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.int32)),
-                             (params["blocks"],))
+    # ZeRO-3 liveness: scan_group_size > 1 gathers G layers per scan step
+    # (engine sets it from stage3_prefetch_bucket_size / max_live_parameters)
+    from ..runtime.zero.liveness import scan_layers_grouped
+
+    (x, _) = scan_layers_grouped(step, (x, jnp.zeros((), jnp.int32)),
+                                 params["blocks"],
+                                 getattr(cfg, "scan_group_size", 1))
     return x
 
 

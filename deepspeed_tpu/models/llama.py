@@ -39,6 +39,9 @@ class LlamaConfig:
     rms_eps: float = 1e-5
     remat: bool = True
     use_flash: Optional[bool] = None
+    #: ZeRO-3 liveness: gather this many layers per scan step (engine sets
+    #: it from stage3_prefetch_bucket_size / stage3_max_live_parameters)
+    scan_group_size: int = 1
     #: sequence-parallel attention impl when mesh sp>1: auto|ulysses|ring
     sp_impl: str = "auto"
 
@@ -185,13 +188,17 @@ def forward(cfg: LlamaConfig, params: PyTree, input_ids, rng=None,
     x = params["embed"][input_ids].astype(params["embed"].dtype)
     cos, sin = rope_angles(cfg, s)
 
-    def body(x, layer):
+    def step(x, layer):
         fn = block_apply
         if cfg.remat:
             fn = jax.checkpoint(block_apply, static_argnums=(0,))
-        return fn(cfg, layer, x, cos, sin), None
+        return fn(cfg, layer, x, cos, sin)
 
-    x, _ = jax.lax.scan(body, x, params["blocks"])
+    # ZeRO-3 liveness: scan_group_size > 1 gathers G layers per scan step
+    from ..runtime.zero.liveness import scan_layers_grouped
+
+    x = scan_layers_grouped(step, x, params["blocks"],
+                            getattr(cfg, "scan_group_size", 1))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     return x @ params["lm_head"].astype(x.dtype)
 

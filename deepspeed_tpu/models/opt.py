@@ -81,6 +81,16 @@ class OPTConfig:
                          ffn_size=8192)
 
     @staticmethod
+    def opt_2_7b() -> "OPTConfig":
+        return OPTConfig(num_layers=32, num_heads=32, hidden_size=2560,
+                         ffn_size=10240)
+
+    @staticmethod
+    def opt_6_7b() -> "OPTConfig":
+        return OPTConfig(num_layers=32, num_heads=32, hidden_size=4096,
+                         ffn_size=16384)
+
+    @staticmethod
     def opt_13b() -> "OPTConfig":
         return OPTConfig(num_layers=40, num_heads=40, hidden_size=5120,
                          ffn_size=20480)
@@ -188,8 +198,13 @@ def _attention(cfg: OPTConfig, q, k, v):
 
 def _block(cfg: OPTConfig, x, layer):
     """One OPT decoder layer. Pre-LN (do_layer_norm_before) or post-LN."""
+    from .gpt2 import _maybe_dequant
+
     b, s, d = x.shape
     h, hd = cfg.num_heads, cfg.head_dim
+    # INT8 weight-only serving: expand this layer's quantized records at
+    # point of use (peak memory = one layer of bf16 weights)
+    layer = _maybe_dequant(layer, x.dtype)
 
     res = x
     y = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"]) \
@@ -242,6 +257,9 @@ def _head(cfg: OPTConfig, params, x):
 def forward(cfg: OPTConfig, params: PyTree, input_ids, rng=None,
             train: bool = True):
     """Token logits. input_ids: [B, S] int32."""
+    from .gpt2 import _dequant_resident
+
+    params = _dequant_resident(params)
     x = _embed(cfg, params, input_ids)
 
     def body(x, xs):
@@ -263,8 +281,11 @@ def init_cache(cfg: OPTConfig, batch_size: int, max_len: int,
 def _block_cached(cfg: OPTConfig, x, layer, ck, cv, pos):
     from ..ops.decode_attention import decode_attention
 
+    from .gpt2 import _maybe_dequant
+
     b, t, d = x.shape
     h, hd = cfg.num_heads, cfg.head_dim
+    layer = _maybe_dequant(layer, x.dtype)
 
     res = x
     y = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"]) \
@@ -296,6 +317,9 @@ def _block_cached(cfg: OPTConfig, x, layer, ck, cv, pos):
 
 def forward_cached(cfg: OPTConfig, params, input_ids, cache, pos):
     """Incremental forward: logits for the LAST position + updated cache."""
+    from .gpt2 import _dequant_resident
+
+    params = _dequant_resident(params)
     pos = jnp.asarray(pos, jnp.int32)
     x = _embed(cfg, params, input_ids, pos0=pos)
 
@@ -468,6 +492,7 @@ def build(cfg: Optional[OPTConfig] = None, **overrides) -> ModelSpec:
                      flops_per_token=6.0 * cfg.num_params(),
                      pipeline_hooks=pipeline_hooks,
                      decode_hooks=decode_hooks,
+                     quant_aware=True,  # per-layer point-of-use dequant
                      name=f"opt-{cfg.num_layers}l-{cfg.hidden_size}d")
 
 

@@ -416,12 +416,26 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, true_kv_len, head_rep,
 #    accumulates dk/dv in VMEM scratch over the sequential q-block grid
 #    dimension; delta = rowsum(do*o) is computed in-kernel and 1/l is folded
 #    into do, so no [bq, S] divide and no broadcast operands exist.
-# The v1 kernels remain for long sequences, the sparse-layout path, and the
-# ring-attention building blocks (parallel/sequence.py).
+# Long sequences (kv_pad > _V2_MAX_KV) take the v3 kernels below; the v1
+# kernels remain for the sparse-layout path, the ring-attention building
+# blocks (parallel/sequence.py), and the DS_FLASH_V2/V3=0 kill switches.
 # ---------------------------------------------------------------------------
 _LOG2E = math.log2(math.e)
 _LN2 = math.log(2.0)
-_V2_MAX_KV = 2048
+# v2's fused backward at kv_pad=2048 measured 348KB OVER the 16MB
+# scoped-vmem limit on v5e at EVERY block_q (round-4 compile failures; the
+# round-3 on-chip fuzz only reached S=512 so the former 2048 limit was
+# never hardware-validated).  kv_pad <= 1024 compiles and is the measured
+# winner at bench shapes; v3 takes over beyond.
+_V2_MAX_KV = 1024
+# defensive cap on the [block_q, kv_pad] f32 score intermediates
+_V2_MAX_SCORE_ELEMS = 2 ** 20
+
+
+def _v2_block_q(block_q: int, kv_pad: int) -> int:
+    # cap only — never RAISE block_q (it may legitimately be the whole
+    # padded q length for short sequences)
+    return max(8, min(block_q, _V2_MAX_SCORE_ELEMS // max(kv_pad, 1)))
 
 
 def _v2_eligible(kv_pad: int, d: int) -> bool:
@@ -430,6 +444,25 @@ def _v2_eligible(kv_pad: int, d: int) -> bool:
     if os.environ.get("DS_FLASH_V2", "1") == "0":  # A/B kill switch
         return False
     return kv_pad <= _V2_MAX_KV and kv_pad % 8 == 0 and d <= 256
+
+
+def _v3_eligible(kv_pad: int, d: int) -> bool:
+    """v3 kernels (chunked-grid, compact row stats): the long-sequence path.
+
+    Measured on v5e (round 4, PROFILE.md): ~8-10% faster than the v1
+    two-kernel path at S in [2048, 8192] — fwd folds the softmax scale and
+    log2(e) into q and uses exp2; bwd reads lse/delta as compact 8-sublane
+    operands instead of v1's [bh, S, 128]-broadcast f32 arrays (~200MB of
+    HBM traffic per layer at bench shapes).  A fused one-kernel backward and
+    a resident-KV chunk-loop variant were both probed and lost (fused: VMEM
+    cliff at S=8192 + slower at 4096; see PROFILE.md round-4 notes).
+    """
+    import os
+
+    if os.environ.get("DS_FLASH_V3", "1") == "0":  # A/B kill switch
+        return False
+    min_kv = int(os.environ.get("DS_FLASH_V3_MIN_KV", _V2_MAX_KV + 1))
+    return kv_pad >= min_kv and kv_pad % 8 == 0 and d <= 256
 
 
 def _fwd_v2_kernel(q_ref, k_ref, v_ref, o_ref, *, scale2: float, causal: bool,
@@ -594,14 +627,289 @@ def _bwd_v2(q, k, v, o, do, sm_scale, causal, block_q, interpret, true_kv_len,
 
 
 # ---------------------------------------------------------------------------
+# v3 kernels: the long-sequence path (kv_pad > _V2_MAX_KV).
+#
+# Same chunked-grid structure as v1 (scratch-carried online softmax in the
+# forward; separate dq / dkv backward kernels) but with the v2 tricks that
+# carry over to chunking, each A/B-measured on-chip (PROFILE.md round 4):
+#  - softmax scale AND log2(e) folded into q once per block ([bq, d] pass
+#    instead of a [bq, bk] f32 multiply per chunk); exp2 everywhere.
+#  - row stats live in COMPACT [bh, 1, S] f32 arrays.  The forward writes
+#    lse2 = m2 + log2(l) via a sublane->lane relayout at finalize; the
+#    backward reads it back with the reverse relayout (measured free) and
+#    reconstructs true probabilities p = exp2(s2 - lse2) directly — no
+#    division, no [bh, S, LANES] broadcast operands (v1 ships ~200MB/layer
+#    of those at bench shapes).
+#  - delta = rowsum(do * o) is one fused XLA pass, also [bh, 1, S].
+# Rejected by measurement (do NOT revisit without new evidence):
+#  - fused one-kernel backward (dk/dv full-row scratch + resident KV):
+#    VMEM cliff at S=8192 (compile failure) and slower at 2048/4096.
+#  - dq-partials-summed-by-XLA fused variant: partial-write traffic costs
+#    more than the two matmuls it saves.
+#  - masked/unmasked chunk-body forking: no measurable win.
+# Reference parity: csrc/transformer/ds_transformer_cuda.cpp:78-121 claims
+# fused-kernel supremacy at its benchmark shapes; this path is what makes
+# the S=4096-8192 driver configs run on the measured-best kernels.
+# ---------------------------------------------------------------------------
+
+
+def _fwd_v3_kernel(*refs, scale2: float, causal: bool, block_q: int,
+                   block_k: int, kv_pad: int, kv_len: int, num_k_blocks: int):
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, ...]
+        qs = (q.astype(jnp.float32) * scale2).astype(q.dtype)
+        k = k_ref[0, ...]
+        v = v_ref[0, ...]
+        s2 = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (block_q, block_k), 1)
+        mask = col < kv_len
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, row >= col)
+        s2 = jnp.where(mask, s2, DEFAULT_MASK_VALUE)
+        m_prev = m_scr[...][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s2, axis=1, keepdims=True))
+        alpha = jnp.exp2(m_prev - m_new)
+        p = jnp.exp2(s2 - m_new)
+        l_scr[...] = jnp.broadcast_to(
+            alpha * l_scr[...][:, :1] + jnp.sum(p, axis=1, keepdims=True),
+            l_scr.shape)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        l = l_scr[...][:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, ...] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        lse2 = m_scr[...][:, :1] + jnp.log2(l_safe)   # exp2-domain lse
+        lse_ref[0, ...] = lse2.reshape(1, block_q)    # sublane -> lane
+
+
+def _fwd_v3(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+            true_kv_len, head_rep):
+    bh, q_len, d = q.shape
+    kv_pad = k.shape[1]
+    nq = pl.cdiv(q_len, block_q)
+    nk = pl.cdiv(kv_pad, block_k)
+    rep = head_rep
+    kernel = functools.partial(
+        _fwd_v3_kernel, scale2=sm_scale * _LOG2E, causal=causal,
+        block_q=block_q, block_k=block_k, kv_pad=kv_pad, kv_len=true_kv_len,
+        num_k_blocks=nk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // rep, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // rep, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),  # m
+            pltpu.VMEM((block_q, LANES), jnp.float32),  # l
+            pltpu.VMEM((block_q, d), jnp.float32),      # acc
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, q_len, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, q_len), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+def _bwd_v3_dq_kernel(*refs, scale2: float, sm_scale: float, causal: bool,
+                      block_q: int, block_k: int, kv_len: int,
+                      num_k_blocks: int):
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, dq_scr) = refs
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, ...]
+        k = k_ref[0, ...]
+        v = v_ref[0, ...]
+        do = do_ref[0, ...]
+        qs = (q.astype(jnp.float32) * scale2).astype(q.dtype)
+        lse2 = lse_ref[0, ...].reshape(block_q, 1)   # lane -> sublane
+        delta = dl_ref[0, ...].reshape(block_q, 1)
+        s2 = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (block_q, block_k), 1)
+        mask = col < kv_len
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, row >= col)
+        s2 = jnp.where(mask, s2, DEFAULT_MASK_VALUE)
+        p = jnp.exp2(s2 - lse2)                      # true softmax probs
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(k.dtype)
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0, ...] = (dq_scr[...] * sm_scale).astype(dq_ref.dtype)
+
+
+def _bwd_v3_dkv_kernel(*refs, scale2: float, causal: bool, block_q: int,
+                       block_k: int, kv_len: int, num_q_blocks: int,
+                       rep: int):
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref, dv_ref,
+     dk_scr, dv_scr) = refs
+    ki = pl.program_id(1)
+    inner = pl.program_id(2)
+    qi = inner % num_q_blocks
+
+    @pl.when(inner == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, ...]
+        k = k_ref[0, ...]
+        v = v_ref[0, ...]
+        do = do_ref[0, ...]
+        qs = (q.astype(jnp.float32) * scale2).astype(q.dtype)
+        lse2 = lse_ref[0, ...].reshape(block_q, 1)
+        delta = dl_ref[0, ...].reshape(block_q, 1)
+        s2 = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (block_q, block_k), 1)
+        mask = col < kv_len
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, row >= col)
+        s2 = jnp.where(mask, s2, DEFAULT_MASK_VALUE)
+        p = jnp.exp2(s2 - lse2)
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(q.dtype)
+        # dk_true = sm_scale * ds^T @ q = (ds^T @ qs) * ln2
+        dk_scr[...] += jax.lax.dot_general(
+            ds, qs, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(inner == rep * num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0, ...] = (dk_scr[...] * _LN2).astype(dk_ref.dtype)
+        dv_ref[0, ...] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_v3(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
+            interpret, true_kv_len, head_rep):
+    bh, q_len, d = q.shape
+    bh_kv, kv_pad, _ = k.shape
+    nq = pl.cdiv(q_len, block_q)
+    nk = pl.cdiv(kv_pad, block_k)
+    rep = head_rep
+    scale2 = sm_scale * _LOG2E
+    # delta = rowsum(do * o): one fused XLA pass, compact [bh, 1, S] layout
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)[:, None, :]
+
+    dq_kernel = functools.partial(
+        _bwd_v3_dq_kernel, scale2=scale2, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, kv_len=true_kv_len,
+        num_k_blocks=nk)
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // rep, j, 0))
+    lspec = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, nq, nk),
+        in_specs=[qspec, kspec, kspec, qspec, lspec, lspec],
+        out_specs=qspec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _bwd_v3_dkv_kernel, scale2=scale2, causal=causal, block_q=block_q,
+        block_k=block_k, kv_len=true_kv_len, num_q_blocks=nq, rep=rep)
+    q_map = lambda b, j, i: (b * rep + i // nq, i % nq, 0)
+    l_map = lambda b, j, i: (b * rep + i // nq, 0, i % nq)
+    qspec2 = pl.BlockSpec((1, block_q, d), q_map)
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    lspec2 = pl.BlockSpec((1, 1, block_q), l_map)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh_kv, nk, rep * nq),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, lspec2, lspec2],
+        out_specs=[kspec2, kspec2],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
 # public op
 # ---------------------------------------------------------------------------
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash_attention_bh(q, k, v, sm_scale, causal, block_q, block_k, interpret,
                         true_kv_len, head_rep):
     if _v2_eligible(k.shape[1], q.shape[2]):
-        return _fwd_v2(q, k, v, sm_scale, causal, block_q, interpret,
+        return _fwd_v2(q, k, v, sm_scale, causal,
+                       _v2_block_q(block_q, k.shape[1]), interpret,
                        true_kv_len, head_rep)
+    if _v3_eligible(k.shape[1], q.shape[2]):
+        o, _ = _fwd_v3(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                       true_kv_len, head_rep)
+        return o
     o, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
                 true_kv_len, head_rep)
     return o
@@ -612,11 +920,18 @@ def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, interpret,
     from jax.ad_checkpoint import checkpoint_name
 
     if _v2_eligible(k.shape[1], q.shape[2]):
-        o = _fwd_v2(q, k, v, sm_scale, causal, block_q, interpret,
+        o = _fwd_v2(q, k, v, sm_scale, causal,
+                    _v2_block_q(block_q, k.shape[1]), interpret,
                     true_kv_len, head_rep)
         # no lse residual: the fused backward recomputes row stats in-kernel
         o = checkpoint_name(o, "flash_out")
         return o, (q, k, v, o)
+    if _v3_eligible(k.shape[1], q.shape[2]):
+        o, lse = _fwd_v3(q, k, v, sm_scale, causal, block_q, block_k,
+                         interpret, true_kv_len, head_rep)
+        o = checkpoint_name(o, "flash_out")
+        lse = checkpoint_name(lse, "flash_lse")
+        return o, (q, k, v, o, lse)
     o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
                   true_kv_len, head_rep)
     # named so remat policies can pin the kernel's residuals: saving o+lse
@@ -631,8 +946,13 @@ def _flash_bwd_rule(sm_scale, causal, block_q, block_k, interpret, true_kv_len,
                     head_rep, res, g):
     if len(res) == 4:  # v2 path (see _flash_fwd_rule)
         q, k, v, o = res
-        return _bwd_v2(q, k, v, o, g, sm_scale, causal, block_q, interpret,
+        return _bwd_v2(q, k, v, o, g, sm_scale, causal,
+                       _v2_block_q(block_q, k.shape[1]), interpret,
                        true_kv_len, head_rep)
+    if res[4].ndim == 3:  # v3 path: compact [bh, 1, S] exp2-domain lse
+        q, k, v, o, lse = res
+        return _bwd_v3(q, k, v, o, lse, g, sm_scale, causal, block_q,
+                       block_k, interpret, true_kv_len, head_rep)
     return _bwd(sm_scale, causal, block_q, block_k, interpret, true_kv_len,
                 head_rep, res, g)
 

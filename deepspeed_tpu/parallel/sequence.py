@@ -24,9 +24,17 @@ Both run inside ``shard_map`` over the engine's global mesh, composing with
 picks Ulysses when head counts divide (cheaper: 2 all-to-alls vs sp ppermute
 rounds), else ring.
 
-Causal ring steps where the visiting KV chunk is strictly in the future are
-masked out at merge time (the kernel work is still issued — the classic ring
-load-imbalance; zigzag block reordering is a future optimization).
+Causal load balance: with contiguous chunking, device 0's chunk attends only
+itself while the last device attends everything — every ring step issues
+kernels on all devices but discards the future-chunk results, wasting ~2x
+FLOPs at large sp.  ``zigzag=True`` (default for causal) assigns each device
+the HALF-chunK PAIR (i, 2*sp-1-i) of 2*sp sequence blocks.  Then at every
+step each device runs exactly two half-sized, fully-valid non-causal kernels
+(plus causal diagonals at step 0): which halves participate depends only on
+the predicate ``idx >= step``, so inputs are routed with selects and the
+compiled program is SPMD-uniform with NO discarded kernel work.  The test
+asserts the kernel-invocation count and shapes (work balance) and numeric
+parity of o/dq/dk/dv against dense flash attention.
 """
 
 from __future__ import annotations
@@ -182,6 +190,226 @@ def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, sp, sm_scale, causal,
             dv_cur.astype(v.dtype))
 
 
+# ---------------------------------------------------------------------------
+# zigzag ring attention: balanced causal work (see module docstring)
+# ---------------------------------------------------------------------------
+def _merge_state(state, o_j, lse_j):
+    """Online-softmax merge of a partial attention output into (m, s, acc)."""
+    m, s, acc = state
+    lse_j = lse_j[..., None]
+    m_new = jnp.maximum(m, lse_j)
+    alpha = jnp.exp(m - m_new)
+    beta = jnp.exp(lse_j - m_new)
+    return (m_new, s * alpha + beta,
+            acc * alpha + beta * o_j.astype(jnp.float32))
+
+
+def _merge_if(pred, state, o_j, lse_j):
+    new = _merge_state(state, o_j, lse_j)
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(pred, n, o), new, state)
+
+
+def _zz_fwd_impl(q, k, v, axis_name, sp, sm_scale, block_q, block_k,
+                 interpret):
+    """Zigzag-causal ring forward.  Device ``i`` holds sequence HALF-BLOCKS
+    (i, 2*sp-1-i) concatenated: q/k/v are [B, H(kv), c, D] with c = 2 half
+    blocks.  Every kernel issued is fully valid:
+
+      step 0 (self):      q1 x k1 (diag), q2 x k1 (full), q2 x k2 (diag)
+      step j, src r < i:  q1 x k1 (full), q2 x k1 (full)
+      step j, src r > i:  q2 x k1 (full), q2 x k2 (full)
+
+    The r<i / r>i cases differ only in which halves feed two equal-shape
+    non-causal kernels, so inputs route through selects on ``idx >= step``
+    and the program is SPMD-uniform.
+    """
+    b, h, c, d = q.shape
+    rep = h // k.shape[1]
+    bh = b * h
+    ch = c // 2
+    qf = _flat(q)
+    q1, q2 = qf[:, :ch], qf[:, ch:]
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(r, (r + 1) % sp) for r in range(sp)]
+
+    def halves(kv_cur):
+        kvf = _rep_flat(kv_cur, rep)
+        return kvf[:, :ch], kvf[:, ch:]
+
+    zero = lambda: (jnp.full((bh, ch, 1), NEG_INF, jnp.float32),
+                    jnp.zeros((bh, ch, 1), jnp.float32),
+                    jnp.zeros((bh, ch, d), jnp.float32))
+    st1, st2 = zero(), zero()
+    k_cur, v_cur = k, v
+
+    kw = dict(block_q=block_q, block_k=block_k, interpret=interpret)
+    # ---- step 0: self-attention of the local half pair
+    k1, k2 = halves(k_cur)
+    v1, v2 = halves(v_cur)
+    o11, l11 = fa._fwd(q1, k1, v1, sm_scale, True, true_kv_len=ch, **kw)
+    o21, l21 = fa._fwd(q2, k1, v1, sm_scale, False, true_kv_len=ch, **kw)
+    o22, l22 = fa._fwd(q2, k2, v2, sm_scale, True, true_kv_len=ch, **kw)
+    st1 = _merge_state(st1, o11, l11)
+    st2 = _merge_state(st2, o21, l21)
+    st2 = _merge_state(st2, o22, l22)
+
+    for step in range(1, sp):
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        k1, k2 = halves(k_cur)
+        v1, v2 = halves(v_cur)
+        past = idx >= step            # visiting source r = idx - step < idx
+        qA = jnp.where(past, q1, q2)
+        kB = jnp.where(past, k1, k2)
+        vB = jnp.where(past, v1, v2)
+        oA, lA = fa._fwd(qA, k1, v1, sm_scale, False, true_kv_len=ch, **kw)
+        oB, lB = fa._fwd(q2, kB, vB, sm_scale, False, true_kv_len=ch, **kw)
+        st1 = _merge_if(past, st1, oA, lA)
+        st2 = _merge_if(jnp.logical_not(past), st2, oA, lA)
+        st2 = _merge_state(st2, oB, lB)
+
+    outs = []
+    lses = []
+    for m, s, acc in (st1, st2):
+        s_safe = jnp.where(s == 0.0, 1.0, s)
+        outs.append((acc / s_safe).astype(q.dtype))
+        lses.append((m + jnp.log(s_safe))[..., 0])
+    o = jnp.concatenate(outs, axis=1).reshape(b, h, c, d)
+    lse = jnp.concatenate(lses, axis=1)                  # [bh, c]
+    return o, lse
+
+
+def _zz_bwd_impl(q, k, v, o, lse, do, axis_name, sp, sm_scale, block_q,
+                 block_k, interpret):
+    b, h, c, d = q.shape
+    hkv = k.shape[1]
+    rep = h // hkv
+    ch = c // 2
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(r, (r + 1) % sp) for r in range(sp)]
+
+    qf, of, dof = _flat(q), _flat(o), _flat(do)
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+    lse_b = jnp.broadcast_to(lse[..., None], lse.shape + (fa.LANES,))
+    delta_b = jnp.broadcast_to(delta[..., None], delta.shape + (fa.LANES,))
+    q1, q2 = qf[:, :ch], qf[:, ch:]
+    do1, do2 = dof[:, :ch], dof[:, ch:]
+    l1, l2 = lse_b[:, :ch], lse_b[:, ch:]
+    d1, d2 = delta_b[:, :ch], delta_b[:, ch:]
+
+    def halves(kv_cur):
+        kvf = _rep_flat(kv_cur, rep)
+        return kvf[:, :ch], kvf[:, ch:]
+
+    def fold(g):
+        """[b*hkv*rep, ch, d] half grads -> [b, hkv, ch, d]."""
+        if rep == 1:
+            return g.reshape(b, hkv, ch, d).astype(jnp.float32)
+        return g.reshape(b, hkv, rep, ch, d).sum(axis=2)
+
+    def kernels(qx, dox, lx, dx, kx, vx, causal):
+        kw = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
+                  block_k=block_k, kv_len=ch, interpret=interpret)
+        dq_ = fa._bwd_dq_call(qx, kx, vx, dox, lx, dx, **kw)
+        dk_, dv_ = fa._bwd_dkv_call(qx, kx, vx, dox, lx, dx, **kw)
+        return dq_.astype(jnp.float32), fold(dk_), fold(dv_)
+
+    dq1 = jnp.zeros((b * h, ch, d), jnp.float32)
+    dq2 = jnp.zeros((b * h, ch, d), jnp.float32)
+    dkv_z = lambda: jnp.zeros((b, hkv, ch, d), jnp.float32)
+    k_cur, v_cur = k, v
+    dk_cur = jnp.zeros((b, hkv, c, d), jnp.float32)
+    dv_cur = jnp.zeros((b, hkv, c, d), jnp.float32)
+
+    def add_halves(full, h1, h2):
+        return full + jnp.concatenate([h1, h2], axis=2)
+
+    # ---- step 0
+    k1, k2 = halves(k_cur)
+    v1, v2 = halves(v_cur)
+    a_dq, a_dk, a_dv = kernels(q1, do1, l1, d1, k1, v1, True)
+    b_dq, b_dk, b_dv = kernels(q2, do2, l2, d2, k1, v1, False)
+    c_dq, c_dk, c_dv = kernels(q2, do2, l2, d2, k2, v2, True)
+    dq1 += a_dq
+    dq2 += b_dq + c_dq
+    dk_cur = add_halves(dk_cur, a_dk + b_dk, c_dk)
+    dv_cur = add_halves(dv_cur, a_dv + b_dv, c_dv)
+    dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
+    dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
+
+    for step in range(1, sp):
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        k1, k2 = halves(k_cur)
+        v1, v2 = halves(v_cur)
+        past = idx >= step
+        qA = jnp.where(past, q1, q2)
+        doA = jnp.where(past, do1, do2)
+        lA = jnp.where(past, l1, l2)
+        dA = jnp.where(past, d1, d2)
+        kB = jnp.where(past, k1, k2)
+        vB = jnp.where(past, v1, v2)
+        a_dq, a_dk, a_dv = kernels(qA, doA, lA, dA, k1, v1, False)
+        b_dq, b_dk, b_dv = kernels(q2, do2, l2, d2, kB, vB, False)
+        # route (all kernel outputs are finite — every issued kernel is a
+        # valid past-attending pair, so additive where-routing is safe)
+        z = jnp.zeros_like(a_dq)
+        dq1 += jnp.where(past, a_dq, z)
+        dq2 += b_dq + jnp.where(past, z, a_dq)
+        zk = dkv_z()
+        dk_cur = add_halves(dk_cur, a_dk + jnp.where(past, b_dk, zk),
+                            jnp.where(past, zk, b_dk))
+        dv_cur = add_halves(dv_cur, a_dv + jnp.where(past, b_dv, zk),
+                            jnp.where(past, zk, b_dv))
+        dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
+
+    # after sp rotations (one per step) the accumulators are home
+    dq = jnp.concatenate([dq1, dq2], axis=1)
+    return (dq.astype(q.dtype).reshape(b, h, c, d), dk_cur.astype(k.dtype),
+            dv_cur.astype(v.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _zz_ring_attn(q, k, v, axis_name, sp, sm_scale, block_q, block_k,
+                  interpret):
+    o, _ = _zz_fwd_impl(q, k, v, axis_name, sp, sm_scale, block_q, block_k,
+                        interpret)
+    return o
+
+
+def _zz_ring_attn_fwd(q, k, v, axis_name, sp, sm_scale, block_q, block_k,
+                      interpret):
+    o, lse = _zz_fwd_impl(q, k, v, axis_name, sp, sm_scale, block_q, block_k,
+                          interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _zz_ring_attn_bwd(axis_name, sp, sm_scale, block_q, block_k, interpret,
+                      res, do):
+    q, k, v, o, lse = res
+    return _zz_bwd_impl(q, k, v, o, lse, do, axis_name, sp, sm_scale, block_q,
+                        block_k, interpret)
+
+
+_zz_ring_attn.defvjp(_zz_ring_attn_fwd, _zz_ring_attn_bwd)
+
+
+def zigzag_order(s_len: int, sp: int):
+    """Permutation placing half-block pair (i, 2*sp-1-i) on device i, and its
+    inverse.  ``s_len`` must divide 2*sp."""
+    import numpy as np
+
+    c2 = s_len // (2 * sp)
+    blocks = []
+    for i in range(sp):
+        blocks += [i, 2 * sp - 1 - i]
+    zig = np.concatenate([np.arange(bl * c2, (bl + 1) * c2) for bl in blocks])
+    inv = np.argsort(zig)
+    return zig, inv
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _ring_attn(q, k, v, axis_name, sp, sm_scale, causal, block_q, block_k,
                interpret):
@@ -276,11 +504,15 @@ def ring_attention(q, k, v, causal: bool = True,
                    sm_scale: Optional[float] = None, mesh=None,
                    sp_axis: str = SP_AXIS, batch_axes=DATA_AXES,
                    head_axis: str = TP_AXIS, block_q: int = 128,
-                   block_k: int = 128, interpret: Optional[bool] = None):
+                   block_k: int = 128, interpret: Optional[bool] = None,
+                   zigzag="auto"):
     """Ring attention over the ``sp`` mesh axis.  q: [B, H, S, D] global.
 
     S is chunked over sp; KV chunks rotate via ppermute.  k, v may have fewer
-    (GQA) heads — they are repeated to H first.
+    (GQA) heads — they are repeated to H first.  ``zigzag`` ("auto" | True |
+    False): balanced-causal half-block pairing (module docstring) — auto uses
+    it for causal attention whenever the per-device chunk splits into two
+    TPU-tileable halves; non-causal attention has no imbalance to fix.
     """
     mesh = _resolve_mesh(mesh)
     sp = mesh.shape[sp_axis]
@@ -297,12 +529,11 @@ def ring_attention(q, k, v, causal: bool = True,
     s_len = q.shape[2]
     assert s_len % sp == 0, f"seq len {s_len} must divide sp={sp}"
     c = s_len // sp
-    bq = _ring_block(c, block_q)
-    bk = _ring_block(c, block_k)
-
-    def local(q, k, v):
-        return _ring_attn(q, k, v, sp_axis, sp, sm_scale, causal, bq, bk,
-                          interpret)
+    use_zz = (causal and c % 2 == 0 and (c // 2) % 8 == 0) \
+        if zigzag == "auto" else bool(zigzag and causal)
+    if use_zz and c % 2:
+        raise ValueError(f"zigzag ring attention needs an even per-device "
+                         f"chunk, got {c}")
 
     q_spec = _qkvo_spec(mesh, q.shape, batch_axes, head_axis, sp_axis)
     kv_spec = _qkvo_spec(mesh, k.shape, batch_axes, head_axis, sp_axis)
@@ -311,6 +542,36 @@ def ring_attention(q, k, v, causal: bool = True,
         # below the kv head count — keep both head dims replicated instead
         q_spec = P(q_spec[0], None, sp_axis, None)
         kv_spec = P(kv_spec[0], None, sp_axis, None)
+
+    if use_zz:
+        bq = _ring_block(c // 2, block_q)
+        bk = _ring_block(c // 2, block_k)
+        # NOTE: the zig/inv gathers below re-permute the sp-sharded
+        # sequence ACROSS devices on every call (~4 rotation-equivalents of
+        # ICI traffic per attention + the backward's scatters).  The FLOP
+        # balance win is ~2x of the attention compute, which dominates at
+        # long S, but a model that keeps its token stream in zigzag layout
+        # end-to-end (permute once at the embedding, fold positions/labels)
+        # would pay this once per step instead of per layer — future work.
+        zig, inv = zigzag_order(s_len, sp)
+
+        def local(q, k, v):
+            return _zz_ring_attn(q, k, v, sp_axis, sp, sm_scale, bq, bk,
+                                 interpret)
+
+        fn = jax.shard_map(local, mesh=mesh,
+                           in_specs=(q_spec, kv_spec, kv_spec),
+                           out_specs=q_spec, check_vma=False)
+        o = fn(q[:, :, zig], k[:, :, zig], v[:, :, zig])
+        return o[:, :, inv]
+
+    bq = _ring_block(c, block_q)
+    bk = _ring_block(c, block_k)
+
+    def local(q, k, v):
+        return _ring_attn(q, k, v, sp_axis, sp, sm_scale, causal, bq, bk,
+                          interpret)
+
     fn = jax.shard_map(local, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec),
                        out_specs=q_spec, check_vma=False)
     return fn(q, k, v)

@@ -110,7 +110,10 @@ class CheckpointManager:
         if save_dir is not None:
             return save_dir
         neb = getattr(self.engine._config, "nebula_config", None)
-        if neb is not None:
+        # a disabled nebula block carrying stale paths must not silently
+        # redirect the default roots (the reference gates all nebula
+        # behavior on enabled=true)
+        if neb is not None and neb.enabled:
             if for_load and neb.enable_nebula_load and neb.load_path:
                 return neb.load_path
             if neb.persistent_storage_path:

@@ -10,10 +10,13 @@ Reference: ``runtime/comm/nccl.py:52 NcclBackend.compressed_allreduce`` — the
  2. worker ``j`` decompresses and averages its chunk, compresses the average
     (residual kept as **server error** feedback), and all-gathers the result.
 
-Wire traffic is ~2x size x 1 byte (int8 both rounds) vs ~2x size x 4 bytes
-for an fp32 ring all-reduce — the same ~4x compression the reference gets,
-here expressed with ``lax.all_to_all``/``all_gather`` on int8 inside
-``shard_map`` so XLA moves the small dtype over ICI.
+Signs travel PACKED, 8 per byte (``uint8`` bitwise ops around the
+collectives), exactly like the reference's ``compress_by_chunk``
+(``cupy.packbits``, ``runtime/comm/nccl.py:78-85``): wire traffic is
+~2x size x 1/8 byte + per-chunk f32 scales vs ~2x size x 4 bytes for an
+fp32 ring all-reduce — a ~32x wire reduction, expressed with
+``lax.all_to_all``/``all_gather`` on packed uint8 inside ``shard_map`` so
+XLA moves the small payload over ICI.
 """
 
 from __future__ import annotations
@@ -24,14 +27,32 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# bit i of a packed byte holds sign of element 8*j + i (1 <-> +1, 0 <-> -1)
+_BIT_WEIGHTS = tuple(1 << i for i in range(8))
+
+
+def _pack_signs(comp):
+    """comp [..., c] (c % 8 == 0) -> packed sign bits, uint8 [..., c // 8]."""
+    bits = (comp >= 0).astype(jnp.uint8).reshape(comp.shape[:-1] + (-1, 8))
+    w = jnp.asarray(_BIT_WEIGHTS, jnp.uint8)
+    return jnp.sum(bits * w, axis=-1, dtype=jnp.uint8)
+
+
+def _unpack_signs(packed):
+    """packed uint8 [..., c8] -> signs f32 [..., c8 * 8] in {-1, +1}."""
+    shifts = jnp.asarray(range(8), jnp.uint8)
+    bits = (packed[..., None] >> shifts) & jnp.uint8(1)
+    return (bits.astype(jnp.float32) * 2.0 -
+            1.0).reshape(packed.shape[:-1] + (-1,))
+
 
 def _compress(comp):
     """sign/scale 1-bit quantization per leading chunk: comp [n, c] ->
-    (signs int8 [n, c], scales f32 [n], residual)."""
+    (packed sign bits uint8 [n, c/8], scales f32 [n], residual)."""
     scales = jnp.mean(jnp.abs(comp), axis=-1)
-    signs = jnp.where(comp >= 0, 1, -1).astype(jnp.int8)
-    deq = signs.astype(jnp.float32) * scales[..., None]
-    return signs, scales, comp - deq
+    sign_f = jnp.where(comp >= 0, 1.0, -1.0)
+    packed = _pack_signs(comp)
+    return packed, scales, comp - sign_f * scales[..., None]
 
 
 def compressed_allreduce(x, worker_error, server_error, axis_name: str):
@@ -48,46 +69,47 @@ def compressed_allreduce(x, worker_error, server_error, axis_name: str):
     n = jax.lax.psum(1, axis_name)
     orig_shape = x.shape
     flat = x.reshape(-1)
-    pad = (-flat.size) % n
+    c = error_shapes(orig_shape, n)[0][1]     # 8-aligned chunk length
+    pad = n * c - flat.size
     if pad:
         flat = jnp.pad(flat, (0, pad))
-    chunks = flat.reshape(n, -1)                              # [n, c]
+    chunks = flat.reshape(n, c)                               # [n, c]
 
-    # stage 1: worker-side compression + all-to-all
+    # stage 1: worker-side compression + all-to-all of PACKED sign bits
     comp = chunks + worker_error
-    signs, scales, new_worker_error = _compress(comp)
-    # trace-time wire accounting: the comms logger records the int8 payloads
-    # (the dense equivalent would be 4 bytes/elem both rounds)
+    packed, scales, new_worker_error = _compress(comp)
+    # trace-time wire accounting: the comms logger records the packed uint8
+    # payloads (the dense equivalent would be 4 bytes/elem both rounds)
     from ...comm.comm import _record
 
-    _record("all_to_all", signs, axis_name, log_name="compressed_allreduce")
-    # worker j receives row j of every peer: [n, c] rows ordered by source
-    recv_signs = jax.lax.all_to_all(signs, axis_name, split_axis=0,
-                                    concat_axis=0, tiled=True)
+    _record("all_to_all", packed, axis_name, log_name="compressed_allreduce")
+    # worker j receives row j of every peer: [n, c/8] rows ordered by source
+    recv_packed = jax.lax.all_to_all(packed, axis_name, split_axis=0,
+                                     concat_axis=0, tiled=True)
     recv_scales = jax.lax.all_to_all(scales, axis_name, split_axis=0,
                                      concat_axis=0, tiled=True)
     chunk_mean = jnp.mean(
-        recv_signs.astype(jnp.float32) * recv_scales[:, None], axis=0)  # [c]
+        _unpack_signs(recv_packed) * recv_scales[:, None], axis=0)  # [c]
 
-    # stage 2: server-side compression + all-gather
+    # stage 2: server-side compression + all-gather of packed bits
     comp2 = (chunk_mean + server_error)[None, :]
-    signs2, scales2, server_residual = _compress(comp2)
+    packed2, scales2, server_residual = _compress(comp2)
     new_server_error = server_residual[0]
-    _record("all_gather", signs2[0], axis_name,
+    _record("all_gather", packed2[0], axis_name,
             log_name="compressed_allreduce")
-    out_signs = jax.lax.all_gather(signs2[0], axis_name)      # [n, c] int8
-    out_scales = jax.lax.all_gather(scales2[0], axis_name)    # [n]
-    out = (out_signs.astype(jnp.float32) *
-           out_scales[:, None]).reshape(-1)
+    out_packed = jax.lax.all_gather(packed2[0], axis_name)   # [n, c/8] uint8
+    out_scales = jax.lax.all_gather(scales2[0], axis_name)   # [n]
+    out = (_unpack_signs(out_packed) * out_scales[:, None]).reshape(-1)
     size = int(np.prod(orig_shape))
     return out[:size].reshape(orig_shape), new_worker_error, new_server_error
 
 
 def error_shapes(x_shape, n: int) -> Tuple[tuple, tuple]:
     """(worker_error_shape, server_error_shape) for a tensor of x_shape
-    reduced over n workers."""
+    reduced over n workers; chunk length is 8-aligned for bit packing."""
     size = int(np.prod(x_shape))
     c = -(-size // n)
+    c = (c + 7) // 8 * 8
     return (n, c), (c,)
 
 

@@ -294,6 +294,7 @@ class DeepSpeedConfig:
         # so the backward exchanges row-sparse grads over the data axes
         self.sparse_gradients_enabled = get_scalar_param(pd, C.SPARSE_GRADIENTS,
                                                          C.SPARSE_GRADIENTS_DEFAULT)
+        self.strict = get_scalar_param(pd, C.STRICT, C.STRICT_DEFAULT)
         self.communication_data_type = get_scalar_param(
             pd, C.COMMUNICATION_DATA_TYPE, C.COMMUNICATION_DATA_TYPE_DEFAULT)
         self.disable_allgather = get_scalar_param(pd, C.DISABLE_ALLGATHER,

@@ -63,6 +63,13 @@ GRADIENT_PREDIVIDE_FACTOR_DEFAULT = 1.0
 SPARSE_GRADIENTS = "sparse_gradients"
 SPARSE_GRADIENTS_DEFAULT = False
 
+# Unsupported-combination policy (extension key, no reference analog): the
+# reference fails loudly on unsupported feature combos (e.g. 1-bit Adam
+# under ZeRO stage >= 2); strict=true mirrors that, strict=false keeps the
+# documented degraded behavior (dense exchange / ignored knob) with a warning.
+STRICT = "strict"
+STRICT_DEFAULT = True
+
 FP16 = "fp16"
 FP16_ENABLED = "enabled"
 FP16_ENABLED_DEFAULT = False

@@ -178,6 +178,13 @@ class DataAnalyzer:
         elif self.accumulate_fns:
             totals = self.reduce_totals(parts)
             for name, (_, finalize) in self.accumulate_fns.items():
+                if totals[name] is None:
+                    # every worker shard produced an empty accumulator —
+                    # surface that instead of a TypeError from finalize()
+                    raise ValueError(
+                        f"accumulate metric '{name}' has no accumulated "
+                        "totals (all map shards were empty); cannot "
+                        "finalize — check the dataset/worker ranges")
                 s2m = np.empty(n, np.float64)
                 for i in range(n):
                     s2m[i] = finalize(totals[name], self.dataset[i])

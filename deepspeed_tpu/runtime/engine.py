@@ -305,7 +305,12 @@ class DeepSpeedEngine:
             and self.topology.model_parallel_size == 1
             and self.topology.pipe_parallel_size == 1
             and self.topology.sequence_parallel_size == 1
-            and self.zero_stage == 0
+            # stage 1 composes: the exchange returns full mean grads and
+            # the partitioned optimizer update slices them per dp shard
+            # (the reference runs its 1-bit optimizers under ZeRO-1,
+            # fp16/onebit/adam.py:11); stages 2/3 shard the grads
+            # themselves and have no dense-exchange seam to compress
+            and self.zero_stage in (0, 1)
             and not self.offload_enabled
             and not self.param_stream_enabled
             # sparse_embedding_lookup's backward opens its own shard_map;
@@ -319,16 +324,33 @@ class DeepSpeedEngine:
         if self._config.optimizer_name in onebit_names and \
                 not self.onebit_comm_enabled and \
                 self.topology.data_parallel_size > 1:
-            logger.warning(
-                "1-bit optimizer: compressed gradient exchange needs a pure "
-                "dp mesh with zero_stage=0 and no offload; the exchange "
-                "stays dense (the optimizer's frozen-variance semantics "
-                "still apply)")
+            if self.zero_stage >= 2:
+                why = f"zero_optimization.stage={self.zero_stage} (needs <=1)"
+            elif self.offload_enabled or self.param_stream_enabled:
+                why = "offload/param streaming"
+            elif self._config.sparse_gradients_enabled:
+                why = ("sparse_gradients (its backward opens its own "
+                       "shard_map; nesting inside the onebit step is "
+                       "rejected by jax)")
+            else:
+                why = "a non-pure-dp mesh (tp/pp/ep/sp axes present)"
+            msg = (
+                "1-bit optimizer: the compressed gradient exchange does not "
+                f"support {why}; the exchange would silently stay dense — a "
+                "convergence-relevant behavior change vs the reference "
+                "semantics. Remove the conflicting feature, or set "
+                '"strict": false to accept the dense exchange.')
+            if self._config.strict:
+                raise ValueError(msg)
+            logger.warning(msg + " (strict=false: keeping the dense "
+                           "exchange; the optimizer's frozen-variance "
+                           "semantics still apply)")
 
         # sharded state
         self._init_rng = jax.random.PRNGKey(self._config.seed or 42)
         self._dropout_rng = jax.random.PRNGKey((self._config.seed or 42) + 1)
         self._build_state()
+        self._configure_stage3_liveness()
         self._build_step_fns()
 
         # data
@@ -510,6 +532,42 @@ class DeepSpeedEngine:
 
         if self.offload_enabled:
             self._init_offload_optimizer()
+
+    def _configure_stage3_liveness(self) -> None:
+        """Map ``stage3_prefetch_bucket_size`` / ``stage3_max_live_parameters``
+        (reference ``zero/config.py:79``, coordinator
+        ``partitioned_param_coordinator.py:239``) onto the scan granularity:
+        the model gathers ``scan_group_size`` layers per scan step, so the
+        prefetch bucket sets the gather size and the live cap bounds the
+        resident gathered weights (current + prefetched group)."""
+        if self.zero_stage != 3 or self.param_stream_enabled:
+            return
+        mc = getattr(self.model_spec, "model_config", None)
+        hooks = getattr(self.model_spec, "pipeline_hooks", None) or {}
+        key = hooks.get("blocks_key")
+        if mc is None or key is None or not getattr(mc, "scan_layers", True) \
+                or not hasattr(mc, "scan_group_size"):
+            return  # model doesn't implement grouped gathers
+        from .zero.liveness import blocks_param_count, stage3_group_size
+
+        node = self._abstract_params
+        try:
+            for k in ((key,) if isinstance(key, str) else key):
+                node = node[k]
+        except (KeyError, TypeError):
+            return
+        num_layers, per_layer = blocks_param_count(node)
+        g = stage3_group_size(self._config.zero_config, per_layer, num_layers)
+        if g > 1:
+            mc.scan_group_size = g
+            log_dist(
+                f"ZeRO-3 liveness: gathering {g} layers/scan step "
+                f"({g * per_layer / 1e6:.1f}M params/bucket, "
+                f"prefetch_bucket_size="
+                f"{self._config.zero_config.prefetch_bucket_size:.0e}, "
+                f"max_live_parameters="
+                f"{self._config.zero_config.max_live_parameters:.0e})",
+                ranks=[0])
 
     # ------------------------------------------------- ZeRO-Infinity streaming
     def _pp_blocks_path(self) -> tuple:
@@ -965,8 +1023,8 @@ class DeepSpeedEngine:
         XLA inserts the dense psum implicitly — there is no seam to
         compress.  This variant runs the whole fwd/bwd inside ``shard_map``
         over dp, so each device holds its LOCAL gas-accumulated gradient,
-        flattens it, and exchanges int8 signs + per-chunk scales
-        (~4x wire reduction) with persistent worker/server error feedback
+        flattens it, and exchanges PACKED sign bits (8/byte) + per-chunk
+        scales (~32x wire reduction) with persistent worker/server error feedback
         carried in ``state["onebit"]``.  Installed only past freeze_step;
         warmup uses the dense path (``_advance_onebit`` retraces at the
         boundary, the same pattern as compression schedule_offsets).
@@ -1144,6 +1202,10 @@ class DeepSpeedEngine:
             seq = batch["input_ids"].shape[-1]
             if batch.get("labels") is None:
                 seq -= 1
+        elif isinstance(batch, (tuple, list)) and len(batch) >= 2:
+            # (input_ids, labels): explicit labels, no shift-by-one
+            # (models/gpt2.py loss convention)
+            seq = jax.tree_util.tree_leaves(batch[0])[0].shape[-1]
         else:
             seq = jax.tree_util.tree_leaves(batch)[0].shape[-1] - 1
         keep = self.random_ltd_scheduler.get_keep_count(
@@ -1212,7 +1274,8 @@ class DeepSpeedEngine:
             log_dist(
                 f"1-bit: freeze_step {self._onebit_freeze} reached — "
                 "gradient exchange switches to the compressed all-reduce "
-                "(int8 signs + per-chunk scales, ~4x wire reduction)",
+                "(packed sign bits + per-chunk scales, ~32x wire "
+                "reduction)",
                 ranks=[0])
             self._build_step_fns()
 

@@ -1,0 +1,78 @@
+"""ZeRO-3 gather granularity: the prefetch/liveness knobs.
+
+Reference semantics: ``stage3_prefetch_bucket_size`` sets how many params the
+coordinator all-gathers ahead of use and ``stage3_max_live_parameters`` caps
+how many gathered params may be resident at once
+(``zero/partitioned_param_coordinator.py:239 fetch_sub_module``,
+``zero/config.py:79``).  Under jit there is no eager coordinator — the layer
+stack is consumed by ``lax.scan`` and XLA gathers each step's slice one step
+ahead.  The same trade therefore lives in the SCAN GRANULARITY: scanning
+groups of ``G`` layers makes XLA gather ``G`` layers per step (bigger, more
+efficient collectives, more compute to overlap the next prefetch against) at
+the cost of up to ``2 * G`` layers of gathered weights resident (current
+group + prefetched next).  ``stage3_group_size`` maps the two reference
+knobs onto ``G``.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def stage3_group_size(zero_config, layer_param_count: int,
+                      num_layers: int) -> int:
+    """Largest ``G`` dividing ``num_layers`` with
+    ``G * layer_param_count <= prefetch_bucket_size`` (elements, like the
+    reference's counts) and ``2 * G * layer_param_count <=
+    max_live_parameters``."""
+    if layer_param_count <= 0 or num_layers <= 0:
+        return 1
+    g_pref = max(1, int(zero_config.prefetch_bucket_size) // layer_param_count)
+    g_live = max(1, int(zero_config.max_live_parameters) //
+                 (2 * layer_param_count))
+    g = max(1, min(g_pref, g_live, num_layers))
+    while num_layers % g:
+        g -= 1
+    return g
+
+
+def scan_layers_grouped(step, carry, blocks, group_size: int = 1):
+    """``lax.scan`` over ``[L, ...]``-stacked blocks, ``group_size`` layers
+    per scan step.  ``step(carry, layer_tree) -> carry``.  With
+    ``group_size=1`` this is a plain scan; otherwise each leaf is reshaped
+    to ``[L/G, G, ...]`` and the inner G layers run unrolled inside one
+    step, so sharded (ZeRO-3) weights are all-gathered G layers at a time.
+    """
+    leaves = jax.tree_util.tree_leaves(blocks)
+    if not leaves:
+        return carry
+    num_layers = leaves[0].shape[0]
+    g = int(group_size)
+    if g <= 1 or num_layers % g:
+        def body(c, layer):
+            return step(c, layer), None
+        carry, _ = jax.lax.scan(body, carry, blocks)
+        return carry
+
+    grouped = jax.tree_util.tree_map(
+        lambda p: p.reshape((num_layers // g, g) + p.shape[1:]), blocks)
+
+    def gbody(c, grp):
+        for i in range(g):
+            c = step(c, jax.tree_util.tree_map(lambda p: p[i], grp))
+        return c, None
+
+    carry, _ = jax.lax.scan(gbody, carry, grouped)
+    return carry
+
+
+def blocks_param_count(abstract_blocks) -> tuple:
+    """(num_layers, per-layer element count) of a stacked blocks subtree."""
+    leaves = jax.tree_util.tree_leaves(abstract_blocks)
+    if not leaves or leaves[0].ndim < 1:
+        return 0, 0
+    num_layers = leaves[0].shape[0]
+    per_layer = sum(int(np.prod(x.shape[1:])) for x in leaves
+                    if x.ndim >= 1 and x.shape[0] == num_layers)
+    return num_layers, per_layer

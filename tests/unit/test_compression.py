@@ -311,6 +311,25 @@ def test_activation_quantization_behavioral():
     assert abs(l100 - dense) > 1e-4
 
 
+def test_activation_quantization_without_knob_is_strict():
+    """A model with no act_quant_bits hook: strict (default) raises instead
+    of silently ignoring the setting; "strict": false keeps the old
+    warn-and-ignore behavior."""
+    from deepspeed_tpu.compression import init_compression
+
+    class Bare:  # no model_config / act_quant_bits
+        loss_fn = staticmethod(lambda *a: 0.0)
+
+    aq_cfg = {"compression_training": {
+        "activation_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"aq": {"params": {"target_bits": 4}}}}}}
+    with pytest.raises(ValueError, match="act_quant_bits"):
+        init_compression(Bare(), aq_cfg)
+    out = init_compression(Bare(), {**aq_cfg, "strict": False})
+    assert out is not None  # proceeds, ignoring the knob
+
+
 def test_distillation_loss_and_wrapper():
     from deepspeed_tpu.compression import (distillation_loss,
                                            init_distillation,

@@ -24,13 +24,18 @@ for _ in range(10):
     CASES.append((2, h_kv * rep, h_kv, s, d, causal))
 
 
-@pytest.mark.parametrize("kernel_ver", ["v2", "v1"])
+@pytest.mark.parametrize("kernel_ver", ["v2", "v1", "v3"])
 @pytest.mark.parametrize("b,h,hkv,s,d,causal", CASES)
 def test_fuzz_matches_reference(b, h, hkv, s, d, causal, kernel_ver,
                                 monkeypatch):
-    # pin BOTH branches: an ambient DS_FLASH_V2 from a debugging shell
+    # pin ALL branches: an ambient DS_FLASH_V2/V3 from a debugging shell
     # must not silently collapse the matrix onto one path
-    monkeypatch.setenv("DS_FLASH_V2", "0" if kernel_ver == "v1" else "1")
+    monkeypatch.setenv("DS_FLASH_V2", "1" if kernel_ver == "v2" else "0")
+    monkeypatch.setenv("DS_FLASH_V3", "1" if kernel_ver == "v3" else "0")
+    if kernel_ver == "v3":
+        # the long-sequence path: force it down to fuzz-sized shapes so the
+        # chunked-grid + compact-lse logic runs with several KV chunks
+        monkeypatch.setenv("DS_FLASH_V3_MIN_KV", "1")
     ks = jax.random.split(jax.random.PRNGKey(hash((b, h, s, d)) % 2**31), 3)
     q = jax.random.normal(ks[0], (b, h, s, d))
     k = jax.random.normal(ks[1], (b, hkv, s, d))

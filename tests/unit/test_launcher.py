@@ -144,3 +144,68 @@ def test_build_env():
     assert env["JAX_NUM_PROCESSES"] == "2"
     assert env["JAX_PROCESS_ID"] == "1"
     assert env["WORLD_SIZE"] == "4"
+
+
+# --------------------------------------------------------------------------
+# elastic training through the CLI (reference launcher/launch.py:257-310:
+# --enable_elastic_training starts the elastic agent)
+# --------------------------------------------------------------------------
+def test_elastic_flag_requires_config(tmp_path):
+    from deepspeed_tpu.launcher import runner
+
+    hf = tmp_path / "hostfile"
+    hf.write_text("a slots=1\nb slots=1\n")
+    with pytest.raises(ValueError, match="elastic_config"):
+        runner.main(["--hostfile", str(hf), "--enable_elastic_training",
+                     "--launcher", "local", "train.py"])
+
+
+def test_elastic_cli_restarts_dead_worker(tmp_path):
+    """CLI path end to end: a worker dies mid-run, the agent re-elects and
+    restarts the group; workers of the second generation (keyed off the
+    agent-injected DS_ELASTIC_RESTART_COUNT) finish cleanly."""
+    import sys as _sys
+
+    from deepspeed_tpu.launcher import runner
+
+    hf = tmp_path / "hostfile"
+    hf.write_text("hostA slots=1\nhostB slots=1\n")
+    cfg = tmp_path / "ds.json"
+    cfg.write_text(json.dumps({
+        "elasticity": {"enabled": True, "max_train_batch_size": 8,
+                       "micro_batch_sizes": [1, 2], "min_gpus": 1,
+                       "max_gpus": 8, "min_time": 0, "version": 0.2},
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+    }))
+    log = tmp_path / "gens.jsonl"
+    script = tmp_path / "worker.py"
+    # generation 0: rank 1 crashes mid-run (the "killed worker"), rank 0
+    # idles so only the agent's restart can reap it; generation 1+ exits 0
+    script.write_text(f"""
+import json, os, sys, time
+with open({str(log)!r}, "a") as f:
+    json.dump({{"gen": os.environ["DS_ELASTIC_RESTART_COUNT"],
+               "n": os.environ["JAX_NUM_PROCESSES"],
+               "rank": os.environ["JAX_PROCESS_ID"]}}, f)
+    f.write("\\n")
+if os.environ["DS_ELASTIC_RESTART_COUNT"] == "0":
+    if os.environ["JAX_PROCESS_ID"] == "1":
+        time.sleep(0.3)
+        sys.exit(1)
+    time.sleep(120)
+""")
+    code = None
+    try:
+        runner.main(["--hostfile", str(hf), "--enable_elastic_training",
+                     "--elastic_config", str(cfg),
+                     "--elastic_monitor_interval", "0.2",
+                     "--launcher", "local", str(script)])
+    except SystemExit as e:
+        code = e.code
+    assert code == 0
+    gens = [json.loads(l) for l in log.read_text().splitlines()]
+    g0 = [g for g in gens if g["gen"] == "0"]
+    g1 = [g for g in gens if g["gen"] != "0"]
+    assert len(g0) == 2 and len(g1) >= 2, gens
+    assert {g["n"] for g in gens} == {"2"}  # both hosts elected each time

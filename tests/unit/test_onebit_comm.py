@@ -70,7 +70,9 @@ def test_convergence_parity_vs_dense():
 
 def test_wire_bytes_drop_in_comms_logger():
     """The comms logger's trace-time records must show the compressed
-    exchange shipping ~1/4 the dense bytes."""
+    exchange shipping ~1/32 the dense bytes: signs travel packed 8/byte
+    (reference ``compress_by_chunk``/``unpackbits``,
+    ``runtime/comm/nccl.py:78-85``)."""
     cfg, engine = _engine(freeze_step=1)
     total = sum(x.size for x in
                 jax.tree_util.tree_leaves(engine.state["params"]))
@@ -85,11 +87,12 @@ def test_wire_bytes_drop_in_comms_logger():
         comp = {name: recs[name] for name in recs
                 if "compressed_allreduce" in name}
         assert comp, f"no compressed records in {list(recs)}"
-        # per-device payload per exchange round: [n, c] int8 (~1 byte/param)
-        # vs the 4-byte dense words a fp32 all-reduce would ship
+        # per-device payload per exchange round: [n, c/8] packed uint8
+        # (~1/8 byte/param) vs the 4-byte dense words fp32 would ship
         byte_counts = [sz for by_size in comp.values() for sz in by_size]
-        assert max(byte_counts) <= total * 1.1
-        assert max(byte_counts) < total * 4  # strictly below dense volume
+        dense = total * 4
+        assert max(byte_counts) <= total / 8 * 1.2  # bit-packed payload
+        assert max(byte_counts) < dense / 24        # >24x below dense
     finally:
         logger.enabled = False
         logger.prof_all = False
@@ -105,7 +108,21 @@ def test_multi_step_dispatch_after_freeze():
     assert np.isfinite(float(m["loss"]))
 
 
-def test_gated_off_with_zero_stage():
+def test_unsupported_combo_raises_by_default():
+    """Strict mode (default): OneBitAdam + ZeRO>=2 fails loudly, like the
+    reference's stage checks, instead of silently going dense."""
+    deepspeed_tpu.comm.reset_topology()
+    model = gpt2.build(gpt2.GPT2Config.tiny())
+    with pytest.raises(ValueError, match="compressed gradient exchange"):
+        deepspeed_tpu.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "OneBitAdam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+        })
+
+
+def test_gated_off_with_zero_stage2():
     deepspeed_tpu.comm.reset_topology()
     cfg = gpt2.GPT2Config.tiny()
     model = gpt2.build(cfg)
@@ -113,7 +130,8 @@ def test_gated_off_with_zero_stage():
         "train_micro_batch_size_per_gpu": 2,
         "gradient_accumulation_steps": 1,
         "optimizer": {"type": "OneBitAdam", "params": {"lr": 1e-3}},
-        "zero_optimization": {"stage": 1},
+        "zero_optimization": {"stage": 2},
+        "strict": False,  # documented opt-in to the dense exchange
     })
     assert not engine.onebit_comm_enabled
     b = {"input_ids": np.random.default_rng(0).integers(
@@ -121,6 +139,44 @@ def test_gated_off_with_zero_stage():
         size=(engine.train_batch_size(), 17)).astype(np.int32)}
     _, m = engine.train_batch(b)  # dense path still trains
     assert np.isfinite(float(m["loss"]))
+
+
+def _zero1_engine(freeze_step):
+    deepspeed_tpu.comm.reset_topology()
+    cfg = gpt2.GPT2Config.tiny()
+    model = gpt2.build(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "OneBitAdam",
+                      "params": {"lr": 1e-3, "freeze_step": freeze_step}},
+        "zero_optimization": {"stage": 1},
+    })
+    return cfg, engine
+
+
+def test_zero1_compressed_parity_vs_dense():
+    """The compressed exchange composes with ZeRO-1 (the reference runs its
+    1-bit optimizers under stage 1, ``fp16/onebit/adam.py:11``): optimizer
+    state stays dp-partitioned while the gradient exchange ships packed
+    sign bits, and the loss tracks the dense stage-1 run."""
+    cfg, e1 = _zero1_engine(freeze_step=3)
+    assert e1.onebit_comm_enabled
+    batches = _batches(cfg, e1, 1) * 12
+    lc = [float(e1.train_batch(b)[1]["loss"]) for b in batches]
+    assert e1._onebit_compressed
+
+    # optimizer state really is partitioned over dp under the onebit step
+    opt_shardings = jax.tree_util.tree_leaves(e1.state_shardings["opt_state"])
+    assert any("dp" in str(getattr(s, "spec", "")) for s in opt_shardings)
+
+    cfg2, e2 = _zero1_engine(freeze_step=10_000)  # dense stage-1 baseline
+    ld = [float(e2.train_batch(b)[1]["loss"]) for b in batches]
+
+    np.testing.assert_allclose(lc[:3], ld[:3], rtol=1e-5)  # warmup identical
+    assert abs(lc[-1] - ld[-1]) < 0.35 * abs(ld[0] - ld[-1]) + 0.02, (lc, ld)
+    assert lc[-1] < lc[0]
+    assert lc[-1] < lc[3]
 
 
 def test_fp16_overflow_rolls_back_error_feedback():
@@ -155,18 +211,28 @@ def test_fp16_overflow_rolls_back_error_feedback():
 
 def test_sparse_gradients_excludes_compressed_mode():
     """sparse_embedding_lookup opens its own shard_map; nesting inside the
-    onebit step is rejected by jax, so the engine must keep the dense
-    exchange when both are configured."""
+    onebit step is rejected by jax.  Strict mode raises; with
+    ``"strict": false`` the engine keeps the dense exchange."""
     deepspeed_tpu.comm.reset_topology()
     cfg = gpt2.GPT2Config.tiny()
     cfg.tie_embeddings = False
     model = gpt2.build(cfg)
+    with pytest.raises(ValueError, match="sparse_gradients"):
+        deepspeed_tpu.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "OneBitAdam",
+                          "params": {"lr": 1e-3, "freeze_step": 1}},
+            "sparse_gradients": True,
+        })
+    deepspeed_tpu.comm.reset_topology()
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
         "train_micro_batch_size_per_gpu": 2,
         "gradient_accumulation_steps": 1,
         "optimizer": {"type": "OneBitAdam",
                       "params": {"lr": 1e-3, "freeze_step": 1}},
         "sparse_gradients": True,
+        "strict": False,
     })
     assert not engine.onebit_comm_enabled
     rng = np.random.default_rng(0)

@@ -71,3 +71,28 @@ def test_int8_inference_close_to_fp():
     logits_fp = np.asarray(e_fp({"input_ids": ids}))
     logits_q = np.asarray(e_q({"input_ids": ids}))
     assert np.abs(logits_fp - logits_q).max() < 0.15
+
+
+def test_int8_inference_opt_quant_aware():
+    """OPT is quant_aware: INT8 weights dequantize per layer at point of use
+    (the path the OPT-6.7B single-chip serving config needs — a whole-tree
+    dequant would double peak memory)."""
+    from deepspeed_tpu.models import opt
+
+    deepspeed_tpu.comm.reset_topology()
+    cfg = opt.OPTConfig.tiny(vocab_size=512)
+    model = opt.build(cfg)
+    assert model.quant_aware
+    params = opt.init_params(cfg, jax.random.PRNGKey(0))
+    e_fp = deepspeed_tpu.init_inference(
+        model, config={"dtype": "float32"}, params=params)
+    deepspeed_tpu.comm.reset_topology()
+    e_q = deepspeed_tpu.init_inference(
+        model, config={"dtype": "float32",
+                       "quant": {"enabled": True, "group_size": 16}},
+        params=params)
+    ids = np.random.default_rng(2).integers(0, 512, (2, 8)).astype(np.int32)
+    out_fp = e_fp.generate(ids, max_new_tokens=8)
+    out_q = e_q.generate(ids, max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(out_fp)[:, :11],
+                                  np.asarray(out_q)[:, :11])
