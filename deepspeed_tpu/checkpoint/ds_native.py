@@ -127,6 +127,10 @@ class DeepSpeedNativeCheckpoint:
 
     # ------------------------------------------------------------- raw reads
     def model_state(self, tp_rank: int = 0) -> Dict[str, Any]:
+        if not self.model_files:
+            raise ValueError(
+                "pipeline-staged checkpoint (layer_* files, no mp_rank "
+                "model states): use pipeline_module_state_dict()")
         if self._model_states[tp_rank] is None:
             self._model_states[tp_rank] = _torch_load(
                 os.path.join(self.dir, self.model_files[tp_rank]))

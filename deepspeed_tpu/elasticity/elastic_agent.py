@@ -28,7 +28,7 @@ import signal
 import subprocess
 import sys
 import time
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Mapping, Optional, Sequence
 
 from ..utils.logging import logger
 from .elasticity import compute_elastic_config
@@ -38,8 +38,14 @@ class ElasticAgent:
     """Process-group supervisor with elastic world-size recomputation.
 
     ``launch_cmd(host, env) -> list[str]`` builds the per-host command
-    (ssh wrapper or local python); ``probe_hosts() -> list[str]`` returns
-    the currently-available hosts each round.
+    (ssh wrapper or local python); ``probe_hosts()`` returns the
+    currently-available hosts each round — either a ``list[str]`` (host
+    names; ``chips_per_host`` stays as constructed) or a
+    ``dict[str, int]`` of host -> chip count, in which case the agent
+    re-derives ``chips_per_host = min(counts)`` at every probe (the SPMD
+    world needs a uniform per-host chip count, so a heterogeneous pool
+    runs at its smallest member) and treats a capacity change like a
+    membership change.
     """
 
     def __init__(self, ds_config: dict,
@@ -64,6 +70,16 @@ class ElasticAgent:
         self.restart_count = 0
         self._procs: Dict[str, subprocess.Popen] = {}
         self._hosts: List[str] = []
+        self._chips_running = chips_per_host  # capacity of the live group
+
+    def _probe(self) -> List[str]:
+        """Probe hosts; a dict result also refreshes ``chips_per_host``."""
+        res = self.probe_hosts()
+        if isinstance(res, Mapping):
+            if res:
+                self.chips_per_host = max(1, min(res.values()))
+            return list(res)
+        return list(res)
 
     # ------------------------------------------------------------------ sizing
     def elect_world(self, hosts: Sequence[str],
@@ -96,7 +112,7 @@ class ElasticAgent:
         attempts = 0
         while True:
             try:
-                return self.elect_world(self.probe_hosts())
+                return self.elect_world(self._probe())
             except RuntimeError as e:
                 attempts += 1
                 if self.restart_count + attempts > self.max_restarts:
@@ -120,6 +136,7 @@ class ElasticAgent:
 
     def _start_group(self, hosts: List[str]) -> None:
         self._hosts = hosts
+        self._chips_running = self.chips_per_host
         self._procs = {}
         for rank, host in enumerate(hosts):
             env = self._env_for(host, rank, hosts)
@@ -157,7 +174,7 @@ class ElasticAgent:
     def run(self) -> int:
         """Supervise until success or restart budget exhaustion (the
         reference's ``_invoke_run`` loop)."""
-        self._start_group(self.elect_world(self.probe_hosts()))
+        self._start_group(self.elect_world(self._probe()))
         partial_ticks = 0
         while True:
             time.sleep(self.monitor_interval)
@@ -174,18 +191,24 @@ class ElasticAgent:
             membership = None
             if state == "HEALTHY":
                 try:
-                    membership = self.elect_world(self.probe_hosts(),
+                    membership = self.elect_world(self._probe(),
                                                   verbose=False)
                 except RuntimeError:
-                    membership = self._hosts  # keep running with who we have
+                    # keep running with who we have, at the running capacity
+                    self.chips_per_host = self._chips_running
+                    membership = self._hosts
                 # Order-insensitive: a probe returning the same host SET in
                 # a different order is not a capacity change (elected order
                 # is still used for rank assignment on a real restart).
-                if sorted(membership) == sorted(self._hosts):
+                # A per-host chip-count change IS one (hostfile slots
+                # edited), even with an identical host set.
+                if sorted(membership) == sorted(self._hosts) and \
+                        self.chips_per_host == self._chips_running:
                     continue
                 logger.warning(
-                    f"elastic: membership change {len(self._hosts)} -> "
-                    f"{len(membership)} hosts; restarting group")
+                    f"elastic: membership change {len(self._hosts)} hosts x "
+                    f"{self._chips_running} chips -> {len(membership)} x "
+                    f"{self.chips_per_host}; restarting group")
             else:
                 logger.warning(
                     f"elastic: worker group {state}; restarting")

@@ -39,6 +39,13 @@ class InferenceEngine:
         assert model.apply_fn is not None, "ModelSpec.apply_fn required for inference"
         self.module = model
         self._config = config
+        # Engines own trace-time model-config state (same contract as the
+        # training engine's remat/liveness wiring): serving always scans one
+        # layer per step — clear a ZeRO-3 G left by a training engine that
+        # shared this model object.
+        mc = getattr(model, "model_config", None)
+        if mc is not None and hasattr(mc, "scan_group_size"):
+            mc.scan_group_size = 1
 
         tp = config.tensor_parallel.tp_size if config.tensor_parallel.enabled else 1
         dist.init_distributed()
@@ -72,9 +79,18 @@ class InferenceEngine:
         if self._quantized:
             from ..ops import quantization as quant
 
-            params = quant.quantize_pytree(
-                params, num_bits=config.quant.num_bits,
-                group_size=config.quant.group_size)
+            # Quantize on the HOST: jnp ops on uncommitted (numpy) inputs
+            # follow default_device, so stacked multi-billion-param leaves
+            # never materialize an f32 copy in HBM (OPT-6.7B's stacked fc_w
+            # alone is 8.6GB f32 — quantizing on-device OOMed a 16GB chip).
+            # Only the int8 payload + scales reach the device, via the
+            # sharded device_put below.
+            params = jax.device_get(params)
+            with jax.default_device(jax.local_devices(backend="cpu")[0]):
+                params = quant.quantize_pytree(
+                    params, num_bits=config.quant.num_bits,
+                    group_size=config.quant.group_size)
+            params = jax.device_get(params)
             shardings = jax.tree_util.tree_map(
                 lambda x, s: ({k: (s if k == "q" else rep) for k in x}
                               if quant.is_quantized(x) else s),

@@ -332,14 +332,15 @@ def _elastic_main(args) -> int:
     local_names = {"localhost", "127.0.0.1", socket.gethostname()}
 
     def probe_hosts():
+        # host -> slots dict: the agent re-derives chips_per_host each
+        # probe, so hostfile slot edits (slice resize) take effect at the
+        # next election, not just the initial one
         pool = fetch_hostfile(args.hostfile)
         if not pool:
-            return [socket.gethostname()]
-        return list(parse_resource_filter(pool, args.include,
-                                          args.exclude).keys())
-
-    pool0 = fetch_hostfile(args.hostfile)
-    chips = min(pool0.values()) if pool0 else 1
+            return {socket.gethostname(): 1}
+        return {host: len(slots) for host, slots in
+                parse_resource_filter(pool, args.include,
+                                      args.exclude).items()}
 
     def launch_cmd(host, env):
         inner = [sys.executable, "-u", args.user_script] + list(args.user_args)
@@ -357,7 +358,7 @@ def _elastic_main(args) -> int:
         return ["ssh", host, remote]
 
     agent = ElasticAgent(
-        ds_config, probe_hosts, launch_cmd, chips_per_host=chips,
+        ds_config, probe_hosts, launch_cmd,
         master_port=args.master_port,
         monitor_interval=args.elastic_monitor_interval,
         max_restarts=args.elastic_max_restarts)

@@ -398,7 +398,7 @@ _zz_ring_attn.defvjp(_zz_ring_attn_fwd, _zz_ring_attn_bwd)
 
 def zigzag_order(s_len: int, sp: int):
     """Permutation placing half-block pair (i, 2*sp-1-i) on device i, and its
-    inverse.  ``s_len`` must divide 2*sp."""
+    inverse.  ``2*sp`` must divide ``s_len``."""
     import numpy as np
 
     c2 = s_len // (2 * sp)

@@ -106,7 +106,14 @@ def compressed_allreduce(x, worker_error, server_error, axis_name: str):
 
 def error_shapes(x_shape, n: int) -> Tuple[tuple, tuple]:
     """(worker_error_shape, server_error_shape) for a tensor of x_shape
-    reduced over n workers; chunk length is 8-aligned for bit packing."""
+    reduced over n workers; chunk length is 8-aligned for bit packing.
+
+    Format note: the 8-alignment (introduced with the packed wire format)
+    changed these shapes wherever ``ceil(size/n) % 8 != 0`` — 1-bit
+    checkpoints written by the earlier int8-sign build store unpadded
+    error buffers and cannot resume against the new shapes (no released
+    version ever shipped the old layout, so no pad-on-load migration is
+    provided)."""
     size = int(np.prod(x_shape))
     c = -(-size // n)
     c = (c + 7) // 8 * 8

@@ -540,9 +540,11 @@ class DeepSpeedEngine:
         the model gathers ``scan_group_size`` layers per scan step, so the
         prefetch bucket sets the gather size and the live cap bounds the
         resident gathered weights (current + prefetched group)."""
+        mc = getattr(self.model_spec, "model_config", None)
+        if mc is not None and hasattr(mc, "scan_group_size"):
+            mc.scan_group_size = 1  # clear a stale G from a reused config
         if self.zero_stage != 3 or self.param_stream_enabled:
             return
-        mc = getattr(self.model_spec, "model_config", None)
         hooks = getattr(self.model_spec, "pipeline_hooks", None) or {}
         key = hooks.get("blocks_key")
         if mc is None or key is None or not getattr(mc, "scan_layers", True) \
@@ -558,8 +560,8 @@ class DeepSpeedEngine:
             return
         num_layers, per_layer = blocks_param_count(node)
         g = stage3_group_size(self._config.zero_config, per_layer, num_layers)
+        mc.scan_group_size = g
         if g > 1:
-            mc.scan_group_size = g
             log_dist(
                 f"ZeRO-3 liveness: gathering {g} layers/scan step "
                 f"({g * per_layer / 1e6:.1f}M params/bucket, "

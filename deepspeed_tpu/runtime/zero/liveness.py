@@ -12,6 +12,13 @@ efficient collectives, more compute to overlap the next prefetch against) at
 the cost of up to ``2 * G`` layers of gathered weights resident (current
 group + prefetched next).  ``stage3_group_size`` maps the two reference
 knobs onto ``G``.
+
+Contract: ``scan_group_size`` on a model config is TRACE-TIME state owned by
+whichever engine was constructed from the model most recently — every engine
+init site sets it (the training engine to its computed ``G``, non-ZeRO-3 and
+inference engines to 1).  Two concurrently-live engines sharing one model
+object would fight over it; that sharing is unsupported (as for the other
+engine-applied model-config knobs, e.g. remat selection).
 """
 
 from __future__ import annotations

@@ -89,3 +89,31 @@ def test_membership_change_triggers_restart(tmp_path):
     worlds = [json.loads(l)["n"] for l in log.read_text().splitlines()]
     assert worlds.count("4") == 4 and worlds.count("2") == 2, worlds
     assert agent.restart_count >= 1
+
+
+def test_slot_count_change_triggers_restart(tmp_path):
+    """Dict probe: hostfile slot edits must take effect at the next election
+    — chips_per_host is re-derived per probe, and a capacity change with an
+    IDENTICAL host set restarts the group with the new WORLD_SIZE.  Same
+    load-independence construction as the membership-change test."""
+    log = tmp_path / "worlds.jsonl"
+
+    def probe():
+        lines = log.read_text().splitlines() if log.exists() else []
+        if len(lines) < 2:
+            return {"a": 1, "b": 1}
+        return {"a": 4, "b": 4}   # slice grew: 4 chips/host now
+
+    prog = ("import os,time,json;"
+            f"f=open({str(log)!r},'a');"
+            "json.dump({'ws': os.environ['WORLD_SIZE']}, f);"
+            "f.write('\\n');f.close();"
+            "time.sleep(120.0) if os.environ['DS_ELASTIC_RESTART_COUNT'] "
+            "== '0' else None")
+    agent = _agent(probe, lambda host, env: [sys.executable, "-c", prog],
+                   monitor_interval=2.0)
+    assert agent.run() == 0
+    worlds = [json.loads(l)["ws"] for l in log.read_text().splitlines()]
+    # first group: 2 hosts x 1 chip = WS 2; second: 2 hosts x 4 = WS 8
+    assert worlds.count("2") == 2 and worlds.count("8") == 2, worlds
+    assert agent.restart_count >= 1

@@ -127,3 +127,33 @@ def test_stage3_grouped_scan_loss_parity():
     l2 = [float(e2.train_batch(batch_of(cfg2, e2))[1]["loss"])
           for _ in range(3)]
     np.testing.assert_allclose(l1, l2, rtol=2e-4)
+
+
+def test_stage3_group_size_cleared_on_reused_model_config():
+    """A model (config) object reused across engines must not inherit the
+    previous engine's G: defaults set G=num_layers on a tiny model, and a
+    second engine built from the SAME model with stage 0 (liveness knobs
+    not applicable) must trace with G=1."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config.tiny()
+    model = gpt2.build(cfg)
+
+    deepspeed_tpu.comm.reset_topology()
+    deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3,
+                              "stage3_param_persistence_threshold": 0},
+    })
+    assert cfg.scan_group_size == cfg.num_layers
+
+    deepspeed_tpu.comm.reset_topology()
+    deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+    })
+    assert cfg.scan_group_size == 1
