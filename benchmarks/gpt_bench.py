@@ -39,6 +39,9 @@ def main():
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--int8", action="store_true",
                     help="INT8 weight-only storage (quant.enabled)")
+    ap.add_argument("--w8a8", action="store_true",
+                    help="INT8 weights + in-kernel activation quant on the "
+                         "s8 MXU (quant.type=w8a8; implies --int8)")
     ap.add_argument("--host-init", action="store_true",
                     help="initialize params on host CPU (required for "
                          "multi-billion models: on-device init materializes "
@@ -64,7 +67,8 @@ def main():
         model=model, params=params,
         config={"dtype": args.dtype,
                 "tensor_parallel": {"tp_size": args.tp},
-                "quant": {"enabled": args.int8}})
+                "quant": {"enabled": args.int8 or args.w8a8,
+                          "type": "w8a8" if args.w8a8 else "weight"}})
 
     rng = np.random.default_rng(0)
     vocab = 1000  # prompt token range; any real vocab exceeds this

@@ -73,11 +73,14 @@ class ElasticAgent:
         self._chips_running = chips_per_host  # capacity of the live group
 
     def _probe(self) -> List[str]:
-        """Probe hosts; a dict result also refreshes ``chips_per_host``."""
+        """Probe hosts; a dict result also refreshes ``chips_per_host``.
+        Hosts reporting 0 chips are excluded (a ``slots=0`` hostfile line
+        behaves like an excluded host, not a 1-chip one)."""
         res = self.probe_hosts()
         if isinstance(res, Mapping):
+            res = {h: c for h, c in res.items() if c > 0}
             if res:
-                self.chips_per_host = max(1, min(res.values()))
+                self.chips_per_host = min(res.values())
             return list(res)
         return list(res)
 

@@ -33,8 +33,17 @@ class DeepSpeedMoEConfig(DeepSpeedConfigModel):
 
 class QuantizationConfig(DeepSpeedConfigModel):
     enabled: bool = False
-    group_size: int = 64
+    # 128 = the TPU lane width: groups at lane multiples let the fused
+    # dequant-matmul kernel (ops/quantized_matmul) serve the weights
+    # straight from int8; other sizes (the reference GroupQuantizer
+    # default is 64) are honored via the dequant+matmul path
+    group_size: int = 128
     num_bits: int = 8
+    # "weight": int8 weight-only storage, bf16 math (default).
+    # "w8a8": K-grouped weights + in-kernel activation quantization on the
+    # s8 MXU for decode (reference analog: MoQ weight+activation INT8);
+    # requires a quant-aware model with stacked blocks.
+    type: str = "weight"
 
 
 class InferenceCheckpointConfig(DeepSpeedConfigModel):

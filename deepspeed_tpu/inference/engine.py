@@ -78,6 +78,19 @@ class InferenceEngine:
         self._quantized = config.quant.enabled
         if self._quantized:
             from ..ops import quantization as quant
+            from ..ops import quantized_matmul as qmm
+
+            # fused kernels only on an unsharded weight path: under tp > 1
+            # the weights are GSPMD-sharded and pallas_calls are opaque to
+            # the partitioner, so EVERY quantized matmul (incl. w8a8's
+            # s8-MXU decode) degrades to dequantize+matmul — loudly.
+            qmm.configure(kernel_ok=(tp <= 1))
+            if tp > 1 and config.quant.type == "w8a8":
+                log_dist(
+                    "quant: w8a8 under tensor parallelism falls back to "
+                    "the dequantize+matmul path (the s8-MXU kernel cannot "
+                    "run on GSPMD-sharded weights); expect weight-only "
+                    "int8 speed, not the w8a8 decode numbers", ranks=[0])
 
             # Quantize on the HOST: jnp ops on uncommitted (numpy) inputs
             # follow default_device, so stacked multi-billion-param leaves
@@ -85,16 +98,53 @@ class InferenceEngine:
             # alone is 8.6GB f32 — quantizing on-device OOMed a 16GB chip).
             # Only the int8 payload + scales reach the device, via the
             # sharded device_put below.
+            #
+            # Quant-aware models quantize the TRANSFORMER BLOCKS only (the
+            # reference GroupQuantizer likewise targets layer weights, not
+            # embeddings): a quantized embedding would be re-dequantized by
+            # every decode step inside the token loop — measured ~6ms/token
+            # on OPT-1.3B — for a memory saving that is <5% of the model.
             params = jax.device_get(params)
+            hooks = getattr(model, "pipeline_hooks", None) or {}
+            bkey = hooks.get("blocks_key") if model.quant_aware else None
+            w8a8 = config.quant.type == "w8a8"
+            if w8a8 and bkey is None:
+                raise ValueError(
+                    f"quant.type 'w8a8' needs a quant-aware model with "
+                    f"stacked blocks; {model.name} is not — use the "
+                    f"default weight-only type")
+            # blocks subtrees are STACKED [L, ...]: min_ndim=3 keeps
+            # per-layer 1D params (e.g. [L, 3d] qkv_b) dense — at L >= 64
+            # they'd otherwise pass the weight-matrix shape tests
+            if w8a8:
+                kg = max(128, int(config.quant.group_size))
+
+                def _quantize(tree, min_ndim):
+                    return quant.quantize_pytree_k_grouped(
+                        tree, k_group=kg, min_ndim=min_ndim)
+            else:
+                def _quantize(tree, min_ndim):
+                    return quant.quantize_pytree(
+                        tree, num_bits=config.quant.num_bits,
+                        group_size=config.quant.group_size,
+                        min_ndim=min_ndim)
             with jax.default_device(jax.local_devices(backend="cpu")[0]):
-                params = quant.quantize_pytree(
-                    params, num_bits=config.quant.num_bits,
-                    group_size=config.quant.group_size)
+                if bkey is not None:
+                    path = (bkey,) if isinstance(bkey, str) else tuple(bkey)
+                    node = params
+                    for k in path[:-1]:
+                        node = node[k]
+                    node[path[-1]] = _quantize(node[path[-1]], min_ndim=3)
+                else:
+                    params = _quantize(params, min_ndim=2)
             params = jax.device_get(params)
+            def _is_rec(x):
+                return quant.is_quantized(x) or quant.is_k_quantized(x)
+
             shardings = jax.tree_util.tree_map(
-                lambda x, s: ({k: (s if k == "q" else rep) for k in x}
-                              if quant.is_quantized(x) else s),
-                params, shardings, is_leaf=quant.is_quantized)
+                lambda x, s: ({k: (s if k in ("q", "qk") else rep)
+                               for k in x} if _is_rec(x) else s),
+                params, shardings, is_leaf=_is_rec)
             if model.quant_aware:
                 self._prepare = lambda p: p
             else:

@@ -188,6 +188,25 @@ def _maybe_dequant(layer, dtype):
         layer, is_leaf=quant.is_quantized)
 
 
+def _qmm(x, leaf, dtype=None):
+    """``x @ leaf`` where ``leaf`` may be an int8 record: K-grouped (W8A8)
+    records run the s8-MXU kernel, N-grouped weight-only records run the
+    dequant path (or the opt-in fused kernel — ops/quantized_matmul);
+    dense leaves take the plain matmul."""
+    from ..ops import quantization as quant
+
+    dtype = dtype or x.dtype
+    if quant.is_k_quantized(leaf):
+        from ..ops.quantized_matmul import w8a8_matmul
+
+        return w8a8_matmul(x, leaf, out_dtype=dtype)
+    if quant.is_quantized(leaf):
+        from ..ops.quantized_matmul import quantized_matmul
+
+        return quantized_matmul(x, leaf, out_dtype=dtype)
+    return x @ leaf.astype(dtype)
+
+
 def _dequant_resident(params, dtype=None):
     """Dequantize the small resident params (embeddings, final LN) up front;
     the stacked ``blocks`` stay int8 and expand per layer in ``_block``."""
@@ -214,7 +233,6 @@ def _block(cfg: GPT2Config, x, layer, mask, rng, dropout: float):
     implement causality internally and would silently drop a custom mask)."""
     b, s, d = x.shape
     h, hd = cfg.num_heads, cfg.head_dim
-    layer = _maybe_dequant(layer, x.dtype)
 
     aq_bits = getattr(cfg, "act_quant_bits", None)
 
@@ -228,7 +246,7 @@ def _block(cfg: GPT2Config, x, layer, mask, rng, dropout: float):
                                            "symmetric"))
 
     y = _aq(_layer_norm(x, layer["ln1_scale"], layer["ln1_bias"]))
-    qkv = y @ layer["qkv_w"].astype(y.dtype) + layer["qkv_b"].astype(y.dtype)
+    qkv = _qmm(y, layer["qkv_w"]) + layer["qkv_b"].astype(y.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
     k = k.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
@@ -268,12 +286,13 @@ def _block(cfg: GPT2Config, x, layer, mask, rng, dropout: float):
             probs = probs * keep / (1.0 - dropout)
         attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
     attn = _aq(attn.transpose(0, 2, 1, 3).reshape(b, s, d))
-    x = x + attn @ layer["o_w"].astype(x.dtype) + layer["o_b"].astype(x.dtype)
+    x = x + _qmm(attn, layer["o_w"], x.dtype) + layer["o_b"].astype(x.dtype)
 
     y = _aq(_layer_norm(x, layer["ln2_scale"], layer["ln2_bias"]))
-    hid = _aq(jax.nn.gelu(y @ layer["fc_w"].astype(y.dtype) +
+    hid = _aq(jax.nn.gelu(_qmm(y, layer["fc_w"]) +
                           layer["fc_b"].astype(y.dtype)))
-    x = x + hid @ layer["proj_w"].astype(x.dtype) + layer["proj_b"].astype(x.dtype)
+    x = x + _qmm(hid, layer["proj_w"], x.dtype) + \
+        layer["proj_b"].astype(x.dtype)
     return x
 
 
@@ -301,10 +320,9 @@ def _block_cached(cfg: GPT2Config, x, layer, ck, cv, pos):
 
     b, t, d = x.shape
     h, hd = cfg.num_heads, cfg.head_dim
-    layer = _maybe_dequant(layer, x.dtype)
 
     y = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
-    qkv = y @ layer["qkv_w"].astype(y.dtype) + layer["qkv_b"].astype(y.dtype)
+    qkv = _qmm(y, layer["qkv_w"]) + layer["qkv_b"].astype(y.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
     k = k.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
@@ -313,12 +331,12 @@ def _block_cached(cfg: GPT2Config, x, layer, ck, cv, pos):
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, pos, 0))
     attn = decode_attention(q, ck, cv, pos)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, t, d)
-    x = x + attn @ layer["o_w"].astype(x.dtype) + layer["o_b"].astype(x.dtype)
+    x = x + _qmm(attn, layer["o_w"], x.dtype) + layer["o_b"].astype(x.dtype)
 
     y = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
-    hid = jax.nn.gelu(y @ layer["fc_w"].astype(y.dtype) +
-                      layer["fc_b"].astype(y.dtype))
-    x = x + hid @ layer["proj_w"].astype(x.dtype) + layer["proj_b"].astype(x.dtype)
+    hid = jax.nn.gelu(_qmm(y, layer["fc_w"]) + layer["fc_b"].astype(y.dtype))
+    x = x + _qmm(hid, layer["proj_w"], x.dtype) + \
+        layer["proj_b"].astype(x.dtype)
     return x, ck, cv
 
 

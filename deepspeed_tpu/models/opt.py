@@ -198,25 +198,24 @@ def _attention(cfg: OPTConfig, q, k, v):
 
 def _block(cfg: OPTConfig, x, layer):
     """One OPT decoder layer. Pre-LN (do_layer_norm_before) or post-LN."""
-    from .gpt2 import _maybe_dequant
+    from .gpt2 import _qmm
 
     b, s, d = x.shape
     h, hd = cfg.num_heads, cfg.head_dim
-    # INT8 weight-only serving: expand this layer's quantized records at
-    # point of use (peak memory = one layer of bf16 weights)
-    layer = _maybe_dequant(layer, x.dtype)
+    # INT8 weight-only serving: quantized records run the fused Pallas
+    # dequant-matmul (ops/quantized_matmul) — no bf16 weight copy in HBM
 
     res = x
     y = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"]) \
         if cfg.do_layer_norm_before else x
-    qkv = y @ layer["qkv_w"].astype(y.dtype) + layer["qkv_b"].astype(y.dtype)
+    qkv = _qmm(y, layer["qkv_w"]) + layer["qkv_b"].astype(y.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
     k = k.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
     v = v.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
     attn = _attention(cfg, q, k, v)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
-    x = res + attn @ layer["o_w"].astype(x.dtype) + \
+    x = res + _qmm(attn, layer["o_w"], x.dtype) + \
         layer["o_b"].astype(x.dtype)
     if not cfg.do_layer_norm_before:
         x = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
@@ -224,9 +223,9 @@ def _block(cfg: OPTConfig, x, layer):
     res = x
     y = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"]) \
         if cfg.do_layer_norm_before else x
-    hid = jax.nn.relu(y @ layer["fc_w"].astype(y.dtype) +
+    hid = jax.nn.relu(_qmm(y, layer["fc_w"]) +
                       layer["fc_b"].astype(y.dtype))
-    x = res + hid @ layer["proj_w"].astype(x.dtype) + \
+    x = res + _qmm(hid, layer["proj_w"], x.dtype) + \
         layer["proj_b"].astype(x.dtype)
     if not cfg.do_layer_norm_before:
         x = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
@@ -281,16 +280,15 @@ def init_cache(cfg: OPTConfig, batch_size: int, max_len: int,
 def _block_cached(cfg: OPTConfig, x, layer, ck, cv, pos):
     from ..ops.decode_attention import decode_attention
 
-    from .gpt2 import _maybe_dequant
+    from .gpt2 import _qmm
 
     b, t, d = x.shape
     h, hd = cfg.num_heads, cfg.head_dim
-    layer = _maybe_dequant(layer, x.dtype)
 
     res = x
     y = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"]) \
         if cfg.do_layer_norm_before else x
-    qkv = y @ layer["qkv_w"].astype(y.dtype) + layer["qkv_b"].astype(y.dtype)
+    qkv = _qmm(y, layer["qkv_w"]) + layer["qkv_b"].astype(y.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
     k = k.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
@@ -299,16 +297,17 @@ def _block_cached(cfg: OPTConfig, x, layer, ck, cv, pos):
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, pos, 0))
     attn = decode_attention(q, ck, cv, pos)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, t, d)
-    x = res + attn @ layer["o_w"].astype(x.dtype) + layer["o_b"].astype(x.dtype)
+    x = res + _qmm(attn, layer["o_w"], x.dtype) + \
+        layer["o_b"].astype(x.dtype)
     if not cfg.do_layer_norm_before:
         x = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
 
     res = x
     y = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"]) \
         if cfg.do_layer_norm_before else x
-    hid = jax.nn.relu(y @ layer["fc_w"].astype(y.dtype) +
+    hid = jax.nn.relu(_qmm(y, layer["fc_w"]) +
                       layer["fc_b"].astype(y.dtype))
-    x = res + hid @ layer["proj_w"].astype(x.dtype) + \
+    x = res + _qmm(hid, layer["proj_w"], x.dtype) + \
         layer["proj_b"].astype(x.dtype)
     if not cfg.do_layer_norm_before:
         x = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])

@@ -71,9 +71,16 @@ def dequantize(rec: dict, dtype=jnp.bfloat16):
     return w.reshape(shape).astype(dtype)
 
 
+def _is_norm_path(path) -> bool:
+    flat = "/".join(str(getattr(k, "key", getattr(k, "name", k)))
+                    for k in path).lower()
+    return any(t in flat for t in
+               ("ln", "norm", "bias", "scale", "gamma", "beta"))
+
+
 def quantize_pytree(params: PyTree, num_bits: int = 8, group_size: int = 64,
                     symmetric: bool = True, min_size: int = 4096,
-                    min_penultimate: int = 64) -> PyTree:
+                    min_penultimate: int = 64, min_ndim: int = 2) -> PyTree:
     """Quantize WEIGHT-MATRIX-like float leaves; others pass through.
 
     A leaf qualifies when it has >= ``min_size`` elements, >= 2 dims, a
@@ -85,19 +92,18 @@ def quantize_pytree(params: PyTree, num_bits: int = 8, group_size: int = 64,
     almost nothing (the weight-only posture of the reference INT8 path).
     Because a deep stack ([L, d] with L >= min_penultimate, e.g. 80-layer
     Llama) defeats the shape test alone, any leaf whose key path names a
-    norm/bias/scale parameter is excluded outright."""
-    def is_norm_path(path) -> bool:
-        flat = "/".join(str(getattr(k, "key", getattr(k, "name", k)))
-                        for k in path).lower()
-        return any(t in flat for t in
-                   ("ln", "norm", "bias", "scale", "gamma", "beta"))
-
+    norm/bias/scale parameter is excluded outright — and callers
+    quantizing a STACKED-blocks subtree pass ``min_ndim=3``, which
+    excludes every per-layer 1D param ([L, d] stacked biases named
+    ``*_b`` defeat both the name filter and, at L >= 64, the
+    penultimate-dim test)."""
     def one(path, x):
         if (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+                and getattr(x, "ndim", 0) >= min_ndim
                 and getattr(x, "ndim", 0) >= 2 and x.size >= min_size
                 and x.shape[-1] % group_size == 0
                 and x.shape[-2] >= min_penultimate
-                and not is_norm_path(path)):
+                and not _is_norm_path(path)):
             return quantize(x, num_bits, group_size, symmetric)
         return x
 
@@ -124,3 +130,67 @@ def quantized_nbytes(params: PyTree) -> int:
         elif hasattr(leaf, "nbytes"):
             total += leaf.nbytes
     return total
+
+
+# ---------------------------------------------------------------- W8A8 (s8 MXU)
+# K-GROUPED weight records for the s8xs8 matmul path
+# (ops/quantized_matmul.w8a8_matmul): scales are constant over each
+# contraction-axis chunk of ``k_group`` rows, so they factor OUT of the
+# k-sum — the MXU runs a native int8 dot and the (activation_scale x
+# weight_scale) product applies to the int32 partial AFTER the dot.  The
+# reference analog is MoQ's combined weight+activation INT8 quantization
+# (``deepspeed/compression/basic_layer.py`` QuantAct + the int8 GEMMs of
+# DS-Inference); on TPU this is the only int8 layout that reaches the
+# MXU's s8 path — N-grouped scales can't leave the accumulation.
+
+_QK_KEYS = frozenset({"qk", "kscale"})
+
+
+def is_k_quantized(leaf) -> bool:
+    """True for a K-grouped record produced by :func:`quantize_k_grouped`."""
+    return isinstance(leaf, dict) and _QK_KEYS == set(leaf)
+
+
+def quantize_k_grouped(w, k_group: int = 256) -> dict:
+    """w: [..., K, N] float, K divisible by ``k_group`` ->
+    ``{"qk": int8 (w.shape), "kscale": f32 [..., K/G, 1, N]}`` (the
+    middle 1 keeps every kscale block lane-legal in Pallas)."""
+    shape = w.shape
+    k_dim, n_dim = shape[-2], shape[-1]
+    assert k_dim % k_group == 0, (shape, k_group)
+    g = w.astype(jnp.float32).reshape(
+        shape[:-2] + (k_dim // k_group, k_group, n_dim))
+    amax = jnp.max(jnp.abs(g), axis=-2, keepdims=True)   # [.., K/G, 1, N]
+    scale = jnp.where(amax == 0, 1.0, amax / 127.0)
+    qk = jnp.clip(jnp.round(g / scale), -127, 127).astype(jnp.int8)
+    return {"qk": qk.reshape(shape), "kscale": scale}
+
+
+def dequantize_k(rec: dict, dtype=jnp.bfloat16):
+    """Expand a K-grouped record (fallback / non-decode path)."""
+    qk, scale = rec["qk"], rec["kscale"]
+    shape = qk.shape
+    k_group = shape[-2] // scale.shape[-3]
+    g = qk.astype(jnp.float32).reshape(
+        shape[:-2] + (shape[-2] // k_group, k_group, shape[-1]))
+    return (g * scale).reshape(shape).astype(dtype)
+
+
+def quantize_pytree_k_grouped(params: PyTree, k_group: int = 256,
+                              min_size: int = 4096,
+                              min_ndim: int = 2) -> PyTree:
+    """W8A8 variant of :func:`quantize_pytree`: same weight-matrix
+    selection rules (incl. ``min_ndim=3`` for stacked-blocks subtrees),
+    K-grouped records; leaves whose K doesn't divide ``k_group`` stay
+    dense."""
+    def one(path, x):
+        if (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+                and getattr(x, "ndim", 0) >= min_ndim
+                and getattr(x, "ndim", 0) >= 2 and x.size >= min_size
+                and x.shape[-2] % k_group == 0
+                and x.shape[-1] % 128 == 0
+                and not _is_norm_path(path)):
+            return quantize_k_grouped(x, k_group)
+        return x
+
+    return jax.tree_util.tree_map_with_path(one, params)

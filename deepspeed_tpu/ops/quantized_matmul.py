@@ -1,0 +1,313 @@
+"""Fused INT8-weight matmul in Pallas — dequantization INSIDE the kernel.
+
+Reference parity: the INT8 inference GEMMs of DS-Inference
+(``csrc/transformer/inference/csrc/gelu.cu`` dequant epilogues and the
+``GroupQuantizer`` weight path of ``module_inject/replace_module.py:152``):
+the reference never materializes an fp16 copy of an int8 weight in HBM —
+dequant happens in the GEMM's shared-memory staging.
+
+TPU design: XLA cannot fuse an elementwise producer into a ``dot`` operand,
+so the point-of-use ``dequantize() @`` pattern (models/gpt2.py
+``_maybe_dequant``) round-trips a full bf16 copy of every weight through HBM
+each decode step: int8 read + bf16 write + bf16 read = ~5 bytes/param where
+the int8 payload is 1.  At bs=1 decode — pure HBM-bandwidth-bound matvecs —
+that is the whole latency.  This kernel streams int8 blocks into VMEM,
+expands ``q * scale`` on the VPU, and feeds the MXU directly: HBM traffic is
+the int8 payload + scales (~1.06 bytes/param), a ~5x cut.
+
+Weight record format is :mod:`deepspeed_tpu.ops.quantization`:
+``{"q": int8 [K, N], "scale": f32 [K, N/G]}`` (groups along the LAST axis).
+The operands are restructured to ``q [K, N/G, G]`` / ``scale [K, N/G, 1]``
+outside the kernel so every in-kernel op is lane-legal; that requires
+``G % 128 == 0`` (the serving default, inference/config.py).  Symmetric
+records only; asymmetric ("zero"), non-tiling, or off-lane-group records
+fall back to dequant+matmul.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+#: Trace-time gate set by the engine that owns the current trace (same
+#: single-active-engine contract as model-config knobs, see
+#: runtime/zero/liveness.py): under tensor parallelism the weight operands
+#: are GSPMD-sharded and a pallas_call is opaque to the partitioner — the
+#: elementwise dequant+matmul path is the TP-compatible one, so
+#: InferenceEngine disables the kernel when tp > 1.
+_KERNEL_OK = True
+
+
+def configure(kernel_ok: bool) -> None:
+    global _KERNEL_OK
+    _KERNEL_OK = bool(kernel_ok)
+
+
+def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, nk: int):
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    bk, gpb, g = q_ref.shape
+    # Decode is per-grid-STEP-overhead-bound, so steps must be large; VMEM
+    # is bounded by the dequant intermediates, not the int8 block.  Hence:
+    # big fetch blocks (bk x bn int8, the pipelining unit), dequantized in
+    # small static sub-tiles via REF slicing (loading q_ref[...] whole
+    # would put the full block in vector registers).  bf16 dequant: int8
+    # values are exact in bf16 and the scale rounding (2^-8 rel) sits
+    # below the int8 quantization error itself.  The [sub, gpb, g] ->
+    # [sub, bn] merge is the lane-aligned reshape Mosaic supports (a
+    # g < 128 split is an unsupported relayout; hence the G % 128
+    # eligibility rule and the host-side 3D restructuring).
+    _, b, sub = x_ref.shape                        # x_ref: [bk//sub, B, sub]
+
+    def tile(t, _):
+        qt = q_ref[pl.ds(t * sub, sub)]            # [sub, gpb, g] int8
+        st = s_ref[pl.ds(t * sub, sub)]            # [sub, gpb, 1] f32
+        w = (qt.astype(jnp.bfloat16) *
+             st.astype(jnp.bfloat16)).reshape(sub, gpb * g)
+        xt = x_ref[pl.ds(t, 1)].reshape(b, sub)    # major-dim slice of x
+        acc_ref[...] += jax.lax.dot(xt.astype(jnp.bfloat16), w,
+                                    preferred_element_type=jnp.float32)
+        return _
+
+    # rolled (static-trip) loop: one set of dequant intermediates is
+    # reused across sub-tiles — unrolling kept them all live and blew the
+    # scoped-VMEM stack (40MB at bk=2048); jax.lax.dynamic_slice on a
+    # loaded VALUE is unimplemented in Mosaic, hence the [bk//sub, B, sub]
+    # x staging that makes every sub-tile a major-dim REF slice
+    jax.lax.fori_loop(0, bk // sub, tile, None)
+
+    @pl.when(ki == nk - 1)
+    def _done():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def _pick_block(dim: int, group: int, cap: int, quantum: int) -> int:
+    """Largest divisor of ``dim`` that is <= cap, a multiple of ``quantum``
+    and of ``group`` (so blocks span whole quant groups)."""
+    step = quantum
+    while step % group:
+        step += quantum
+    best = 0
+    b = step
+    while b <= min(dim, cap):
+        if dim % b == 0:
+            best = b
+        b += step
+    return best
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype", "block_k",
+                                             "block_n", "interpret"))
+def _qmm_call(x2d, q3, scale3, out_dtype, block_k, block_n, interpret):
+    b, k_dim = x2d.shape
+    _, n_groups, g = q3.shape
+    n_dim = n_groups * g
+    gpb = block_n // g
+    # sub-tile rows capped so the bf16 cast + product intermediates stay
+    # ~4MB: sub * bn <= 1M elements
+    sub = _pick_block(block_k, 1, max(8, (2 ** 20) // block_n), 8) or block_k
+    grid = (n_dim // block_n, k_dim // block_k)
+    x3 = x2d.reshape(b, k_dim // sub, sub).swapaxes(0, 1)
+    return pl.pallas_call(
+        functools.partial(_kernel, nk=grid[1]),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_k // sub, b, sub),
+                         lambda n, ki: (ki, 0, 0)),
+            pl.BlockSpec((block_k, gpb, g), lambda n, ki: (ki, n, 0)),
+            pl.BlockSpec((block_k, gpb, 1), lambda n, ki: (ki, n, 0)),
+        ],
+        out_specs=pl.BlockSpec((b, block_n), lambda n, ki: (0, n)),
+        out_shape=jax.ShapeDtypeStruct((b, n_dim), out_dtype),
+        scratch_shapes=[pltpu.VMEM((b, block_n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(x3, q3, scale3)
+
+
+def quantized_matmul(x, rec: dict, out_dtype=None, *, block_k: int = None,
+                     block_n: int = None, max_rows: int = 256):
+    """``x @ dequant(rec)`` without materializing the dequantized weight.
+
+    x: [..., K] float; rec: symmetric int8 record (see module docstring).
+
+    OFF BY DEFAULT (``DS_QMM=1`` opts in): measured end-to-end on the v5e
+    the fused path LOSES to XLA's dequantize+matmul at every model size
+    (OPT-1.3B 68.5 vs 82.2 tok/s; OPT-6.7B 10.1 vs 12.1) — the in-kernel
+    int8->bf16 convert is a cross-tiling relayout costing ~7us/MB, 6x the
+    fetch+VPU theory, and XLA runs its 5-byte/param materializing pipeline
+    at full HBM bandwidth (PROFILE.md round-4 second pass).  The kernel is
+    kept as the scaffold for a true s8-MXU (W8A8) path, which avoids the
+    relayout entirely.  Also falls back when the shapes don't tile, the
+    record is asymmetric, or the row count exceeds the accumulator budget
+    (long prefills are compute-bound and amortize the dequant copy).
+    """
+    from . import quantization as quant
+
+    q, scale = rec["q"], rec["scale"]
+    k_dim, n_dim = q.shape[-2], q.shape[-1]
+    lead = x.shape[:-1]
+    rows = 1
+    for d in lead:
+        rows *= d
+    g = n_dim // scale.shape[-1]
+    # FULL-row n blocks (bn = N): a single output block avoids the output
+    # double-buffering that put mid-size bn configs over the scoped-VMEM
+    # limit (bk=512/bn=4096 fails at 20.1M where bk=512/bn=8192 compiles),
+    # and decode is per-grid-step-overhead-bound so steps should be as
+    # large as VMEM allows anyway.  k blocks are sized by a per-step byte
+    # budget (the double-buffered int8 fetch granularity).
+    if block_n is None and "DS_QMM_BN" in os.environ:
+        block_n = int(os.environ["DS_QMM_BN"])
+    bn = n_dim if block_n is None else _pick_block(n_dim, g, block_n, 128)
+    if block_k is None:
+        step_bytes = int(float(os.environ.get("DS_QMM_STEP_MB", 1)) * 2**20)
+        cap_k = max(1, step_bytes // max(bn, 1))
+        block_k = cap_k
+    bk = _pick_block(k_dim, 1, block_k, 256) or \
+        _pick_block(k_dim, 1, block_k, 8)
+    # the f32 accumulator + output block are [rows, bn]-sized: with
+    # full-row bn the row budget shrinks accordingly (128 rows x 16384
+    # lanes is an 8MB accumulator — prefill-sized inputs take the
+    # dequant path, which amortizes its bf16 materialization over the
+    # row count anyway)
+    row_cap = min(max_rows, max(8, (2 ** 19) // max(bn, 1)))
+    eligible = (
+        _KERNEL_OK
+        and os.environ.get("DS_QMM", "0") == "1"
+        and q.ndim == 2
+        and "zero" not in rec
+        and rows <= row_cap
+        and g % 128 == 0          # lane-aligned groups (see _kernel)
+        and bk > 0 and bn >= g
+    )
+    if not eligible:
+        return x @ quant.dequantize(rec, x.dtype)
+    out_dtype = out_dtype or x.dtype
+    x2d = x.reshape(rows, k_dim)
+    out = _qmm_call(x2d, q.reshape(k_dim, n_dim // g, g),
+                    scale.reshape(k_dim, n_dim // g, 1),
+                    out_dtype, bk, bn, _use_interpret())
+    return out.reshape(lead + (n_dim,))
+
+
+# ------------------------------------------------------------------ W8A8 path
+# True s8-MXU serving matmul: activations quantize per k-chunk IN-KERNEL,
+# the dot runs int8 x int8 -> int32 natively, and (activation_scale x
+# K-grouped weight scale) applies to the partial AFTER the dot — no
+# int8->bf16 weight relayout anywhere (the cost that sank the weight-only
+# fused kernel, see module docstring).  Records come from
+# quantization.quantize_k_grouped; accuracy trades ~0.5-1% activation
+# rounding for the bandwidth floor (reference analog: MoQ weight+activation
+# INT8, deepspeed/compression/basic_layer.py QuantAct).
+
+
+def _w8a8_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, nk: int,
+                 k_group: int):
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    bk = q_ref.shape[0]
+    _, b, sub = x_ref.shape                       # sub == k_group
+
+    def tile(t, _):
+        xt = x_ref[pl.ds(t, 1)].reshape(b, sub).astype(jnp.float32)
+        ax = jnp.max(jnp.abs(xt), axis=1, keepdims=True)      # [B, 1]
+        ax = jnp.where(ax == 0, 1.0, ax)
+        xq = jnp.clip(jnp.round(xt * (127.0 / ax)),
+                      -127, 127).astype(jnp.int8)
+        qt = q_ref[pl.ds(t * sub, sub)]                       # [sub, bn] s8
+        st = s_ref[pl.ds(t, 1)].reshape(1, -1)                # [1, bn] f32
+        part = jax.lax.dot(xq, qt,
+                           preferred_element_type=jnp.int32)  # s8 MXU
+        acc_ref[...] += part.astype(jnp.float32) * (ax / 127.0) * st
+        return _
+
+    jax.lax.fori_loop(0, bk // sub, tile, None)
+
+    @pl.when(ki == nk - 1)
+    def _done():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype", "block_k",
+                                             "interpret"))
+def _w8a8_call(x2d, qk, kscale, out_dtype, block_k, interpret):
+    b, k_dim = x2d.shape
+    n_dim = qk.shape[1]
+    k_group = k_dim // kscale.shape[0]
+    grid = (1, k_dim // block_k)
+    x3 = x2d.reshape(b, k_dim // k_group, k_group).swapaxes(0, 1)
+    return pl.pallas_call(
+        functools.partial(_w8a8_kernel, nk=grid[1], k_group=k_group),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_k // k_group, b, k_group),
+                         lambda n, ki: (ki, 0, 0)),
+            pl.BlockSpec((block_k, n_dim), lambda n, ki: (ki, 0)),
+            pl.BlockSpec((block_k // k_group, 1, n_dim),
+                         lambda n, ki: (ki, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((b, n_dim), lambda n, ki: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, n_dim), out_dtype),
+        scratch_shapes=[pltpu.VMEM((b, n_dim), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(x3, qk, kscale)
+
+
+def w8a8_matmul(x, rec: dict, out_dtype=None, *, block_k: int = None,
+                max_rows: int = 8):
+    """``x @ dequant_k(rec)`` on the s8 MXU with in-kernel activation
+    quantization.  Decode-shaped inputs only (``rows <= max_rows``); other
+    shapes — and ``DS_W8A8=0`` — fall back to dequantize+matmul (prefill
+    is compute-bound and amortizes the bf16 copy; its activations stay
+    unquantized there, which is also the more accurate choice for the
+    prompt pass)."""
+    from . import quantization as quant
+
+    qk, kscale = rec["qk"], rec["kscale"]
+    k_dim, n_dim = qk.shape[-2], qk.shape[-1]
+    k_group = k_dim // kscale.shape[-3]
+    lead = x.shape[:-1]
+    rows = 1
+    for d in lead:
+        rows *= d
+    if block_k is None:
+        step_bytes = int(float(os.environ.get("DS_QMM_STEP_MB", 4)) * 2**20)
+        block_k = max(1, step_bytes // max(n_dim, 1))
+    bk = _pick_block(k_dim, k_group, block_k, k_group)
+    eligible = (
+        _KERNEL_OK
+        and os.environ.get("DS_W8A8", "1") != "0"
+        and qk.ndim == 2
+        and rows <= max_rows
+        and n_dim % 128 == 0
+        and bk > 0
+    )
+    if not eligible:
+        return x @ quant.dequantize_k(rec, x.dtype)
+    out_dtype = out_dtype or x.dtype
+    x2d = x.reshape(rows, k_dim)
+    out = _w8a8_call(x2d, qk, kscale.reshape(k_dim // k_group, 1, n_dim),
+                     out_dtype, bk, _use_interpret())
+    return out.reshape(lead + (n_dim,))

@@ -117,3 +117,13 @@ def test_slot_count_change_triggers_restart(tmp_path):
     # first group: 2 hosts x 1 chip = WS 2; second: 2 hosts x 4 = WS 8
     assert worlds.count("2") == 2 and worlds.count("8") == 2, worlds
     assert agent.restart_count >= 1
+
+
+def test_zero_slot_hosts_excluded():
+    """A slots=0 hostfile line behaves like an excluded host: it is not
+    elected and does not drag chips_per_host to 1."""
+    agent = _agent(lambda: {"a": 4, "b": 0, "c": 4},
+                   lambda host, env: [sys.executable, "-c", "pass"])
+    hosts = agent._probe()
+    assert hosts == ["a", "c"]
+    assert agent.chips_per_host == 4

@@ -1,0 +1,219 @@
+"""Fused int8 dequant-matmul kernel (ops/quantized_matmul) — parity with the
+dequantize+matmul reference path, eligibility fallbacks, and the quant-aware
+model wiring (reference: DS-Inference int8 GEMMs never materialize an fp16
+weight copy; ``module_inject/replace_module.py:152`` GroupQuantizer)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops import quantization as quant
+from deepspeed_tpu.ops.quantized_matmul import quantized_matmul
+
+
+def _mk(k, n, g, rows=1, dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=(k, n)).astype(np.float32)
+    x = rng.normal(size=(rows, k)).astype(np.float32)
+    rec = quant.quantize(jnp.asarray(w), group_size=g)
+    return jnp.asarray(x, dtype), rec
+
+
+@pytest.fixture(autouse=True)
+def _kernel_on(monkeypatch):
+    # the fused kernel is opt-in (it loses to XLA's dequant path end-to-end
+    # on this chip — see module docstring); these tests exercise it anyway
+    monkeypatch.setenv("DS_QMM", "1")
+
+
+@pytest.mark.parametrize("rows", [1, 8, 128])
+def test_kernel_matches_dequant_matmul(rows):
+    x, rec = _mk(512, 1024, 128, rows=rows)
+    ref = x @ quant.dequantize(rec, x.dtype)
+    out = quantized_matmul(x, rec)
+    assert out.shape == (rows, 1024)
+    # kernel dequantizes in bf16 (scale rounding ~2^-8, below the int8
+    # quantization error itself); reference path computes in f32
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-2, atol=3e-1)
+
+
+def test_kernel_3d_rows_and_bf16():
+    x, rec = _mk(512, 512, 128, rows=6, dtype=jnp.bfloat16)
+    x3 = x.reshape(2, 3, 512)
+    ref = x3 @ quant.dequantize(rec, x3.dtype)
+    out = quantized_matmul(x3, rec)
+    assert out.shape == (2, 3, 512) and out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=6e-2, atol=6e-1)
+
+
+def test_off_lane_group_size_falls_back():
+    # reference GroupQuantizer group sizes (64) are honored via fallback
+    x, rec = _mk(512, 1024, 64)
+    ref = x @ quant.dequantize(rec, x.dtype)
+    np.testing.assert_allclose(np.asarray(quantized_matmul(x, rec)),
+                               np.asarray(ref), rtol=1e-6)
+
+
+def test_non_tiling_shapes_fall_back():
+    # N=192 has no 128-multiple divisor block: must fall back, still correct
+    x, rec = _mk(512, 192, 64)
+    ref = x @ quant.dequantize(rec, x.dtype)
+    np.testing.assert_allclose(np.asarray(quantized_matmul(x, rec)),
+                               np.asarray(ref), rtol=1e-6)
+
+
+def test_kill_switch_and_row_cap(monkeypatch):
+    x, rec = _mk(512, 1024, 128, rows=4)
+    ref = x @ quant.dequantize(rec, x.dtype)
+    monkeypatch.setenv("DS_QMM", "0")
+    np.testing.assert_allclose(np.asarray(quantized_matmul(x, rec)),
+                               np.asarray(ref), rtol=1e-6)
+    monkeypatch.delenv("DS_QMM")
+    xl, _ = _mk(512, 1024, 128, rows=512)  # > max_rows: long-prefill fallback
+    np.testing.assert_allclose(
+        np.asarray(quantized_matmul(xl, rec)),
+        np.asarray(xl @ quant.dequantize(rec, xl.dtype)), rtol=1e-6)
+
+
+def test_model_decode_parity_kernel_vs_fallback(monkeypatch):
+    """An int8-served OPT (tileable dims: hidden 128) must generate the
+    same tokens with the fused kernel and with the dequant fallback."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import opt
+
+    cfg = opt.OPTConfig(vocab_size=512, max_seq_len=64, num_layers=2,
+                        num_heads=4, hidden_size=128, ffn_size=512)
+    cpu = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu):
+        params = opt.build(cfg).init_fn(jax.random.PRNGKey(0))
+    params = jax.device_get(params)
+    ids = np.ones((1, 6), dtype=np.int32)
+
+    outs, logits = {}, {}
+    for tag, env in (("kernel", "1"), ("fallback", "0")):
+        monkeypatch.setenv("DS_QMM", env)
+        deepspeed_tpu.comm.reset_topology()
+        eng = deepspeed_tpu.init_inference(
+            model=opt.build(cfg), params=params,
+            config={"dtype": "float32",
+                    "quant": {"enabled": True, "group_size": 128}})
+        outs[tag] = np.asarray(eng.generate(ids, max_new_tokens=8))
+        logits[tag] = np.asarray(eng.forward({"input_ids": ids}))
+    # bf16 in-kernel dequant vs f32 fallback: logits agree to bf16-level
+    # tolerance and greedy decode stays on the same tokens
+    np.testing.assert_allclose(logits["kernel"], logits["fallback"],
+                               rtol=5e-2, atol=5e-2)
+    agree = (outs["kernel"] == outs["fallback"]).mean()
+    assert agree >= 0.9, (agree, outs)
+
+
+# ------------------------------------------------------------------ W8A8
+def test_w8a8_matmul_matches_dequant():
+    from deepspeed_tpu.ops.quantized_matmul import w8a8_matmul
+
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.normal(size=(512, 1024)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(1, 512)), jnp.float32)
+    rec = quant.quantize_k_grouped(w, k_group=256)
+    ref = np.asarray(x @ quant.dequantize_k(rec, jnp.float32))
+    out = np.asarray(w8a8_matmul(x, rec))
+    # activation quantization adds ~1% error on top of the weight int8
+    np.testing.assert_allclose(out, ref, rtol=5e-2, atol=5e-1)
+    # prefill-sized rows fall back to exact dequant+matmul
+    xl = jnp.asarray(rng.normal(size=(64, 512)), jnp.float32)
+    refl = np.asarray(xl @ quant.dequantize_k(rec, xl.dtype))
+    np.testing.assert_allclose(np.asarray(w8a8_matmul(xl, rec)), refl,
+                               rtol=1e-5)
+
+
+def test_w8a8_engine_decode(monkeypatch):
+    """Tiny OPT served with quant.type=w8a8: decode runs, logits track the
+    bf16 model, greedy tokens mostly agree."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import opt
+
+    cfg = opt.OPTConfig(vocab_size=512, max_seq_len=64, num_layers=2,
+                        num_heads=4, hidden_size=128, ffn_size=512)
+    cpu = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu):
+        params = opt.build(cfg).init_fn(jax.random.PRNGKey(0))
+    params = jax.device_get(params)
+    ids = np.ones((1, 6), dtype=np.int32)
+
+    deepspeed_tpu.comm.reset_topology()
+    ref_eng = deepspeed_tpu.init_inference(
+        model=opt.build(cfg), params=params, config={"dtype": "float32"})
+    ref_tok = np.asarray(ref_eng.generate(ids, max_new_tokens=8))
+    ref_logits = np.asarray(ref_eng.forward({"input_ids": ids}))
+
+    deepspeed_tpu.comm.reset_topology()
+    eng = deepspeed_tpu.init_inference(
+        model=opt.build(cfg), params=params,
+        config={"dtype": "float32",
+                "quant": {"enabled": True, "type": "w8a8"}})
+    from deepspeed_tpu.ops import quantization as q
+    recs = [x for x in jax.tree_util.tree_leaves(
+        eng.params, is_leaf=q.is_k_quantized) if q.is_k_quantized(x)]
+    assert recs, "w8a8 quantization did not produce K-grouped records"
+    tok = np.asarray(eng.generate(ids, max_new_tokens=8))
+    logits = np.asarray(eng.forward({"input_ids": ids}))
+    np.testing.assert_allclose(logits, ref_logits, rtol=2e-1, atol=2e-1)
+    assert (tok == ref_tok).mean() >= 0.75, (tok, ref_tok)
+
+
+def test_w8a8_rejects_non_quant_aware_model():
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama
+
+    deepspeed_tpu.comm.reset_topology()
+    with pytest.raises(ValueError, match="w8a8"):
+        deepspeed_tpu.init_inference(
+            model=llama.build(llama.LlamaConfig.tiny()),
+            config={"dtype": "float32",
+                    "quant": {"enabled": True, "type": "w8a8"}})
+
+
+def test_stacked_biases_stay_dense_at_64_layers():
+    """[L, 3d] stacked biases pass the 2D weight-matrix shape tests once
+    L >= 64 (they are not caught by the name filter either: 'qkv_b' does
+    not contain 'bias'); the blocks-subtree quantizers must exclude them
+    via min_ndim=3 or the block matmul wrappers crash on a record where
+    a bias array is expected."""
+    L, d = 64, 128
+    blocks = {"qkv_w": jnp.zeros((L, d, 3 * d)),
+              "qkv_b": jnp.ones((L, 3 * d)),
+              "ln1_scale": jnp.ones((L, d))}
+    for fn in (lambda t: quant.quantize_pytree(t, group_size=128,
+                                               min_ndim=3),
+               lambda t: quant.quantize_pytree_k_grouped(t, k_group=128,
+                                                         min_ndim=3)):
+        out = fn(blocks)
+        assert not isinstance(out["qkv_b"], dict), "bias was quantized"
+        assert not isinstance(out["ln1_scale"], dict)
+        assert isinstance(out["qkv_w"], dict), "weight was NOT quantized"
+
+
+def test_engine_serves_64_layer_quant_aware_model(monkeypatch):
+    """End-to-end: a 64-layer tiny GPT-2 with quant.enabled must build and
+    decode (regression: stacked biases became records and .astype crashed
+    at trace time)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config(vocab_size=256, max_seq_len=32, num_layers=64,
+                          num_heads=2, hidden_size=128)
+    cpu = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu):
+        params = gpt2.build(cfg).init_fn(jax.random.PRNGKey(0))
+    params = jax.device_get(params)
+    deepspeed_tpu.comm.reset_topology()
+    eng = deepspeed_tpu.init_inference(
+        model=gpt2.build(cfg), params=params,
+        config={"dtype": "float32", "quant": {"enabled": True}})
+    out = eng.generate(np.ones((1, 4), np.int32), max_new_tokens=2)
+    assert out.shape == (1, 6)
