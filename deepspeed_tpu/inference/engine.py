@@ -80,17 +80,19 @@ class InferenceEngine:
             from ..ops import quantization as quant
             from ..ops import quantized_matmul as qmm
 
-            # fused kernels only on an unsharded weight path: under tp > 1
-            # the weights are GSPMD-sharded and pallas_calls are opaque to
-            # the partitioner, so EVERY quantized matmul (incl. w8a8's
-            # s8-MXU decode) degrades to dequantize+matmul — loudly.
-            qmm.configure(kernel_ok=(tp <= 1))
+            # the weight-only fused kernel needs an unsharded weight path
+            # (pallas_calls are opaque to the GSPMD partitioner); the w8a8
+            # kernel instead runs TP-sharded through a custom_partitioning
+            # wrapper — column shards run the s8 kernel locally, row shards
+            # psum a local partial (ops/quantized_matmul._w8a8_tp_call)
+            qmm.configure(kernel_ok=(tp <= 1), w8a8_tp=(tp > 1))
             if tp > 1 and config.quant.type == "w8a8":
                 log_dist(
-                    "quant: w8a8 under tensor parallelism falls back to "
-                    "the dequantize+matmul path (the s8-MXU kernel cannot "
-                    "run on GSPMD-sharded weights); expect weight-only "
-                    "int8 speed, not the w8a8 decode numbers", ranks=[0])
+                    "quant: w8a8 under tensor parallelism — decode matmuls "
+                    "run sharded via custom_partitioning (s8 kernel per "
+                    "shard; row-parallel adds one psum); weights whose "
+                    "quant groups don't divide tp gather instead (warned "
+                    "per shape at compile)", ranks=[0])
 
             # Quantize on the HOST: jnp ops on uncommitted (numpy) inputs
             # follow default_device, so stacked multi-billion-param leaves
@@ -141,10 +143,33 @@ class InferenceEngine:
             def _is_rec(x):
                 return quant.is_quantized(x) or quant.is_k_quantized(x)
 
+            def _rec_shardings(x, s):
+                if not _is_rec(x):
+                    return s
+                out = {}
+                for k in x:
+                    if k in ("q", "qk"):
+                        out[k] = s
+                    elif k == "kscale" and getattr(s, "spec", None) is not None:
+                        # kscale [..., K/G, 1, N] follows the weight's
+                        # [..., K, N] spec (K-dim sharding lands on the K/G
+                        # dim) so TP decode never re-slices a replicated
+                        # scale tree; dims the axis doesn't divide (e.g.
+                        # K/G=1 at hidden==k_group) stay replicated
+                        spec = tuple(s.spec)
+                        spec = spec + (None,) * (x[k].ndim - 1 - len(spec))
+                        kspec = spec[:-2] + (spec[-2], None, spec[-1])
+                        kspec = tuple(
+                            ax if x[k].shape[i] %
+                            qmm.axis_size(self.mesh, ax) == 0 else None
+                            for i, ax in enumerate(kspec))
+                        out[k] = NamedSharding(self.mesh, P(*kspec))
+                    else:
+                        out[k] = rep
+                return out
+
             shardings = jax.tree_util.tree_map(
-                lambda x, s: ({k: (s if k in ("q", "qk") else rep)
-                               for k in x} if _is_rec(x) else s),
-                params, shardings, is_leaf=_is_rec)
+                _rec_shardings, params, shardings, is_leaf=_is_rec)
             if model.quant_aware:
                 self._prepare = lambda p: p
             else:

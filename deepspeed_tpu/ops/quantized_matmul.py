@@ -39,18 +39,24 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-#: Trace-time gate set by the engine that owns the current trace (same
+#: Trace-time gates set by the engine that owns the current trace (same
 #: single-active-engine contract as model-config knobs, see
-#: runtime/zero/liveness.py): under tensor parallelism the weight operands
-#: are GSPMD-sharded and a pallas_call is opaque to the partitioner — the
-#: elementwise dequant+matmul path is the TP-compatible one, so
-#: InferenceEngine disables the kernel when tp > 1.
+#: runtime/zero/liveness.py).  A pallas_call is opaque to the GSPMD
+#: partitioner, so under tensor parallelism the WEIGHT-ONLY fused kernel is
+#: disabled (``kernel_ok=False`` — its dequant+matmul fallback shards fine).
+#: The W8A8 kernel instead goes through :func:`_w8a8_tp_call`, a
+#: ``custom_partitioning`` wrapper that teaches the partitioner the two TP
+#: layouts (``w8a8_tp=True``): column-parallel (N sharded — every shard runs
+#: the s8 kernel on its weight slice, no communication) and row-parallel
+#: (K sharded — local partial on the s8 kernel, one psum after).
 _KERNEL_OK = True
+_W8A8_TP = False
 
 
-def configure(kernel_ok: bool) -> None:
-    global _KERNEL_OK
+def configure(kernel_ok: bool, w8a8_tp: bool = False) -> None:
+    global _KERNEL_OK, _W8A8_TP
     _KERNEL_OK = bool(kernel_ok)
+    _W8A8_TP = bool(w8a8_tp)
 
 
 def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, nk: int):
@@ -275,6 +281,127 @@ def _w8a8_call(x2d, qk, kscale, out_dtype, block_k, interpret):
     )(x3, qk, kscale)
 
 
+def _w8a8_local(x2d, qk, kscale3, block_k=None, out_dtype=None):
+    """One shard's worth of the W8A8 matmul: the s8-MXU kernel when the
+    LOCAL shapes tile (lane-aligned N, whole k-groups), exact dequant+matmul
+    otherwise.  Correct for any shapes, so the custom_partitioning lowering
+    below can call it on whatever slice the partitioner hands each device.
+    ``out_dtype`` keeps row-parallel partials in f32 so the cross-shard psum
+    adds no rounding the unsharded kernel doesn't have."""
+    from . import quantization as quant
+
+    out_dtype = out_dtype or x2d.dtype
+    k_dim, n_dim = qk.shape
+    kg_blocks = kscale3.shape[0]
+    k_group = k_dim // kg_blocks if kg_blocks else 0
+    bk = 0
+    if k_group and k_dim % kg_blocks == 0:
+        if block_k is None:
+            step_bytes = int(
+                float(os.environ.get("DS_QMM_STEP_MB", 4)) * 2**20)
+            block_k = max(1, step_bytes // max(n_dim, 1))
+        bk = _pick_block(k_dim, k_group, block_k, k_group)
+    if (bk > 0 and n_dim % 128 == 0
+            and os.environ.get("DS_W8A8", "1") != "0"):
+        return _w8a8_call(x2d, qk, kscale3, out_dtype, bk, _use_interpret())
+    deq = quant.dequantize_k({"qk": qk, "kscale": kscale3}, x2d.dtype)
+    return jax.lax.dot(x2d, deq, preferred_element_type=out_dtype)
+
+
+def axis_size(mesh, axes) -> int:
+    """Product of the mesh sizes of ``axes`` (a PartitionSpec entry: None,
+    an axis name, or a tuple of names; absent axes count as 1)."""
+    if axes is None:
+        return 1
+    names = axes if isinstance(axes, tuple) else (axes,)
+    size = 1
+    for name in names:
+        size *= mesh.shape.get(name, 1)
+    return size
+
+
+def _qk_spec(arg_shapes):
+    spec = getattr(arg_shapes[1].sharding, "spec", None)
+    spec = tuple(spec) if spec is not None else ()
+    return (spec + (None, None))[:2]
+
+
+def _w8a8_infer_sharding(mesh, arg_shapes, result_shape):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    _, n_s = _qk_spec(arg_shapes)
+    return NamedSharding(mesh, P(None, n_s) if n_s is not None else P())
+
+
+def _w8a8_partition(mesh, arg_shapes, result_shape):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    k_s, n_s = _qk_spec(arg_shapes)
+    k_dim, n_dim = arg_shapes[1].shape
+    kg_blocks = arg_shapes[2].shape[0]
+    rep = NamedSharding(mesh, P())
+    # column shards never split quant groups (scales are per-column), so any
+    # even N split is exact — shards whose local N is off-lane just run the
+    # sharded dequant+dot inside _w8a8_local; K shards must keep whole
+    # k-groups or the record's chunking misaligns (gather + warn below)
+    if n_s is not None and n_dim % axis_size(mesh, n_s) == 0:
+        arg_sh = (rep, NamedSharding(mesh, P(None, n_s)),
+                  NamedSharding(mesh, P(None, None, n_s)))
+        return mesh, _w8a8_tp_body, NamedSharding(mesh, P(None, n_s)), arg_sh
+    if k_s is not None and kg_blocks % axis_size(mesh, k_s) == 0:
+        arg_sh = (NamedSharding(mesh, P(None, k_s)),
+                  NamedSharding(mesh, P(k_s, None)),
+                  NamedSharding(mesh, P(k_s, None, None)))
+
+        def lower(x2d, qk, kscale3):
+            # f32 partials: each shard rounds once AFTER the full local K
+            # reduction, and the psum itself runs in f32 — matching the
+            # unsharded kernel's single-rounding accumulation
+            part = _w8a8_local(x2d, qk, kscale3, out_dtype=jnp.float32)
+            return jax.lax.psum(part, k_s).astype(x2d.dtype)
+
+        return mesh, lower, rep, arg_sh
+    if k_s is not None or n_s is not None:
+        # an aligned sharding was suggested but the shard slices would split
+        # k-groups: correctness demands a gathered lowering.  This defeats
+        # the TP memory goal for THIS weight, so say so (once per shape —
+        # the partition callback refires on every retrace) instead of
+        # silently eating the gather.
+        from ..utils.logging import warning_once
+
+        warning_once(
+            f"w8a8 weight [{k_dim}, {n_dim}] (K/G={kg_blocks}) cannot "
+            f"shard over spec ({k_s}, {n_s}) without splitting quant "
+            f"groups — this matmul runs GATHERED on every device; pick a "
+            f"k_group-aligned tp degree to keep it sharded")
+    return mesh, _w8a8_tp_body, rep, (rep, rep, rep)
+
+
+from jax.experimental.custom_partitioning import custom_partitioning  # noqa: E402
+
+def _w8a8_tp_body(x2d, qk, kscale3):
+    # 3-arg body for custom_partitioning: the wrapper derives its operand
+    # arity from the signature, so _w8a8_local's block_k/out_dtype knobs
+    # must not leak into it
+    return _w8a8_local(x2d, qk, kscale3)
+
+
+#: GSPMD/shardy-aware entry: same math as :func:`_w8a8_local`, but the
+#: partitioner is told how to run it sharded instead of gathering the weight
+#: (which would defeat TP serving).  The k factor is declared a reduction so
+#: shardy's propagation knows a K-sharded weight still yields a full [B, N]
+#: result; the partition lowering owns the actual psum.
+_w8a8_tp_call = custom_partitioning(_w8a8_tp_body)
+_w8a8_tp_call.def_partition(
+    partition=_w8a8_partition,
+    infer_sharding_from_operands=_w8a8_infer_sharding,
+    propagate_user_sharding=lambda mesh, user_shape: user_shape.sharding,
+    sharding_rule="b k, k n, s u n -> b n",
+    reduction_factors=("k", "s"),
+    need_replication_factors=("b", "u"),
+)
+
+
 def w8a8_matmul(x, rec: dict, out_dtype=None, *, block_k: int = None,
                 max_rows: int = 8):
     """``x @ dequant_k(rec)`` on the s8 MXU with in-kernel activation
@@ -292,22 +419,23 @@ def w8a8_matmul(x, rec: dict, out_dtype=None, *, block_k: int = None,
     rows = 1
     for d in lead:
         rows *= d
-    if block_k is None:
-        step_bytes = int(float(os.environ.get("DS_QMM_STEP_MB", 4)) * 2**20)
-        block_k = max(1, step_bytes // max(n_dim, 1))
-    bk = _pick_block(k_dim, k_group, block_k, k_group)
-    eligible = (
-        _KERNEL_OK
-        and os.environ.get("DS_W8A8", "1") != "0"
-        and qk.ndim == 2
-        and rows <= max_rows
-        and n_dim % 128 == 0
-        and bk > 0
-    )
-    if not eligible:
-        return x @ quant.dequantize_k(rec, x.dtype)
-    out_dtype = out_dtype or x.dtype
-    x2d = x.reshape(rows, k_dim)
-    out = _w8a8_call(x2d, qk, kscale.reshape(k_dim // k_group, 1, n_dim),
-                     out_dtype, bk, _use_interpret())
-    return out.reshape(lead + (n_dim,))
+    if (qk.ndim == 2 and rows <= max_rows
+            and os.environ.get("DS_W8A8", "1") != "0"):
+        x2d = x.reshape(rows, k_dim)
+        kscale3 = kscale.reshape(k_dim // k_group, 1, n_dim)
+        if _W8A8_TP:
+            # tensor-parallel serving: the custom_partitioning wrapper
+            # keeps the weight sharded (column: per-shard s8 kernel, no
+            # comm; row: f32 local partial + psum); per-shard kernel
+            # ineligibility degrades to a SHARDED dequant+matmul.  Only a
+            # sharding that would split quant groups forces a gathered
+            # lowering (warned in _w8a8_partition).  Block sizing is
+            # per-shard inside the lowering; ``block_k`` is not threaded.
+            out = _w8a8_tp_call(x2d, qk, kscale3)
+        elif _KERNEL_OK:
+            out = _w8a8_local(x2d, qk, kscale3, block_k=block_k,
+                              out_dtype=out_dtype)
+        else:
+            return x @ quant.dequantize_k(rec, x.dtype)
+        return out.astype(out_dtype or x.dtype).reshape(lead + (n_dim,))
+    return x @ quant.dequantize_k(rec, x.dtype)
