@@ -46,6 +46,18 @@ def main():
                     help="initialize params on host CPU (required for "
                          "multi-billion models: on-device init materializes "
                          "an f32 copy that can exceed HBM)")
+    ap.add_argument("--host-init-bf16", action="store_true",
+                    help="random bf16 host init built leaf-by-leaf with "
+                         "numpy (no f32 jit tree: OPT-30B f32 is 120GB — "
+                         "this peaks at the bf16 tree instead; weight "
+                         "VALUES are random, for serving-throughput "
+                         "measurement only)")
+    ap.add_argument("--zero-inference", action="store_true",
+                    help="ZeRO-Inference streamed serving: blocks stay "
+                         "host-resident and stream per layer "
+                         "(inference/zero_inference.py)")
+    ap.add_argument("--pin-layers", type=int, default=0)
+    ap.add_argument("--prefetch", type=int, default=1)
     args = ap.parse_args()
 
     import jax
@@ -63,12 +75,36 @@ def main():
             params = jax.jit(model.init_fn, backend="cpu")(
                 jax.random.PRNGKey(0))
         params = jax.device_get(params)
+    elif args.host_init_bf16 and not args.hf_dir:
+        import jax.numpy as jnp
+
+        abstract = jax.eval_shape(model.init_fn, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        bf16 = np.dtype(jnp.bfloat16)
+
+        def mk(x):
+            if not jnp.issubdtype(x.dtype, jnp.floating):
+                return np.zeros(x.shape, x.dtype)
+            out = np.empty(x.shape, bf16)
+            flat = out.reshape(-1)
+            step = 1 << 24
+            for i in range(0, flat.size, step):
+                n = min(step, flat.size - i)
+                flat[i:i + n] = (0.02 * rng.standard_normal(
+                    n, dtype=np.float32)).astype(bf16)
+            return out
+
+        params = jax.tree_util.tree_map(mk, abstract)
     engine = deepspeed_tpu.init_inference(
         model=model, params=params,
         config={"dtype": args.dtype,
                 "tensor_parallel": {"tp_size": args.tp},
+                "zero_inference": {"enabled": args.zero_inference,
+                                   "pin_layers": args.pin_layers,
+                                   "prefetch": args.prefetch},
                 "quant": {"enabled": args.int8 or args.w8a8,
                           "type": "w8a8" if args.w8a8 else "weight"}})
+    params = None  # free the host dense tree (13B f32 = 51GB) for serving
 
     rng = np.random.default_rng(0)
     vocab = 1000  # prompt token range; any real vocab exceeds this

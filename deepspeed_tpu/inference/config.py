@@ -44,6 +44,32 @@ class QuantizationConfig(DeepSpeedConfigModel):
     # s8 MXU for decode (reference analog: MoQ weight+activation INT8);
     # requires a quant-aware model with stacked blocks.
     type: str = "weight"
+    # w8a8 group-size alignment target: quant groups are refined so a
+    # row-parallel K shard over this many devices never splits a group
+    # (ops/quantization.pick_k_group).  None = the engine's tp degree.
+    # Pin it (e.g. to the largest tp you'll serve) to get bit-identical
+    # weight records across tp degrees.
+    shard_multiple: Optional[int] = None
+
+
+class ZeroInferenceConfig(DeepSpeedConfigModel):
+    """ZeRO-Inference analog (reference: zero stage-3 ``offload_param`` to
+    CPU driving inference-only forwards — the OPT-30B-on-one-GPU
+    configuration of BASELINE.md): the stacked transformer blocks stay
+    HOST-resident and stream through HBM one layer at a time during
+    prefill/decode, so the servable model size is bounded by host DRAM,
+    not device HBM.  Large batches amortize the per-step weight traffic
+    (the reference's throughput recipe).  See
+    inference/zero_inference.py."""
+    enabled: bool = False
+    #: first N layers stay device-resident (use spare HBM to cut traffic)
+    pin_layers: int = 0
+    #: host->device transfers issued ahead of compute (double buffering)
+    prefetch: int = 1
+    #: dispatch-throttle period, in layers: every N layers the host waits
+    #: on a 1-element activation fetch so in-flight transfers stay
+    #: bounded instead of racing the whole model into HBM
+    sync_every: int = 1
 
 
 class InferenceCheckpointConfig(DeepSpeedConfigModel):
@@ -63,6 +89,8 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     triangular_masking: bool = True
     moe: DeepSpeedMoEConfig = Field(default_factory=DeepSpeedMoEConfig)
     quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
+    zero_inference: ZeroInferenceConfig = Field(
+        default_factory=ZeroInferenceConfig)
     checkpoint: Optional[Any] = None
     base_dir: str = ""
     max_tokens: int = Field(1024, alias="max_out_tokens")

@@ -24,10 +24,48 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import comm as dist
 from ..parallel.topology import MeshTopology
-from ..runtime.engine import _cast_floating
 from ..runtime.model import ModelSpec
 from ..utils.logging import log_dist
 from .config import DeepSpeedInferenceConfig
+
+
+def _auto_seed(obj, seed):
+    """Fresh draws per call (HF generate uses a stateful RNG); pass an
+    explicit seed for reproducibility.  Shared by the resident and
+    streamed (zero_inference) generate paths."""
+    if seed is not None:
+        return seed
+    obj._sample_calls = getattr(obj, "_sample_calls", -1) + 1
+    return obj._sample_calls
+
+
+def _fill_after_eos(out, prompt_len, eos_token_id):
+    """Back-fill everything after the first eos with eos (HF padding
+    semantics).  Shared by the resident and streamed generate paths."""
+    if eos_token_id is not None:
+        for row in range(out.shape[0]):
+            hits = np.where(out[row, prompt_len:] == eos_token_id)[0]
+            if hits.size:
+                out[row, prompt_len + hits[0] + 1:] = eos_token_id
+    return out
+
+
+def _cast_floating_skip_records(tree, dtype):
+    """Cast float leaves to the serving dtype, leaving quantization
+    records intact: PRE-QUANTIZED param trees (a quantized checkpoint, or
+    a host tree quantized offline at 30B scale) must keep int8 payloads
+    and f32 scales — the w8a8 kernel consumes f32 scales, and casting
+    them to bf16 would silently degrade every matmul."""
+    from ..ops import quantization as quant
+
+    def cast(x):
+        if quant.is_record(x):
+            return x
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree, is_leaf=quant.is_record)
 
 
 class InferenceEngine:
@@ -58,7 +96,7 @@ class InferenceEngine:
         if params is None:
             # init_fn: immune to a user-held OnDevice('meta') context
             params = model.init_fn(jax.random.PRNGKey(0))
-        params = _cast_floating(params, config.jnp_dtype)
+        params = _cast_floating_skip_records(params, config.jnp_dtype)
         tp_specs = model.tp_rules(jax.eval_shape(lambda: params)) \
             if model.tp_rules else None
         rep = NamedSharding(self.mesh, P())
@@ -120,31 +158,63 @@ class InferenceEngine:
             # they'd otherwise pass the weight-matrix shape tests
             if w8a8:
                 kg = max(128, int(config.quant.group_size))
+                # group sizes refine so row-parallel K shards never split a
+                # quant group — otherwise _w8a8_partition must gather the
+                # weight (e.g. OPT-2.7B K=2560 at tp=8: g=128 -> 20 groups,
+                # 20 % 8 != 0 -> gathered; g=80 -> 32 groups, sharded).
+                # With the degree DERIVED from tp, only K-sharded leaves
+                # refine (spec-aware: finer groups cost scale storage +
+                # kernel trip count, pointless on column shards); an
+                # EXPLICIT quant.shard_multiple refines uniformly so the
+                # records stay bit-identical across tp degrees.
+                sm = config.quant.shard_multiple or tp
+                spec_aware = config.quant.shard_multiple is None and tp > 1
 
-                def _quantize(tree, min_ndim):
+                def _quantize(tree, min_ndim, specs=None):
                     return quant.quantize_pytree_k_grouped(
-                        tree, k_group=kg, min_ndim=min_ndim)
+                        tree, k_group=kg, min_ndim=min_ndim,
+                        shard_multiple=sm,
+                        spec_tree=specs if spec_aware else None)
             else:
-                def _quantize(tree, min_ndim):
+                def _quantize(tree, min_ndim, specs=None):
                     return quant.quantize_pytree(
                         tree, num_bits=config.quant.num_bits,
                         group_size=config.quant.group_size,
                         min_ndim=min_ndim)
+            prequantized = any(
+                quant.is_record(leaf) for leaf in jax.tree_util.tree_leaves(
+                    params, is_leaf=quant.is_record))
+            if prequantized:
+                # pre-quantized tree (quantized checkpoint / offline host
+                # quantization): records pass through untouched — the kind
+                # must match the configured quant type
+                kinds = {
+                    "w8a8" if quant.is_k_quantized(leaf) else "weight"
+                    for leaf in jax.tree_util.tree_leaves(
+                        params, is_leaf=quant.is_record)
+                    if quant.is_record(leaf)}
+                if kinds != {config.quant.type}:
+                    raise ValueError(
+                        f"pre-quantized params carry {sorted(kinds)} records "
+                        f"but quant.type is {config.quant.type!r}")
+                log_dist("quant: params arrived pre-quantized — skipping "
+                         "host-side quantization", ranks=[0])
             with jax.default_device(jax.local_devices(backend="cpu")[0]):
-                if bkey is not None:
+                if prequantized:
+                    pass
+                elif bkey is not None:
                     path = (bkey,) if isinstance(bkey, str) else tuple(bkey)
-                    node = params
+                    node, snode = params, shardings
                     for k in path[:-1]:
-                        node = node[k]
-                    node[path[-1]] = _quantize(node[path[-1]], min_ndim=3)
+                        node, snode = node[k], snode[k]
+                    node[path[-1]] = _quantize(node[path[-1]], min_ndim=3,
+                                               specs=snode[path[-1]])
                 else:
-                    params = _quantize(params, min_ndim=2)
+                    params = _quantize(params, min_ndim=2, specs=shardings)
             params = jax.device_get(params)
-            def _is_rec(x):
-                return quant.is_quantized(x) or quant.is_k_quantized(x)
 
             def _rec_shardings(x, s):
-                if not _is_rec(x):
+                if not quant.is_record(x):
                     return s
                 out = {}
                 for k in x:
@@ -169,7 +239,7 @@ class InferenceEngine:
                 return out
 
             shardings = jax.tree_util.tree_map(
-                _rec_shardings, params, shardings, is_leaf=_is_rec)
+                _rec_shardings, params, shardings, is_leaf=quant.is_record)
             if model.quant_aware:
                 self._prepare = lambda p: p
             else:
@@ -181,6 +251,9 @@ class InferenceEngine:
                     p, config.jnp_dtype)
         else:
             self._prepare = lambda p: p
+        self._streamed = None
+        if config.zero_inference.enabled:
+            params, shardings = self._init_zero_inference(params, shardings)
         self.params = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, s), params, shardings)
 
@@ -188,12 +261,62 @@ class InferenceEngine:
         self._forward_fn = jax.jit(
             lambda p, batch: model.apply_fn(prepare(p), batch, None))
         self._generate_fns: Dict[Any, Any] = {}
+        if self._streamed is not None:
+            self._streamed.resident = self.params
         log_dist(f"InferenceEngine: mesh={self.topology}, dtype={config.dtype}",
                  ranks=[0])
+
+    def _init_zero_inference(self, params, shardings):
+        """ZeRO-Inference mode (inference/zero_inference.py): pull the
+        stacked blocks OUT of the device tree — they stay host-resident
+        (int8 records when quantized) and stream per layer during
+        generate.  Returns the resident (params, shardings) to place."""
+        from .zero_inference import StreamedGenerator
+
+        model, config = self.module, self._config
+        zi = config.zero_inference
+        if model.stream_hooks is None or model.decode_hooks is None:
+            raise ValueError(
+                f"zero_inference needs a model with stream_hooks + "
+                f"decode_hooks; {model.name} has neither — serve it "
+                "resident or add the per-layer hooks")
+        if self.topology.tensor_parallel_size > 1:
+            raise ValueError(
+                "zero_inference streams layers through ONE device's HBM; "
+                "combine with tp later or drop tensor_parallel")
+        hooks = getattr(model, "pipeline_hooks", None) or {}
+        bkey = hooks.get("blocks_key")
+        if bkey is None:
+            raise ValueError(
+                f"zero_inference needs pipeline_hooks.blocks_key on "
+                f"{model.name} to locate the stacked blocks")
+        path = (bkey,) if isinstance(bkey, str) else tuple(bkey)
+        params = jax.device_get(params)
+        node, snode = params, shardings
+        for k in path[:-1]:
+            node, snode = node[k], snode[k]
+        host_blocks = node.pop(path[-1])
+        snode.pop(path[-1])
+        num_layers = jax.tree_util.tree_leaves(host_blocks)[0].shape[0]
+        self._streamed = StreamedGenerator(
+            resident_params=None,  # set after device_put of the residents
+            host_blocks=host_blocks, num_layers=num_layers,
+            stream_hooks=model.stream_hooks,
+            init_cache=model.decode_hooks["init_cache"],
+            cache_dtype=config.jnp_dtype,
+            pin_layers=zi.pin_layers, prefetch=zi.prefetch,
+            sync_every=zi.sync_every,
+            picker_factory=_make_token_picker)
+        return params, shardings
 
     # ------------------------------------------------------------------ forward
     def forward(self, batch):
         """Logits for a batch (reference ``inference/engine.py:541``)."""
+        if self._streamed is not None:
+            raise NotImplementedError(
+                "zero_inference serves via generate(); whole-batch logits "
+                "would stream every layer for one forward — run a resident "
+                "engine (or generate with max_new_tokens=1) instead")
         batch = self._put_batch(batch)
         return self._forward_fn(self.params, batch)
 
@@ -228,6 +351,12 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
                 f"= {total} exceeds the model context length {max_ctx}")
+        if self._streamed is not None:
+            return self._streamed.generate(
+                input_ids, max_new_tokens=max_new_tokens,
+                eos_token_id=eos_token_id, do_sample=do_sample,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                seed=seed)
         sample_cfg = (do_sample, float(temperature), int(top_k),
                       float(top_p)) if do_sample else None
         key = (b, prompt_len, max_new_tokens, sample_cfg)
@@ -240,20 +369,10 @@ class InferenceEngine:
             else:
                 self._generate_fns[key] = self._build_recompute_gen(
                     b, prompt_len, total, sample_cfg)
-        if seed is None:
-            # fresh draws per call (HF generate uses a stateful RNG); pass
-            # an explicit seed for reproducibility
-            self._sample_calls = getattr(self, "_sample_calls", -1) + 1
-            seed = self._sample_calls
-        rng = jax.random.PRNGKey(seed)
+        rng = jax.random.PRNGKey(_auto_seed(self, seed))
         out = self._generate_fns[key](self.params, jnp.asarray(input_ids), rng)
         out = np.array(out)  # writable host copy (np.asarray view is read-only)
-        if eos_token_id is not None:
-            for row in range(b):
-                hits = np.where(out[row, prompt_len:] == eos_token_id)[0]
-                if hits.size:
-                    out[row, prompt_len + hits[0] + 1:] = eos_token_id
-        return out
+        return _fill_after_eos(out, prompt_len, eos_token_id)
 
     def _build_recompute_gen(self, b, prompt_len, total, sample_cfg=None):
         """Full-recompute fallback for models without decode hooks."""

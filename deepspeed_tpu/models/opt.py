@@ -485,12 +485,30 @@ def build(cfg: Optional[OPTConfig] = None, **overrides) -> ModelSpec:
         "max_seq_len": cfg.max_seq_len,
     }
 
+    def _stream_embed(params, ids, pos):
+        from .gpt2 import _dequant_resident
+
+        return _embed(cfg, _dequant_resident(params), ids, pos0=pos)
+
+    def _stream_head(params, x_last):
+        from .gpt2 import _dequant_resident
+
+        return _head(cfg, _dequant_resident(params), x_last)
+
+    stream_hooks = {
+        "embed": _stream_embed,
+        "block": lambda layer, x, ck, cv, pos: _block_cached(
+            cfg, x, layer, ck, cv, pos),
+        "head": _stream_head,
+    }
+
     return ModelSpec(
         init_fn=init_fn, model_config=cfg, loss_fn=loss_fn, apply_fn=apply_fn,
                      tp_rules=lambda ap: tp_rules(cfg, ap),
                      flops_per_token=6.0 * cfg.num_params(),
                      pipeline_hooks=pipeline_hooks,
                      decode_hooks=decode_hooks,
+                     stream_hooks=stream_hooks,
                      quant_aware=True,  # per-layer point-of-use dequant
                      name=f"opt-{cfg.num_layers}l-{cfg.hidden_size}d")
 

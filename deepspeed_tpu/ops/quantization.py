@@ -151,13 +151,79 @@ def is_k_quantized(leaf) -> bool:
     return isinstance(leaf, dict) and _QK_KEYS == set(leaf)
 
 
+def is_record(leaf) -> bool:
+    """True for ANY quantization record kind (N-grouped weight-only or
+    K-grouped w8a8) — the tree-traversal ``is_leaf`` predicate."""
+    return is_quantized(leaf) or is_k_quantized(leaf)
+
+
+def pick_k_group(k_dim: int, cap: int, shard_multiple: int = 1) -> int:
+    """Largest k-chunk size ``g <= cap`` (multiple of 8 — the TPU sublane
+    quantum of the in-kernel ``[.., g]`` activation tiles) with
+    ``k_dim % g == 0``, and — when the contraction dim divides over
+    ``shard_multiple`` row-parallel TP shards — whole groups on every
+    shard (``(k_dim // g) % shard_multiple == 0``), so a K sharding never
+    splits a quant group and the custom_partitioning lowering
+    (ops/quantized_matmul._w8a8_partition) stays sharded instead of
+    gathering the weight.  Returns 0 when no such ``g`` exists."""
+    need_align = shard_multiple > 1 and k_dim % shard_multiple == 0
+    g = (min(cap, k_dim) // 8) * 8
+    while g >= 8:
+        if k_dim % g == 0 and (
+                not need_align or (k_dim // g) % shard_multiple == 0):
+            return g
+        g -= 8
+    return 0
+
+
+_HOST_QUANT_CHUNK_BYTES = 1 << 30
+
+
+def _quantize_k_grouped_np(w, k_group: int) -> dict:
+    """Chunked pure-numpy K-grouped quantization for HOST arrays.
+
+    Multi-billion-param host trees must not run the eager jnp pipeline:
+    every elementwise op materializes a full f32 copy (a stacked OPT-13B
+    fc leaf is 16.8GB — the chain of astype/div/round/clip OOM-killed a
+    125GB host).  Slices of the leading dim bound the transient to
+    ~chunk-size; outputs write into preallocated arrays."""
+    shape = w.shape
+    k_dim, n_dim = shape[-2], shape[-1]
+    lead = int(np.prod(shape[:-2], dtype=np.int64)) if len(shape) > 2 else 1
+    w3 = np.asarray(w).reshape(lead, k_dim, n_dim)
+    qk = np.empty((lead, k_dim, n_dim), np.int8)
+    scale = np.empty((lead, k_dim // k_group, 1, n_dim), np.float32)
+    step = max(1, _HOST_QUANT_CHUNK_BYTES //
+               max(k_dim * n_dim * 4, 1))
+    for i in range(0, lead, step):
+        # astype always copies: the in-place divide/round below must
+        # never write through a view into the caller's weights
+        g = w3[i:i + step].astype(np.float32).reshape(
+            -1, k_dim // k_group, k_group, n_dim)
+        amax = np.max(np.abs(g), axis=-2, keepdims=True)
+        s = np.where(amax == 0, np.float32(1.0),
+                     amax / np.float32(127.0)).astype(np.float32)
+        np.divide(g, s, out=g)
+        np.round(g, out=g)
+        np.clip(g, -127, 127, out=g)
+        qk[i:i + step] = g.astype(np.int8).reshape(-1, k_dim, n_dim)
+        scale[i:i + step] = s
+    return {"qk": qk.reshape(shape),
+            "kscale": scale.reshape(shape[:-2] +
+                                    (k_dim // k_group, 1, n_dim))}
+
+
 def quantize_k_grouped(w, k_group: int = 256) -> dict:
     """w: [..., K, N] float, K divisible by ``k_group`` ->
     ``{"qk": int8 (w.shape), "kscale": f32 [..., K/G, 1, N]}`` (the
-    middle 1 keeps every kscale block lane-legal in Pallas)."""
+    middle 1 keeps every kscale block lane-legal in Pallas).  Host
+    (numpy) inputs above ~1GB take a chunked numpy path that bounds the
+    transient working set (see :func:`_quantize_k_grouped_np`)."""
     shape = w.shape
     k_dim, n_dim = shape[-2], shape[-1]
     assert k_dim % k_group == 0, (shape, k_group)
+    if isinstance(w, np.ndarray) and w.nbytes >= _HOST_QUANT_CHUNK_BYTES:
+        return _quantize_k_grouped_np(w, k_group)
     g = w.astype(jnp.float32).reshape(
         shape[:-2] + (k_dim // k_group, k_group, n_dim))
     amax = jnp.max(jnp.abs(g), axis=-2, keepdims=True)   # [.., K/G, 1, N]
@@ -176,21 +242,52 @@ def dequantize_k(rec: dict, dtype=jnp.bfloat16):
     return (g * scale).reshape(shape).astype(dtype)
 
 
+def _k_dim_sharded(sharding, ndim: int) -> bool:
+    """True when a (Named)Sharding places a mesh axis on a ``[..., K, N]``
+    leaf's contraction dim — the row-parallel layout whose shards must
+    hold whole quant groups."""
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return False
+    spec = tuple(spec) + (None,) * (ndim - len(tuple(spec)))
+    return ndim >= 2 and spec[ndim - 2] is not None
+
+
 def quantize_pytree_k_grouped(params: PyTree, k_group: int = 256,
                               min_size: int = 4096,
-                              min_ndim: int = 2) -> PyTree:
+                              min_ndim: int = 2,
+                              shard_multiple: int = 1,
+                              spec_tree: PyTree = None) -> PyTree:
     """W8A8 variant of :func:`quantize_pytree`: same weight-matrix
     selection rules (incl. ``min_ndim=3`` for stacked-blocks subtrees),
     K-grouped records; leaves whose K doesn't divide ``k_group`` stay
-    dense."""
-    def one(path, x):
+    dense (selection is independent of ``shard_multiple`` so every tp
+    degree quantizes the same leaf set).  ``shard_multiple`` (the serving
+    tp degree) refines the group SIZE of eligible leaves when the default
+    would split quant groups across row-parallel shards: e.g. OPT-2.7B
+    (K=2560, K/128=20 groups) under tp=8 quantizes at g=80 (32 groups,
+    :func:`pick_k_group`) and stays TP-sharded instead of gathering.
+    Finer groups only tighten the quantization error — but they also grow
+    the f32 scale storage and the decode kernel's per-block trip count,
+    so with ``spec_tree`` (a matching tree of shardings) only leaves whose
+    K dim is actually sharded refine; N-sharded and replicated leaves
+    keep the cap.  Without ``spec_tree`` the refinement is uniform, which
+    keeps records bit-identical across tp degrees (the
+    ``quant.shard_multiple`` pinning contract, inference/config.py)."""
+    def one(path, x, sharding=None):
         if (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
                 and getattr(x, "ndim", 0) >= min_ndim
                 and getattr(x, "ndim", 0) >= 2 and x.size >= min_size
                 and x.shape[-2] % k_group == 0
                 and x.shape[-1] % 128 == 0
                 and not _is_norm_path(path)):
-            return quantize_k_grouped(x, k_group)
+            sm = shard_multiple
+            if spec_tree is not None and not _k_dim_sharded(sharding, x.ndim):
+                sm = 1
+            g = pick_k_group(x.shape[-2], k_group, sm) or k_group
+            return quantize_k_grouped(x, g)
         return x
 
+    if spec_tree is not None:
+        return jax.tree_util.tree_map_with_path(one, params, spec_tree)
     return jax.tree_util.tree_map_with_path(one, params)

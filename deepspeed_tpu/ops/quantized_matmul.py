@@ -308,16 +308,7 @@ def _w8a8_local(x2d, qk, kscale3, block_k=None, out_dtype=None):
     return jax.lax.dot(x2d, deq, preferred_element_type=out_dtype)
 
 
-def axis_size(mesh, axes) -> int:
-    """Product of the mesh sizes of ``axes`` (a PartitionSpec entry: None,
-    an axis name, or a tuple of names; absent axes count as 1)."""
-    if axes is None:
-        return 1
-    names = axes if isinstance(axes, tuple) else (axes,)
-    size = 1
-    for name in names:
-        size *= mesh.shape.get(name, 1)
-    return size
+from ..utils.sharding import axis_size  # noqa: E402  (shared helper)
 
 
 def _qk_spec(arg_shapes):
@@ -326,11 +317,19 @@ def _qk_spec(arg_shapes):
     return (spec + (None, None))[:2]
 
 
+def _b_spec(arg_shapes, *exclude):
+    """Batch-dim sharding of the x operand, dropped when it collides with
+    an axis the weight layout already uses."""
+    spec = getattr(arg_shapes[0].sharding, "spec", None)
+    b_s = tuple(spec)[0] if spec else None
+    return None if b_s in exclude else b_s
+
+
 def _w8a8_infer_sharding(mesh, arg_shapes, result_shape):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     _, n_s = _qk_spec(arg_shapes)
-    return NamedSharding(mesh, P(None, n_s) if n_s is not None else P())
+    return NamedSharding(mesh, P(_b_spec(arg_shapes, n_s), n_s))
 
 
 def _w8a8_partition(mesh, arg_shapes, result_shape):
@@ -340,16 +339,26 @@ def _w8a8_partition(mesh, arg_shapes, result_shape):
     k_dim, n_dim = arg_shapes[1].shape
     kg_blocks = arg_shapes[2].shape[0]
     rep = NamedSharding(mesh, P())
-    # column shards never split quant groups (scales are per-column), so any
+    # both lowerings return f32: the single value-rounding cast to the
+    # caller's out_dtype happens OUTSIDE the custom_partitioning call
+    # (w8a8_matmul), so tp=N matches the unsharded kernel's
+    # accumulate-in-f32-round-once numerics for every out_dtype.  The
+    # batch dim keeps the x operand's sharding (dp serving: each replica
+    # computes only its batch slice).
+    # Column shards never split quant groups (scales are per-column), so any
     # even N split is exact — shards whose local N is off-lane just run the
     # sharded dequant+dot inside _w8a8_local; K shards must keep whole
     # k-groups or the record's chunking misaligns (gather + warn below)
     if n_s is not None and n_dim % axis_size(mesh, n_s) == 0:
-        arg_sh = (rep, NamedSharding(mesh, P(None, n_s)),
+        b_s = _b_spec(arg_shapes, n_s)
+        arg_sh = (NamedSharding(mesh, P(b_s, None)),
+                  NamedSharding(mesh, P(None, n_s)),
                   NamedSharding(mesh, P(None, None, n_s)))
-        return mesh, _w8a8_tp_body, NamedSharding(mesh, P(None, n_s)), arg_sh
+        return (mesh, _w8a8_tp_body,
+                NamedSharding(mesh, P(b_s, n_s)), arg_sh)
     if k_s is not None and kg_blocks % axis_size(mesh, k_s) == 0:
-        arg_sh = (NamedSharding(mesh, P(None, k_s)),
+        b_s = _b_spec(arg_shapes, k_s)
+        arg_sh = (NamedSharding(mesh, P(b_s, k_s)),
                   NamedSharding(mesh, P(k_s, None)),
                   NamedSharding(mesh, P(k_s, None, None)))
 
@@ -358,9 +367,9 @@ def _w8a8_partition(mesh, arg_shapes, result_shape):
             # reduction, and the psum itself runs in f32 — matching the
             # unsharded kernel's single-rounding accumulation
             part = _w8a8_local(x2d, qk, kscale3, out_dtype=jnp.float32)
-            return jax.lax.psum(part, k_s).astype(x2d.dtype)
+            return jax.lax.psum(part, k_s)
 
-        return mesh, lower, rep, arg_sh
+        return mesh, lower, NamedSharding(mesh, P(b_s, None)), arg_sh
     if k_s is not None or n_s is not None:
         # an aligned sharding was suggested but the shard slices would split
         # k-groups: correctness demands a gathered lowering.  This defeats
@@ -374,7 +383,9 @@ def _w8a8_partition(mesh, arg_shapes, result_shape):
             f"shard over spec ({k_s}, {n_s}) without splitting quant "
             f"groups — this matmul runs GATHERED on every device; pick a "
             f"k_group-aligned tp degree to keep it sharded")
-    return mesh, _w8a8_tp_body, rep, (rep, rep, rep)
+    b_s = _b_spec(arg_shapes, k_s, n_s)
+    return (mesh, _w8a8_tp_body, NamedSharding(mesh, P(b_s, None)),
+            (NamedSharding(mesh, P(b_s, None)), rep, rep))
 
 
 from jax.experimental.custom_partitioning import custom_partitioning  # noqa: E402
@@ -382,8 +393,12 @@ from jax.experimental.custom_partitioning import custom_partitioning  # noqa: E4
 def _w8a8_tp_body(x2d, qk, kscale3):
     # 3-arg body for custom_partitioning: the wrapper derives its operand
     # arity from the signature, so _w8a8_local's block_k/out_dtype knobs
-    # must not leak into it
-    return _w8a8_local(x2d, qk, kscale3)
+    # must not leak into it.  Always f32 out — every lowering (replicated,
+    # column, row+psum) then carries the full accumulator precision and
+    # w8a8_matmul's single outer cast supplies the caller's out_dtype,
+    # keeping tp=N bit-compatible with the unsharded kernel's
+    # round-once-from-f32 result for out_dtypes wider than x.dtype.
+    return _w8a8_local(x2d, qk, kscale3, out_dtype=jnp.float32)
 
 
 #: GSPMD/shardy-aware entry: same math as :func:`_w8a8_local`, but the
@@ -398,7 +413,7 @@ _w8a8_tp_call.def_partition(
     propagate_user_sharding=lambda mesh, user_shape: user_shape.sharding,
     sharding_rule="b k, k n, s u n -> b n",
     reduction_factors=("k", "s"),
-    need_replication_factors=("b", "u"),
+    need_replication_factors=("u",),
 )
 
 

@@ -453,15 +453,7 @@ def sp_size() -> int:
     return comm.get_topology().sequence_parallel_size
 
 
-def _axis_size(mesh, axes) -> int:
-    if axes is None:
-        return 1
-    if isinstance(axes, str):
-        axes = (axes,)
-    size = 1
-    for a in axes:
-        size *= mesh.shape.get(a, 1)
-    return size
+from ..utils.sharding import axis_size as _axis_size  # noqa: E402
 
 
 def _qkvo_spec(mesh, q_shape, batch_axes, head_axis, sp_axis):

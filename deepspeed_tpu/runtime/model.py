@@ -55,6 +55,18 @@ class ModelSpec:
     #: passes the quantized pytree straight through — per-layer peak memory
     #: instead of a whole-tree dequantized copy.
     quant_aware: bool = False
+    #: Optional per-layer decode decomposition for ZeRO-Inference-style
+    #: weight streaming (inference/zero_inference.py) — serving models
+    #: whose weights exceed device HBM by keeping the stacked blocks
+    #: host-resident and streaming one layer at a time through the
+    #: KV-cache decode step (reference: ZeRO-Inference, zero stage-3
+    #: param offload driving inference-only forwards):
+    #:   embed(params, input_ids, pos)       -> activations [B, T, D]
+    #:   block(layer, x, ck, cv, pos)        -> (x, ck, cv)  (one layer,
+    #:       per-LAYER cache slices [B, H, S, hd])
+    #:   head(params, x_last)                -> last-position logits [B, V]
+    #: ``params`` is the RESIDENT tree (everything but the blocks).
+    stream_hooks: Optional[dict] = None
 
     def init(self, rng) -> PyTree:
         if _ON_DEVICE_STACK:

@@ -1,0 +1,16 @@
+"""Small shared sharding-math helpers (no jax import at module load)."""
+
+from __future__ import annotations
+
+
+def axis_size(mesh, axes) -> int:
+    """Product of the mesh sizes of ``axes`` (one PartitionSpec entry:
+    ``None``, an axis name, or a tuple of names; absent axes count as 1).
+    Trace-time python int."""
+    if axes is None:
+        return 1
+    names = axes if isinstance(axes, tuple) else (axes,)
+    size = 1
+    for name in names:
+        size *= mesh.shape.get(name, 1)
+    return size

@@ -301,9 +301,10 @@ def test_w8a8_tp_misaligned_shard_still_correct(_w8a8_tp):
 def test_w8a8_tp_engine_decode_parity():
     """init_inference(tp=2/4, w8a8) decodes the same tokens as tp=1 w8a8
     on a 128-aligned quant-aware OPT (the driver dryrun asserts the same
-    parity for the bf16 auto-TP path; this covers the quantized one).  At
-    tp=4 several weights fail the lane/quant-group alignment and take the
-    gathered lowering — the parity must hold across the mixed paths too."""
+    parity for the bf16 auto-TP path; this covers the quantized one).
+    ``shard_multiple: 4`` pins the group refinement so every tp degree
+    serves bit-identical weight records (hidden K=128 refines to g=32 —
+    whole groups on every row-parallel shard)."""
     import deepspeed_tpu
     from deepspeed_tpu.models import opt as opt_model
     from deepspeed_tpu.ops import quantized_matmul as qmm_mod
@@ -323,7 +324,8 @@ def test_w8a8_tp_engine_decode_parity():
                 model=opt_model.build(cfg), params=params,
                 config={"dtype": "float32",
                         "tensor_parallel": {"tp_size": tp},
-                        "quant": {"enabled": True, "type": "w8a8"}})
+                        "quant": {"enabled": True, "type": "w8a8",
+                                  "shard_multiple": 4}})
             outs[tp] = eng.generate(ids, max_new_tokens=4)
     finally:
         # engine init set the module gates (kernel_ok=False at tp=2);
@@ -332,3 +334,160 @@ def test_w8a8_tp_engine_decode_parity():
         deepspeed_tpu.comm.reset_topology()
     np.testing.assert_array_equal(outs[1], outs[2])
     np.testing.assert_array_equal(outs[1], outs[4])
+
+
+def test_w8a8_tp_engine_mixed_gathered_parity():
+    """``shard_multiple: 1`` pins g=128 so the hidden-K weights (o_w,
+    K=128 -> ONE quant group) cannot be K-sharded at tp=4: the engine's
+    kscale divisibility fallback replicates the scale tree and
+    _w8a8_partition takes the gathered-but-correct lowering for those
+    weights while the column-parallel ones stay sharded — the mixed-path
+    parity the refined default no longer exercises."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import opt as opt_model
+    from deepspeed_tpu.ops import quantized_matmul as qmm_mod
+
+    cfg = opt_model.OPTConfig(vocab_size=512, max_seq_len=64, num_layers=2,
+                              num_heads=2, hidden_size=128, ffn_size=512)
+    cpu = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu):
+        params = opt_model.build(cfg).init_fn(jax.random.PRNGKey(0))
+    params = jax.device_get(params)
+    ids = np.ones((1, 4), np.int32)
+    outs = {}
+    try:
+        for tp in (1, 4):
+            deepspeed_tpu.comm.reset_topology()
+            eng = deepspeed_tpu.init_inference(
+                model=opt_model.build(cfg), params=params,
+                config={"dtype": "float32",
+                        "tensor_parallel": {"tp_size": tp},
+                        "quant": {"enabled": True, "type": "w8a8",
+                                  "shard_multiple": 1}})
+            # unrefined: o_w keeps ONE group (the gathered case at tp=4)
+            assert eng.params["blocks"]["o_w"]["kscale"].shape[-3] == 1
+            outs[tp] = eng.generate(ids, max_new_tokens=4)
+    finally:
+        qmm_mod.configure(kernel_ok=True, w8a8_tp=False)
+        deepspeed_tpu.comm.reset_topology()
+    np.testing.assert_array_equal(outs[1], outs[4])
+
+
+def test_w8a8_engine_spec_aware_refinement():
+    """With shard_multiple DERIVED from tp (the default), only K-sharded
+    (row-parallel) weights refine: o_w (K=128, P(None, tp, None)) splits
+    into 4 groups of 32 so tp=4 shards hold whole groups; the
+    column-parallel qkv_w keeps the g=128 cap (refining it would buy
+    nothing and cost scale storage + kernel trip count)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import opt as opt_model
+    from deepspeed_tpu.ops import quantized_matmul as qmm_mod
+
+    cfg = opt_model.OPTConfig(vocab_size=512, max_seq_len=64, num_layers=2,
+                              num_heads=2, hidden_size=128, ffn_size=512)
+    cpu = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu):
+        params = opt_model.build(cfg).init_fn(jax.random.PRNGKey(0))
+    params = jax.device_get(params)
+    try:
+        deepspeed_tpu.comm.reset_topology()
+        eng = deepspeed_tpu.init_inference(
+            model=opt_model.build(cfg), params=params,
+            config={"dtype": "float32",
+                    "tensor_parallel": {"tp_size": 4},
+                    "quant": {"enabled": True, "type": "w8a8"}})
+        blocks = eng.params["blocks"]
+        assert blocks["o_w"]["kscale"].shape[-3] == 4      # g=32, K-sharded
+        assert blocks["proj_w"]["kscale"].shape[-3] == 4   # K=512, g=128 ok
+        assert blocks["qkv_w"]["kscale"].shape[-3] == 1    # column: cap
+        out = eng.generate(np.ones((1, 4), np.int32), max_new_tokens=4)
+        assert out.shape == (1, 8)
+    finally:
+        qmm_mod.configure(kernel_ok=True, w8a8_tp=False)
+        deepspeed_tpu.comm.reset_topology()
+
+
+def test_pick_k_group_alignment():
+    """pick_k_group refines groups so row-parallel shards hold whole
+    groups: OPT-2.7B's K=2560 has 20 groups at the g=128 cap (20 % 8 != 0
+    -> would gather at tp=8); g=80 gives 32 groups and stays sharded."""
+    assert quant.pick_k_group(2560, 128) == 128
+    assert quant.pick_k_group(2560, 128, shard_multiple=8) == 80
+    # already aligned: keep the cap
+    assert quant.pick_k_group(4096, 128, shard_multiple=8) == 128
+    # K=384: 3 groups at 128; tp=2 needs an even count -> g=96 (4 groups)
+    assert quant.pick_k_group(384, 128, shard_multiple=2) == 96
+    # K not divisible by the shard degree: no K sharding is possible
+    # anyway, so no refinement constraint applies
+    assert quant.pick_k_group(384, 128, shard_multiple=7) == 128
+    # nothing admissible (odd K)
+    assert quant.pick_k_group(2050, 128) == 0
+
+
+def test_w8a8_tp_refined_groups_stay_sharded(_w8a8_tp, monkeypatch):
+    """A K=384 weight refined to g=96 (shard_multiple=2) runs the ROW-
+    PARALLEL sharded lowering — no gathered-fallback warning — and matches
+    the unsharded kernel."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.ops import quantized_matmul as qmm_mod
+    from deepspeed_tpu.utils import logging as ds_logging
+
+    gathered = []
+    monkeypatch.setattr(ds_logging, "warning_once",
+                        lambda msg, *a, **k: gathered.append(msg))
+    g = quant.pick_k_group(384, 128, shard_multiple=2)
+    assert g == 96
+    x, rec = _mk_k_grouped(384, 256, g, rows=2)
+    qmm_mod.configure(kernel_ok=True, w8a8_tp=False)
+    ref = qmm_mod.w8a8_matmul(x, rec)
+    qmm_mod.configure(kernel_ok=True, w8a8_tp=True)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    qk = jax.device_put(rec["qk"], NamedSharding(mesh, P("tp", None)))
+    ks = jax.device_put(rec["kscale"], NamedSharding(mesh, P("tp", None, None)))
+    xs = jax.device_put(x, NamedSharding(mesh, P()))
+    out = jax.jit(
+        lambda a, b, c: qmm_mod.w8a8_matmul(a, {"qk": b, "kscale": c})
+    )(xs, qk, ks)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=1e-5, atol=1e-4)
+    assert not [m for m in gathered if "GATHERED" in m], gathered
+
+
+def test_quantize_k_grouped_host_chunked_matches_jnp(monkeypatch):
+    """The chunked numpy path (multi-billion host trees: bounds the
+    transient that OOM-killed a 125GB host on OPT-13B) must produce the
+    records of the jnp path bit-for-bit, without mutating the input."""
+    monkeypatch.setattr(quant, "_HOST_QUANT_CHUNK_BYTES", 1024)
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=(3, 64, 128)).astype(np.float32)
+    w_orig = w.copy()
+    rec_np = quant.quantize_k_grouped(w, k_group=32)       # numpy path
+    rec_jnp = quant.quantize_k_grouped(jnp.asarray(w), k_group=32)
+    assert isinstance(rec_np["qk"], np.ndarray)
+    np.testing.assert_array_equal(w, w_orig)
+    np.testing.assert_array_equal(rec_np["qk"], np.asarray(rec_jnp["qk"]))
+    np.testing.assert_array_equal(rec_np["kscale"],
+                                  np.asarray(rec_jnp["kscale"]))
+    # bf16 host leaves (the engine casts before quantizing) also go
+    # through the numpy path via ml_dtypes
+    wb = np.asarray(jax.device_get(jnp.asarray(w, jnp.bfloat16)))
+    rec_b = quant.quantize_k_grouped(wb, k_group=32)
+    rec_bj = quant.quantize_k_grouped(jnp.asarray(wb), k_group=32)
+    np.testing.assert_array_equal(rec_b["qk"], np.asarray(rec_bj["qk"]))
+
+
+def test_quantize_pytree_k_grouped_shard_multiple():
+    """Leaf SELECTION is shard_multiple-independent (every tp degree
+    quantizes the same leaves); only the group size refines."""
+    tree = {"w": jnp.ones((2560, 128)), "odd": jnp.ones((100, 128))}
+    base = quant.quantize_pytree_k_grouped(tree, k_group=128)
+    ref8 = quant.quantize_pytree_k_grouped(tree, k_group=128,
+                                           shard_multiple=8)
+    assert quant.is_k_quantized(base["w"]) and quant.is_k_quantized(ref8["w"])
+    assert base["w"]["kscale"].shape[0] == 20    # g=128
+    assert ref8["w"]["kscale"].shape[0] == 32    # g=80: 32 % 8 == 0
+    # ineligible leaf stays dense under every shard_multiple
+    assert not quant.is_k_quantized(base["odd"])
+    assert not quant.is_k_quantized(ref8["odd"])
