@@ -20,6 +20,7 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def percentile(xs, p):
@@ -76,25 +77,9 @@ def main():
                 jax.random.PRNGKey(0))
         params = jax.device_get(params)
     elif args.host_init_bf16 and not args.hf_dir:
-        import jax.numpy as jnp
+        from host_init import host_init_bf16
 
-        abstract = jax.eval_shape(model.init_fn, jax.random.PRNGKey(0))
-        rng = np.random.default_rng(0)
-        bf16 = np.dtype(jnp.bfloat16)
-
-        def mk(x):
-            if not jnp.issubdtype(x.dtype, jnp.floating):
-                return np.zeros(x.shape, x.dtype)
-            out = np.empty(x.shape, bf16)
-            flat = out.reshape(-1)
-            step = 1 << 24
-            for i in range(0, flat.size, step):
-                n = min(step, flat.size - i)
-                flat[i:i + n] = (0.02 * rng.standard_normal(
-                    n, dtype=np.float32)).astype(bf16)
-            return out
-
-        params = jax.tree_util.tree_map(mk, abstract)
+        params = host_init_bf16(model)
     engine = deepspeed_tpu.init_inference(
         model=model, params=params,
         config={"dtype": args.dtype,

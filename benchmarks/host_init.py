@@ -1,0 +1,31 @@
+"""Shared bench-side host initialization helpers."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def host_init_bf16(model, seed: int = 0):
+    """Leaf-by-leaf random bf16 host tree (no f32 jit tree — OPT-30B f32
+    is 120GB; this peaks at the 58GB bf16 tree).  Weight VALUES are
+    random: for serving-throughput measurement only."""
+    import jax
+    import jax.numpy as jnp
+
+    abstract = jax.eval_shape(model.init_fn, jax.random.PRNGKey(seed))
+    rng = np.random.default_rng(seed)
+    bf16 = np.dtype(jnp.bfloat16)
+
+    def mk(x):
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return np.zeros(x.shape, x.dtype)
+        out = np.empty(x.shape, bf16)
+        flat = out.reshape(-1)
+        step = 1 << 24
+        for i in range(0, flat.size, step):
+            n = min(step, flat.size - i)
+            flat[i:i + n] = (0.02 * rng.standard_normal(
+                n, dtype=np.float32)).astype(bf16)
+        return out
+
+    return jax.tree_util.tree_map(mk, abstract)

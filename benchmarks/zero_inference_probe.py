@@ -1,0 +1,81 @@
+"""One-shot ZeRO-Inference probe: serve a model BIGGER than device HBM.
+
+Unlike gpt_bench (which re-runs generate for percentile latency — each
+call re-streams the whole model), this times a SINGLE generate and
+reports per-phase numbers from ``StreamedGenerator.last_timings``, plus
+the implied host->device link bandwidth.  Use it to demonstrate e.g.
+OPT-30B (29GB int8) serving through a 16GB chip, and to calibrate the
+``tok/s ~= batch * link_GB_s / streamed_GB`` throughput model on the
+host you actually have (reference anchor: ZeRO-Inference OPT-30B at 43
+tok/s via PCIe, BASELINE.md).
+
+Usage:
+  python benchmarks/zero_inference_probe.py --model opt-30b --batch 8 \
+      --prompt 32 --steps 2 [--pin-layers N] [--prefetch 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from host_init import host_init_bf16  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="opt-30b")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompt", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=3,
+                    help="decode steps after the first token (the first "
+                         "is discarded as jit-compile warmup)")
+    ap.add_argument("--pin-layers", type=int, default=0)
+    ap.add_argument("--prefetch", type=int, default=2)
+    args = ap.parse_args()
+
+    import deepspeed_tpu
+
+    model = deepspeed_tpu.models.get_model(args.model)
+    params = host_init_bf16(model)
+    engine = deepspeed_tpu.init_inference(
+        model=model, params=params,
+        config={"dtype": "bfloat16",
+                "quant": {"enabled": True, "type": "w8a8"},
+                "zero_inference": {"enabled": True,
+                                   "pin_layers": args.pin_layers,
+                                   "prefetch": args.prefetch}})
+    params = None
+    sg = engine._streamed
+    streamed_bytes = sg.streamed_bytes
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(2, 1000, (args.batch, args.prompt)).astype(np.int32)
+    out = engine.generate(ids, max_new_tokens=1 + args.steps)
+    assert out.shape == (args.batch, args.prompt + 1 + args.steps)
+
+    t = sg.last_timings
+    # discard the first decode step: it pays the T=1 jit compile
+    steps = t["decode_step_s"][1:] or t["decode_step_s"]
+    step_s = sorted(steps)[len(steps) // 2] if steps else None
+    print(json.dumps({
+        "model": args.model, "batch": args.batch, "prompt": args.prompt,
+        "streamed_gib_per_step": round(streamed_bytes / 2**30, 2),
+        "pin_layers": args.pin_layers,
+        "prefill_s": round(t["prefill_s"], 2),
+        "decode_step_s_p50": round(step_s, 2) if step_s else None,
+        "tokens_per_sec": round(args.batch / step_s, 3) if step_s else None,
+        "implied_link_gib_s": round(
+            streamed_bytes / 2**30 / step_s, 3) if step_s else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

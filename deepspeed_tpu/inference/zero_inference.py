@@ -29,6 +29,7 @@ int8 records halve the streamed bytes.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -75,13 +76,17 @@ class StreamedGenerator:
         # pinned prefix lives in HBM permanently
         self._pinned = [jax.device_put(self.host_layers[i])
                         for i in range(self.pin_layers)]
-        streamed = sum(
+        #: bytes that cross the host->device link per full layer pass
+        self.streamed_bytes = sum(
             leaf.nbytes for i in range(self.pin_layers, num_layers)
             for leaf in jax.tree_util.tree_leaves(self.host_layers[i]))
+        #: per-phase wall clock of the LAST completed generate (the
+        #: model_times analog for the streamed path)
+        self.last_timings = {"prefill_s": None, "decode_step_s": []}
         log_dist(
             f"zero-inference: {num_layers} layers, {self.pin_layers} "
-            f"pinned, {streamed / 2**30:.2f} GiB streamed per step",
-            ranks=[0])
+            f"pinned, {self.streamed_bytes / 2**30:.2f} GiB streamed per "
+            f"step", ranks=[0])
         self._embed_j = jax.jit(self.hooks["embed"])
         self._block_j = jax.jit(self.hooks["block"])
         self._head_j = jax.jit(self.hooks["head"])
@@ -147,6 +152,12 @@ class StreamedGenerator:
         pick = self._pickers[sample_cfg]
         rng = jax.random.PRNGKey(_auto_seed(self, seed))
 
+        if max_new_tokens <= 0:
+            # resident path clamps silently; mirror it without streaming
+            return np.array(input_ids)
+        # reset BEFORE streaming so a mid-prefill failure can't leave a
+        # previous run's timings looking current
+        self.last_timings = {"prefill_s": None, "decode_step_s": []}
         caches = self._make_caches(b, cache_len)
         out = np.zeros((b, total), np.int32)
         out[:, :prompt_len] = input_ids
@@ -155,18 +166,24 @@ class StreamedGenerator:
         # prefill (T=prompt) and one serves every decode step
         zero = jnp.asarray(0, jnp.int32)
         # prefill: one streamed pass over the whole prompt
+        t0 = time.perf_counter()
         x = self._embed_j(self.resident, jnp.asarray(input_ids), zero)
         x = self._run_layers(x, caches, zero)
         logits = self._head_j(self.resident, x[:, -1])
         tok = pick(logits, jax.random.fold_in(rng, prompt_len))
         out[:, prompt_len] = np.asarray(tok)
+        # the per-token np.asarray sync makes each entry meaningful
+        self.last_timings["prefill_s"] = time.perf_counter() - t0
 
         for pos in range(prompt_len, total - 1):
+            t0 = time.perf_counter()
             pos_a = jnp.asarray(pos, jnp.int32)
             x = self._embed_j(self.resident, tok[:, None], pos_a)
             x = self._run_layers(x, caches, pos_a)
             logits = self._head_j(self.resident, x[:, -1])
             tok = pick(logits, jax.random.fold_in(rng, pos + 1))
             out[:, pos + 1] = np.asarray(tok)
+            self.last_timings["decode_step_s"].append(
+                time.perf_counter() - t0)
 
         return _fill_after_eos(out, prompt_len, eos_token_id)
