@@ -92,7 +92,9 @@ def main():
 
         variants = [("v1", 512, 1024), ("v1", 1024, 1024),
                     ("v3", 512, 1024), ("v3", 1024, 1024)]
-        if s <= 1024:
+        if s <= 1024 or int(os.environ.get("DS_V2_MAX_KV", 1024)) >= s:
+            # DS_V2_MAX_KV raises the v2 gate for scoped-vmem experiments
+            # (pair with XLA_FLAGS=--xla_tpu_scoped_vmem_limit_kib=...)
             variants.append(("v2", 1024, 1024))
         fns = {f"{ver}_{bq}x{bk}": (ver,) + build(bq, bk)
                for ver, bq, bk in variants}

@@ -1,0 +1,220 @@
+"""W8A8 kernel bandwidth probe: achieved GB/s per OPT matmul shape.
+
+bs=1 decode is HBM-bound on the int8 weight read, so the kernel's achieved
+bandwidth IS the serving headroom question (PROFILE.md round-4: OPT-6.7B
+decodes at ~2x the int8 read floor — this probe locates the gap shape by
+shape).  For each decode matmul shape it times:
+
+  - the w8a8 Pallas kernel (`quantized_matmul.w8a8_matmul`)
+  - a pure int8 read floor on the same buffer (sum-reduce, XLA)
+  - the bf16 dense dot (2 bytes/param yardstick)
+
+Usage: python benchmarks/w8a8_microbench.py [--d 4096] [--ffn 16384]
+       [--b 1] [--trials 30] [--step-mb 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--d", type=int, default=4096)
+    ap.add_argument("--ffn", type=int, default=16384)
+    ap.add_argument("--vocab", type=int, default=50272)
+    ap.add_argument("--b", type=int, default=1)
+    ap.add_argument("--trials", type=int, default=30)
+    ap.add_argument("--layers", type=int, default=16)
+    ap.add_argument("--k-group", type=int, default=128)
+    ap.add_argument("--unroll", action="store_true",
+                    help="python-unrolled layer loop instead of lax.scan")
+    ap.add_argument("--skip-shapes", action="store_true",
+                    help="only run the layer-stack probe")
+    ap.add_argument("--step-mb", type=float, default=None)
+    args = ap.parse_args()
+    if args.step_mb is not None:
+        os.environ["DS_QMM_STEP_MB"] = str(args.step_mb)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.ops import quantization as quant
+    from deepspeed_tpu.ops import quantized_matmul as qmm
+
+    d, ffn = args.d, args.ffn
+    shapes = [("qkv", d, 3 * d), ("attn_out", d, d),
+              ("fc1", d, ffn), ("fc2", ffn, d),
+              ("lm_head", d, args.vocab)]
+    rng = np.random.default_rng(0)
+
+    # block_until_ready is a no-op through the axon tunnel (PROFILE.md) and
+    # a value-fetch sync pays the tunnel RTT, which swamps microsecond
+    # kernels.  So: run the op R times inside one jit (data-dependent chain
+    # so XLA cannot CSE the repeats) and take the (R_hi - R_lo) slope —
+    # dispatch + RTT cancel.
+    def timeit(op, x, *a, n=args.trials):
+        def repeat(r):
+            def f(x, *a):
+                def body(i, x):
+                    y = op(x, *a)
+                    # fold a runtime scalar of the output back into x at a
+                    # numerically-negligible magnitude: XLA cannot fold it
+                    # (value unknown) so iterations stay serialized and the
+                    # op cannot be hoisted out of the loop
+                    s = jnp.sum(y[:1, :1].astype(jnp.float32))
+                    return x + (s * 1e-30).astype(x.dtype)
+                return jax.lax.fori_loop(0, r, body, x)
+            return jax.jit(f)
+
+        def sync(out):
+            jax.device_get(jnp.sum(out[:1, :1].astype(jnp.float32)))
+
+        # estimate op time with a coarse window, then size the repeat count
+        # so each window carries ~50ms of device work (tunnel RTT jitter is
+        # ms-scale; the r_hi - r_lo slope cancels the mean RTT)
+        f_est = repeat(256)
+        sync(f_est(x, *a))
+        t0 = time.perf_counter(); sync(f_est(x, *a))
+        est = max((time.perf_counter() - t0) / 256, 1e-7)
+        r_lo = max(8, int(0.05 / est))
+        r_hi = 2 * r_lo
+        f_lo, f_hi = repeat(r_lo), repeat(r_hi)
+        sync(f_lo(x, *a)); sync(f_hi(x, *a))
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter(); sync(f_lo(x, *a))
+            t1 = time.perf_counter(); sync(f_hi(x, *a))
+            t2 = time.perf_counter()
+            ts.append(((t2 - t1) - (t1 - t0)) / (r_hi - r_lo))
+        return float(np.median(ts))
+
+    print(f"# b={args.b} trials={args.trials} "
+          f"step_mb={os.environ.get('DS_QMM_STEP_MB', '4(default)')}")
+    if args.skip_shapes:
+        shapes_run = []
+    else:
+        shapes_run = shapes
+    print(f"{'shape':>9} {'KxN':>14} {'int8MB':>7} "
+          f"{'w8a8 us':>9} {'GB/s':>6} {'read us':>9} {'GB/s':>6} "
+          f"{'bf16 us':>9} {'GB/s':>6}")
+    tot_w8a8 = tot_floor = 0.0
+    for name, k, n in shapes_run:
+        w = rng.standard_normal((k, n)).astype(np.float32) * 0.02
+        rec = quant.quantize_k_grouped(jnp.asarray(w), k_group=args.k_group)
+        x = jnp.asarray(rng.standard_normal((args.b, k)), jnp.bfloat16)
+        wb = jnp.asarray(w, jnp.bfloat16)
+        mb = k * n / 2**20
+
+        t_w8 = timeit(lambda x, qk, ks: qmm.w8a8_matmul(
+            x, {"qk": qk, "kscale": ks}), x, rec["qk"], rec["kscale"])
+
+        def read_floor(x, qk):
+            # perturb qk with a runtime-valued (but actually-zero) int8 from
+            # the loop carry so the reduce cannot be hoisted out of the
+            # timing loop as loop-invariant
+            t8 = jnp.clip(x[:1, :1].astype(jnp.float32) * 1e-30,
+                          0, 1).astype(jnp.int8)
+            return jnp.max(jnp.abs((qk + t8).astype(jnp.int32))) \
+                .reshape(1, 1).astype(jnp.float32)
+
+        t_rd = timeit(read_floor, x, rec["qk"])
+
+        t_bf = timeit(lambda x, w: jax.lax.dot(x, w), x, wb)
+
+        gbs = lambda t, bytes_: bytes_ / t / 1e9
+        print(f"{name:>9} {k:>6}x{n:<7} {mb:>7.1f} "
+              f"{t_w8*1e6:>9.0f} {gbs(t_w8, k*n):>6.0f} "
+              f"{t_rd*1e6:>9.0f} {gbs(t_rd, k*n):>6.0f} "
+              f"{t_bf*1e6:>9.0f} {gbs(t_bf, 2*k*n):>6.0f}")
+        if name != "lm_head":
+            tot_w8a8 += t_w8
+            tot_floor += t_rd
+    print(f"# per-layer matmul total (no head): w8a8 {tot_w8a8*1e3:.3f} ms, "
+          f"read floor {tot_floor*1e3:.3f} ms "
+          f"(ratio {tot_w8a8/max(tot_floor,1e-12):.2f}x)")
+
+    # ---- layer-stack probe: scan over n_layers of the four matmuls -------
+    # One dispatch covers n_layers x 4 matmuls (~the whole decode weight
+    # read), so tunnel RTT jitter is amortized away without any dependency
+    # tricks — this is the trustworthy per-layer number.
+    n_layers = args.layers
+    ws = {}
+    for i, (name, k, n) in enumerate(shapes[:4]):
+        # weights born on-device: the tunnel host->device link is ~0.06
+        # GiB/s, shipping GBs of host randoms would take minutes.  Chunk
+        # the generate+quantize so the f32 transient stays ~1 layer
+        # (a 32-layer fc leaf is 8.6GB f32 — 2x that in-jit thrashes HBM)
+        @jax.jit
+        def make(key, k=k, n=n):
+            w = jax.random.normal(key, (1, k, n), jnp.float32) * 0.02
+            return quant.quantize_k_grouped(w, k_group=args.k_group)
+        parts = [make(jax.random.fold_in(jax.random.PRNGKey(i), j))
+                 for j in range(n_layers)]
+        ws[name] = {
+            kk: jnp.concatenate([p[kk] for p in parts], axis=0)
+            for kk in parts[0]}
+        jax.device_get(jnp.sum(ws[name]["qk"].astype(jnp.int32)))
+
+    x0 = jnp.asarray(rng.standard_normal((args.b, d)), jnp.bfloat16)
+
+    def stack_step(x, layer):
+        qkv = qmm.w8a8_matmul(x, layer["qkv"])
+        h = qmm.w8a8_matmul(qkv[:, :d], layer["attn_out"])
+        f = qmm.w8a8_matmul(h, layer["fc1"])
+        o = qmm.w8a8_matmul(jax.nn.gelu(f), layer["fc2"])
+        return (x + o.astype(x.dtype)) * 0.5, None
+
+    layers = {name: {"qk": ws[name]["qk"], "kscale": ws[name]["kscale"]}
+              for name in ws}
+
+    def build(n_sub):
+        # run only the first n_sub layers of the same stacked weights, so
+        # the lo/hi variants share buffers; the (hi - lo) time slope
+        # cancels the per-dispatch tunnel RTT (~100ms here)
+        sub = jax.tree_util.tree_map(lambda a: a[:n_sub], layers)
+        if args.unroll:
+            @jax.jit
+            def stack(x, sub=sub, n=n_sub):
+                for i in range(n):
+                    layer = jax.tree_util.tree_map(lambda a: a[i], sub)
+                    x, _ = stack_step(x, layer)
+                return x
+        else:
+            @jax.jit
+            def stack(x, sub=sub):
+                y, _ = jax.lax.scan(stack_step, x, sub)
+                return y
+        return stack
+
+    def sync_arr(y):
+        jax.device_get(jnp.sum(y.astype(jnp.float32)))
+
+    if n_layers < 2:
+        raise SystemExit("--layers must be >= 2 for the slope probe")
+    n_lo = max(1, n_layers // 8)
+    f_lo, f_hi = build(n_lo), build(n_layers)
+    sync_arr(f_lo(x0)); sync_arr(f_hi(x0))
+    slopes, his = [], []
+    for _ in range(args.trials):
+        t0 = time.perf_counter(); sync_arr(f_lo(x0))
+        t1 = time.perf_counter(); sync_arr(f_hi(x0))
+        t2 = time.perf_counter()
+        slopes.append(((t2 - t1) - (t1 - t0)) / (n_layers - n_lo))
+        his.append(t2 - t1)
+    per_layer = float(np.median(slopes))
+    layer_bytes = sum(k * n for _, k, n in shapes[:4])
+    print(f"# w8a8 stack slope ({n_lo}->{n_layers} layers): "
+          f"{per_layer*1e6:.0f} us/layer = "
+          f"{layer_bytes/per_layer/1e9:.0f} GB/s on the int8 weights "
+          f"(full dispatch {float(np.median(his))*1e3:.1f} ms incl. RTT)")
+
+
+if __name__ == "__main__":
+    main()

@@ -62,8 +62,13 @@ def main():
     assert out.shape == (args.batch, args.prompt + 1 + args.steps)
 
     t = sg.last_timings
-    # discard the first decode step: it pays the T=1 jit compile
-    steps = t["decode_step_s"][1:] or t["decode_step_s"]
+    # discard the first decode step: it pays the T=1 jit compile.  With
+    # --steps 1 there is nothing left to report honestly — refuse rather
+    # than silently publishing the compile step as the p50.
+    if args.steps < 2:
+        raise SystemExit("--steps must be >= 2: the first decode step is "
+                         "jit-compile warmup and is discarded")
+    steps = t["decode_step_s"][1:]
     step_s = sorted(steps)[len(steps) // 2] if steps else None
     print(json.dumps({
         "model": args.model, "batch": args.batch, "prompt": args.prompt,

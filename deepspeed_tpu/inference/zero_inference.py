@@ -154,7 +154,9 @@ class StreamedGenerator:
 
         if max_new_tokens <= 0:
             # resident path clamps silently; mirror it without streaming
-            return np.array(input_ids)
+            # (int32 output + cleared timings, like a real streamed call)
+            self.last_timings = {"prefill_s": None, "decode_step_s": []}
+            return np.array(input_ids, dtype=np.int32)
         # reset BEFORE streaming so a mid-prefill failure can't leave a
         # previous run's timings looking current
         self.last_timings = {"prefill_s": None, "decode_step_s": []}

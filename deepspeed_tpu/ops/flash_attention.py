@@ -443,7 +443,11 @@ def _v2_eligible(kv_pad: int, d: int) -> bool:
 
     if os.environ.get("DS_FLASH_V2", "1") == "0":  # A/B kill switch
         return False
-    return kv_pad <= _V2_MAX_KV and kv_pad % 8 == 0 and d <= 256
+    # experiment override: a larger scoped-vmem budget (set via
+    # --xla_tpu_scoped_vmem_limit_kib) can admit the fused v2 backward past
+    # 1024 — DS_V2_MAX_KV raises the gate for A/B runs
+    max_kv = int(os.environ.get("DS_V2_MAX_KV", _V2_MAX_KV))
+    return kv_pad <= max_kv and kv_pad % 8 == 0 and d <= 256
 
 
 def _v3_eligible(kv_pad: int, d: int) -> bool:
@@ -463,6 +467,18 @@ def _v3_eligible(kv_pad: int, d: int) -> bool:
         return False
     min_kv = int(os.environ.get("DS_FLASH_V3_MIN_KV", _V2_MAX_KV + 1))
     return kv_pad >= min_kv and kv_pad % 8 == 0 and d <= 256
+
+
+def _v2_compiler_params(dimension_semantics):
+    """CompilerParams for the v2 kernels; ``DS_V2_VMEM_MB`` raises the
+    per-kernel scoped-vmem budget (the fused v2 backward at kv_pad=2048
+    needs ~16.4MB against the 16MB default — see _V2_MAX_KV note)."""
+    import os
+
+    vmem_mb = os.environ.get("DS_V2_VMEM_MB")
+    return pltpu.CompilerParams(
+        dimension_semantics=dimension_semantics,
+        vmem_limit_bytes=(int(float(vmem_mb) * 2**20) if vmem_mb else None))
 
 
 def _fwd_v2_kernel(q_ref, k_ref, v_ref, o_ref, *, scale2: float, causal: bool,
@@ -513,8 +529,7 @@ def _fwd_v2(q, k, v, sm_scale, causal, block_q, interpret, true_kv_len,
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, q_len, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel")),
+        compiler_params=_v2_compiler_params(("parallel", "parallel")),
         interpret=interpret,
     )(q, k, v)
 
@@ -619,8 +634,7 @@ def _bwd_v2(q, k, v, o, do, sm_scale, causal, block_q, interpret, true_kv_len,
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+        compiler_params=_v2_compiler_params(("parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, o, do)
     return dq, dk, dv
