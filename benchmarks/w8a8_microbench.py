@@ -149,18 +149,28 @@ def main():
     for i, (name, k, n) in enumerate(shapes[:4]):
         # weights born on-device: the tunnel host->device link is ~0.06
         # GiB/s, shipping GBs of host randoms would take minutes.  Chunk
-        # the generate+quantize so the f32 transient stays ~1 layer
-        # (a 32-layer fc leaf is 8.6GB f32 — 2x that in-jit thrashes HBM)
+        # the generate+quantize in groups of <=8 layers so the in-jit f32
+        # transient stays ~2GB (a 32-layer fc leaf is 8.6GB f32, and 2x
+        # that in one jit thrashes 16GB HBM); one dispatch per chunk keeps
+        # the ~100ms-RTT dispatch count low
+        chunk = min(8, n_layers)
+
         @jax.jit
         def make(key, k=k, n=n):
-            w = jax.random.normal(key, (1, k, n), jnp.float32) * 0.02
+            w = jax.random.normal(key, (chunk, k, n), jnp.float32) * 0.02
             return quant.quantize_k_grouped(w, k_group=args.k_group)
-        parts = [make(jax.random.fold_in(jax.random.PRNGKey(i), j))
-                 for j in range(n_layers)]
+        parts = []
+        for j in range(0, n_layers, chunk):
+            p = make(jax.random.fold_in(jax.random.PRNGKey(i), j))
+            # serialize: queued async chunks would co-allocate their ~2GB
+            # f32 generator transients and OOM the 16GB chip at 32 layers
+            jax.device_get(jnp.sum(p["qk"][0, 0, :8].astype(jnp.int32)))
+            parts.append(p)
         ws[name] = {
             kk: jnp.concatenate([p[kk] for p in parts], axis=0)
             for kk in parts[0]}
-        jax.device_get(jnp.sum(ws[name]["qk"].astype(jnp.int32)))
+        del parts
+        jax.device_get(jnp.sum(ws[name]["qk"][0, 0, :8].astype(jnp.int32)))
 
     x0 = jnp.asarray(rng.standard_normal((args.b, d)), jnp.bfloat16)
 

@@ -40,6 +40,9 @@ def main():
     ap.add_argument("--pin-layers", type=int, default=0)
     ap.add_argument("--prefetch", type=int, default=2)
     args = ap.parse_args()
+    if args.steps < 2:
+        raise SystemExit("--steps must be >= 2: the first decode step is "
+                         "jit-compile warmup and is discarded")
 
     import deepspeed_tpu
 
@@ -62,12 +65,8 @@ def main():
     assert out.shape == (args.batch, args.prompt + 1 + args.steps)
 
     t = sg.last_timings
-    # discard the first decode step: it pays the T=1 jit compile.  With
-    # --steps 1 there is nothing left to report honestly — refuse rather
-    # than silently publishing the compile step as the p50.
-    if args.steps < 2:
-        raise SystemExit("--steps must be >= 2: the first decode step is "
-                         "jit-compile warmup and is discarded")
+    # first decode step discarded: it pays the T=1 jit compile (--steps
+    # is validated >= 2 up front, before any streaming work)
     steps = t["decode_step_s"][1:]
     step_s = sorted(steps)[len(steps) // 2] if steps else None
     print(json.dumps({

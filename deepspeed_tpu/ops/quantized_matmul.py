@@ -276,7 +276,13 @@ def _w8a8_call(x2d, qk, kscale, out_dtype, block_k, interpret):
         out_shape=jax.ShapeDtypeStruct((b, n_dim), out_dtype),
         scratch_shapes=[pltpu.VMEM((b, n_dim), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=("parallel", "arbitrary"),
+            # DS_QMM_VMEM_MB raises the per-kernel scoped-vmem budget so
+            # larger DS_QMM_STEP_MB fetch blocks (2x double-buffered in
+            # VMEM) can compile for bandwidth experiments
+            vmem_limit_bytes=(
+                int(float(os.environ["DS_QMM_VMEM_MB"]) * 2**20)
+                if os.environ.get("DS_QMM_VMEM_MB") else None)),
         interpret=interpret,
     )(x3, qk, kscale)
 
