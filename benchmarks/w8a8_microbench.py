@@ -16,6 +16,7 @@ Usage: python benchmarks/w8a8_microbench.py [--d 4096] [--ffn 16384]
 from __future__ import annotations
 
 import argparse
+import functools
 import os
 import sys
 import time
@@ -155,13 +156,16 @@ def main():
         # the ~100ms-RTT dispatch count low
         chunk = min(8, n_layers)
 
-        @jax.jit
-        def make(key, k=k, n=n):
-            w = jax.random.normal(key, (chunk, k, n), jnp.float32) * 0.02
+        @functools.partial(jax.jit, static_argnames=("size",))
+        def make(key, size, k=k, n=n):
+            w = jax.random.normal(key, (size, k, n), jnp.float32) * 0.02
             return quant.quantize_k_grouped(w, k_group=args.k_group)
         parts = []
         for j in range(0, n_layers, chunk):
-            p = make(jax.random.fold_in(jax.random.PRNGKey(i), j))
+            # the last chunk is sized to the remainder so --layers values
+            # that are not multiples of 8 never allocate extra layers
+            p = make(jax.random.fold_in(jax.random.PRNGKey(i), j),
+                     size=min(chunk, n_layers - j))
             # serialize: queued async chunks would co-allocate their ~2GB
             # f32 generator transients and OOM the 16GB chip at 32 layers
             jax.device_get(jnp.sum(p["qk"][0, 0, :8].astype(jnp.int32)))

@@ -67,6 +67,73 @@ GPT2_REPLICATED = [
     re.compile(r"(transformer\.)?wpe\.weight"),
 ]
 
+#: HF Llama (nn.Linear = [out, in]: column-parallel weights concat on dim 0,
+#: row-parallel on dim 1 — the transpose of GPT-2's Conv1D convention).
+#: q/k/v are separate projections, so there is no fused-QKV reassembly.
+LLAMA_CAT_DIMS = [
+    (re.compile(r"(model\.)?layers\.\d+\.self_attn\.[qkv]_proj\.weight"), 0),
+    (re.compile(r"(model\.)?layers\.\d+\.mlp\.(gate|up)_proj\.weight"), 0),
+    (re.compile(r"(model\.)?layers\.\d+\.self_attn\.o_proj\.weight"), 1),
+    (re.compile(r"(model\.)?layers\.\d+\.mlp\.down_proj\.weight"), 1),
+    (re.compile(r"(model\.)?embed_tokens\.weight"), 0),
+    (re.compile(r"lm_head\.weight"), 0),
+]
+LLAMA_REPLICATED = [
+    re.compile(r"(model\.)?layers\.\d+\."
+               r"(input_layernorm|post_attention_layernorm)\.weight"),
+    re.compile(r"(model\.)?norm\.weight"),
+]
+
+#: HF OPT (nn.Linear): column-parallel q/k/v/fc1 concat weights AND biases
+#: on dim 0; row-parallel out_proj/fc2 weights on dim 1 (biases replicated).
+OPT_CAT_DIMS = [
+    (re.compile(r"(model\.decoder\.|decoder\.)?layers\.\d+\.self_attn\."
+                r"[qkv]_proj\.(weight|bias)"), 0),
+    (re.compile(r"(model\.decoder\.|decoder\.)?layers\.\d+\.fc1\."
+                r"(weight|bias)"), 0),
+    (re.compile(r"(model\.decoder\.|decoder\.)?layers\.\d+\.self_attn\."
+                r"out_proj\.weight"), 1),
+    (re.compile(r"(model\.decoder\.|decoder\.)?layers\.\d+\.fc2\.weight"), 1),
+    (re.compile(r"(model\.decoder\.|decoder\.)?embed_tokens\.weight"), 0),
+    (re.compile(r"lm_head\.weight"), 0),
+]
+OPT_REPLICATED = [
+    re.compile(r"(model\.decoder\.|decoder\.)?layers\.\d+\."
+               r"(self_attn_layer_norm|final_layer_norm)\.(weight|bias)"),
+    re.compile(r"(model\.decoder\.|decoder\.)?layers\.\d+\."
+               r"(self_attn\.out_proj|fc2)\.bias"),
+    re.compile(r"(model\.decoder\.|decoder\.)?final_layer_norm\."
+               r"(weight|bias)"),
+    re.compile(r"(model\.decoder\.|decoder\.)?embed_positions\.weight"),
+    re.compile(r"(model\.decoder\.|decoder\.)?project_(in|out)\.weight"),
+]
+
+#: family name -> (cat_dims, replicated, qkv_fused) TP merge rules
+TP_MERGE_FAMILIES: Dict[str, tuple] = {
+    "gpt2": (GPT2_CAT_DIMS, GPT2_REPLICATED, None),  # fused set below
+    "llama": (LLAMA_CAT_DIMS, LLAMA_REPLICATED, []),
+    "opt": (OPT_CAT_DIMS, OPT_REPLICATED, []),
+}
+
+
+def detect_tp_merge_family(names) -> Optional[str]:
+    """Pick the TP merge rule family from module parameter names, or
+    ``None`` when no family's marker names appear (the caller decides
+    whether that is fatal — it is whenever tp>1 shards must merge).
+
+    The reference reshapes arbitrary layouts via per-model policy maps
+    (``deepspeed/module_inject/replace_policy.py``); here the weight names
+    themselves identify the family (HF naming IS the layout spec)."""
+    names = list(names)
+    if any("attn.c_attn" in n or ".c_fc." in n for n in names):
+        return "gpt2"
+    if any("mlp.gate_proj" in n for n in names):
+        return "llama"
+    if any(".fc1." in n for n in names) and \
+            any("self_attn.q_proj" in n for n in names):
+        return "opt"
+    return None
+
 
 def _np(t) -> np.ndarray:
     if hasattr(t, "detach"):
@@ -83,7 +150,12 @@ def _torch_load(path):
 class DeepSpeedNativeCheckpoint:
     """Parsed view of a reference-engine checkpoint directory."""
 
-    def __init__(self, ckpt_dir: str):
+    def __init__(self, ckpt_dir: str, family: Optional[str] = None):
+        if family is not None and family not in TP_MERGE_FAMILIES:
+            raise ValueError(
+                f"unknown TP merge family {family!r}; "
+                f"known: {sorted(TP_MERGE_FAMILIES)}")
+        self.family = family
         if os.path.isfile(os.path.join(ckpt_dir, "latest")):
             with open(os.path.join(ckpt_dir, "latest")) as f:
                 ckpt_dir = os.path.join(ckpt_dir, f.read().strip())
@@ -170,8 +242,30 @@ class DeepSpeedNativeCheckpoint:
         ``checkpoint/reshape_3d_utils.py`` handles the same layout as a 3D
         reshape; here the target is always the full unsharded module)."""
         assert self.layer_files, "not a pipeline-staged checkpoint"
-        if name_map is None:
+        default_map = name_map is None
+        if default_map:
             name_map = self.gpt2_pipeline_name_map(self.layer_files)
+        # name-only pass (rank-0 shard per layer, tensors discarded) so the
+        # merge family is detected from the FULL global name set before any
+        # merge — a single q_proj name is ambiguous between the llama and
+        # opt rule tables — without holding every layer's shards in RAM
+        if self.family is None:
+            names = []
+            for idx in sorted(self.layer_files):
+                by_tp = self.layer_files[idx]
+                sd0 = _torch_load(
+                    os.path.join(self.dir, by_tp[min(by_tp)]))
+                names.extend(name_map(idx, local) for local in sd0)
+                del sd0
+            self._family_rules(names)
+        if default_map and self.family != "gpt2":
+            raise NotImplementedError(
+                f"pipeline-staged checkpoint detected as family "
+                f"{self.family!r}, but the default layer->global name map "
+                "is GPT-2-shaped (h.N.*), which that family's TP merge "
+                "rules cannot match — pass name_map= mapping "
+                "(global_layer_idx, local_name) to the family's HF names "
+                "(e.g. layers.N.self_attn.q_proj.weight)")
         out: Dict[str, np.ndarray] = {}
         for idx in sorted(self.layer_files):
             by_tp = self.layer_files[idx]
@@ -184,9 +278,36 @@ class DeepSpeedNativeCheckpoint:
         return out
 
     # ------------------------------------------------------- module weights
+    def _family_rules(self, names):
+        """(cat_dims, replicated, qkv_fused) for this checkpoint's model
+        family — explicit (constructor ``family=``) or detected from the
+        parameter names on first use."""
+        if self.family is None:
+            fam = detect_tp_merge_family(names)
+            if fam is None:
+                if self.tp_degree > 1:
+                    # silently taking rank 0 of an unrecognized tp>1 layout
+                    # would return a half-sharded model — fail loudly
+                    raise ValueError(
+                        "cannot detect a TP merge family from the weight "
+                        f"names (tp={self.tp_degree}); known families: "
+                        f"{sorted(TP_MERGE_FAMILIES)} — pass family= or "
+                        "merge the shards with a custom rule table")
+                fam = "gpt2"  # tp=1: single shards, rules never consulted
+            self.family = fam
+            logger.info(f"DS-native: TP merge family -> {self.family!r}")
+        cat, rep, fused = TP_MERGE_FAMILIES[self.family]
+        if fused is None:
+            fused = GPT2_QKV_FUSED
+        return cat, rep, fused
+
     def _merge_tp(self, name: str, shards: List[np.ndarray],
-                  cat_dims=GPT2_CAT_DIMS, replicated=GPT2_REPLICATED,
-                  qkv_fused=GPT2_QKV_FUSED):
+                  cat_dims=None, replicated=None, qkv_fused=None):
+        if cat_dims is None or replicated is None or qkv_fused is None:
+            fam_cat, fam_rep, fam_fused = self._family_rules([name])
+            cat_dims = fam_cat if cat_dims is None else cat_dims
+            replicated = fam_rep if replicated is None else replicated
+            qkv_fused = fam_fused if qkv_fused is None else qkv_fused
         if len(shards) == 1:
             return shards[0]
         for pat in replicated:
@@ -209,6 +330,7 @@ class DeepSpeedNativeCheckpoint:
         :meth:`fp32_state_dict` when ZeRO files exist)."""
         per_rank = [self.model_state(r)["module"]
                     for r in range(self.tp_degree)]
+        self._family_rules(list(per_rank[0]))
         out = {}
         for name in per_rank[0]:
             shards = [_np(sd[name]) for sd in per_rank]
@@ -316,39 +438,102 @@ class DeepSpeedNativeCheckpoint:
                     "pipeline_module_state_dict()")
             return self.pipeline_module_state_dict()
         per_rank = [self.fp32_state_dict(r) for r in range(self.tp_degree)]
+        self._family_rules(list(per_rank[0]))
         return {name: self._merge_tp(name, [sd[name] for sd in per_rank])
                 for name in per_rank[0]}
 
 
+def _infer_gpt2_cfg(sd):
+    from ..models.gpt2 import GPT2Config
+
+    n_layer = 1 + max(int(m.group(1)) for m in
+                      (re.search(r"h\.(\d+)\.", k) for k in sd)
+                      if m)
+    wte = next(v for k, v in sd.items() if k.endswith("wte.weight"))
+    wpe = next(v for k, v in sd.items() if k.endswith("wpe.weight"))
+    qkv = next(v for k, v in sd.items()
+               if k.endswith("h.0.attn.c_attn.weight"))
+    d = wte.shape[1]
+    assert qkv.shape == (d, 3 * d), "not a GPT-2-family checkpoint"
+    return GPT2Config(vocab_size=wte.shape[0], max_seq_len=wpe.shape[0],
+                      num_layers=n_layer, hidden_size=d,
+                      num_heads=max(1, d // 64))
+
+
+def _infer_opt_cfg(sd):
+    from ..models.opt import _POS_OFFSET, OPTConfig
+
+    n_layer = 1 + max(int(m.group(1)) for m in
+                      (re.search(r"layers\.(\d+)\.", k) for k in sd)
+                      if m)
+    emb = next(v for k, v in sd.items() if k.endswith("embed_tokens.weight"))
+    pos = next(v for k, v in sd.items()
+               if k.endswith("embed_positions.weight"))
+    fc1 = next(v for k, v in sd.items() if k.endswith("layers.0.fc1.weight"))
+    # fc1 is [ffn, hidden]; embed_tokens' second dim is word_embed_proj_dim,
+    # which differs from hidden_size on projected variants (OPT-350m)
+    d = fc1.shape[1]
+    proj = emb.shape[1] if emb.shape[1] != d else None
+    return OPTConfig(vocab_size=emb.shape[0],
+                     max_seq_len=pos.shape[0] - _POS_OFFSET,
+                     num_layers=n_layer, hidden_size=d,
+                     ffn_size=fc1.shape[0], word_embed_proj_dim=proj,
+                     num_heads=max(1, d // 64))
+
+
+def _infer_llama_cfg(sd):
+    from ..models.llama import LlamaConfig
+
+    n_layer = 1 + max(int(m.group(1)) for m in
+                      (re.search(r"layers\.(\d+)\.", k) for k in sd)
+                      if m)
+    emb = next(v for k, v in sd.items() if k.endswith("embed_tokens.weight"))
+    gate = next(v for k, v in sd.items()
+                if k.endswith("layers.0.mlp.gate_proj.weight"))
+    kw = next(v for k, v in sd.items()
+              if k.endswith("layers.0.self_attn.k_proj.weight"))
+    d = emb.shape[1]
+    head_dim = 128 if d % 128 == 0 else 64   # llama convention; pass an
+    logger.warning(                          # explicit cfg for other dims
+        "DS-native: rope_theta / max_seq_len / head_dim are not derivable "
+        "from weight shapes — inferring a LlamaConfig with its (Llama-3) "
+        "defaults; pass an explicit cfg for Llama-1/2 checkpoints "
+        "(rope_theta=10000)")
+    return LlamaConfig(
+        vocab_size=emb.shape[0], num_layers=n_layer, hidden_size=d,
+        ffn_size=gate.shape[0], num_heads=max(1, d // head_dim),
+        num_kv_heads=max(1, kw.shape[0] // head_dim))
+
+
+_FAMILY_CONVERT = {
+    "gpt2": ("_gpt2_convert", _infer_gpt2_cfg),
+    "opt": ("_opt_convert", _infer_opt_cfg),
+    "llama": ("_llama_convert", _infer_llama_cfg),
+}
+
+
 def load_ds_checkpoint_into(ckpt_dir: str, cfg=None,
-                            convert: Optional[Callable] = None):
+                            convert: Optional[Callable] = None,
+                            family: Optional[str] = None):
     """One-call ingestion: reference checkpoint dir -> our param pytree.
 
-    ``convert(cfg, state_dict) -> params`` defaults to the GPT-2 family's
-    HF-name converter (module_inject policy table).  Returns
+    ``convert(cfg, state_dict) -> params`` defaults to the detected
+    family's HF-name converter (module_inject policy table; gpt2/opt/llama
+    supported — other families pass an explicit ``convert``).  Returns
     ``(params, cfg, client_state)`` — the (possibly inferred) config is
-    returned so the caller can ``gpt2.build(cfg)`` a matching model
-    (NOTE: a cfg inferred from shapes guesses ``num_heads = d // 64``;
-    pass an explicit cfg for other head dims).
+    returned so the caller can build a matching model (NOTE: a cfg
+    inferred from shapes guesses ``num_heads`` from conventional head
+    dims; pass an explicit cfg when the guess is wrong).
     """
-    ck = DeepSpeedNativeCheckpoint(ckpt_dir)
+    ck = DeepSpeedNativeCheckpoint(ckpt_dir, family=family)
     sd = ck.merged_fp32_state_dict()
     if convert is None:
-        from ..models.gpt2 import GPT2Config
-        from ..module_inject.replace_policy import _gpt2_convert
+        fam = ck.family  # set by merged_fp32_state_dict on every path
+        assert fam is not None
+        conv_name, infer = _FAMILY_CONVERT[fam]
+        from ..module_inject import replace_policy
 
+        convert = getattr(replace_policy, conv_name)
         if cfg is None:
-            n_layer = 1 + max(int(m.group(1)) for m in
-                              (re.search(r"h\.(\d+)\.", k) for k in sd)
-                              if m)
-            wte = next(v for k, v in sd.items() if k.endswith("wte.weight"))
-            wpe = next(v for k, v in sd.items() if k.endswith("wpe.weight"))
-            qkv = next(v for k, v in sd.items()
-                       if k.endswith("h.0.attn.c_attn.weight"))
-            d = wte.shape[1]
-            cfg = GPT2Config(vocab_size=wte.shape[0], max_seq_len=wpe.shape[0],
-                             num_layers=n_layer, hidden_size=d,
-                             num_heads=max(1, d // 64))
-            assert qkv.shape == (d, 3 * d), "not a GPT-2-family checkpoint"
-        convert = _gpt2_convert
+            cfg = infer(sd)
     return convert(cfg, sd), cfg, ck.client_state()

@@ -254,9 +254,20 @@ def _w8a8_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, nk: int,
         o_ref[...] = acc_ref[...].astype(o_ref.dtype)
 
 
+def _qmm_vmem_limit():
+    """DS_QMM_VMEM_MB raises the w8a8 kernel's per-kernel scoped-vmem
+    budget so larger DS_QMM_STEP_MB fetch blocks (2x double-buffered in
+    VMEM) can compile for bandwidth experiments.  Resolved OUTSIDE the
+    jitted call and passed as a static arg so it keys the jit cache —
+    sweep scripts that change it mid-process get fresh compiles."""
+    v = os.environ.get("DS_QMM_VMEM_MB")
+    return int(float(v) * 2**20) if v else None
+
+
 @functools.partial(jax.jit, static_argnames=("out_dtype", "block_k",
-                                             "interpret"))
-def _w8a8_call(x2d, qk, kscale, out_dtype, block_k, interpret):
+                                             "interpret", "vmem_limit"))
+def _w8a8_call(x2d, qk, kscale, out_dtype, block_k, interpret,
+               vmem_limit=None):
     b, k_dim = x2d.shape
     n_dim = qk.shape[1]
     k_group = k_dim // kscale.shape[0]
@@ -277,12 +288,7 @@ def _w8a8_call(x2d, qk, kscale, out_dtype, block_k, interpret):
         scratch_shapes=[pltpu.VMEM((b, n_dim), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
-            # DS_QMM_VMEM_MB raises the per-kernel scoped-vmem budget so
-            # larger DS_QMM_STEP_MB fetch blocks (2x double-buffered in
-            # VMEM) can compile for bandwidth experiments
-            vmem_limit_bytes=(
-                int(float(os.environ["DS_QMM_VMEM_MB"]) * 2**20)
-                if os.environ.get("DS_QMM_VMEM_MB") else None)),
+            vmem_limit_bytes=vmem_limit),
         interpret=interpret,
     )(x3, qk, kscale)
 
@@ -309,7 +315,8 @@ def _w8a8_local(x2d, qk, kscale3, block_k=None, out_dtype=None):
         bk = _pick_block(k_dim, k_group, block_k, k_group)
     if (bk > 0 and n_dim % 128 == 0
             and os.environ.get("DS_W8A8", "1") != "0"):
-        return _w8a8_call(x2d, qk, kscale3, out_dtype, bk, _use_interpret())
+        return _w8a8_call(x2d, qk, kscale3, out_dtype, bk, _use_interpret(),
+                          vmem_limit=_qmm_vmem_limit())
     deq = quant.dequantize_k({"qk": qk, "kscale": kscale3}, x2d.dtype)
     return jax.lax.dot(x2d, deq, preferred_element_type=out_dtype)
 
