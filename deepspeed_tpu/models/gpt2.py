@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+import os
 from typing import Any, Dict, Optional
 
 import jax
@@ -207,6 +208,47 @@ def _qmm(x, leaf, dtype=None):
     return x @ leaf.astype(dtype)
 
 
+def _qmm_indexed(x, leaf, l, dtype=None):
+    """``x @ leaf[l]`` for STACKED per-layer leaves selected by a (possibly
+    traced) layer index: K-grouped records run the stacked s8 kernel with
+    the layer chosen in-kernel (scalar prefetch — no per-layer weight copy
+    in HBM); other leaf kinds dynamic-slice the layer and take the same
+    path as :func:`_qmm`."""
+    from ..ops import quantization as quant
+
+    dtype = dtype or x.dtype
+    if quant.is_k_quantized(leaf):
+        from ..ops.quantized_matmul import w8a8_matmul_stacked
+
+        return w8a8_matmul_stacked(x, leaf, l, out_dtype=dtype)
+    if quant.is_quantized(leaf):
+        from ..ops.quantized_matmul import quantized_matmul
+
+        sliced = {k: jax.lax.dynamic_index_in_dim(v, l, keepdims=False)
+                  for k, v in leaf.items()}
+        return quantized_matmul(x, sliced, out_dtype=dtype)
+    w = jax.lax.dynamic_index_in_dim(leaf, l, keepdims=False)
+    return x @ w.astype(dtype)
+
+
+def use_indexed_decode(blocks, probe: str = "qkv_w",
+                       rows: int = 1) -> bool:
+    """Trace-time dispatch for quantized serving: run the layer-INDEXED
+    decode loop (stacked s8 kernel selects the layer in-kernel — no
+    per-layer int8 weight copy in HBM) instead of the scan.  False when the
+    stacked kernel wouldn't engage (TP, kernel off, or ``rows`` beyond the
+    kernel's decode-shaped cap — prefill traces and big batches) — there
+    the indexed loop would only add KV-stack slice/update traffic.
+    ``DS_INDEXED_DECODE=0`` is the kill switch (on-chip A/B)."""
+    from ..ops import quantization as quant
+    from ..ops.quantized_matmul import W8A8_MAX_ROWS, stacked_kernel_enabled
+
+    return (quant.is_k_quantized(blocks[probe])
+            and stacked_kernel_enabled()
+            and rows <= W8A8_MAX_ROWS
+            and os.environ.get("DS_INDEXED_DECODE", "1") != "0")
+
+
 def _dequant_resident(params, dtype=None):
     """Dequantize the small resident params (embeddings, final LN) up front;
     the stacked ``blocks`` stay int8 and expand per layer in ``_block``."""
@@ -313,16 +355,18 @@ def init_cache(cfg: GPT2Config, batch_size: int, max_len: int,
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def _block_cached(cfg: GPT2Config, x, layer, ck, cv, pos):
-    """One block with KV-cache read/write.  x: [B, T, D]; ck/cv: [B, H, S, hd];
-    pos: traced global position of x[:, 0]."""
+def _block_cached_body(cfg: GPT2Config, x, get, mm, ck, cv, pos):
+    """One block with KV-cache read/write, parameterized by weight access
+    (``get(name)`` small leaf, ``mm(y, name, dtype)`` matmul) so the scan
+    and layer-indexed decode paths share the math.  x: [B, T, D]; ck/cv:
+    [B, H, S, hd]; pos: traced global position of x[:, 0]."""
     from ..ops.decode_attention import decode_attention
 
     b, t, d = x.shape
     h, hd = cfg.num_heads, cfg.head_dim
 
-    y = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
-    qkv = _qmm(y, layer["qkv_w"]) + layer["qkv_b"].astype(y.dtype)
+    y = _layer_norm(x, get("ln1_scale"), get("ln1_bias"))
+    qkv = mm(y, "qkv_w", None) + get("qkv_b").astype(y.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
     k = k.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
@@ -331,13 +375,64 @@ def _block_cached(cfg: GPT2Config, x, layer, ck, cv, pos):
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, pos, 0))
     attn = decode_attention(q, ck, cv, pos)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, t, d)
-    x = x + _qmm(attn, layer["o_w"], x.dtype) + layer["o_b"].astype(x.dtype)
+    x = x + mm(attn, "o_w", x.dtype) + get("o_b").astype(x.dtype)
 
-    y = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
-    hid = jax.nn.gelu(_qmm(y, layer["fc_w"]) + layer["fc_b"].astype(y.dtype))
-    x = x + _qmm(hid, layer["proj_w"], x.dtype) + \
-        layer["proj_b"].astype(x.dtype)
+    y = _layer_norm(x, get("ln2_scale"), get("ln2_bias"))
+    hid = jax.nn.gelu(mm(y, "fc_w", None) + get("fc_b").astype(y.dtype))
+    x = x + mm(hid, "proj_w", x.dtype) + get("proj_b").astype(x.dtype)
     return x, ck, cv
+
+
+def decode_over_layers(body, x, blocks, cache_k, cache_v, num_layers,
+                       probe: str = "qkv_w"):
+    """Run ``body(x, get, mm, ck, cv) -> (x, ck, cv)`` over all layers:
+    a ``lax.scan`` over pre-sliced layers normally, or — quantized serving
+    with the stacked s8 kernel available — a layer-indexed ``fori_loop``
+    whose matmuls select the layer in-kernel (scalar prefetch), so no
+    per-layer int8 weight copy is ever materialized in HBM."""
+    from ..ops import quantization as quant
+
+    stack_l = jax.tree_util.tree_leaves(
+        blocks, is_leaf=quant.is_record)[0]
+    if quant.is_record(stack_l):
+        stack_l = stack_l.get("qk", stack_l.get("q"))
+    stack_l = stack_l.shape[0]
+    if stack_l != num_layers:
+        # fail-fast like lax.scan would: the fori_loop path's clamped
+        # dynamic indexing would otherwise silently re-run the last layer
+        raise ValueError(
+            f"stacked blocks carry {stack_l} layers but num_layers="
+            f"{num_layers}")
+    if use_indexed_decode(blocks, probe, rows=x.shape[0] * x.shape[1]):
+        def ibody(l, carry):
+            x, ck_all, cv_all = carry
+
+            def get(name):
+                return jax.lax.dynamic_index_in_dim(blocks[name], l,
+                                                    keepdims=False)
+
+            def mm(y, name, dtype):
+                return _qmm_indexed(y, blocks[name], l, dtype)
+
+            ck = jax.lax.dynamic_index_in_dim(ck_all, l, keepdims=False)
+            cv = jax.lax.dynamic_index_in_dim(cv_all, l, keepdims=False)
+            x, ck, cv = body(x, get, mm, ck, cv)
+            ck_all = jax.lax.dynamic_update_index_in_dim(ck_all, ck, l, 0)
+            cv_all = jax.lax.dynamic_update_index_in_dim(cv_all, cv, l, 0)
+            return x, ck_all, cv_all
+
+        return jax.lax.fori_loop(0, num_layers, ibody,
+                                 (x, cache_k, cache_v))
+
+    def sbody(x, xs):
+        layer, ck, cv = xs
+        x, ck, cv = body(x, layer.__getitem__,
+                         lambda y, name, dtype: _qmm(y, layer[name], dtype),
+                         ck, cv)
+        return x, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(sbody, x, (blocks, cache_k, cache_v))
+    return x, ks, vs
 
 
 def forward_cached(cfg: GPT2Config, params, input_ids, cache, pos):
@@ -349,13 +444,10 @@ def forward_cached(cfg: GPT2Config, params, input_ids, cache, pos):
     wpe = jax.lax.dynamic_slice(params["wpe"], (pos, 0), (t, d))
     x = (params["wte"][input_ids] + wpe).astype(params["wte"].dtype)
 
-    def body(x, xs):
-        layer, ck, cv = xs
-        x, ck, cv = _block_cached(cfg, x, layer, ck, cv, pos)
-        return x, (ck, cv)
-
-    x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"],
-                                         cache["v"]))
+    x, ks, vs = decode_over_layers(
+        lambda x, get, mm, ck, cv: _block_cached_body(cfg, x, get, mm, ck,
+                                                      cv, pos),
+        x, params["blocks"], cache["k"], cache["v"], cfg.num_layers)
     x = _layer_norm(x[:, -1], params["lnf_scale"], params["lnf_bias"])
     logits = x @ params["wte"].T.astype(x.dtype)
     return logits, {"k": ks, "v": vs}

@@ -160,30 +160,38 @@ def _attention(cfg: LlamaConfig, q, k, v):
 
 
 def block_apply(cfg: LlamaConfig, layer: PyTree, x, cos, sin):
+    # matmuls route through gpt2._qmm: dense leaves trace to the identical
+    # ``x @ w.astype`` HLO; INT8 records (quant-aware serving prefill)
+    # dequantize at point of use instead of crashing on a dict leaf
+    from .gpt2 import _qmm
+
     b, s, d = x.shape
     h, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
     y = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
-    q = (y @ layer["q_w"].astype(y.dtype)).reshape(b, s, h, hd)
-    k = (y @ layer["k_w"].astype(y.dtype)).reshape(b, s, hkv, hd)
-    v = (y @ layer["v_w"].astype(y.dtype)).reshape(b, s, hkv, hd)
+    q = _qmm(y, layer["q_w"]).reshape(b, s, h, hd)
+    k = _qmm(y, layer["k_w"]).reshape(b, s, hkv, hd)
+    v = _qmm(y, layer["v_w"]).reshape(b, s, hkv, hd)
     q = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)
     k = apply_rope(k.transpose(0, 2, 1, 3), cos, sin)
     v = v.transpose(0, 2, 1, 3)
     attn = _attention(cfg, q, k, v)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
-    x = x + attn @ layer["o_w"].astype(x.dtype)
+    x = x + _qmm(attn, layer["o_w"], x.dtype)
 
     y = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
-    gate = jax.nn.silu(y @ layer["w1"].astype(y.dtype))
-    up = y @ layer["w3"].astype(y.dtype)
-    x = x + (gate * up) @ layer["w2"].astype(x.dtype)
+    gate = jax.nn.silu(_qmm(y, layer["w1"]))
+    up = _qmm(y, layer["w3"])
+    x = x + _qmm(gate * up, layer["w2"], x.dtype)
     return x
 
 
 def forward(cfg: LlamaConfig, params: PyTree, input_ids, rng=None,
             train: bool = True):
     del rng, train  # no dropout in llama pretraining config
+    from .gpt2 import _dequant_resident
+
+    params = _dequant_resident(params)
     b, s = input_ids.shape
     x = params["embed"][input_ids].astype(params["embed"].dtype)
     cos, sin = rope_angles(cfg, s)
@@ -221,18 +229,21 @@ def _rope_cached(cfg: LlamaConfig, x, pos):
     return apply_rope(x, jnp.cos(angles), jnp.sin(angles))
 
 
-def _block_cached(cfg: LlamaConfig, x, layer, ck, cv, pos, mlp_fn=None):
-    """Cached-attention block; ``mlp_fn(layer, y) -> y`` overrides the dense
-    SwiGLU (mixtral reuses this path with its MoE FFN)."""
+def _block_cached_body(cfg: LlamaConfig, x, get, mm, ck, cv, pos,
+                       mlp=None):
+    """Cached-attention block parameterized by weight access (``get(name)``
+    small leaf, ``mm(y, name, dtype)`` matmul — shared by the scan and
+    layer-indexed quantized decode paths, see gpt2.decode_over_layers).
+    ``mlp(y) -> y`` overrides the dense SwiGLU (mixtral's MoE FFN)."""
     from ..ops.decode_attention import decode_attention
 
     b, t, d = x.shape
     h, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
-    y = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
-    q = (y @ layer["q_w"].astype(y.dtype)).reshape(b, t, h, hd)
-    k = (y @ layer["k_w"].astype(y.dtype)).reshape(b, t, hkv, hd)
-    v = (y @ layer["v_w"].astype(y.dtype)).reshape(b, t, hkv, hd)
+    y = rms_norm(x, get("attn_norm"), cfg.rms_eps)
+    q = mm(y, "q_w", None).reshape(b, t, h, hd)
+    k = mm(y, "k_w", None).reshape(b, t, hkv, hd)
+    v = mm(y, "v_w", None).reshape(b, t, hkv, hd)
     q = _rope_cached(cfg, q.transpose(0, 2, 1, 3), pos)
     k = _rope_cached(cfg, k.transpose(0, 2, 1, 3), pos)
     v = v.transpose(0, 2, 1, 3)
@@ -240,32 +251,54 @@ def _block_cached(cfg: LlamaConfig, x, layer, ck, cv, pos, mlp_fn=None):
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, pos, 0))
     attn = decode_attention(q, ck, cv, pos)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, t, h * hd)
-    x = x + attn @ layer["o_w"].astype(x.dtype)
+    x = x + mm(attn, "o_w", x.dtype)
 
-    y = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
-    if mlp_fn is not None:
-        return x + mlp_fn(layer, y), ck, cv
-    gate = jax.nn.silu(y @ layer["w1"].astype(y.dtype))
-    up = y @ layer["w3"].astype(y.dtype)
-    x = x + (gate * up) @ layer["w2"].astype(x.dtype)
+    y = rms_norm(x, get("mlp_norm"), cfg.rms_eps)
+    if mlp is not None:
+        return x + mlp(y), ck, cv
+    gate = jax.nn.silu(mm(y, "w1", None))
+    up = mm(y, "w3", None)
+    x = x + mm(gate * up, "w2", x.dtype)
     return x, ck, cv
+
+
+def _block_cached(cfg: LlamaConfig, x, layer, ck, cv, pos, mlp_fn=None):
+    from .gpt2 import _qmm
+
+    return _block_cached_body(
+        cfg, x, layer.__getitem__,
+        lambda y, name, dtype: _qmm(y, layer[name], dtype), ck, cv, pos,
+        mlp=None if mlp_fn is None else (lambda y: mlp_fn(layer, y)))
 
 
 def forward_cached(cfg: LlamaConfig, params, input_ids, cache, pos,
                    mlp_fn=None):
     """Incremental forward: logits for the LAST input position + updated
     cache.  ``mlp_fn`` threads through to :func:`_block_cached` (mixtral
-    delegates here with its MoE FFN)."""
+    delegates here with its MoE FFN).  Quantized serving (no mlp_fn) takes
+    the layer-indexed stacked-kernel path via gpt2.decode_over_layers."""
+    from .gpt2 import _dequant_resident, decode_over_layers
+
+    params = _dequant_resident(params)
     pos = jnp.asarray(pos, jnp.int32)
     x = params["embed"][input_ids].astype(params["embed"].dtype)
 
-    def body(x, xs):
-        layer, ck, cv = xs
-        x, ck, cv = _block_cached(cfg, x, layer, ck, cv, pos, mlp_fn=mlp_fn)
-        return x, (ck, cv)
+    if mlp_fn is None:
+        x, ks, vs = decode_over_layers(
+            lambda x, get, mm, ck, cv: _block_cached_body(
+                cfg, x, get, mm, ck, cv, pos),
+            x, params["blocks"], cache["k"], cache["v"], cfg.num_layers,
+            probe="q_w")
+    else:
+        # mixtral's MoE FFN needs the whole layer dict: scan path only
+        def body(x, xs):
+            layer, ck, cv = xs
+            x, ck, cv = _block_cached(cfg, x, layer, ck, cv, pos,
+                                      mlp_fn=mlp_fn)
+            return x, (ck, cv)
 
-    x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"],
-                                         cache["v"]))
+        x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"],
+                                             cache["v"]))
     x = rms_norm(x[:, -1], params["final_norm"], cfg.rms_eps)
     return x @ params["lm_head"].astype(x.dtype), {"k": ks, "v": vs}
 
@@ -353,4 +386,5 @@ def build(cfg: Optional[LlamaConfig] = None, **overrides) -> ModelSpec:
             "forward_cached": lambda params, ids, cache, pos: forward_cached(
                 cfg, params, ids, cache, pos),
         },
+        quant_aware=True,  # per-layer point-of-use dequant / w8a8 records
         name=f"llama-{cfg.num_layers}l-{cfg.hidden_size}d")

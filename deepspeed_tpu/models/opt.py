@@ -277,18 +277,20 @@ def init_cache(cfg: OPTConfig, batch_size: int, max_len: int,
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def _block_cached(cfg: OPTConfig, x, layer, ck, cv, pos):
+def _block_cached_body(cfg: OPTConfig, x, get, mm, ck, cv, pos):
+    """One decoder layer over a KV cache, parameterized by how per-layer
+    weights are fetched: ``get(name)`` returns a small leaf, ``mm(y, name,
+    dtype)`` runs ``y @ weight`` — the scan path indexes a pre-sliced layer
+    dict, the quantized indexed path selects the layer in-kernel."""
     from ..ops.decode_attention import decode_attention
-
-    from .gpt2 import _qmm
 
     b, t, d = x.shape
     h, hd = cfg.num_heads, cfg.head_dim
 
     res = x
-    y = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"]) \
+    y = _layer_norm(x, get("ln1_scale"), get("ln1_bias")) \
         if cfg.do_layer_norm_before else x
-    qkv = _qmm(y, layer["qkv_w"]) + layer["qkv_b"].astype(y.dtype)
+    qkv = mm(y, "qkv_w", None) + get("qkv_b").astype(y.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
     k = k.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
@@ -297,38 +299,42 @@ def _block_cached(cfg: OPTConfig, x, layer, ck, cv, pos):
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, pos, 0))
     attn = decode_attention(q, ck, cv, pos)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, t, d)
-    x = res + _qmm(attn, layer["o_w"], x.dtype) + \
-        layer["o_b"].astype(x.dtype)
+    x = res + mm(attn, "o_w", x.dtype) + get("o_b").astype(x.dtype)
     if not cfg.do_layer_norm_before:
-        x = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+        x = _layer_norm(x, get("ln1_scale"), get("ln1_bias"))
 
     res = x
-    y = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"]) \
+    y = _layer_norm(x, get("ln2_scale"), get("ln2_bias")) \
         if cfg.do_layer_norm_before else x
-    hid = jax.nn.relu(_qmm(y, layer["fc_w"]) +
-                      layer["fc_b"].astype(y.dtype))
-    x = res + _qmm(hid, layer["proj_w"], x.dtype) + \
-        layer["proj_b"].astype(x.dtype)
+    hid = jax.nn.relu(mm(y, "fc_w", None) + get("fc_b").astype(y.dtype))
+    x = res + mm(hid, "proj_w", x.dtype) + get("proj_b").astype(x.dtype)
     if not cfg.do_layer_norm_before:
-        x = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+        x = _layer_norm(x, get("ln2_scale"), get("ln2_bias"))
     return x, ck, cv
 
 
+def _block_cached(cfg: OPTConfig, x, layer, ck, cv, pos):
+    from .gpt2 import _qmm
+
+    return _block_cached_body(
+        cfg, x, layer.__getitem__,
+        lambda y, name, dtype: _qmm(y, layer[name], dtype), ck, cv, pos)
+
+
 def forward_cached(cfg: OPTConfig, params, input_ids, cache, pos):
-    """Incremental forward: logits for the LAST position + updated cache."""
-    from .gpt2 import _dequant_resident
+    """Incremental forward: logits for the LAST position + updated cache.
+    Quantized serving runs the layer-indexed loop (stacked s8 kernel,
+    gpt2.decode_over_layers) instead of the scan."""
+    from .gpt2 import _dequant_resident, decode_over_layers
 
     params = _dequant_resident(params)
     pos = jnp.asarray(pos, jnp.int32)
     x = _embed(cfg, params, input_ids, pos0=pos)
 
-    def body(x, xs):
-        layer, ck, cv = xs
-        x, ck, cv = _block_cached(cfg, x, layer, ck, cv, pos)
-        return x, (ck, cv)
-
-    x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"],
-                                         cache["v"]))
+    x, ks, vs = decode_over_layers(
+        lambda x, get, mm, ck, cv: _block_cached_body(cfg, x, get, mm, ck,
+                                                      cv, pos),
+        x, params["blocks"], cache["k"], cache["v"], cfg.num_layers)
     logits = _head(cfg, params, x[:, -1])
     return logits, {"k": ks, "v": vs}
 

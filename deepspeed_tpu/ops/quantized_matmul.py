@@ -49,6 +49,11 @@ def _use_interpret() -> bool:
 #: layouts (``w8a8_tp=True``): column-parallel (N sharded — every shard runs
 #: the s8 kernel on its weight slice, no communication) and row-parallel
 #: (K sharded — local partial on the s8 kernel, one psum after).
+#: decode-shaped row cap shared by w8a8_matmul / w8a8_matmul_stacked and
+#: the models' indexed-decode gate (gpt2.use_indexed_decode)
+W8A8_MAX_ROWS = 8
+
+
 _KERNEL_OK = True
 _W8A8_TP = False
 
@@ -224,14 +229,17 @@ def quantized_matmul(x, rec: dict, out_dtype=None, *, block_k: int = None,
 
 
 def _w8a8_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, nk: int,
-                 k_group: int):
+                 k_group: int, stacked: bool = False):
+    # ``stacked``: q_ref/s_ref carry a leading unit layer dim (the stacked
+    # entry's scalar-prefetch index maps picked the layer; the body is
+    # otherwise identical, so both paths share this one implementation).
     ki = pl.program_id(1)
 
     @pl.when(ki == 0)
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    bk = q_ref.shape[0]
+    bk = q_ref.shape[1] if stacked else q_ref.shape[0]
     _, b, sub = x_ref.shape                       # sub == k_group
 
     def tile(t, _):
@@ -240,8 +248,12 @@ def _w8a8_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, nk: int,
         ax = jnp.where(ax == 0, 1.0, ax)
         xq = jnp.clip(jnp.round(xt * (127.0 / ax)),
                       -127, 127).astype(jnp.int8)
-        qt = q_ref[pl.ds(t * sub, sub)]                       # [sub, bn] s8
-        st = s_ref[pl.ds(t, 1)].reshape(1, -1)                # [1, bn] f32
+        if stacked:
+            qt = q_ref[0, pl.ds(t * sub, sub)]                # [sub, bn] s8
+            st = s_ref[0, pl.ds(t, 1)].reshape(1, -1)         # [1, bn] f32
+        else:
+            qt = q_ref[pl.ds(t * sub, sub)]                   # [sub, bn] s8
+            st = s_ref[pl.ds(t, 1)].reshape(1, -1)            # [1, bn] f32
         part = jax.lax.dot(xq, qt,
                            preferred_element_type=jnp.int32)  # s8 MXU
         acc_ref[...] += part.astype(jnp.float32) * (ax / 127.0) * st
@@ -293,6 +305,19 @@ def _w8a8_call(x2d, qk, kscale, out_dtype, block_k, interpret,
     )(x3, qk, kscale)
 
 
+def _w8a8_pick_bk(k_dim, kg_blocks, n_dim, block_k):
+    """Shared W8A8 block sizing: returns ``(bk, k_group)`` with ``bk == 0``
+    when the shape cannot tile (the per-layer and stacked entries must stay
+    eligible under IDENTICAL conditions)."""
+    k_group = k_dim // kg_blocks if kg_blocks else 0
+    if not (k_group and k_dim % kg_blocks == 0):
+        return 0, k_group
+    if block_k is None:
+        step_bytes = int(float(os.environ.get("DS_QMM_STEP_MB", 4)) * 2**20)
+        block_k = max(1, step_bytes // max(n_dim, 1))
+    return _pick_block(k_dim, k_group, block_k, k_group), k_group
+
+
 def _w8a8_local(x2d, qk, kscale3, block_k=None, out_dtype=None):
     """One shard's worth of the W8A8 matmul: the s8-MXU kernel when the
     LOCAL shapes tile (lane-aligned N, whole k-groups), exact dequant+matmul
@@ -304,15 +329,7 @@ def _w8a8_local(x2d, qk, kscale3, block_k=None, out_dtype=None):
 
     out_dtype = out_dtype or x2d.dtype
     k_dim, n_dim = qk.shape
-    kg_blocks = kscale3.shape[0]
-    k_group = k_dim // kg_blocks if kg_blocks else 0
-    bk = 0
-    if k_group and k_dim % kg_blocks == 0:
-        if block_k is None:
-            step_bytes = int(
-                float(os.environ.get("DS_QMM_STEP_MB", 4)) * 2**20)
-            block_k = max(1, step_bytes // max(n_dim, 1))
-        bk = _pick_block(k_dim, k_group, block_k, k_group)
+    bk, _ = _w8a8_pick_bk(k_dim, kscale3.shape[0], n_dim, block_k)
     if (bk > 0 and n_dim % 128 == 0
             and os.environ.get("DS_W8A8", "1") != "0"):
         return _w8a8_call(x2d, qk, kscale3, out_dtype, bk, _use_interpret(),
@@ -431,7 +448,7 @@ _w8a8_tp_call.def_partition(
 
 
 def w8a8_matmul(x, rec: dict, out_dtype=None, *, block_k: int = None,
-                max_rows: int = 8):
+                max_rows: int = W8A8_MAX_ROWS):
     """``x @ dequant_k(rec)`` on the s8 MXU with in-kernel activation
     quantization.  Decode-shaped inputs only (``rows <= max_rows``); other
     shapes — and ``DS_W8A8=0`` — fall back to dequantize+matmul (prefill
@@ -467,3 +484,101 @@ def w8a8_matmul(x, rec: dict, out_dtype=None, *, block_k: int = None,
             return x @ quant.dequantize_k(rec, x.dtype)
         return out.astype(out_dtype or x.dtype).reshape(lead + (n_dim,))
     return x @ quant.dequantize_k(rec, x.dtype)
+
+
+# ------------------------------------------------- stacked (indexed) W8A8
+# A scan/fori_loop over stacked per-layer weights hands the kernel a
+# dynamic-slice of the [L, K, N] stack; XLA cannot fuse that slice into a
+# custom call, so it materializes a per-layer int8 COPY in HBM every decode
+# step — read + write + read where the payload is one read.  The stacked
+# kernel instead takes the WHOLE stack plus the layer index as a
+# scalar-prefetch operand: the BlockSpec index maps add the layer offset
+# and every weight block is DMA'd straight from the resident stack.
+
+
+def _w8a8_stacked_kernel(idx_ref, x_ref, q_ref, s_ref, o_ref, acc_ref, *,
+                         nk: int, k_group: int):
+    del idx_ref  # consumed by the index maps; kernel body sees the blocks
+    _w8a8_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, nk=nk,
+                 k_group=k_group, stacked=True)
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype", "block_k",
+                                             "interpret", "vmem_limit"))
+def _w8a8_stacked_call(idx, x2d, qks, kscales, out_dtype, block_k,
+                       interpret, vmem_limit=None):
+    b, k_dim = x2d.shape
+    n_layers, _, n_dim = qks.shape
+    k_group = k_dim // kscales.shape[1]
+    grid = (1, k_dim // block_k)
+    x3 = x2d.reshape(b, k_dim // k_group, k_group).swapaxes(0, 1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_k // k_group, b, k_group),
+                         lambda n, ki, idx_ref: (ki, 0, 0)),
+            pl.BlockSpec((1, block_k, n_dim),
+                         lambda n, ki, idx_ref: (idx_ref[0], ki, 0)),
+            pl.BlockSpec((1, block_k // k_group, 1, n_dim),
+                         lambda n, ki, idx_ref: (idx_ref[0], ki, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((b, n_dim), lambda n, ki, idx_ref: (0, 0)),
+        scratch_shapes=[pltpu.VMEM((b, n_dim), jnp.float32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_w8a8_stacked_kernel, nk=grid[1], k_group=k_group),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n_dim), out_dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+            vmem_limit_bytes=vmem_limit),
+        interpret=interpret,
+    )(jnp.asarray(idx, jnp.int32).reshape(1), x3, qks, kscales)
+
+
+def stacked_kernel_enabled() -> bool:
+    """True when :func:`w8a8_matmul_stacked` would actually select the layer
+    in-kernel.  Models gate their layer-indexed decode loop on this: under
+    TP, or with the kernel disabled, the indexed loop would pay the
+    per-layer dynamic-slice cost the scan path pays WITHOUT the stacked
+    kernel's benefit (plus extra KV-stack slice/update traffic)."""
+    return (_KERNEL_OK and not _W8A8_TP
+            and os.environ.get("DS_W8A8", "1") != "0")
+
+
+def w8a8_matmul_stacked(x, rec: dict, layer_idx, out_dtype=None, *,
+                        block_k: int = None, max_rows: int = W8A8_MAX_ROWS):
+    """``x @ dequant_k(rec[layer_idx])`` on the s8 MXU, selecting the layer
+    INSIDE the kernel (scalar-prefetch index) so no per-layer weight copy
+    is ever materialized.  ``rec`` holds stacked records (``qk [L, K, N]``,
+    ``kscale [L, K/G, 1, N]``); ``layer_idx`` may be a traced scalar (a
+    ``fori_loop`` induction variable).  Shapes the kernel cannot tile fall
+    back to dequantizing the sliced layer — the same cost the scan path
+    always pays."""
+    from . import quantization as quant
+
+    qk, kscale = rec["qk"], rec["kscale"]
+    assert qk.ndim == 3, "stacked records only; use w8a8_matmul for 2D"
+    k_dim, n_dim = qk.shape[-2], qk.shape[-1]
+    lead = x.shape[:-1]
+    rows = 1
+    for d in lead:
+        rows *= d
+    bk, _ = _w8a8_pick_bk(k_dim, kscale.shape[-3], n_dim, block_k)
+    eligible = (rows <= max_rows and bk > 0 and n_dim % 128 == 0
+                and stacked_kernel_enabled())
+    if eligible:
+        x2d = x.reshape(rows, k_dim)
+        out = _w8a8_stacked_call(layer_idx, x2d, qk, kscale,
+                                 out_dtype or x.dtype, bk,
+                                 _use_interpret(),
+                                 vmem_limit=_qmm_vmem_limit())
+        return out.reshape(lead + (n_dim,))
+    layer = {
+        "qk": jax.lax.dynamic_index_in_dim(qk, layer_idx, keepdims=False),
+        "kscale": jax.lax.dynamic_index_in_dim(kscale, layer_idx,
+                                               keepdims=False),
+    }
+    return w8a8_matmul(x, layer, out_dtype=out_dtype, block_k=block_k,
+                       max_rows=max_rows)

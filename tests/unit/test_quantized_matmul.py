@@ -167,13 +167,15 @@ def test_w8a8_engine_decode(monkeypatch):
 
 
 def test_w8a8_rejects_non_quant_aware_model():
+    # mixtral's forwards don't dequantize at point of use (llama became
+    # quant-aware in round 4)
     import deepspeed_tpu
-    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.models import mixtral
 
     deepspeed_tpu.comm.reset_topology()
     with pytest.raises(ValueError, match="w8a8"):
         deepspeed_tpu.init_inference(
-            model=llama.build(llama.LlamaConfig.tiny()),
+            model=mixtral.build(mixtral.MixtralConfig.tiny()),
             config={"dtype": "float32",
                     "quant": {"enabled": True, "type": "w8a8"}})
 
@@ -491,3 +493,171 @@ def test_quantize_pytree_k_grouped_shard_multiple():
     # ineligible leaf stays dense under every shard_multiple
     assert not quant.is_k_quantized(base["odd"])
     assert not quant.is_k_quantized(ref8["odd"])
+
+
+def test_w8a8_stacked_matches_per_layer():
+    """The stacked (scalar-prefetch layer index) kernel returns EXACTLY the
+    per-layer kernel's result for every layer, including traced indices."""
+    from deepspeed_tpu.ops.quantized_matmul import (w8a8_matmul,
+                                                    w8a8_matmul_stacked)
+
+    rng = np.random.default_rng(3)
+    L, K, N, G = 3, 512, 256, 128
+    w = jnp.asarray(rng.standard_normal((L, K, N)), jnp.float32) * 0.05
+    rec = quant.quantize_k_grouped(w, k_group=G)
+    x = jnp.asarray(rng.standard_normal((1, K)), jnp.bfloat16)
+    for l in range(L):
+        layer = {"qk": rec["qk"][l], "kscale": rec["kscale"][l]}
+        a = np.asarray(w8a8_matmul(x, layer, out_dtype=jnp.float32))
+        b = np.asarray(w8a8_matmul_stacked(x, rec, jnp.int32(l),
+                                           out_dtype=jnp.float32))
+        np.testing.assert_array_equal(a, b)
+
+    def body(l, acc):
+        return acc + w8a8_matmul_stacked(x, rec, l, out_dtype=jnp.float32)
+
+    tot = np.asarray(jax.lax.fori_loop(0, L, body,
+                                       jnp.zeros((1, N), jnp.float32)))
+    want = sum(np.asarray(w8a8_matmul(
+        x, {"qk": rec["qk"][l], "kscale": rec["kscale"][l]},
+        out_dtype=jnp.float32)) for l in range(L))
+    np.testing.assert_allclose(tot, want, rtol=1e-5, atol=1e-5)
+
+
+def test_w8a8_stacked_ineligible_falls_back():
+    """Off-lane N and TP mode route the stacked call to the sliced-layer
+    path (same math, no kernel)."""
+    from deepspeed_tpu.ops import quantized_matmul as qmm
+
+    rng = np.random.default_rng(4)
+    L, K, N = 2, 256, 96          # N % 128 != 0 -> ineligible
+    w = jnp.asarray(rng.standard_normal((L, K, N)), jnp.float32)
+    rec = quant.quantize_k_grouped(w, k_group=128)
+    x = jnp.asarray(rng.standard_normal((1, K)), jnp.float32)
+    out = np.asarray(qmm.w8a8_matmul_stacked(x, rec, 1))
+    ref = np.asarray(x @ quant.dequantize_k(
+        {"qk": rec["qk"][1], "kscale": rec["kscale"][1]}, x.dtype))
+    np.testing.assert_allclose(out, ref, rtol=5e-2, atol=5e-1)
+
+
+def _tiny_model(family):
+    if family == "opt":
+        from deepspeed_tpu.models import opt as m
+
+        cfg = m.OPTConfig(vocab_size=512, max_seq_len=64, num_layers=2,
+                          num_heads=4, hidden_size=128, ffn_size=512)
+    else:
+        from deepspeed_tpu.models import gpt2 as m
+
+        cfg = m.GPT2Config(vocab_size=512, max_seq_len=64, num_layers=2,
+                           num_heads=4, hidden_size=128, remat=False)
+    return m, cfg
+
+
+@pytest.mark.parametrize("family", ["opt", "gpt2"])
+def test_indexed_decode_matches_scan_path(family, monkeypatch):
+    """forward_cached's layer-indexed loop (quantized serving) produces the
+    same tokens as the scan path (DS_INDEXED_DECODE=0 kill switch) over the
+    same quantized records — the dispatch is shared (gpt2.decode_over_layers)
+    so every quant-aware family goes through it."""
+    import deepspeed_tpu
+
+    m, cfg = _tiny_model(family)
+    cpu = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu):
+        params = m.build(cfg).init_fn(jax.random.PRNGKey(0))
+    params = jax.device_get(params)
+    ids = np.ones((1, 6), dtype=np.int32)
+    qcfg = {"dtype": "float32", "quant": {"enabled": True, "type": "w8a8"}}
+
+    monkeypatch.setenv("DS_INDEXED_DECODE", "1")  # ambient =0 would make
+    deepspeed_tpu.comm.reset_topology()           # this test vacuous
+    eng = deepspeed_tpu.init_inference(model=m.build(cfg), params=params,
+                                       config=qcfg)
+    tok_indexed = np.asarray(eng.generate(ids, max_new_tokens=8))
+
+    monkeypatch.setenv("DS_INDEXED_DECODE", "0")
+    deepspeed_tpu.comm.reset_topology()
+    eng2 = deepspeed_tpu.init_inference(model=m.build(cfg), params=params,
+                                        config=qcfg)
+    tok_scan = np.asarray(eng2.generate(ids, max_new_tokens=8))
+    np.testing.assert_array_equal(tok_indexed, tok_scan)
+
+
+def test_indexed_decode_gate_respects_kernel_state(monkeypatch):
+    """use_indexed_decode is False whenever the stacked kernel would fall
+    back (TP mode, kernel off, DS_W8A8=0, unquantized blocks) — the indexed
+    loop must not run without its benefit."""
+    from deepspeed_tpu.models.gpt2 import use_indexed_decode
+    from deepspeed_tpu.ops import quantized_matmul as qmm
+    from deepspeed_tpu.ops import quantization as quant
+
+    w = jnp.ones((2, 256, 128), jnp.float32)
+    blocks = {"qkv_w": quant.quantize_k_grouped(w, k_group=128)}
+    monkeypatch.setenv("DS_INDEXED_DECODE", "1")
+    monkeypatch.setenv("DS_W8A8", "1")
+
+    try:
+        qmm.configure(kernel_ok=True, w8a8_tp=False)
+        assert use_indexed_decode(blocks)
+        qmm.configure(kernel_ok=True, w8a8_tp=True)    # TP serving
+        assert not use_indexed_decode(blocks)
+        qmm.configure(kernel_ok=False, w8a8_tp=False)  # kernel unavailable
+        assert not use_indexed_decode(blocks)
+        qmm.configure(kernel_ok=True, w8a8_tp=False)
+        monkeypatch.setenv("DS_W8A8", "0")             # w8a8 disabled
+        assert not use_indexed_decode(blocks)
+        monkeypatch.setenv("DS_W8A8", "1")
+        assert not use_indexed_decode({"qkv_w": w})    # dense blocks
+        assert use_indexed_decode(blocks, rows=8)      # batched decode
+        assert not use_indexed_decode(blocks, rows=9)  # prefill/big batch
+        monkeypatch.setenv("DS_INDEXED_DECODE", "0")   # kill switch
+        assert not use_indexed_decode(blocks)
+    finally:
+        # module-global kernel state: a failed assert must not leak TP
+        # mode into later tests
+        qmm.configure(kernel_ok=True, w8a8_tp=False)
+
+
+def test_llama_w8a8_serving(monkeypatch):
+    """Llama is quant-aware (round 4): w8a8 serving decodes through the
+    stacked-kernel indexed path with token parity vs the scan kill switch,
+    and logits track the dense model."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama
+
+    cfg = llama.LlamaConfig(vocab_size=512, max_seq_len=64, num_layers=2,
+                            num_heads=4, num_kv_heads=2, hidden_size=128,
+                            ffn_size=256, rope_theta=10000.0, remat=False)
+    cpu = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu):
+        params = llama.build(cfg).init_fn(jax.random.PRNGKey(0))
+    params = jax.device_get(params)
+    ids = np.ones((1, 6), dtype=np.int32)
+
+    deepspeed_tpu.comm.reset_topology()
+    ref_eng = deepspeed_tpu.init_inference(
+        model=llama.build(cfg), params=params, config={"dtype": "float32"})
+    ref_tok = np.asarray(ref_eng.generate(ids, max_new_tokens=8))
+    ref_logits = np.asarray(ref_eng.forward({"input_ids": ids}))
+
+    qcfg = {"dtype": "float32", "quant": {"enabled": True, "type": "w8a8"}}
+    monkeypatch.setenv("DS_INDEXED_DECODE", "1")
+    deepspeed_tpu.comm.reset_topology()
+    eng = deepspeed_tpu.init_inference(model=llama.build(cfg),
+                                       params=params, config=qcfg)
+    from deepspeed_tpu.ops import quantization as q
+    recs = [x for x in jax.tree_util.tree_leaves(
+        eng.params, is_leaf=q.is_k_quantized) if q.is_k_quantized(x)]
+    assert recs, "llama w8a8 quantization produced no K-grouped records"
+    tok = np.asarray(eng.generate(ids, max_new_tokens=8))
+    logits = np.asarray(eng.forward({"input_ids": ids}))
+    np.testing.assert_allclose(logits, ref_logits, rtol=2e-1, atol=2e-1)
+    assert (tok == ref_tok).mean() >= 0.75, (tok, ref_tok)
+
+    monkeypatch.setenv("DS_INDEXED_DECODE", "0")
+    deepspeed_tpu.comm.reset_topology()
+    eng2 = deepspeed_tpu.init_inference(model=llama.build(cfg),
+                                        params=params, config=qcfg)
+    tok_scan = np.asarray(eng2.generate(ids, max_new_tokens=8))
+    np.testing.assert_array_equal(tok, tok_scan)
