@@ -146,7 +146,8 @@ class InferenceEngine:
             # on OPT-1.3B — for a memory saving that is <5% of the model.
             params = jax.device_get(params)
             hooks = getattr(model, "pipeline_hooks", None) or {}
-            bkey = hooks.get("blocks_key") if model.quant_aware else None
+            bkey = (getattr(model, "blocks_key", None)
+                    or hooks.get("blocks_key")) if model.quant_aware else None
             w8a8 = config.quant.type == "w8a8"
             if w8a8 and bkey is None:
                 raise ValueError(

@@ -142,12 +142,18 @@ def _attention(cfg: BloomConfig, q, k, v, q_offset=0):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-def _block(cfg: BloomConfig, x, layer, pos=0, cache=None):
+def _block(cfg: BloomConfig, x, layer, pos=0, cache=None, get=None,
+           mm=None):
+    if get is None or mm is None:
+        from .gpt2 import layer_accessors
+
+        get, mm = layer_accessors(layer)
+
     b, s, d = x.shape
     h, hd = cfg.num_heads, cfg.head_dim
 
-    y = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
-    qkv = y @ layer["qkv_w"].astype(y.dtype) + layer["qkv_b"].astype(y.dtype)
+    y = _layer_norm(x, get("ln1_scale"), get("ln1_bias"))
+    qkv = mm(y, "qkv_w", None) + get("qkv_b").astype(y.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
     k = k.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
@@ -163,13 +169,12 @@ def _block(cfg: BloomConfig, x, layer, pos=0, cache=None):
     else:
         attn = _attention(cfg, q, k, v)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
-    x = x + attn @ layer["o_w"].astype(x.dtype) + layer["o_b"].astype(x.dtype)
+    x = x + mm(attn, "o_w", x.dtype) + get("o_b").astype(x.dtype)
 
-    y = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
-    hid = jax.nn.gelu(y @ layer["fc_w"].astype(y.dtype) +
-                      layer["fc_b"].astype(y.dtype), approximate=False)
-    x = x + hid @ layer["proj_w"].astype(x.dtype) + \
-        layer["proj_b"].astype(x.dtype)
+    y = _layer_norm(x, get("ln2_scale"), get("ln2_bias"))
+    hid = jax.nn.gelu(mm(y, "fc_w", None) + get("fc_b").astype(y.dtype),
+                      approximate=False)
+    x = x + mm(hid, "proj_w", x.dtype) + get("proj_b").astype(x.dtype)
     return x, cache
 
 
@@ -180,6 +185,9 @@ def _embed(cfg: BloomConfig, params, input_ids):
 
 def forward(cfg: BloomConfig, params: PyTree, input_ids, rng=None,
             train: bool = True):
+    from .gpt2 import _dequant_resident
+
+    params = _dequant_resident(params)
     x = _embed(cfg, params, input_ids)
 
     def body(x, xs):
@@ -200,16 +208,19 @@ def init_cache(cfg: BloomConfig, batch_size: int, max_len: int,
 
 
 def forward_cached(cfg: BloomConfig, params, input_ids, cache, pos):
+    from .gpt2 import _dequant_resident, decode_over_layers
+
+    params = _dequant_resident(params)
     pos = jnp.asarray(pos, jnp.int32)
     x = _embed(cfg, params, input_ids)
 
-    def body(x, xs):
-        layer, ck, cv = xs
-        x, (ck, cv) = _block(cfg, x, layer, pos=pos, cache=(ck, cv))
-        return x, (ck, cv)
+    def body(x, get, mm, ck, cv):
+        x, (ck, cv) = _block(cfg, x, None, pos=pos, cache=(ck, cv),
+                             get=get, mm=mm)
+        return x, ck, cv
 
-    x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"],
-                                         cache["v"]))
+    x, ks, vs = decode_over_layers(body, x, params["blocks"], cache["k"],
+                                   cache["v"], cfg.num_layers)
     x = _layer_norm(x[:, -1], params["lnf_scale"], params["lnf_bias"])
     return x @ params["word_embeddings"].T.astype(x.dtype), \
         {"k": ks, "v": vs}
@@ -351,6 +362,7 @@ def build(cfg: Optional[BloomConfig] = None, **overrides) -> ModelSpec:
                      flops_per_token=6.0 * cfg.num_params(),
                      pipeline_hooks=pipeline_hooks,
                      decode_hooks=decode_hooks,
+                     quant_aware=True,  # point-of-use dequant in _block
                      name=f"bloom-{cfg.num_layers}l-{cfg.hidden_size}d")
 
 

@@ -231,6 +231,19 @@ def _qmm_indexed(x, leaf, l, dtype=None):
     return x @ w.astype(dtype)
 
 
+def layer_accessors(layer):
+    """Default weight accessors for an accessor-parameterized block body:
+    ``get(name)`` reads a small leaf from the pre-sliced layer dict, ``mm(y,
+    name, dtype)`` runs the matmul through :func:`_qmm` (identical HLO for
+    dense leaves; point-of-use dequant / w8a8 kernel for INT8 records).
+    The quantized indexed decode path substitutes stacked-kernel accessors
+    instead (:func:`decode_over_layers`)."""
+    def mm(y, name, dtype):
+        return _qmm(y, layer[name], dtype)
+
+    return layer.__getitem__, mm
+
+
 def use_indexed_decode(blocks, probe: str = "qkv_w",
                        rows: int = 1) -> bool:
     """Trace-time dispatch for quantized serving: run the layer-INDEXED
@@ -426,9 +439,7 @@ def decode_over_layers(body, x, blocks, cache_k, cache_v, num_layers,
 
     def sbody(x, xs):
         layer, ck, cv = xs
-        x, ck, cv = body(x, layer.__getitem__,
-                         lambda y, name, dtype: _qmm(y, layer[name], dtype),
-                         ck, cv)
+        x, ck, cv = body(x, *layer_accessors(layer), ck, cv)
         return x, (ck, cv)
 
     x, (ks, vs) = jax.lax.scan(sbody, x, (blocks, cache_k, cache_v))

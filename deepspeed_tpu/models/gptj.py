@@ -141,17 +141,19 @@ def _attention(cfg: GPTJConfig, q, k, v, q_offset=0):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-def _block(cfg: GPTJConfig, x, layer, pos=0, cache=None):
+def _block(cfg: GPTJConfig, x, layer, pos=0, cache=None, get=None, mm=None):
+    if get is None or mm is None:
+        from .gpt2 import layer_accessors
+
+        get, mm = layer_accessors(layer)
+
     b, s, d = x.shape
     h, hd = cfg.num_heads, cfg.head_dim
 
-    y = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
-    q = (y @ layer["q_w"].astype(y.dtype)).reshape(b, s, h, hd) \
-        .transpose(0, 2, 1, 3)
-    k = (y @ layer["k_w"].astype(y.dtype)).reshape(b, s, h, hd) \
-        .transpose(0, 2, 1, 3)
-    v = (y @ layer["v_w"].astype(y.dtype)).reshape(b, s, h, hd) \
-        .transpose(0, 2, 1, 3)
+    y = _layer_norm(x, get("ln1_scale"), get("ln1_bias"))
+    q = mm(y, "q_w", None).reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    k = mm(y, "k_w", None).reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    v = mm(y, "v_w", None).reshape(b, s, h, hd).transpose(0, 2, 1, 3)
     q = _rope_interleaved(cfg, q, offset=pos)
     k = _rope_interleaved(cfg, k, offset=pos)
     if cache is not None:
@@ -165,18 +167,20 @@ def _block(cfg: GPTJConfig, x, layer, pos=0, cache=None):
     else:
         attn = _attention(cfg, q, k, v)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
-    attn_out = attn @ layer["o_w"].astype(x.dtype)
+    attn_out = mm(attn, "o_w", x.dtype)
 
     # parallel residual off the SAME norm output (GPT-J has one ln per block)
-    hid = jax.nn.gelu(y @ layer["fc_w"].astype(y.dtype) +
-                      layer["fc_b"].astype(y.dtype), approximate=True)
-    mlp_out = hid @ layer["proj_w"].astype(x.dtype) + \
-        layer["proj_b"].astype(x.dtype)
+    hid = jax.nn.gelu(mm(y, "fc_w", None) + get("fc_b").astype(y.dtype),
+                      approximate=True)
+    mlp_out = mm(hid, "proj_w", x.dtype) + get("proj_b").astype(x.dtype)
     return x + attn_out + mlp_out, cache
 
 
 def forward(cfg: GPTJConfig, params: PyTree, input_ids, rng=None,
             train: bool = True):
+    from .gpt2 import _dequant_resident
+
+    params = _dequant_resident(params)
     x = params["wte"][input_ids].astype(params["wte"].dtype)
 
     def body(x, xs):
@@ -198,16 +202,19 @@ def init_cache(cfg: GPTJConfig, batch_size: int, max_len: int,
 
 
 def forward_cached(cfg: GPTJConfig, params, input_ids, cache, pos):
+    from .gpt2 import _dequant_resident, decode_over_layers
+
+    params = _dequant_resident(params)
     pos = jnp.asarray(pos, jnp.int32)
     x = params["wte"][input_ids].astype(params["wte"].dtype)
 
-    def body(x, xs):
-        layer, ck, cv = xs
-        x, (ck, cv) = _block(cfg, x, layer, pos=pos, cache=(ck, cv))
-        return x, (ck, cv)
+    def body(x, get, mm, ck, cv):
+        x, (ck, cv) = _block(cfg, x, None, pos=pos, cache=(ck, cv),
+                             get=get, mm=mm)
+        return x, ck, cv
 
-    x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"],
-                                         cache["v"]))
+    x, ks, vs = decode_over_layers(body, x, params["blocks"], cache["k"],
+                                   cache["v"], cfg.num_layers, probe="q_w")
     x = _layer_norm(x[:, -1], params["lnf_scale"], params["lnf_bias"])
     logits = x @ params["lm_head_w"].astype(x.dtype) + \
         params["lm_head_b"].astype(x.dtype)
@@ -323,4 +330,6 @@ def build(cfg: Optional[GPTJConfig] = None, **overrides) -> ModelSpec:
                      tp_rules=lambda ap: tp_rules(cfg, ap),
                      flops_per_token=6.0 * cfg.num_params(),
                      decode_hooks=decode_hooks,
+                     quant_aware=True,  # point-of-use dequant in _block
+                     blocks_key=("blocks",),
                      name=f"gptj-{cfg.num_layers}l-{cfg.hidden_size}d")

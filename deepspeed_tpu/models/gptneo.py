@@ -147,16 +147,19 @@ def _attention(cfg: GPTNeoConfig, q, k, v, local: bool, q_offset=0):
 
 
 def _block(cfg: GPTNeoConfig, x, layer, local: bool, pos=0, cache=None):
+    # matmuls route through gpt2._qmm (identical HLO for dense leaves;
+    # point-of-use dequant / per-layer w8a8 kernel for INT8 records — the
+    # unrolled loop slices layers statically, so records arrive per-layer
+    # and the stacked indexed path is unnecessary here)
+    from .gpt2 import _qmm
+
     b, s, d = x.shape
     h, hd = cfg.num_heads, cfg.head_dim
 
     y = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
-    q = (y @ layer["q_w"].astype(y.dtype)).reshape(b, s, h, hd) \
-        .transpose(0, 2, 1, 3)
-    k = (y @ layer["k_w"].astype(y.dtype)).reshape(b, s, h, hd) \
-        .transpose(0, 2, 1, 3)
-    v = (y @ layer["v_w"].astype(y.dtype)).reshape(b, s, h, hd) \
-        .transpose(0, 2, 1, 3)
+    q = _qmm(y, layer["q_w"]).reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    k = _qmm(y, layer["k_w"]).reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    v = _qmm(y, layer["v_w"]).reshape(b, s, h, hd).transpose(0, 2, 1, 3)
     if cache is not None:
         ck, cv = cache
         ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
@@ -168,12 +171,12 @@ def _block(cfg: GPTNeoConfig, x, layer, local: bool, pos=0, cache=None):
     else:
         attn = _attention(cfg, q, k, v, local)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
-    x = x + attn @ layer["o_w"].astype(x.dtype) + layer["o_b"].astype(x.dtype)
+    x = x + _qmm(attn, layer["o_w"], x.dtype) + layer["o_b"].astype(x.dtype)
 
     y = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
-    hid = jax.nn.gelu(y @ layer["fc_w"].astype(y.dtype) +
+    hid = jax.nn.gelu(_qmm(y, layer["fc_w"]) +
                       layer["fc_b"].astype(y.dtype), approximate=True)
-    x = x + hid @ layer["proj_w"].astype(x.dtype) + \
+    x = x + _qmm(hid, layer["proj_w"], x.dtype) + \
         layer["proj_b"].astype(x.dtype)
     return x, cache
 
@@ -200,6 +203,9 @@ def _run_blocks(cfg: GPTNeoConfig, params, x, pos=0, cache=None):
 
 def forward(cfg: GPTNeoConfig, params: PyTree, input_ids, rng=None,
             train: bool = True):
+    from .gpt2 import _dequant_resident
+
+    params = _dequant_resident(params)
     b, s = input_ids.shape
     x = (params["wte"][input_ids] + params["wpe"][:s]).astype(
         params["wte"].dtype)
@@ -215,6 +221,9 @@ def init_cache(cfg: GPTNeoConfig, batch_size: int, max_len: int,
 
 
 def forward_cached(cfg: GPTNeoConfig, params, input_ids, cache, pos):
+    from .gpt2 import _dequant_resident
+
+    params = _dequant_resident(params)
     b, t = input_ids.shape
     d = cfg.hidden_size
     pos = jnp.asarray(pos, jnp.int32)
@@ -333,4 +342,6 @@ def build(cfg: Optional[GPTNeoConfig] = None, **overrides) -> ModelSpec:
                      tp_rules=lambda ap: tp_rules(cfg, ap),
                      flops_per_token=6.0 * cfg.num_params(),
                      decode_hooks=decode_hooks,
+                     quant_aware=True,  # per-layer point-of-use dequant
+                     blocks_key=("blocks",),
                      name=f"gptneo-{cfg.num_layers}l-{cfg.hidden_size}d")

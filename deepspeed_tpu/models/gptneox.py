@@ -144,13 +144,18 @@ def _attention(cfg: GPTNeoXConfig, q, k, v, q_offset=0):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-def _block(cfg: GPTNeoXConfig, x, layer, pos=0, cache=None):
+def _block(cfg: GPTNeoXConfig, x, layer, pos=0, cache=None, get=None,
+           mm=None):
+    if get is None or mm is None:
+        from .gpt2 import layer_accessors
+
+        get, mm = layer_accessors(layer)
+
     b, s, d = x.shape
     h, hd = cfg.num_heads, cfg.head_dim
 
-    y1 = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
-    qkv = y1 @ layer["qkv_w"].astype(y1.dtype) + \
-        layer["qkv_b"].astype(y1.dtype)
+    y1 = _layer_norm(x, get("ln1_scale"), get("ln1_bias"))
+    qkv = mm(y1, "qkv_w", None) + get("qkv_b").astype(y1.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
     k = k.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
@@ -168,18 +173,16 @@ def _block(cfg: GPTNeoXConfig, x, layer, pos=0, cache=None):
     else:
         attn = _attention(cfg, q, k, v)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
-    attn_out = attn @ layer["o_w"].astype(x.dtype) + \
-        layer["o_b"].astype(x.dtype)
+    attn_out = mm(attn, "o_w", x.dtype) + get("o_b").astype(x.dtype)
 
     if cfg.use_parallel_residual:
-        y2 = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+        y2 = _layer_norm(x, get("ln2_scale"), get("ln2_bias"))
     else:
         x = x + attn_out
-        y2 = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
-    hid = jax.nn.gelu(y2 @ layer["fc_w"].astype(y2.dtype) +
-                      layer["fc_b"].astype(y2.dtype), approximate=False)
-    mlp_out = hid @ layer["proj_w"].astype(x.dtype) + \
-        layer["proj_b"].astype(x.dtype)
+        y2 = _layer_norm(x, get("ln2_scale"), get("ln2_bias"))
+    hid = jax.nn.gelu(mm(y2, "fc_w", None) + get("fc_b").astype(y2.dtype),
+                      approximate=False)
+    mlp_out = mm(hid, "proj_w", x.dtype) + get("proj_b").astype(x.dtype)
     if cfg.use_parallel_residual:
         x = x + attn_out + mlp_out
     else:
@@ -189,6 +192,9 @@ def _block(cfg: GPTNeoXConfig, x, layer, pos=0, cache=None):
 
 def forward(cfg: GPTNeoXConfig, params: PyTree, input_ids, rng=None,
             train: bool = True):
+    from .gpt2 import _dequant_resident
+
+    params = _dequant_resident(params)
     x = params["embed_in"][input_ids].astype(params["embed_in"].dtype)
 
     def body(x, xs):
@@ -209,16 +215,19 @@ def init_cache(cfg: GPTNeoXConfig, batch_size: int, max_len: int,
 
 
 def forward_cached(cfg: GPTNeoXConfig, params, input_ids, cache, pos):
+    from .gpt2 import _dequant_resident, decode_over_layers
+
+    params = _dequant_resident(params)
     pos = jnp.asarray(pos, jnp.int32)
     x = params["embed_in"][input_ids].astype(params["embed_in"].dtype)
 
-    def body(x, xs):
-        layer, ck, cv = xs
-        x, (ck, cv) = _block(cfg, x, layer, pos=pos, cache=(ck, cv))
-        return x, (ck, cv)
+    def body(x, get, mm, ck, cv):
+        x, (ck, cv) = _block(cfg, x, None, pos=pos, cache=(ck, cv),
+                             get=get, mm=mm)
+        return x, ck, cv
 
-    x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"],
-                                         cache["v"]))
+    x, ks, vs = decode_over_layers(body, x, params["blocks"], cache["k"],
+                                   cache["v"], cfg.num_layers)
     x = _layer_norm(x[:, -1], params["lnf_scale"], params["lnf_bias"])
     return x @ params["embed_out"].astype(x.dtype), {"k": ks, "v": vs}
 
@@ -342,4 +351,6 @@ def build(cfg: Optional[GPTNeoXConfig] = None, **overrides) -> ModelSpec:
                      tp_rules=lambda ap: tp_rules(cfg, ap),
                      flops_per_token=6.0 * cfg.num_params(),
                      decode_hooks=decode_hooks,
+                     quant_aware=True,  # point-of-use dequant in _block
+                     blocks_key=("blocks",),
                      name=f"gptneox-{cfg.num_layers}l-{cfg.hidden_size}d")

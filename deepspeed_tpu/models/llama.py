@@ -263,11 +263,10 @@ def _block_cached_body(cfg: LlamaConfig, x, get, mm, ck, cv, pos,
 
 
 def _block_cached(cfg: LlamaConfig, x, layer, ck, cv, pos, mlp_fn=None):
-    from .gpt2 import _qmm
+    from .gpt2 import layer_accessors
 
     return _block_cached_body(
-        cfg, x, layer.__getitem__,
-        lambda y, name, dtype: _qmm(y, layer[name], dtype), ck, cv, pos,
+        cfg, x, *layer_accessors(layer), ck, cv, pos,
         mlp=None if mlp_fn is None else (lambda y: mlp_fn(layer, y)))
 
 

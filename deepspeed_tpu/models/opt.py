@@ -314,11 +314,9 @@ def _block_cached_body(cfg: OPTConfig, x, get, mm, ck, cv, pos):
 
 
 def _block_cached(cfg: OPTConfig, x, layer, ck, cv, pos):
-    from .gpt2 import _qmm
+    from .gpt2 import layer_accessors
 
-    return _block_cached_body(
-        cfg, x, layer.__getitem__,
-        lambda y, name, dtype: _qmm(y, layer[name], dtype), ck, cv, pos)
+    return _block_cached_body(cfg, x, *layer_accessors(layer), ck, cv, pos)
 
 
 def forward_cached(cfg: OPTConfig, params, input_ids, cache, pos):

@@ -55,6 +55,11 @@ class ModelSpec:
     #: passes the quantized pytree straight through — per-layer peak memory
     #: instead of a whole-tree dequantized copy.
     quant_aware: bool = False
+    #: Tuple path of the [L, ...]-stacked block params for consumers
+    #: outside pipeline parallelism (block-only quantization in the
+    #: inference engine).  Falls back to pipeline_hooks["blocks_key"]
+    #: when unset, so models with pipeline hooks declare it once.
+    blocks_key: Optional[tuple] = None
     #: Optional per-layer decode decomposition for ZeRO-Inference-style
     #: weight streaming (inference/zero_inference.py) — serving models
     #: whose weights exceed device HBM by keeping the stacked blocks

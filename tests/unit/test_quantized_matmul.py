@@ -546,15 +546,38 @@ def _tiny_model(family):
 
         cfg = m.OPTConfig(vocab_size=512, max_seq_len=64, num_layers=2,
                           num_heads=4, hidden_size=128, ffn_size=512)
-    else:
+    elif family == "gpt2":
         from deepspeed_tpu.models import gpt2 as m
 
         cfg = m.GPT2Config(vocab_size=512, max_seq_len=64, num_layers=2,
                            num_heads=4, hidden_size=128, remat=False)
+    elif family == "bloom":
+        from deepspeed_tpu.models import bloom as m
+
+        cfg = m.BloomConfig(vocab_size=512, max_seq_len=64, num_layers=2,
+                            num_heads=4, hidden_size=128)
+    elif family == "gptj":
+        from deepspeed_tpu.models import gptj as m
+
+        cfg = m.GPTJConfig(vocab_size=512, max_seq_len=64, num_layers=2,
+                           num_heads=4, hidden_size=128, rotary_dim=16)
+    elif family == "gptneox":
+        from deepspeed_tpu.models import gptneox as m
+
+        cfg = m.GPTNeoXConfig(vocab_size=512, max_seq_len=64, num_layers=2,
+                              num_heads=4, hidden_size=128)
+    elif family == "gptneo":
+        from deepspeed_tpu.models import gptneo as m
+
+        cfg = m.GPTNeoConfig(vocab_size=512, max_seq_len=64, num_layers=2,
+                             num_heads=4, hidden_size=128, window_size=16)
+    else:
+        raise ValueError(family)
     return m, cfg
 
 
-@pytest.mark.parametrize("family", ["opt", "gpt2"])
+@pytest.mark.parametrize("family", ["opt", "gpt2", "bloom", "gptj",
+                                    "gptneox"])
 def test_indexed_decode_matches_scan_path(family, monkeypatch):
     """forward_cached's layer-indexed loop (quantized serving) produces the
     same tokens as the scan path (DS_INDEXED_DECODE=0 kill switch) over the
@@ -661,3 +684,40 @@ def test_llama_w8a8_serving(monkeypatch):
                                         params=params, config=qcfg)
     tok_scan = np.asarray(eng2.generate(ids, max_new_tokens=8))
     np.testing.assert_array_equal(tok, tok_scan)
+
+
+@pytest.mark.parametrize("family", ["bloom", "gptj", "gptneox", "gptneo"])
+def test_w8a8_serving_new_families(family, monkeypatch):
+    """Round-4 quant-aware families: w8a8 serving decodes with logits
+    tracking the dense model and mostly-agreeing greedy tokens (bloom/
+    gptj/gptneox ride the shared indexed dispatch; gptneo's static
+    local/global loop uses per-layer records)."""
+    import deepspeed_tpu
+
+    m, cfg = _tiny_model(family)
+    cpu = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu):
+        params = m.build(cfg).init_fn(jax.random.PRNGKey(0))
+    params = jax.device_get(params)
+    ids = np.ones((1, 6), dtype=np.int32)
+
+    deepspeed_tpu.comm.reset_topology()
+    ref_eng = deepspeed_tpu.init_inference(
+        model=m.build(cfg), params=params, config={"dtype": "float32"})
+    ref_tok = np.asarray(ref_eng.generate(ids, max_new_tokens=8))
+    ref_logits = np.asarray(ref_eng.forward({"input_ids": ids}))
+
+    monkeypatch.setenv("DS_INDEXED_DECODE", "1")
+    deepspeed_tpu.comm.reset_topology()
+    eng = deepspeed_tpu.init_inference(
+        model=m.build(cfg), params=params,
+        config={"dtype": "float32",
+                "quant": {"enabled": True, "type": "w8a8"}})
+    recs = [x for x in jax.tree_util.tree_leaves(
+        eng.params, is_leaf=quant.is_k_quantized)
+        if quant.is_k_quantized(x)]
+    assert recs, f"{family}: w8a8 produced no K-grouped records"
+    tok = np.asarray(eng.generate(ids, max_new_tokens=8))
+    logits = np.asarray(eng.forward({"input_ids": ids}))
+    np.testing.assert_allclose(logits, ref_logits, rtol=2e-1, atol=2e-1)
+    assert (tok == ref_tok).mean() >= 0.75, (tok, ref_tok)
