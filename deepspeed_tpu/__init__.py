@@ -125,3 +125,19 @@ def init_inference(model=None, config=None, params=None, **kwargs):
         if params is None:
             params = converted
     return InferenceEngine(model, config, params=params)
+
+
+def init_serving(model=None, config=None, params=None, *, slots=8,
+                 max_seq_len=None, prompt_buckets=None, prefill_batch=4,
+                 **kwargs):
+    """Continuous-batching serving entry: an ``init_inference`` engine
+    wrapped in the slot-pool scheduler (``inference/serving.py``).  Mixed-
+    length request traces run at iteration-level granularity — finished
+    sequences free their KV slot immediately and waiting requests prefill
+    into it — instead of ``generate``'s run-to-longest static batches."""
+    from .inference.serving import ServingEngine
+
+    engine = init_inference(model, config, params, **kwargs)
+    return ServingEngine(engine, slots=slots, max_seq_len=max_seq_len,
+                         prompt_buckets=prompt_buckets,
+                         prefill_batch=prefill_batch)

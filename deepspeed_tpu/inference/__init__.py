@@ -1,0 +1,1 @@
+from .serving import Request, ServingEngine, default_buckets  # noqa: F401

@@ -7,10 +7,15 @@ so "injection" is a sharding annotation instead of a module swap), casts to the
 inference dtype, and compiles the forward.  ``jit`` replaces CUDA-graph
 capture/replay (reference :479/:498).
 
-Round-1 decode is full-recompute greedy generation with fixed shapes (one jitted
-``fori_loop`` over the token budget).  The KV-cache decode-attention Pallas path
-(reference ``softmax_context`` kernels) lands in ``ops/decode_attention.py`` and
-will replace the inner step.
+Decode runs over a static KV cache when the model carries ``decode_hooks``
+(prefill + single-token steps through the Pallas decode-attention kernel,
+``ops/decode_attention.py`` — the reference ``softmax_context`` analog); models
+without hooks fall back to full-recompute generation.  Both loops early-exit via
+``lax.while_loop`` once every sequence has emitted ``eos_token_id``.  Compiled
+generate programs are cached per shape with true LRU eviction.  For mixed-length
+request traffic, the continuous-batching scheduler in ``inference/serving.py``
+replaces these one-shot static batches with a slot-based KV pool and
+iteration-level scheduling.
 """
 
 from __future__ import annotations
@@ -41,12 +46,18 @@ def _auto_seed(obj, seed):
 
 def _fill_after_eos(out, prompt_len, eos_token_id):
     """Back-fill everything after the first eos with eos (HF padding
-    semantics).  Shared by the resident and streamed generate paths."""
-    if eos_token_id is not None:
-        for row in range(out.shape[0]):
-            hits = np.where(out[row, prompt_len:] == eos_token_id)[0]
-            if hits.size:
-                out[row, prompt_len + hits[0] + 1:] = eos_token_id
+    semantics).  Shared by the resident and streamed generate paths.
+
+    Vectorized: a cumulative "eos seen" mask over the generated region,
+    shifted right one column, marks every position strictly after each
+    row's first eos (the eos itself stays; rows without eos are untouched;
+    eos inside the prompt is ignored)."""
+    if eos_token_id is not None and out.shape[1] > prompt_len:
+        gen = out[:, prompt_len:]          # view — writes land in ``out``
+        seen = np.cumsum(gen == eos_token_id, axis=1) > 0
+        after = np.concatenate(
+            [np.zeros((out.shape[0], 1), bool), seen[:, :-1]], axis=1)
+        gen[after] = eos_token_id
     return out
 
 
@@ -360,23 +371,32 @@ class InferenceEngine:
                 seed=seed)
         sample_cfg = (do_sample, float(temperature), int(top_k),
                       float(top_p)) if do_sample else None
-        key = (b, prompt_len, max_new_tokens, sample_cfg)
-        if key not in self._generate_fns:
+        # eos is part of the compiled program (early-exit while_loop)
+        key = (b, prompt_len, max_new_tokens, sample_cfg, eos_token_id)
+        # true LRU: a hit re-inserts at the back, so eviction pops the
+        # least-recently-USED shape instead of the oldest-inserted one
+        gen_fn = self._generate_fns.pop(key, None)
+        if gen_fn is None:
             if len(self._generate_fns) >= 32:  # bound the per-shape jit cache
                 self._generate_fns.pop(next(iter(self._generate_fns)))
             if self.module.decode_hooks is not None:
-                self._generate_fns[key] = self._build_kv_cache_gen(
-                    b, prompt_len, total, sample_cfg)
+                gen_fn = self._build_kv_cache_gen(
+                    b, prompt_len, total, sample_cfg, eos_token_id)
             else:
-                self._generate_fns[key] = self._build_recompute_gen(
-                    b, prompt_len, total, sample_cfg)
+                gen_fn = self._build_recompute_gen(
+                    b, prompt_len, total, sample_cfg, eos_token_id)
+        self._generate_fns[key] = gen_fn
         rng = jax.random.PRNGKey(_auto_seed(self, seed))
-        out = self._generate_fns[key](self.params, jnp.asarray(input_ids), rng)
+        out = gen_fn(self.params, jnp.asarray(input_ids), rng)
         out = np.array(out)  # writable host copy (np.asarray view is read-only)
         return _fill_after_eos(out, prompt_len, eos_token_id)
 
-    def _build_recompute_gen(self, b, prompt_len, total, sample_cfg=None):
-        """Full-recompute fallback for models without decode hooks."""
+    def _build_recompute_gen(self, b, prompt_len, total, sample_cfg=None,
+                             eos_token_id=None):
+        """Full-recompute fallback for models without decode hooks.  With an
+        ``eos_token_id`` the token loop is a ``lax.while_loop`` that stops
+        stepping once every sequence has emitted eos (positions past a
+        row's eos stay 0 in-graph; ``_fill_after_eos`` back-fills them)."""
         apply_fn = self.module.apply_fn
         pick = _make_token_picker(sample_cfg)
         prepare = self._prepare
@@ -386,20 +406,41 @@ class InferenceEngine:
             buf = jnp.zeros((b, total), jnp.int32)
             buf = buf.at[:, :prompt_len].set(ids)
 
-            def body(i, buf):
+            def step(i, buf):
                 logits = apply_fn(params, {"input_ids": buf}, None)
                 next_tok = pick(logits[:, i - 1, :],
                                 jax.random.fold_in(rng, i))
-                return buf.at[:, i].set(next_tok)
+                return buf.at[:, i].set(next_tok), next_tok
 
-            return jax.lax.fori_loop(prompt_len, total, body, buf)
+            if eos_token_id is None:
+                return jax.lax.fori_loop(
+                    prompt_len, total,
+                    lambda i, buf: step(i, buf)[0], buf)
+
+            def cond(carry):
+                _, i, done = carry
+                return (i < total) & ~jnp.all(done)
+
+            def body(carry):
+                buf, i, done = carry
+                buf, next_tok = step(i, buf)
+                return buf, i + 1, done | (next_tok == eos_token_id)
+
+            buf, _, _ = jax.lax.while_loop(
+                cond, body,
+                (buf, jnp.int32(prompt_len), jnp.zeros((b,), bool)))
+            return buf
 
         return jax.jit(gen)
 
-    def _build_kv_cache_gen(self, b, prompt_len, total, sample_cfg=None):
+    def _build_kv_cache_gen(self, b, prompt_len, total, sample_cfg=None,
+                            eos_token_id=None):
         """Prefill + single-token decode loop over a static KV cache
         (reference ``softmax_context`` path; workspace sized like
-        ``inference_context.h`` by the token budget)."""
+        ``inference_context.h`` by the token budget).  With an
+        ``eos_token_id`` the loop is a ``lax.while_loop`` that stops once
+        every sequence has emitted eos — mixed-length batches stop at the
+        LAST-finishing row instead of always burning the full budget."""
         hooks = self.module.decode_hooks
         init_cache, forward_cached = hooks["init_cache"], hooks["forward_cached"]
         # round the workspace up so the Pallas kernel's block_k divides it
@@ -414,20 +455,39 @@ class InferenceEngine:
             buf = jnp.zeros((b, total), jnp.int32)
             buf = buf.at[:, :prompt_len].set(ids)
             logits, cache = forward_cached(params, ids, cache, 0)   # prefill
-            buf = buf.at[:, prompt_len].set(
-                pick(logits, jax.random.fold_in(rng, prompt_len)))
+            first = pick(logits, jax.random.fold_in(rng, prompt_len))
+            buf = buf.at[:, prompt_len].set(first)
 
-            def body(pos, carry):
-                buf, cache = carry
+            def step(pos, buf, cache):
                 tok = jax.lax.dynamic_slice(buf, (0, pos), (b, 1))
-                logits, cache2 = forward_cached(params, tok, cache, pos)
+                logits, cache = forward_cached(params, tok, cache, pos)
                 nxt = pick(logits, jax.random.fold_in(rng, pos + 1))
                 buf = jax.lax.dynamic_update_slice(buf, nxt[:, None],
                                                    (0, pos + 1))
-                return buf, cache2
+                return buf, cache, nxt
 
-            buf, _ = jax.lax.fori_loop(prompt_len, total - 1, body,
-                                       (buf, cache))
+            if eos_token_id is None:
+                def body(pos, carry):
+                    buf, cache = carry
+                    buf, cache, _ = step(pos, buf, cache)
+                    return buf, cache
+
+                buf, _ = jax.lax.fori_loop(prompt_len, total - 1, body,
+                                           (buf, cache))
+                return buf
+
+            def cond(carry):
+                _, _, pos, done = carry
+                return (pos < total - 1) & ~jnp.all(done)
+
+            def body(carry):
+                buf, cache, pos, done = carry
+                buf, cache, nxt = step(pos, buf, cache)
+                return buf, cache, pos + 1, done | (nxt == eos_token_id)
+
+            buf, _, _, _ = jax.lax.while_loop(
+                cond, body, (buf, cache, jnp.int32(prompt_len),
+                             first == eos_token_id))
             return buf
 
         return jax.jit(gen)

@@ -1,0 +1,340 @@
+"""Continuous-batching serving: slot-based KV-cache pool + iteration-level
+scheduler.
+
+The one-shot :meth:`InferenceEngine.generate` path holds a whole batch until
+its *longest* request finishes and jit-compiles a fresh program for every exact
+``(batch, prompt_len, max_new_tokens)`` tuple — Orca's head-of-line-blocking
+problem.  This module serves mixed-length traffic the way Orca/vLLM do:
+
+ - **Slot pool**: one statically-shaped KV cache of ``SLOTS`` sequence slots
+   (plus one scratch slot that absorbs pad rows), allocated once via the
+   model's ``init_cache`` hook.  A finished sequence frees its slot
+   *immediately*; the next waiting request is prefilled into it on the
+   following iteration.
+ - **Iteration-level scheduling**: every engine iteration admits waiting
+   requests into free slots (strict FIFO — no starvation), runs one bucketed
+   prefill per prompt bucket for the joiners, then one single-token decode
+   step over *all* slots.  Each slot carries its own position: the decode
+   contract is the per-sequence ``lengths: int32[B]`` vector threaded through
+   ``forward_cached`` down to ``ops/decode_attention``.
+ - **Bucketed compilation**: prompts are right-padded to a small bucket
+   ladder and joiners to a fixed prefill batch, so the whole serving loop
+   compiles ``O(#buckets) + 1`` XLA programs regardless of how many request
+   shapes the trace contains.  ``compile_count`` / ``compiled_programs`` are
+   the probe the tests assert against.
+
+Greedy decoding only: per-request outputs are token-identical to sequential
+``generate`` (pinned in ``tests/unit/test_serving.py``).  Sampling needs
+per-request RNG lanes and is left to a follow-up.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..utils.logging import log_dist
+
+
+def default_buckets(max_seq_len: int, lo: int = 32) -> Tuple[int, ...]:
+    """Power-of-two prompt-bucket ladder ``[lo, .., max_seq_len]``."""
+    buckets = []
+    b = lo
+    while b < max_seq_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_seq_len)
+    return tuple(buckets)
+
+
+@dataclasses.dataclass
+class Request:
+    """One serving request: prompt token ids + a completion budget."""
+    uid: Any
+    prompt: np.ndarray                      # int32 [prompt_len]
+    max_new_tokens: int = 32
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError(f"request {self.uid!r}: empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(f"request {self.uid!r}: max_new_tokens must "
+                             "be >= 1")
+
+
+@dataclasses.dataclass
+class _SlotState:
+    req: Request
+    out: List[int] = dataclasses.field(default_factory=list)
+
+
+class ServingEngine:
+    """Iteration-level (continuous-batching) scheduler over an
+    :class:`~deepspeed_tpu.inference.engine.InferenceEngine`'s KV-decode path.
+
+    Parameters
+    ----------
+    engine:        an ``init_inference`` engine whose model carries
+                   ``decode_hooks`` with ``supports_lengths`` (gpt2 / llama /
+                   opt / mixtral families).
+    slots:         KV-cache pool size = max concurrently-decoding sequences.
+    max_seq_len:   per-slot cache length (prompt + completion budget);
+                   rounded up to a multiple of 128 for the Pallas block_k,
+                   clamped to the model context length.
+    prompt_buckets: ascending prompt-length ladder; prompts pad up to the
+                   smallest fitting bucket.  Default: powers of two.
+    prefill_batch: fixed number of joiner rows per prefill program (shorter
+                   groups pad into the scratch slot), so joiner count never
+                   forces a recompile.
+    """
+
+    def __init__(self, engine, *, slots: int = 8,
+                 max_seq_len: Optional[int] = None,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 prefill_batch: int = 4):
+        hooks = engine.module.decode_hooks
+        if not hooks:
+            raise ValueError(
+                f"continuous batching needs decode_hooks; model "
+                f"{engine.module.name} has none")
+        if not hooks.get("supports_lengths"):
+            raise ValueError(
+                f"model {engine.module.name}'s decode hooks predate "
+                "per-sequence lengths (supports_lengths) — update its "
+                "forward_cached to the lengths contract first")
+        self.engine = engine
+        self._fwd = hooks["forward_cached"]
+        self._init_cache = hooks["init_cache"]
+        max_ctx = hooks.get("max_seq_len")
+        if max_seq_len is None:
+            max_seq_len = max_ctx or 512
+        if max_ctx is not None and max_seq_len > max_ctx:
+            raise ValueError(
+                f"max_seq_len {max_seq_len} exceeds the model context "
+                f"length {max_ctx}")
+        self.max_seq_len = int(max_seq_len)
+        # the CACHE may be longer than the logical context: round up so the
+        # Pallas decode kernel's block_k divides it (same rounding as
+        # InferenceEngine._build_kv_cache_gen)
+        self._cache_len = -(-self.max_seq_len // 128) * 128
+        self.slots = int(slots)
+        buckets = tuple(sorted(prompt_buckets)) if prompt_buckets \
+            else default_buckets(self.max_seq_len)
+        if any(b > self.max_seq_len for b in buckets):
+            raise ValueError(
+                f"prompt bucket(s) {buckets} exceed max_seq_len "
+                f"{self.max_seq_len}")
+        self.prompt_buckets = buckets
+        self.prefill_batch = int(prefill_batch)
+        # slot `slots` is SCRATCH: pad rows of short prefill groups write
+        # their (discarded) KV there so every prefill program has a fixed
+        # [prefill_batch] shape.  Committed replicated on the engine mesh so
+        # the very first step sees the same placement as every later one
+        # (an uncommitted pool would cost each program a second trace).
+        rep = NamedSharding(engine.mesh, P())
+        self._cache = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, rep),
+            self._init_cache(self.slots + 1, self._cache_len,
+                             engine._config.jnp_dtype))
+        self._prefill_fns: Dict[int, Any] = {}
+        self._decode_fn = None
+        #: compile probe — one entry per traced program; the serving loop
+        #: stays at O(#buckets)+1 entries for an entire trace
+        self.compiled_programs: List[Any] = []
+        # decode stats for the bench
+        self.iterations = 0
+        self.decode_steps = 0
+        self.prefill_calls = 0
+        log_dist(
+            f"ServingEngine: slots={self.slots}, cache_len="
+            f"{self._cache_len}, buckets={self.prompt_buckets}, "
+            f"prefill_batch={self.prefill_batch}", ranks=[0])
+
+    # ------------------------------------------------------------ compiled fns
+    @property
+    def compile_count(self) -> int:
+        return len(self.compiled_programs)
+
+    def _donate(self):
+        # donating the pool avoids a full cache copy per step; XLA:CPU
+        # ignores donation with a warning, so only ask for it on TPU
+        return (1,) if jax.default_backend() == "tpu" else ()
+
+    def _get_decode_fn(self):
+        if self._decode_fn is None:
+            fwd, prepare = self._fwd, self.engine._prepare
+
+            def step(params, cache, tokens, lengths):
+                logits, cache = fwd(prepare(params), tokens[:, None], cache,
+                                    0, lengths=lengths)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+            self._decode_fn = jax.jit(step, donate_argnums=self._donate())
+            self.compiled_programs.append(("decode", self.slots + 1))
+        return self._decode_fn
+
+    def _get_prefill_fn(self, bucket: int):
+        if bucket not in self._prefill_fns:
+            fwd, prepare = self._fwd, self.engine._prepare
+            init_cache = self._init_cache
+            dtype = self.engine._config.jnp_dtype
+
+            def prefill(params, cache, ids, slot_idx, lengths):
+                """ids [J, bucket] right-padded; slot_idx int32 [J] (pad rows
+                point at the scratch slot); lengths int32 [J]."""
+                params = prepare(params)
+                # fresh slots have no history: prefill into a zeroed
+                # bucket-length sub-cache (no pool gather) and scatter only
+                # the first ``bucket`` positions of each joiner's slot row.
+                # Cache leaves are [L, B, ..., S, hd]: batch dim 1, length
+                # dim -2.  Stale KV beyond ``bucket`` from a previous
+                # occupant is never read — decode masks by each row's
+                # length and overwrites position L before attending it.
+                sub = init_cache(ids.shape[0], bucket, dtype)
+                logits, sub = fwd(params, ids, sub, 0, lengths=lengths)
+                cache = jax.tree_util.tree_map(
+                    lambda c, s: c.at[:, slot_idx, ..., :bucket, :].set(
+                        s.astype(c.dtype)), cache, sub)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+            self._prefill_fns[bucket] = jax.jit(
+                prefill, donate_argnums=self._donate())
+            self.compiled_programs.append(("prefill", bucket,
+                                           self.prefill_batch))
+        return self._prefill_fns[bucket]
+
+    # --------------------------------------------------------------- schedule
+    def _bucket_for(self, prompt_len: int) -> int:
+        for b in self.prompt_buckets:
+            if prompt_len <= b:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest bucket "
+            f"{self.prompt_buckets[-1]}")
+
+    def _admit(self, pending, active, admission_log):
+        """FIFO admission of waiting requests into free slots.  Returns the
+        joiners admitted this iteration as (slot, request) pairs."""
+        joiners = []
+        free = [s for s in range(self.slots) if s not in active]
+        while pending and free:
+            req = pending.popleft()
+            slot = free.pop(0)
+            active[slot] = _SlotState(req)
+            joiners.append((slot, req))
+            admission_log.append((req.uid, slot))
+        return joiners
+
+    def serve(self, requests: Sequence[Request],
+              eos_token_id: Optional[int] = None,
+              admission_log: Optional[list] = None) -> Dict[Any, np.ndarray]:
+        """Run a request trace to completion; returns ``uid -> [prompt +
+        completion]`` int32 arrays, padded to ``prompt + max_new_tokens``
+        with eos back-fill (HF semantics, same as ``generate``).
+
+        ``admission_log``, when given, collects ``(uid, slot)`` in admission
+        order — the scheduler-determinism tests read it.
+        """
+        for r in requests:
+            total = len(r.prompt) + r.max_new_tokens
+            if total > self.max_seq_len:
+                raise ValueError(
+                    f"request {r.uid!r}: prompt ({len(r.prompt)}) + "
+                    f"max_new_tokens ({r.max_new_tokens}) = {total} exceeds "
+                    f"max_seq_len {self.max_seq_len}")
+            self._bucket_for(len(r.prompt))  # raises if no bucket fits
+        uids = [r.uid for r in requests]
+        if len(set(uids)) != len(uids):
+            raise ValueError("duplicate request uids")
+
+        params = self.engine.params
+        pending = deque(requests)
+        active: Dict[int, _SlotState] = {}
+        if admission_log is None:
+            admission_log = []
+        results: Dict[Any, np.ndarray] = {}
+        # host-side mirrors of the device step inputs: the token each slot
+        # feeds next, and how many tokens its cache already holds
+        tokens = np.zeros(self.slots + 1, np.int32)
+        lengths = np.zeros(self.slots + 1, np.int32)
+
+        def finish(slot):
+            st = active.pop(slot)
+            req = st.req
+            out = np.full(req.max_new_tokens, 0, np.int32)
+            gen = np.asarray(st.out, np.int32)
+            out[:gen.size] = gen
+            if eos_token_id is not None and gen.size and \
+                    gen[-1] == eos_token_id:
+                out[gen.size:] = eos_token_id  # back-fill (HF semantics)
+            results[req.uid] = np.concatenate([req.prompt, out])
+            tokens[slot] = 0
+            lengths[slot] = 0
+
+        while pending or active:
+            self.iterations += 1
+            joiners = self._admit(pending, active, admission_log)
+
+            # bucketed prefill, fixed-J groups per bucket
+            by_bucket: Dict[int, list] = {}
+            for slot, req in joiners:
+                by_bucket.setdefault(self._bucket_for(len(req.prompt)),
+                                     []).append((slot, req))
+            for bucket in sorted(by_bucket):
+                group = by_bucket[bucket]
+                for i in range(0, len(group), self.prefill_batch):
+                    chunk = group[i:i + self.prefill_batch]
+                    first = self._run_prefill(bucket, chunk, params)
+                    self.prefill_calls += 1
+                    for row, (slot, req) in enumerate(chunk):
+                        tok = int(first[row])
+                        active[slot].out.append(tok)
+                        tokens[slot] = tok
+                        lengths[slot] = len(req.prompt)
+                        if (eos_token_id is not None
+                                and tok == eos_token_id) \
+                                or req.max_new_tokens <= 1:
+                            finish(slot)
+
+            # one decode step over every slot (per-sequence positions)
+            if active:
+                nxt, self._cache = self._get_decode_fn()(
+                    params, self._cache, jnp.asarray(tokens),
+                    jnp.asarray(lengths))
+                nxt = np.asarray(nxt)
+                self.decode_steps += 1
+                for slot in sorted(active):
+                    st = active[slot]
+                    lengths[slot] += 1       # the fed token is now cached
+                    tok = int(nxt[slot])
+                    st.out.append(tok)
+                    if (eos_token_id is not None and tok == eos_token_id) \
+                            or len(st.out) >= st.req.max_new_tokens:
+                        finish(slot)
+                    else:
+                        tokens[slot] = tok
+        return results
+
+    def _run_prefill(self, bucket, chunk, params):
+        """Prefill one fixed-J group of joiners into their slots; returns
+        the first generated token per row (np.int32 [J])."""
+        j = self.prefill_batch
+        ids = np.zeros((j, bucket), np.int32)
+        slot_idx = np.full(j, self.slots, np.int32)      # pad -> scratch
+        lens = np.ones(j, np.int32)
+        for row, (slot, req) in enumerate(chunk):
+            plen = len(req.prompt)
+            ids[row, :plen] = req.prompt
+            slot_idx[row] = slot
+            lens[row] = plen
+        first, self._cache = self._get_prefill_fn(bucket)(
+            params, self._cache, jnp.asarray(ids), jnp.asarray(slot_idx),
+            jnp.asarray(lens))
+        return np.asarray(first)

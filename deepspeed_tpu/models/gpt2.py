@@ -368,11 +368,32 @@ def init_cache(cfg: GPT2Config, batch_size: int, max_len: int,
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
+def cache_update(ck, cv, k, v, pos):
+    """Write new keys/values into the cache at ``pos``: a scalar writes one
+    contiguous [T]-span shared by every row (the classic static-batch decode);
+    an int32 [B] vector writes each row's single new entry at its own
+    position (continuous-batching slots, T must be 1).  Shared by every
+    decode-hook model family."""
+    pos = jnp.asarray(pos, jnp.int32)
+    if pos.ndim == 0:
+        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                          (0, 0, pos, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                          (0, 0, pos, 0))
+        return ck, cv
+    assert k.shape[2] == 1, "per-sequence positions require T == 1"
+    rows = jnp.arange(k.shape[0])
+    ck = ck.at[rows, :, pos].set(k[:, :, 0].astype(ck.dtype))
+    cv = cv.at[rows, :, pos].set(v[:, :, 0].astype(cv.dtype))
+    return ck, cv
+
+
 def _block_cached_body(cfg: GPT2Config, x, get, mm, ck, cv, pos):
     """One block with KV-cache read/write, parameterized by weight access
     (``get(name)`` small leaf, ``mm(y, name, dtype)`` matmul) so the scan
     and layer-indexed decode paths share the math.  x: [B, T, D]; ck/cv:
-    [B, H, S, hd]; pos: traced global position of x[:, 0]."""
+    [B, H, S, hd]; pos: traced global position of x[:, 0] — scalar, or
+    int32 [B] per-row positions (continuous-batching decode, T=1)."""
     from ..ops.decode_attention import decode_attention
 
     b, t, d = x.shape
@@ -384,8 +405,7 @@ def _block_cached_body(cfg: GPT2Config, x, get, mm, ck, cv, pos):
     q = q.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
     k = k.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
     v = v.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
-    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, pos, 0))
-    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, pos, 0))
+    ck, cv = cache_update(ck, cv, k, v, pos)
     attn = decode_attention(q, ck, cv, pos)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, t, d)
     x = x + mm(attn, "o_w", x.dtype) + get("o_b").astype(x.dtype)
@@ -446,22 +466,56 @@ def decode_over_layers(body, x, blocks, cache_k, cache_v, num_layers,
     return x, ks, vs
 
 
-def forward_cached(cfg: GPT2Config, params, input_ids, cache, pos):
-    """Incremental forward: logits for the LAST input position + updated cache."""
+def forward_cached(cfg: GPT2Config, params, input_ids, cache, pos,
+                   lengths=None):
+    """Incremental forward: logits for the LAST input position + updated
+    cache.
+
+    ``lengths`` (optional int32 [B]) is the per-sequence valid length for
+    continuous-batching slots:
+     - T == 1 (decode): row ``b``'s token sits at global position
+       ``lengths[b]`` — per-row cache write, per-row attention prefix.
+       ``pos`` is ignored.
+     - T > 1 (ragged bucketed prefill): rows are right-padded to T with
+       ``pos`` as the shared base (0 for fresh slots); causal attention makes
+       the pad positions unreachable from valid queries, and the returned
+       logits are gathered at each row's own last prompt token
+       (``lengths[b] - 1``) instead of column T-1.
+    """
     params = _dequant_resident(params)
     b, t = input_ids.shape
     d = cfg.hidden_size
     pos = jnp.asarray(pos, jnp.int32)
-    wpe = jax.lax.dynamic_slice(params["wpe"], (pos, 0), (t, d))
+    per_row = lengths is not None and t == 1
+    if per_row:
+        lengths = jnp.asarray(lengths, jnp.int32)
+        step_pos = lengths
+        wpe = params["wpe"][jnp.clip(lengths, 0, cfg.max_seq_len - 1)][:, None]
+    else:
+        step_pos = pos
+        wpe = jax.lax.dynamic_slice(params["wpe"], (pos, 0), (t, d))
     x = (params["wte"][input_ids] + wpe).astype(params["wte"].dtype)
 
     x, ks, vs = decode_over_layers(
         lambda x, get, mm, ck, cv: _block_cached_body(cfg, x, get, mm, ck,
-                                                      cv, pos),
+                                                      cv, step_pos),
         x, params["blocks"], cache["k"], cache["v"], cfg.num_layers)
-    x = _layer_norm(x[:, -1], params["lnf_scale"], params["lnf_bias"])
+    x = _gather_last(x, lengths if not per_row else None)
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
     logits = x @ params["wte"].T.astype(x.dtype)
     return logits, {"k": ks, "v": vs}
+
+
+def _gather_last(x, lengths):
+    """Last valid hidden state per row: column T-1 when ``lengths`` is None
+    (uniform batch / per-row decode where T == 1), else each row's
+    ``lengths[b] - 1`` (ragged prefill).  Shared by the model families'
+    ``forward_cached`` heads."""
+    if lengths is None:
+        return x[:, -1]
+    t = x.shape[1]
+    idx = jnp.clip(jnp.asarray(lengths, jnp.int32) - 1, 0, t - 1)
+    return x[jnp.arange(x.shape[0]), idx]
 
 
 def _wte_lookup(cfg: GPT2Config, params, input_ids):
@@ -719,11 +773,13 @@ def build(cfg: Optional[GPT2Config] = None, **overrides) -> ModelSpec:
     decode_hooks = {
         "init_cache": lambda b, s, dtype=jnp.bfloat16: init_cache(cfg, b, s,
                                                                   dtype),
-        "forward_cached": lambda params, ids, cache, pos: forward_cached(
-            cfg, params, ids, cache, pos),
+        "forward_cached": lambda params, ids, cache, pos, lengths=None:
+            forward_cached(cfg, params, ids, cache, pos, lengths),
         # learned absolute positions: decoding past this silently clamps the
         # wpe dynamic_slice, so the engine must reject it up front
         "max_seq_len": cfg.max_seq_len,
+        # per-sequence decode positions (continuous-batching serving)
+        "supports_lengths": True,
     }
 
     return ModelSpec(

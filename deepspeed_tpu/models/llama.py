@@ -121,6 +121,10 @@ def rope_angles(cfg: LlamaConfig, seq_len: int, offset: int = 0):
 def apply_rope(x, cos, sin):
     """x: [B, H, S, hd]; rotate pairs (HF half-split convention).
 
+    ``cos``/``sin`` are [S, hd/2] (shared across the batch) or [B, S, hd/2]
+    (per-sequence positions — continuous-batching slots each sit at their
+    own decode offset).
+
     Rotation math runs in fp32 (cos/sin tables are fp32) but the result is
     cast back to x's dtype so bf16 activations stay bf16 through the block —
     scan-over-layers carries require a fixed dtype, and keeping the residual
@@ -128,8 +132,12 @@ def apply_rope(x, cos, sin):
     """
     hd = x.shape[-1]
     x1, x2 = x[..., :hd // 2], x[..., hd // 2:]
-    c = cos[None, None, :, :]
-    s = sin[None, None, :, :]
+    if cos.ndim == 3:
+        c = cos[:, None, :, :]
+        s = sin[:, None, :, :]
+    else:
+        c = cos[None, None, :, :]
+        s = sin[None, None, :, :]
     out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
     return out.astype(x.dtype)
 
@@ -220,12 +228,18 @@ def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int,
 
 
 def _rope_cached(cfg: LlamaConfig, x, pos):
-    """Rotary embedding at traced offset ``pos``.  x: [B, H, T, hd]."""
+    """Rotary embedding at traced offset ``pos`` (scalar, or int32 [B] for
+    per-sequence decode positions).  x: [B, H, T, hd]."""
     hd = cfg.head_dim
     inv_freq = 1.0 / (cfg.rope_theta ** (jnp.arange(0, hd, 2,
                                                     dtype=jnp.float32) / hd))
-    p = pos + jnp.arange(x.shape[2], dtype=jnp.float32)
-    angles = p[:, None] * inv_freq[None, :]
+    pos = jnp.asarray(pos)
+    t = jnp.arange(x.shape[2], dtype=jnp.float32)
+    if pos.ndim == 0:
+        angles = (pos + t)[:, None] * inv_freq[None, :]          # [T, hd/2]
+    else:
+        p = pos.astype(jnp.float32)[:, None] + t[None, :]        # [B, T]
+        angles = p[..., None] * inv_freq[None, None, :]          # [B, T, hd/2]
     return apply_rope(x, jnp.cos(angles), jnp.sin(angles))
 
 
@@ -247,8 +261,9 @@ def _block_cached_body(cfg: LlamaConfig, x, get, mm, ck, cv, pos,
     q = _rope_cached(cfg, q.transpose(0, 2, 1, 3), pos)
     k = _rope_cached(cfg, k.transpose(0, 2, 1, 3), pos)
     v = v.transpose(0, 2, 1, 3)
-    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, pos, 0))
-    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, pos, 0))
+    from .gpt2 import cache_update
+
+    ck, cv = cache_update(ck, cv, k, v, pos)
     attn = decode_attention(q, ck, cv, pos)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, t, h * hd)
     x = x + mm(attn, "o_w", x.dtype)
@@ -271,34 +286,43 @@ def _block_cached(cfg: LlamaConfig, x, layer, ck, cv, pos, mlp_fn=None):
 
 
 def forward_cached(cfg: LlamaConfig, params, input_ids, cache, pos,
-                   mlp_fn=None):
+                   lengths=None, mlp_fn=None):
     """Incremental forward: logits for the LAST input position + updated
     cache.  ``mlp_fn`` threads through to :func:`_block_cached` (mixtral
     delegates here with its MoE FFN).  Quantized serving (no mlp_fn) takes
-    the layer-indexed stacked-kernel path via gpt2.decode_over_layers."""
-    from .gpt2 import _dequant_resident, decode_over_layers
+    the layer-indexed stacked-kernel path via gpt2.decode_over_layers.
+
+    ``lengths`` (optional int32 [B]): per-sequence valid lengths for
+    continuous-batching slots — T == 1 decodes each row at its own position
+    ``lengths[b]`` (rope offset, cache write, attention prefix); T > 1 is
+    ragged right-padded prefill, gathering each row's logits at
+    ``lengths[b] - 1`` (see gpt2.forward_cached for the full contract)."""
+    from .gpt2 import _dequant_resident, _gather_last, decode_over_layers
 
     params = _dequant_resident(params)
     pos = jnp.asarray(pos, jnp.int32)
+    per_row = lengths is not None and input_ids.shape[1] == 1
+    step_pos = jnp.asarray(lengths, jnp.int32) if per_row else pos
     x = params["embed"][input_ids].astype(params["embed"].dtype)
 
     if mlp_fn is None:
         x, ks, vs = decode_over_layers(
             lambda x, get, mm, ck, cv: _block_cached_body(
-                cfg, x, get, mm, ck, cv, pos),
+                cfg, x, get, mm, ck, cv, step_pos),
             x, params["blocks"], cache["k"], cache["v"], cfg.num_layers,
             probe="q_w")
     else:
         # mixtral's MoE FFN needs the whole layer dict: scan path only
         def body(x, xs):
             layer, ck, cv = xs
-            x, ck, cv = _block_cached(cfg, x, layer, ck, cv, pos,
+            x, ck, cv = _block_cached(cfg, x, layer, ck, cv, step_pos,
                                       mlp_fn=mlp_fn)
             return x, (ck, cv)
 
         x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"],
                                              cache["v"]))
-    x = rms_norm(x[:, -1], params["final_norm"], cfg.rms_eps)
+    x = _gather_last(x, lengths if not per_row else None)
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     return x @ params["lm_head"].astype(x.dtype), {"k": ks, "v": vs}
 
 
@@ -382,8 +406,9 @@ def build(cfg: Optional[LlamaConfig] = None, **overrides) -> ModelSpec:
         decode_hooks={
             "init_cache": lambda b, s, dtype=jnp.bfloat16: init_cache(
                 cfg, b, s, dtype),
-            "forward_cached": lambda params, ids, cache, pos: forward_cached(
-                cfg, params, ids, cache, pos),
+            "forward_cached": lambda params, ids, cache, pos, lengths=None:
+                forward_cached(cfg, params, ids, cache, pos, lengths),
+            "supports_lengths": True,
         },
         quant_aware=True,  # per-layer point-of-use dequant / w8a8 records
         name=f"llama-{cfg.num_layers}l-{cfg.hidden_size}d")

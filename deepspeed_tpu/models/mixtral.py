@@ -157,12 +157,15 @@ def _moe_ffn(cfg: MixtralConfig, layer, y, train: bool):
     return moe_apply(cfg.moe_cfg(), moe_params, y, train=train)
 
 
-def forward_cached(cfg: MixtralConfig, params, input_ids, cache, pos):
+def forward_cached(cfg: MixtralConfig, params, input_ids, cache, pos,
+                   lengths=None):
     """Incremental MoE forward (reference ``moe_inference.py``: expert
     routing runs per decode token too) — llama's cached path with the MoE
-    FFN hooked in."""
+    FFN hooked in.  ``lengths`` (per-sequence positions for
+    continuous-batching slots) passes straight through: expert routing is
+    position-independent."""
     return L.forward_cached(
-        cfg, params, input_ids, cache, pos,
+        cfg, params, input_ids, cache, pos, lengths=lengths,
         mlp_fn=lambda lyr, y: _moe_ffn(cfg, lyr, y, train=False)[0])
 
 
@@ -194,9 +197,10 @@ def build(cfg: Optional[MixtralConfig] = None, **overrides) -> ModelSpec:
     decode_hooks = {
         "init_cache": lambda b, s, dtype=jnp.bfloat16: L.init_cache(
             cfg, b, s, dtype),
-        "forward_cached": lambda params, ids, cache, pos: forward_cached(
-            cfg, params, ids, cache, pos),
+        "forward_cached": lambda params, ids, cache, pos, lengths=None:
+            forward_cached(cfg, params, ids, cache, pos, lengths),
         "max_seq_len": cfg.max_seq_len,
+        "supports_lengths": True,
     }
 
     return ModelSpec(

@@ -233,14 +233,23 @@ def _block(cfg: OPTConfig, x, layer):
 
 
 def _embed(cfg: OPTConfig, params, input_ids, pos0: int = 0):
+    """Token + learned position embeddings.  ``pos0``: shared base position
+    (scalar), or int32 [B] per-sequence positions (T must be 1 — each
+    continuous-batching slot decodes at its own offset)."""
     s = input_ids.shape[1]
     x = params["embed_tokens"][input_ids]
     if cfg.has_proj:
         x = x @ params["project_in"].astype(x.dtype)
-    pos = jax.lax.dynamic_slice(
-        params["embed_positions"],
-        (jnp.asarray(pos0, jnp.int32) + _POS_OFFSET, 0),
-        (s, cfg.hidden_size))
+    pos0 = jnp.asarray(pos0, jnp.int32)
+    if pos0.ndim == 0:
+        pos = jax.lax.dynamic_slice(
+            params["embed_positions"], (pos0 + _POS_OFFSET, 0),
+            (s, cfg.hidden_size))
+    else:
+        assert s == 1, "per-sequence positions require T == 1"
+        idx = jnp.clip(pos0 + _POS_OFFSET, 0,
+                       params["embed_positions"].shape[0] - 1)
+        pos = params["embed_positions"][idx][:, None]      # [B, 1, D]
     return (x + pos).astype(params["embed_tokens"].dtype)
 
 
@@ -295,8 +304,9 @@ def _block_cached_body(cfg: OPTConfig, x, get, mm, ck, cv, pos):
     q = q.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
     k = k.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
     v = v.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
-    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, pos, 0))
-    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, pos, 0))
+    from .gpt2 import cache_update
+
+    ck, cv = cache_update(ck, cv, k, v, pos)
     attn = decode_attention(q, ck, cv, pos)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, t, d)
     x = res + mm(attn, "o_w", x.dtype) + get("o_b").astype(x.dtype)
@@ -319,21 +329,30 @@ def _block_cached(cfg: OPTConfig, x, layer, ck, cv, pos):
     return _block_cached_body(cfg, x, *layer_accessors(layer), ck, cv, pos)
 
 
-def forward_cached(cfg: OPTConfig, params, input_ids, cache, pos):
+def forward_cached(cfg: OPTConfig, params, input_ids, cache, pos,
+                   lengths=None):
     """Incremental forward: logits for the LAST position + updated cache.
     Quantized serving runs the layer-indexed loop (stacked s8 kernel,
-    gpt2.decode_over_layers) instead of the scan."""
-    from .gpt2 import _dequant_resident, decode_over_layers
+    gpt2.decode_over_layers) instead of the scan.
+
+    ``lengths`` (optional int32 [B]): per-sequence valid lengths for
+    continuous-batching slots — T == 1 decodes each row at position
+    ``lengths[b]``; T > 1 is ragged right-padded prefill with per-row logit
+    gather at ``lengths[b] - 1`` (contract in gpt2.forward_cached)."""
+    from .gpt2 import _dequant_resident, _gather_last, decode_over_layers
 
     params = _dequant_resident(params)
     pos = jnp.asarray(pos, jnp.int32)
-    x = _embed(cfg, params, input_ids, pos0=pos)
+    per_row = lengths is not None and input_ids.shape[1] == 1
+    step_pos = jnp.asarray(lengths, jnp.int32) if per_row else pos
+    x = _embed(cfg, params, input_ids, pos0=step_pos)
 
     x, ks, vs = decode_over_layers(
         lambda x, get, mm, ck, cv: _block_cached_body(cfg, x, get, mm, ck,
-                                                      cv, pos),
+                                                      cv, step_pos),
         x, params["blocks"], cache["k"], cache["v"], cfg.num_layers)
-    logits = _head(cfg, params, x[:, -1])
+    logits = _head(cfg, params, _gather_last(
+        x, lengths if not per_row else None))
     return logits, {"k": ks, "v": vs}
 
 
@@ -484,9 +503,10 @@ def build(cfg: Optional[OPTConfig] = None, **overrides) -> ModelSpec:
     decode_hooks = {
         "init_cache": lambda b, s, dtype=jnp.bfloat16: init_cache(cfg, b, s,
                                                                   dtype),
-        "forward_cached": lambda params, ids, cache, pos: forward_cached(
-            cfg, params, ids, cache, pos),
+        "forward_cached": lambda params, ids, cache, pos, lengths=None:
+            forward_cached(cfg, params, ids, cache, pos, lengths),
         "max_seq_len": cfg.max_seq_len,
+        "supports_lengths": True,
     }
 
     def _stream_embed(params, ids, pos):

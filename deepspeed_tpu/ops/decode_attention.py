@@ -5,9 +5,11 @@ workspace ``csrc/transformer/inference/includes/inference_context.h``).
 
 The cache is a statically-shaped HBM buffer ``[B, HKV, S_max, D]`` sized by
 ``max_out_tokens`` exactly like the reference's ``InferenceContext`` workspace;
-the valid prefix length is a traced scalar, so one compiled program serves every
-decode step (the reference gets the same effect from CUDA-graph replay; here it
-falls out of ``jit`` + static shapes).
+the valid prefix length is a traced scalar — or, for continuous-batching
+serving, a traced ``int32[B]`` vector so every cache slot attends over its own
+valid prefix — so one compiled program serves every decode step (the reference
+gets the same effect from CUDA-graph replay; here it falls out of ``jit`` +
+static shapes).
 
 Two paths, one API:
  - ``decode_attention_reference``: q of one or more new positions against the
@@ -34,6 +36,10 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 LANES = 128
 
+# jax renamed TPUCompilerParams -> CompilerParams across releases; accept both
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -47,7 +53,9 @@ def decode_attention_reference(q, k_cache, v_cache, q_pos, *,
                               T=prompt_len for prefill)
     k_cache:  [B, HKV, S, D], v_cache: [B, HKV, S, D] — the *already updated*
               cache (new keys written at q_pos .. q_pos+T-1)
-    q_pos:    scalar int32 — global position of q[:, :, 0]
+    q_pos:    scalar int32 — global position of q[:, :, 0]; or int32 [B] for
+              per-sequence positions (continuous-batching slots, each row
+              attends over its own valid prefix)
     """
     b, h, t, d = q.shape
     hkv, s = k_cache.shape[1], k_cache.shape[2]
@@ -58,10 +66,17 @@ def decode_attention_reference(q, k_cache, v_cache, q_pos, *,
         v_cache = jnp.repeat(v_cache, rep, axis=1)
     scores = jnp.einsum("bhtd,bhsd->bhts", q, k_cache).astype(jnp.float32)
     scores = scores * scale
-    key_idx = jnp.arange(s)[None, :]
-    query_idx = q_pos + jnp.arange(t)[:, None]
-    mask = key_idx <= query_idx                       # [T, S]
-    scores = jnp.where(mask[None, None], scores, NEG_INF)
+    q_pos = jnp.asarray(q_pos, jnp.int32)
+    key_idx = jnp.arange(s)
+    if q_pos.ndim == 0:
+        query_idx = q_pos + jnp.arange(t)[:, None]
+        mask = key_idx[None, :] <= query_idx          # [T, S]
+        mask = mask[None, None]                       # [1, 1, T, S]
+    else:
+        query_idx = q_pos[:, None] + jnp.arange(t)[None, :]
+        mask = key_idx[None, None, :] <= query_idx[:, :, None]  # [B, T, S]
+        mask = mask[:, None]                          # [B, 1, T, S]
+    scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhts,bhsd->bhtd", probs, v_cache)
 
@@ -75,10 +90,13 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
 
     q_ref: [1, 1, rep, D] — the ``rep`` query heads sharing this KV head.
     k_ref/v_ref: [1, 1, block_k, D] chunk of the cache.
+    pos_ref: int32 [B] in SMEM — per-row query position (a scalar q_pos is
+    broadcast before the call), read for the row this grid step covers, so
+    chunk skipping scales FLOPs with each slot's own valid length.
     """
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
-    pos = pos_ref[0]
+    pos = pos_ref[pl.program_id(0)]
 
     @pl.when(kb == 0)
     def _init():
@@ -121,7 +139,8 @@ def decode_attention_pallas(q, k_cache, v_cache, q_pos, *,
                             sm_scale: Optional[float] = None,
                             block_k: int = 256,
                             interpret: Optional[bool] = None):
-    """Single-token decode: q [B, H, 1, D] vs cache [B, HKV, S, D]."""
+    """Single-token decode: q [B, H, 1, D] vs cache [B, HKV, S, D].
+    ``q_pos``: scalar or per-row int32 [B] query positions."""
     b, h, t, d = q.shape
     assert t == 1, "pallas decode kernel is single-token; use the XLA path"
     hkv, s = k_cache.shape[1], k_cache.shape[2]
@@ -134,7 +153,7 @@ def decode_attention_pallas(q, k_cache, v_cache, q_pos, *,
         interpret = _use_interpret()
 
     qg = q[:, :, 0, :].reshape(b, hkv, rep, d)        # [B, HKV, rep, D]
-    pos = jnp.asarray(q_pos, jnp.int32).reshape(1)
+    pos = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1), (b,))
 
     out = pl.pallas_call(
         functools.partial(_decode_kernel, sm_scale=scale, block_k=block_k),
@@ -152,7 +171,7 @@ def decode_attention_pallas(q, k_cache, v_cache, q_pos, *,
             pltpu.VMEM((rep, LANES), jnp.float32),    # l
             pltpu.VMEM((rep, d), jnp.float32),        # acc
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(pos, qg, k_cache, v_cache)

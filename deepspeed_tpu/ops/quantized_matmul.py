@@ -34,6 +34,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams across releases; accept both
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -145,7 +149,7 @@ def _qmm_call(x2d, q3, scale3, out_dtype, block_k, block_n, interpret):
         out_specs=pl.BlockSpec((b, block_n), lambda n, ki: (0, n)),
         out_shape=jax.ShapeDtypeStruct((b, n_dim), out_dtype),
         scratch_shapes=[pltpu.VMEM((b, block_n), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(x3, q3, scale3)
@@ -298,7 +302,7 @@ def _w8a8_call(x2d, qk, kscale, out_dtype, block_k, interpret,
         out_specs=pl.BlockSpec((b, n_dim), lambda n, ki: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((b, n_dim), out_dtype),
         scratch_shapes=[pltpu.VMEM((b, n_dim), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
             vmem_limit_bytes=vmem_limit),
         interpret=interpret,
@@ -437,14 +441,23 @@ def _w8a8_tp_body(x2d, qk, kscale3):
 #: shardy's propagation knows a K-sharded weight still yields a full [B, N]
 #: result; the partition lowering owns the actual psum.
 _w8a8_tp_call = custom_partitioning(_w8a8_tp_body)
-_w8a8_tp_call.def_partition(
-    partition=_w8a8_partition,
-    infer_sharding_from_operands=_w8a8_infer_sharding,
-    propagate_user_sharding=lambda mesh, user_shape: user_shape.sharding,
-    sharding_rule="b k, k n, s u n -> b n",
-    reduction_factors=("k", "s"),
-    need_replication_factors=("u",),
-)
+try:
+    _w8a8_tp_call.def_partition(
+        partition=_w8a8_partition,
+        infer_sharding_from_operands=_w8a8_infer_sharding,
+        propagate_user_sharding=lambda mesh, user_shape: user_shape.sharding,
+        sharding_rule="b k, k n, s u n -> b n",
+        reduction_factors=("k", "s"),
+        need_replication_factors=("u",),
+    )
+except TypeError:
+    # older jax: def_partition predates the shardy sharding_rule kwargs —
+    # GSPMD propagation alone still gets the sharded lowering right
+    _w8a8_tp_call.def_partition(
+        partition=_w8a8_partition,
+        infer_sharding_from_operands=_w8a8_infer_sharding,
+        propagate_user_sharding=lambda mesh, user_shape: user_shape.sharding,
+    )
 
 
 def w8a8_matmul(x, rec: dict, out_dtype=None, *, block_k: int = None,
@@ -530,7 +543,7 @@ def _w8a8_stacked_call(idx, x2d, qks, kscales, out_dtype, block_k,
         functools.partial(_w8a8_stacked_kernel, nk=grid[1], k_group=k_group),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, n_dim), out_dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
             vmem_limit_bytes=vmem_limit),
         interpret=interpret,

@@ -40,10 +40,16 @@ class ModelSpec:
     pipeline_hooks: Optional[dict] = None
     #: Optional KV-cache decode path (see inference/engine.py generate):
     #:   init_cache(batch_size, max_len, dtype) -> cache pytree
-    #:   forward_cached(params, input_ids, cache, pos) ->
+    #:       (leaves [L, B, ..., S, hd]: batch dim 1, length dim -2)
+    #:   forward_cached(params, input_ids, cache, pos, lengths=None) ->
     #:       (last-position logits [B, V], updated cache)
     #: ``pos`` is the (traced) global position of input_ids[:, 0]; the same
-    #: function serves prefill (T=prompt) and decode (T=1).
+    #: function serves prefill (T=prompt) and decode (T=1).  ``lengths``
+    #: (traced int32 [B]; hooks that accept it set ``supports_lengths``) is
+    #: the per-sequence position vector for continuous-batching slots
+    #: (inference/serving.py): T == 1 decodes row ``b`` at its own position
+    #: ``lengths[b]``; T > 1 is ragged right-padded prefill whose logits
+    #: gather at each row's ``lengths[b] - 1``.
     decode_hooks: Optional[dict] = None
     #: The builder's config object (e.g. GPT2Config).  The engine mutates its
     #: remat knobs when the json config carries an ``activation_checkpointing``

@@ -1,0 +1,35 @@
+"""serving_bench-derived acceptance checks (slow lane: runs a full trace
+through both serving paths — minutes on a CPU-sim box).
+
+Asserts the PROFILE.md claims reproduce: aggregate-throughput speedup of the
+continuous-batching scheduler over sequential ``generate``, O(#buckets)
+compile count, and token parity.  Timing-based, hence ``slow`` — tier-1
+covers the functional pieces in test_serving.py.
+"""
+
+import os
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "benchmarks")))
+
+
+def test_serving_bench_speedup_parity_and_compiles():
+    import serving_bench
+
+    res = serving_bench.run_bench(requests=32, slots=8, layers=2, hidden=64,
+                                  heads=4, vocab=512, seed=0)
+    assert res["token_parity"], res["mismatched_uids"]
+    # O(#buckets): at most one prefill program per ladder rung + one decode
+    assert res["serving"]["compiled_programs"] <= \
+        len(serving_bench.PROMPT_GRID) + 1
+    # the sequential path compiled one program per request SHAPE instead
+    # (LRU-capped at 32 entries)
+    assert res["sequential"]["compiled_programs"] > \
+        res["serving"]["compiled_programs"]
+    # acceptance: >= 1.5x aggregate tokens/sec on the mixed-length trace
+    assert res["speedup"] >= 1.5, res
