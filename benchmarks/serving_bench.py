@@ -1,30 +1,38 @@
-"""Continuous-batching serving benchmark: slot-pool scheduler vs sequential
-``generate`` on a synthetic mixed-length request trace.
+"""Serving benchmark: paged chunked-prefill scheduler vs the bucketed
+slot-pool baseline vs sequential ``generate``.
 
-Drives the same trace through both paths and reports aggregate generated
-tokens/sec plus compile counts:
+Drives the same trace through three paths and reports aggregate generated
+tokens/sec plus compile counts and the paged engine's ``stats()``:
 
- - **serving**: ``inference/serving.py`` — slot-based KV pool, iteration-level
-   scheduling, bucketed prefill (O(#buckets)+1 compiled programs total).
- - **sequential**: the one-shot ``InferenceEngine.generate`` loop, one request
-   at a time (batch 1), one compiled program per exact request shape.
+ - **serving** (the headline): ``inference/serving.py`` with the block-paged
+   KV pool, chunked prefill and prefix caching — exactly 2 compiled
+   programs (1 prefill + 1 decode) for any trace, and shared prompt
+   prefixes prefill for free after their first occurrence.
+ - **serving_bucketed**: the PR 1-style fallback on the same engine —
+   bucket-ladder prefill over the paged pool, no prefix reuse,
+   O(#buckets)+1 compiled programs.  ``speedup_vs_bucketed`` is the paged/
+   chunked win isolated from the continuous-batching win.
+ - **sequential**: one-shot ``InferenceEngine.generate``, one request at a
+   time, one compiled program per exact request shape.
 
 Methodology (PROFILE.md "continuous-batching serving" entry): the default
 trace draws ARBITRARY prompt lengths in [32, 512] and completion budgets in
 [16, 64] — real mixed traffic, where the sequential path jit-compiles one
-program per exact request shape (and, past its 32-entry LRU, recompiles on
-repeats too) while the serving loop compiles O(#buckets)+1 programs total.
-The headline is aggregate generated tokens/sec over the whole trace, compiles
-included on both sides, because per-shape compilation IS the sequential
-path's steady state on arbitrary shapes.  ``--grid`` instead snaps the trace
-to a small shape grid that fits the sequential LRU and reports a second
-compile-warm pass for both paths — the batching/scheduling win isolated from
-the compile-caching win.  Greedy decoding; the bench asserts serving outputs
-are token-identical to sequential before reporting numbers.
+program per exact request shape while the serving loop compiles O(1).
+``--prefix-len N`` instead prepends a shared N-token system prompt to every
+request (tails in [16, 64]) — the prefix-heavy trace where the prefix cache
+collapses per-request prefill to the unique tail.  The headline is
+aggregate generated tokens/sec over the whole trace, compiles included on
+both sides; a second pass over the same trace reports the compile- and
+prefix-warm steady state.  ``--grid`` snaps the default trace to a small
+shape grid that fits the sequential LRU and reports a compile-warm
+sequential pass too.  Greedy decoding; the bench asserts all serving
+outputs are token-identical to sequential before reporting numbers.
 
 Usage:
-  python benchmarks/serving_bench.py [--requests 64] [--slots 8] [--grid]
-      [--layers 2] [--hidden 128] [--seed 0] [--json out.json]
+  python benchmarks/serving_bench.py [--requests 64] [--slots 8]
+      [--prefix-len 256] [--grid] [--layers 2] [--hidden 128] [--seed 0]
+      [--json out.json]
 """
 
 from __future__ import annotations
@@ -41,27 +49,42 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 PROMPT_RANGE = (32, 512)
 NEW_TOKEN_RANGE = (16, 64)
+#: --prefix-len mode: unique tail length / completion budget ranges —
+#: long shared context, short unique tail and output (the classification /
+#: extraction-style traffic prefix caching exists for)
+TAIL_RANGE = (16, 64)
+PREFIX_NEW_RANGE = (8, 32)
 # --grid shape grids: |prompts| * |budgets| stays under the engine's
 # 32-entry LRU so a second sequential pass is compile-free (see module doc)
 PROMPT_GRID = (32, 64, 96, 128, 192, 256, 384, 512)
 NEW_TOKEN_GRID = (16, 32, 64)
 
 
-def build_trace(n_requests: int, vocab: int, seed: int, grid: bool):
+def build_trace(n_requests: int, vocab: int, seed: int, grid: bool,
+                prefix_len: int = 0):
     from deepspeed_tpu.inference.serving import Request
 
     rng = np.random.default_rng(seed)
+    prefix = rng.integers(0, vocab, prefix_len) if prefix_len else None
     reqs = []
     for i in range(n_requests):
-        if grid:
-            plen = int(rng.choice(PROMPT_GRID))
+        if prefix_len:
+            tail = rng.integers(0, vocab,
+                                int(rng.integers(TAIL_RANGE[0],
+                                                 TAIL_RANGE[1] + 1)))
+            prompt = np.concatenate([prefix, tail])
+            mnew = int(rng.integers(PREFIX_NEW_RANGE[0],
+                                    PREFIX_NEW_RANGE[1] + 1))
+        elif grid:
+            prompt = rng.integers(0, vocab, int(rng.choice(PROMPT_GRID)))
             mnew = int(rng.choice(NEW_TOKEN_GRID))
         else:
-            plen = int(rng.integers(PROMPT_RANGE[0], PROMPT_RANGE[1] + 1))
+            prompt = rng.integers(0, vocab,
+                                  int(rng.integers(PROMPT_RANGE[0],
+                                                   PROMPT_RANGE[1] + 1)))
             mnew = int(rng.integers(NEW_TOKEN_RANGE[0],
                                     NEW_TOKEN_RANGE[1] + 1))
-        reqs.append(Request(uid=i, max_new_tokens=mnew,
-                            prompt=rng.integers(0, vocab, plen)))
+        reqs.append(Request(uid=i, max_new_tokens=mnew, prompt=prompt))
     return reqs
 
 
@@ -77,19 +100,23 @@ def run_sequential(engine, reqs):
 def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
               layers: int = 2, hidden: int = 128, heads: int = 4,
               vocab: int = 2048, seed: int = 0, dtype: str = "fp32",
-              grid: bool = False):
+              grid: bool = False, prefix_len: int = 0,
+              block_size: int = 32, prefill_chunk: int = 128):
     import deepspeed_tpu
     from deepspeed_tpu.inference.serving import ServingEngine
     from deepspeed_tpu.models import gpt2
 
-    max_total = max(PROMPT_GRID) + max(NEW_TOKEN_GRID)
+    if prefix_len:
+        max_total = prefix_len + max(TAIL_RANGE) + max(PREFIX_NEW_RANGE)
+    else:
+        max_total = max(PROMPT_GRID) + max(NEW_TOKEN_GRID)
     cfg = gpt2.GPT2Config(vocab_size=vocab, max_seq_len=1024,
                           num_layers=layers, num_heads=heads,
                           hidden_size=hidden)
     engine = deepspeed_tpu.init_inference(
         gpt2.build(cfg), config={"dtype": dtype,
                                  "tensor_parallel": {"tp_size": 1}})
-    reqs = build_trace(requests, vocab, seed, grid)
+    reqs = build_trace(requests, vocab, seed, grid, prefix_len)
     gen_tokens = sum(r.max_new_tokens for r in reqs)
 
     # --- sequential pass 1: per-shape compiles included — this IS the
@@ -97,38 +124,57 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
     seq_outs, seq_cold = run_sequential(engine, reqs)
     n_shapes = len({(len(r.prompt), r.max_new_tokens) for r in reqs})
     seq_warm = None
-    if grid:
+    if grid and not prefix_len:
         # grid mode: every shape program survived the LRU, pass 2 is
         # compile-free — the batching win isolated from the compile win
         assert n_shapes <= 32, "shape grid exceeds the LRU"
         _, seq_warm = run_sequential(engine, reqs)
 
-    # --- serving: cold (compiles included), then a warm pass reusing the
-    # compiled bucket programs
-    def fresh_serving():
-        return ServingEngine(
-            engine, slots=slots, max_seq_len=max_total,
-            prompt_buckets=tuple(PROMPT_GRID), prefill_batch=prefill_batch)
+    # --- bucketed fallback (PR 1-style slot-pool semantics on the paged
+    # pool): bucket-ladder prefill, no prefix reuse
+    buckets = tuple(b for b in PROMPT_GRID if b < max_total) + (max_total,)
+    srv_b = ServingEngine(engine, slots=slots, max_seq_len=max_total,
+                          prompt_buckets=buckets, prefill_batch=prefill_batch,
+                          block_size=block_size)
+    t0 = time.perf_counter()
+    bkt_outs = srv_b.serve(reqs)
+    bkt_cold = time.perf_counter() - t0
+    bkt_stats_cold = srv_b.stats()
+    # second pass on the same engine: compile-warm (no prefix cache in
+    # bucketed mode, so there is nothing else to warm)
+    t0 = time.perf_counter()
+    bkt_outs2 = srv_b.serve(reqs)
+    bkt_warm = time.perf_counter() - t0
 
-    srv = fresh_serving()
+    # --- paged chunked prefill + prefix cache: cold (compiles included),
+    # then a second pass on the same engine — compile-warm AND prefix-warm
+    # (the steady state under shared-prefix traffic)
+    srv = ServingEngine(engine, slots=slots, max_seq_len=max_total,
+                        prefill_batch=prefill_batch, block_size=block_size,
+                        prefill_chunk=prefill_chunk)
     t0 = time.perf_counter()
     srv_outs = srv.serve(reqs)
     srv_cold = time.perf_counter() - t0
-    srv2 = fresh_serving()
-    srv2._prefill_fns = srv._prefill_fns       # keep the compiled programs
-    srv2._decode_fn = srv._decode_fn
-    t0 = time.perf_counter()
-    srv_outs2 = srv2.serve(reqs)
+    stats_cold = srv.stats()               # pass-1 numbers (counters are
+    t0 = time.perf_counter()               # cumulative across serve calls)
+    srv_outs2 = srv.serve(reqs)
     srv_warm = time.perf_counter() - t0
 
     mismatches = [r.uid for r in reqs
                   if not (np.array_equal(seq_outs[r.uid], srv_outs[r.uid])
                           and np.array_equal(seq_outs[r.uid],
-                                             srv_outs2[r.uid]))]
+                                             srv_outs2[r.uid])
+                          and np.array_equal(seq_outs[r.uid],
+                                             bkt_outs[r.uid])
+                          and np.array_equal(seq_outs[r.uid],
+                                             bkt_outs2[r.uid]))]
     result = {
-        "trace": "shape-grid" if grid else
-                 f"arbitrary prompts {PROMPT_RANGE}, new {NEW_TOKEN_RANGE}",
+        "trace": (f"shared {prefix_len}-token prefix, tails {TAIL_RANGE}, "
+                  f"new {PREFIX_NEW_RANGE}") if prefix_len else
+                 ("shape-grid" if grid else
+                  f"arbitrary prompts {PROMPT_RANGE}, new {NEW_TOKEN_RANGE}"),
         "requests": requests,
+        "prefix_len": prefix_len,
         "request_shapes": n_shapes,
         "generated_tokens": gen_tokens,
         "sequential": {
@@ -136,6 +182,8 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
             "wall_s": seq_cold,
             "tok_s_warm": gen_tokens / seq_warm if seq_warm else None,
             "wall_warm_s": seq_warm,
+            # resident programs only — the engine LRU caps at 32, so on the
+            # arbitrary-shape trace true compile count is >= request_shapes
             "compiled_programs": len(engine._generate_fns),
         },
         "serving": {
@@ -145,11 +193,23 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
             "wall_warm_s": srv_warm,
             "compiled_programs": srv.compile_count,
             "slots": slots, "prefill_batch": prefill_batch,
-            "decode_steps": srv2.decode_steps,
-            "prefill_calls": srv2.prefill_calls,
+            "stats": stats_cold,
+            "stats_after_warm_pass": srv.stats(),
+        },
+        "serving_bucketed": {
+            "tok_s": gen_tokens / bkt_cold,
+            "wall_s": bkt_cold,
+            "tok_s_warm": gen_tokens / bkt_warm,
+            "wall_warm_s": bkt_warm,
+            "compiled_programs": srv_b.compile_count,
+            "stats": bkt_stats_cold,
         },
         "speedup": seq_cold / srv_cold,
         "speedup_warm": (seq_warm / srv_warm) if seq_warm else None,
+        # the paged/chunked/prefix win over the PR 1-style bucketed slot
+        # pool: compiles included, and the compile-warm steady state
+        "speedup_vs_bucketed": bkt_cold / srv_cold,
+        "speedup_vs_bucketed_warm": bkt_warm / srv_warm,
         "token_parity": not mismatches,
         "mismatched_uids": mismatches,
         "model": f"gpt2-{layers}l-{hidden}d-{vocab}v ({dtype})",
@@ -163,6 +223,11 @@ def main():
     ap.add_argument("--requests", type=int, default=64)
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--prefill-batch", type=int, default=4)
+    ap.add_argument("--block-size", type=int, default=32)
+    ap.add_argument("--prefill-chunk", type=int, default=128)
+    ap.add_argument("--prefix-len", type=int, default=0,
+                    help="prepend a shared N-token system prompt to every "
+                         "request (prefix-heavy trace)")
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--hidden", type=int, default=128)
     ap.add_argument("--heads", type=int, default=4)
@@ -178,7 +243,9 @@ def main():
     res = run_bench(requests=args.requests, slots=args.slots,
                     prefill_batch=args.prefill_batch, layers=args.layers,
                     hidden=args.hidden, heads=args.heads, vocab=args.vocab,
-                    seed=args.seed, dtype=args.dtype, grid=args.grid)
+                    seed=args.seed, dtype=args.dtype, grid=args.grid,
+                    prefix_len=args.prefix_len, block_size=args.block_size,
+                    prefill_chunk=args.prefill_chunk)
     print(json.dumps(res, indent=2))
     if args.json:
         with open(args.json, "w") as f:

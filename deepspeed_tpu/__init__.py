@@ -129,15 +129,24 @@ def init_inference(model=None, config=None, params=None, **kwargs):
 
 def init_serving(model=None, config=None, params=None, *, slots=8,
                  max_seq_len=None, prompt_buckets=None, prefill_batch=4,
-                 **kwargs):
+                 block_size=32, num_blocks=None, chunked_prefill=None,
+                 prefill_chunk=128, prefix_caching=True, **kwargs):
     """Continuous-batching serving entry: an ``init_inference`` engine
-    wrapped in the slot-pool scheduler (``inference/serving.py``).  Mixed-
-    length request traces run at iteration-level granularity — finished
-    sequences free their KV slot immediately and waiting requests prefill
-    into it — instead of ``generate``'s run-to-longest static batches."""
+    wrapped in the block-paged scheduler (``inference/serving.py``).
+    Mixed-length request traces run at iteration-level granularity over a
+    paged KV pool — finished sequences free their blocks immediately,
+    shared block-aligned prompt prefixes are reused from the prefix cache
+    with zero recompute, and prompts prefill in fixed chunks (one compiled
+    prefill program) — instead of ``generate``'s run-to-longest static
+    batches.  Passing ``prompt_buckets`` selects the bucket-ladder prefill
+    fallback (no prefix reuse)."""
     from .inference.serving import ServingEngine
 
     engine = init_inference(model, config, params, **kwargs)
     return ServingEngine(engine, slots=slots, max_seq_len=max_seq_len,
                          prompt_buckets=prompt_buckets,
-                         prefill_batch=prefill_batch)
+                         prefill_batch=prefill_batch, block_size=block_size,
+                         num_blocks=num_blocks,
+                         chunked_prefill=chunked_prefill,
+                         prefill_chunk=prefill_chunk,
+                         prefix_caching=prefix_caching)

@@ -31,6 +31,7 @@ from .. import comm as dist
 from ..parallel.topology import MeshTopology
 from ..runtime.model import ModelSpec
 from ..utils.logging import log_dist
+from ..utils.lru import LRUCache
 from .config import DeepSpeedInferenceConfig
 
 
@@ -272,7 +273,9 @@ class InferenceEngine:
         prepare = self._prepare
         self._forward_fn = jax.jit(
             lambda p, batch: model.apply_fn(prepare(p), batch, None))
-        self._generate_fns: Dict[Any, Any] = {}
+        # bounded per-shape jit cache; hot shapes survive eviction pressure
+        # (utils/lru.py — same policy as ServingEngine's prefill-fn cache)
+        self._generate_fns = LRUCache(capacity=32)
         if self._streamed is not None:
             self._streamed.resident = self.params
         log_dist(f"InferenceEngine: mesh={self.topology}, dtype={config.dtype}",
@@ -373,19 +376,15 @@ class InferenceEngine:
                       float(top_p)) if do_sample else None
         # eos is part of the compiled program (early-exit while_loop)
         key = (b, prompt_len, max_new_tokens, sample_cfg, eos_token_id)
-        # true LRU: a hit re-inserts at the back, so eviction pops the
-        # least-recently-USED shape instead of the oldest-inserted one
-        gen_fn = self._generate_fns.pop(key, None)
-        if gen_fn is None:
-            if len(self._generate_fns) >= 32:  # bound the per-shape jit cache
-                self._generate_fns.pop(next(iter(self._generate_fns)))
+
+        def build():
             if self.module.decode_hooks is not None:
-                gen_fn = self._build_kv_cache_gen(
+                return self._build_kv_cache_gen(
                     b, prompt_len, total, sample_cfg, eos_token_id)
-            else:
-                gen_fn = self._build_recompute_gen(
-                    b, prompt_len, total, sample_cfg, eos_token_id)
-        self._generate_fns[key] = gen_fn
+            return self._build_recompute_gen(
+                b, prompt_len, total, sample_cfg, eos_token_id)
+
+        gen_fn = self._generate_fns.get_or_build(key, build)
         rng = jax.random.PRNGKey(_auto_seed(self, seed))
         out = gen_fn(self.params, jnp.asarray(input_ids), rng)
         out = np.array(out)  # writable host copy (np.asarray view is read-only)

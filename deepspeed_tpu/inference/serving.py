@@ -1,31 +1,54 @@
-"""Continuous-batching serving: slot-based KV-cache pool + iteration-level
-scheduler.
+"""Continuous-batching serving: block-paged KV cache, prefix reuse, and
+chunked prefill over an iteration-level scheduler.
 
-The one-shot :meth:`InferenceEngine.generate` path holds a whole batch until
-its *longest* request finishes and jit-compiles a fresh program for every exact
-``(batch, prompt_len, max_new_tokens)`` tuple — Orca's head-of-line-blocking
-problem.  This module serves mixed-length traffic the way Orca/vLLM do:
+PR 1's slot pool reserved one contiguous ``max_seq_len`` KV region per slot
+and re-ran a full bucketed prefill for every admitted prompt — worst-case
+memory per sequence, and shared prompt prefixes (system prompts, few-shot
+headers) recomputed on every request.  This engine layers the two highest-
+leverage serving optimisations on top of continuous batching:
 
- - **Slot pool**: one statically-shaped KV cache of ``SLOTS`` sequence slots
-   (plus one scratch slot that absorbs pad rows), allocated once via the
-   model's ``init_cache`` hook.  A finished sequence frees its slot
-   *immediately*; the next waiting request is prefilled into it on the
-   following iteration.
- - **Iteration-level scheduling**: every engine iteration admits waiting
-   requests into free slots (strict FIFO — no starvation), runs one bucketed
-   prefill per prompt bucket for the joiners, then one single-token decode
-   step over *all* slots.  Each slot carries its own position: the decode
-   contract is the per-sequence ``lengths: int32[B]`` vector threaded through
-   ``forward_cached`` down to ``ops/decode_attention``.
- - **Bucketed compilation**: prompts are right-padded to a small bucket
-   ladder and joiners to a fixed prefill batch, so the whole serving loop
-   compiles ``O(#buckets) + 1`` XLA programs regardless of how many request
-   shapes the trace contains.  ``compile_count`` / ``compiled_programs`` are
-   the probe the tests assert against.
+ - **Block-paged KV pool** (vLLM PagedAttention): one statically-shaped
+   ``[L, num_blocks, HKV, block_size, hd]`` cache plus per-slot ``int32``
+   block tables mapping each sequence's logical block index (``position //
+   block_size``) to a physical block.  Blocks are handed out by a
+   refcounted free-list allocator (``inference/paged.py``); physical block
+   0 is reserved scratch — pad rows and inactive slots write their
+   discarded KV there, so every device program keeps a fixed shape.
+   Attention reaches the pool through the table: a gather-based XLA path
+   for prefill/CPU and a Pallas kernel that walks the table in-kernel via
+   scalar prefetch for TPU decode (``ops/decode_attention.py``).
+ - **Prefix cache** (SGLang RadixAttention at block granularity): a token
+   trie over *full* blocks.  A new request whose prompt shares a
+   block-aligned prefix with any previously prefilled sequence reuses
+   those physical blocks with zero recompute — only the tail is prefilled.
+   Reuse is capped below the full prompt (>= 1 tail token always runs) and
+   is full-block only, so a sequence's next write always lands in a
+   privately owned block: shared blocks are read-only, no copy-on-write.
+   When the allocator runs dry, least-recently-used cache entries are
+   evicted first; if that is not enough, the *latest-admitted* sequence is
+   preempted — its blocks are freed and it re-enters the queue front with
+   its generated tokens folded into the prompt (greedy decoding makes the
+   recompute token-exact, and its re-prefill usually hits its own cached
+   blocks).
+ - **Chunked prefill**: prompts advance through the cache in fixed-size
+   windows (``prefill_chunk`` tokens, ``prefill_batch`` sequences per
+   call) interleaved with decode steps, replacing the bucket ladder — the
+   whole serving loop compiles exactly **1 prefill + 1 decode program**
+   regardless of trace shape.  The bucket ladder survives as a fallback
+   (``chunked_prefill=False``, auto-selected when ``prompt_buckets`` is
+   passed): per-bucket programs over the same paged pool, no prefix reuse.
 
-Greedy decoding only: per-request outputs are token-identical to sequential
-``generate`` (pinned in ``tests/unit/test_serving.py``).  Sampling needs
-per-request RNG lanes and is left to a follow-up.
+Scheduling is iteration-level and strict-FIFO as before: every iteration
+admits waiting requests into free slots (gated on block availability —
+the queue head blocks admission, no starvation), advances every
+prefilling slot by one chunk, then runs one single-token decode step over
+all slots with per-sequence positions (``lengths: int32[B]``).
+``compile_count`` / ``compiled_programs`` remain the compile probe;
+``stats()`` adds prefix-hit, block-occupancy, and preemption counters.
+
+Greedy decoding only: per-request outputs are token-identical to
+sequential ``generate`` (pinned in ``tests/unit/test_serving.py`` and
+``tests/unit/test_paged_serving.py``).
 """
 
 from __future__ import annotations
@@ -40,16 +63,28 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..utils.logging import log_dist
+from ..utils.lru import LRUCache
+from .paged import BlockAllocator, PrefixCache
 
 
 def default_buckets(max_seq_len: int, lo: int = 32) -> Tuple[int, ...]:
-    """Power-of-two prompt-bucket ladder ``[lo, .., max_seq_len]``."""
+    """Power-of-two prompt-bucket ladder ``[lo, .., max_seq_len]``.
+
+    Robust to the edges the serving engine can hand it: ``lo`` above
+    ``max_seq_len`` clamps to a single ``(max_seq_len,)`` bucket, a
+    non-power-of-two ``max_seq_len`` gets exactly one tail entry (no
+    duplicates), and ``lo < 1`` raises instead of looping forever."""
+    if max_seq_len < 1:
+        raise ValueError(f"max_seq_len must be >= 1, got {max_seq_len}")
+    if lo < 1:
+        raise ValueError(f"bucket floor lo must be >= 1, got {lo}")
+    b = min(lo, max_seq_len)
     buckets = []
-    b = lo
     while b < max_seq_len:
         buckets.append(b)
         b *= 2
-    buckets.append(max_seq_len)
+    if not buckets or buckets[-1] != max_seq_len:
+        buckets.append(max_seq_len)
     return tuple(buckets)
 
 
@@ -72,33 +107,62 @@ class Request:
 @dataclasses.dataclass
 class _SlotState:
     req: Request
+    admit_seq: int                 # admission recency (preemption victim order)
+    prompt_eff: np.ndarray         # prompt (+ pre-preemption tokens on resume)
+    prior: List[int]               # tokens generated before a preemption
     out: List[int] = dataclasses.field(default_factory=list)
+    base: int = 0                  # tokens already in the paged cache
+    phase: str = "prefill"         # "prefill" -> "decode"
+
+    @property
+    def plen_eff(self) -> int:
+        return int(self.prompt_eff.size)
+
+    @property
+    def gen_count(self) -> int:
+        return len(self.prior) + len(self.out)
 
 
 class ServingEngine:
     """Iteration-level (continuous-batching) scheduler over an
-    :class:`~deepspeed_tpu.inference.engine.InferenceEngine`'s KV-decode path.
+    :class:`~deepspeed_tpu.inference.engine.InferenceEngine`'s KV-decode
+    path, with a block-paged cache (module docstring has the design).
 
     Parameters
     ----------
-    engine:        an ``init_inference`` engine whose model carries
-                   ``decode_hooks`` with ``supports_lengths`` (gpt2 / llama /
-                   opt / mixtral families).
-    slots:         KV-cache pool size = max concurrently-decoding sequences.
-    max_seq_len:   per-slot cache length (prompt + completion budget);
-                   rounded up to a multiple of 128 for the Pallas block_k,
-                   clamped to the model context length.
-    prompt_buckets: ascending prompt-length ladder; prompts pad up to the
-                   smallest fitting bucket.  Default: powers of two.
-    prefill_batch: fixed number of joiner rows per prefill program (shorter
-                   groups pad into the scratch slot), so joiner count never
-                   forces a recompile.
+    engine:         an ``init_inference`` engine whose model carries
+                    ``decode_hooks`` with ``supports_lengths`` and
+                    ``supports_paged`` (gpt2 / llama / opt / mixtral).
+    slots:          max concurrently-active sequences.
+    max_seq_len:    per-sequence budget (prompt + completion), clamped to
+                    the model context length.
+    block_size:     tokens per KV block (paging granularity — also the
+                    prefix-reuse granularity).
+    num_blocks:     physical pool size incl. the scratch block.  Default
+                    ``1 + slots * ceil(max_seq_len/block_size)`` (no
+                    oversubscription); smaller pools oversubscribe and rely
+                    on prefix eviction + preemption.
+    chunked_prefill: ``True`` = fixed-window chunked prefill (1 compiled
+                    prefill program, prefix reuse available).  ``False`` =
+                    bucket-ladder fallback (per-bucket programs, no
+                    reuse).  Default ``None`` = auto: bucketed iff
+                    ``prompt_buckets`` is passed.
+    prefill_chunk:  chunk window length (chunked mode).
+    prompt_buckets: ascending prompt-length ladder (bucketed mode).
+    prefill_batch:  sequences per prefill call (both modes); short groups
+                    pad with scratch-routed rows.
+    prefix_caching: enable the block trie (chunked mode only).
     """
 
     def __init__(self, engine, *, slots: int = 8,
                  max_seq_len: Optional[int] = None,
                  prompt_buckets: Optional[Sequence[int]] = None,
-                 prefill_batch: int = 4):
+                 prefill_batch: int = 4,
+                 block_size: int = 32,
+                 num_blocks: Optional[int] = None,
+                 chunked_prefill: Optional[bool] = None,
+                 prefill_chunk: int = 128,
+                 prefix_caching: bool = True):
         hooks = engine.module.decode_hooks
         if not hooks:
             raise ValueError(
@@ -109,6 +173,11 @@ class ServingEngine:
                 f"model {engine.module.name}'s decode hooks predate "
                 "per-sequence lengths (supports_lengths) — update its "
                 "forward_cached to the lengths contract first")
+        if not hooks.get("supports_paged"):
+            raise ValueError(
+                f"model {engine.module.name}'s decode hooks predate the "
+                "block-paged cache (supports_paged) — thread block_tables "
+                "through its forward_cached first")
         self.engine = engine
         self._fwd = hooks["forward_cached"]
         self._init_cache = hooks["init_cache"]
@@ -120,42 +189,85 @@ class ServingEngine:
                 f"max_seq_len {max_seq_len} exceeds the model context "
                 f"length {max_ctx}")
         self.max_seq_len = int(max_seq_len)
-        # the CACHE may be longer than the logical context: round up so the
-        # Pallas decode kernel's block_k divides it (same rounding as
-        # InferenceEngine._build_kv_cache_gen)
-        self._cache_len = -(-self.max_seq_len // 128) * 128
         self.slots = int(slots)
-        buckets = tuple(sorted(prompt_buckets)) if prompt_buckets \
-            else default_buckets(self.max_seq_len)
-        if any(b > self.max_seq_len for b in buckets):
-            raise ValueError(
-                f"prompt bucket(s) {buckets} exceed max_seq_len "
-                f"{self.max_seq_len}")
-        self.prompt_buckets = buckets
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.block_size = int(block_size)
+        # logical per-sequence capacity, rounded up to whole blocks
+        self._cache_len = -(-self.max_seq_len // block_size) * block_size
+        self._nbper = self._cache_len // block_size      # block-table width
+
+        self.chunked_prefill = (prompt_buckets is None) \
+            if chunked_prefill is None else bool(chunked_prefill)
+        if self.chunked_prefill:
+            self.prompt_buckets: Tuple[int, ...] = ()
+            # floor of 2: forward_cached dispatches per-row DECODE on T == 1,
+            # so a width-1 prefill window would be misread as a decode step
+            # (1-token prompts prefill fine in a width-2 window — the pad
+            # column writes to scratch)
+            self.prefill_chunk = max(2, min(int(prefill_chunk),
+                                            self._cache_len))
+        else:
+            buckets = tuple(sorted(prompt_buckets)) if prompt_buckets \
+                else default_buckets(self.max_seq_len)
+            if any(b > self.max_seq_len for b in buckets):
+                raise ValueError(
+                    f"prompt bucket(s) {buckets} exceed max_seq_len "
+                    f"{self.max_seq_len}")
+            self.prompt_buckets = buckets
+            self.prefill_chunk = 0
         self.prefill_batch = int(prefill_batch)
-        # slot `slots` is SCRATCH: pad rows of short prefill groups write
-        # their (discarded) KV there so every prefill program has a fixed
-        # [prefill_batch] shape.  Committed replicated on the engine mesh so
-        # the very first step sees the same placement as every later one
-        # (an uncommitted pool would cost each program a second trace).
+
+        if num_blocks is None:
+            num_blocks = 1 + self.slots * self._nbper
+        if num_blocks < 1 + self._nbper:
+            raise ValueError(
+                f"num_blocks {num_blocks} cannot hold one full sequence "
+                f"({self._nbper} blocks + 1 scratch)")
+        self._alloc = BlockAllocator(num_blocks)
+        self._prefix = PrefixCache(self.block_size) \
+            if (prefix_caching and self.chunked_prefill) else None
+
+        # single pool, committed replicated on the engine mesh so the very
+        # first step sees the same placement as every later one
         rep = NamedSharding(engine.mesh, P())
         self._cache = jax.tree_util.tree_map(
             lambda x: jax.device_put(x, rep),
-            self._init_cache(self.slots + 1, self._cache_len,
+            self._init_cache(num_blocks, self.block_size,
                              engine._config.jnp_dtype))
-        self._prefill_fns: Dict[int, Any] = {}
+        # host-side block tables; entry 0 = scratch doubles as "unset"
+        self._tables = np.zeros((self.slots, self._nbper), np.int32)
+        self._held: List[List[int]] = [[] for _ in range(self.slots)]
+        self._tokens = np.zeros(self.slots, np.int32)
+        self._lengths = np.zeros(self.slots, np.int32)
+
+        # compiled-program caches (true LRU, utils/lru.py — shared policy
+        # with InferenceEngine._generate_fns); sized past the ladder so a
+        # large custom bucket set can never thrash-recompile per call
+        self._prefill_fns = LRUCache(
+            capacity=max(16, len(self.prompt_buckets) + 1))
         self._decode_fn = None
-        #: compile probe — one entry per traced program; the serving loop
-        #: stays at O(#buckets)+1 entries for an entire trace
+        #: compile probe — one entry per traced program; chunked mode stays
+        #: at 1 prefill + 1 decode for an entire trace
         self.compiled_programs: List[Any] = []
-        # decode stats for the bench
+        # scheduler counters (stats())
         self.iterations = 0
         self.decode_steps = 0
         self.prefill_calls = 0
+        self.admitted = 0
+        self.preempted = 0
+        self.prompt_tokens = 0
+        self.prefix_hit_tokens = 0
+        self._admit_seq = 0
+        self._blocked_gate = None          # (head id, resume len, version)
         log_dist(
             f"ServingEngine: slots={self.slots}, cache_len="
-            f"{self._cache_len}, buckets={self.prompt_buckets}, "
-            f"prefill_batch={self.prefill_batch}", ranks=[0])
+            f"{self._cache_len}, block_size={self.block_size}, "
+            f"num_blocks={num_blocks}, "
+            + (f"chunked prefill (chunk={self.prefill_chunk}, prefix_cache="
+               f"{self._prefix is not None})" if self.chunked_prefill
+               else f"bucketed prefill {self.prompt_buckets}")
+            + f", prefill_batch={self.prefill_batch}", ranks=[0])
 
     # ------------------------------------------------------------ compiled fns
     @property
@@ -171,44 +283,88 @@ class ServingEngine:
         if self._decode_fn is None:
             fwd, prepare = self._fwd, self.engine._prepare
 
-            def step(params, cache, tokens, lengths):
+            def step(params, cache, tokens, lengths, block_tables):
                 logits, cache = fwd(prepare(params), tokens[:, None], cache,
-                                    0, lengths=lengths)
+                                    0, lengths=lengths,
+                                    block_tables=block_tables)
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
             self._decode_fn = jax.jit(step, donate_argnums=self._donate())
-            self.compiled_programs.append(("decode", self.slots + 1))
+            self.compiled_programs.append(("decode", self.slots))
         return self._decode_fn
 
-    def _get_prefill_fn(self, bucket: int):
-        if bucket not in self._prefill_fns:
-            fwd, prepare = self._fwd, self.engine._prepare
-            init_cache = self._init_cache
-            dtype = self.engine._config.jnp_dtype
+    def _get_prefill_fn(self, width: int):
+        """One compiled prefill program per window length: chunked mode uses
+        a single ``prefill_chunk`` width, bucketed mode one per bucket."""
+        fwd, prepare = self._fwd, self.engine._prepare
 
-            def prefill(params, cache, ids, slot_idx, lengths):
-                """ids [J, bucket] right-padded; slot_idx int32 [J] (pad rows
-                point at the scratch slot); lengths int32 [J]."""
-                params = prepare(params)
-                # fresh slots have no history: prefill into a zeroed
-                # bucket-length sub-cache (no pool gather) and scatter only
-                # the first ``bucket`` positions of each joiner's slot row.
-                # Cache leaves are [L, B, ..., S, hd]: batch dim 1, length
-                # dim -2.  Stale KV beyond ``bucket`` from a previous
-                # occupant is never read — decode masks by each row's
-                # length and overwrites position L before attending it.
-                sub = init_cache(ids.shape[0], bucket, dtype)
-                logits, sub = fwd(params, ids, sub, 0, lengths=lengths)
-                cache = jax.tree_util.tree_map(
-                    lambda c, s: c.at[:, slot_idx, ..., :bucket, :].set(
-                        s.astype(c.dtype)), cache, sub)
+        def build():
+            def prefill(params, cache, ids, block_tables, base, valid):
+                """ids [J, width] right-padded; base int32 [J] per-row chunk
+                start (reused-prefix length for fresh slots); valid int32
+                [J] real tokens per row (pads write to scratch block 0)."""
+                logits, cache = fwd(prepare(params), ids, cache, base,
+                                    lengths=valid, block_tables=block_tables)
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
-            self._prefill_fns[bucket] = jax.jit(
-                prefill, donate_argnums=self._donate())
-            self.compiled_programs.append(("prefill", bucket,
-                                           self.prefill_batch))
-        return self._prefill_fns[bucket]
+            return jax.jit(prefill, donate_argnums=self._donate())
+
+        return self._prefill_fns.get_or_build(
+            width, build,
+            on_build=lambda _: self.compiled_programs.append(
+                ("prefill", width, self.prefill_batch)))
+
+    # ----------------------------------------------------------- block plumbing
+    def _release_slot(self, slot: int) -> None:
+        for b in self._held[slot]:
+            self._alloc.decref(b)
+        self._held[slot] = []
+        self._tables[slot] = 0
+        self._tokens[slot] = 0
+        self._lengths[slot] = 0
+
+    def _preempt(self, slot: int, active, pending) -> None:
+        """Evict a sequence under block pressure: free its blocks and
+        re-queue it at the FRONT with generated tokens folded into the
+        prompt (greedy => recompute is token-exact)."""
+        st = active.pop(slot)
+        self._release_slot(slot)
+        pending.appendleft((st.req, st.prior + st.out))
+        self.preempted += 1
+
+    def _alloc_block(self, active, pending, requester: int) -> Optional[int]:
+        """One fresh block, reclaiming in order: free list -> LRU prefix-
+        cache eviction -> preempting the latest-admitted sequence.  Returns
+        ``None`` iff the requester itself was preempted."""
+        while True:
+            b = self._alloc.alloc()
+            if b is not None:
+                return b
+            if self._prefix is not None and \
+                    self._prefix.evict_one(self._alloc):
+                continue
+            victim = max(active, key=lambda s: active[s].admit_seq)
+            if victim == requester and len(active) == 1:
+                # cannot happen when num_blocks >= nbper+1 (ctor check)
+                raise RuntimeError(
+                    "paged KV pool too small for a single sequence")
+            self._preempt(victim, active, pending)
+            if victim == requester:
+                return None
+
+    def _ensure_blocks(self, slot: int, active, pending, upto: int) -> bool:
+        """Make the slot's table cover positions ``[0, upto)``; may preempt
+        other slots (or the slot itself — returns False)."""
+        for li in range(-(-upto // self.block_size)):
+            if slot not in active:
+                return False
+            if self._tables[slot, li] == 0:
+                b = self._alloc_block(active, pending, requester=slot)
+                if b is None:
+                    return False
+                self._tables[slot, li] = b
+                self._held[slot].append(b)
+        return slot in active
 
     # --------------------------------------------------------------- schedule
     def _bucket_for(self, prompt_len: int) -> int:
@@ -219,29 +375,95 @@ class ServingEngine:
             f"prompt length {prompt_len} exceeds the largest bucket "
             f"{self.prompt_buckets[-1]}")
 
+    def _prefill_width(self, prompt_len: int) -> int:
+        """Prefill window for a prompt in bucketed mode: its ladder rung —
+        or the full cache width for a preemption resume whose prompt (with
+        generated tokens folded in) outgrew a custom ladder, instead of
+        failing mid-trace (the prefill program is width-generic, so this
+        costs at most one extra compile).  Floor of 2 for the same T == 1
+        decode-dispatch reason as ``prefill_chunk``."""
+        for b in self.prompt_buckets:
+            if prompt_len <= b:
+                return max(2, b)
+        return self._cache_len
+
     def _admit(self, pending, active, admission_log):
-        """FIFO admission of waiting requests into free slots.  Returns the
-        joiners admitted this iteration as (slot, request) pairs."""
+        """Strict-FIFO admission into free slots, gated on block
+        availability (free + prefix-evictable) so an admitted sequence can
+        always prefill its prompt; the queue head blocks admission when it
+        doesn't fit — no starvation."""
         joiners = []
         free = [s for s in range(self.slots) if s not in active]
-        while pending and free:
-            req = pending.popleft()
+        reserved = 0                       # blocks promised to this call's
+        while pending and free:            # earlier joiners, not yet alloc'd
+            req, prior = pending[0]
+            # blocked-head memo: while nothing refcount-related moved, the
+            # gate's probe/evictable answer cannot change — skip the
+            # O(prompt + trie) host walk every idle iteration
+            gate_key = (id(req), len(prior), self._alloc.version)
+            if gate_key == self._blocked_gate:
+                break
+            prompt_eff = np.concatenate(
+                [req.prompt, np.asarray(prior, np.int32)]) \
+                if prior else req.prompt
+            plen = int(prompt_eff.size)
+            # gate on a non-mutating probe first: while the queue head is
+            # blocked, iterations must not churn refcounts / LRU recency
+            total_need = -(-(plen + 1) // self.block_size)
+            n_hit = self._prefix.probe(prompt_eff, plen - 1) \
+                if self._prefix is not None else 0
+
+            def _avail():
+                return self._alloc.free_blocks - reserved + \
+                    (self._prefix.evictable(self._alloc)
+                     if self._prefix is not None else 0)
+
+            if total_need - n_hit > _avail():
+                self._blocked_gate = gate_key
+                break                      # strict FIFO: head blocks the rest
+            hits: List[int] = []
+            if self._prefix is not None:
+                # cap below the full prompt: >= 1 tail token must prefill
+                hits = self._prefix.lookup(prompt_eff, plen - 1, self._alloc)
+            # re-check post-claim: hit blocks that were evictable no longer
+            # count toward avail, so the probe gate can be optimistic by
+            # up to n_hit blocks
+            need = total_need - len(hits)
+            if need > _avail():
+                for b in hits:             # unclaim and wait for pressure
+                    self._alloc.decref(b)  # to drain
+                self._blocked_gate = (id(req), len(prior),
+                                      self._alloc.version)
+                break
+            reserved += max(need, 0)
+            pending.popleft()
             slot = free.pop(0)
-            active[slot] = _SlotState(req)
-            joiners.append((slot, req))
+            self._tables[slot, :len(hits)] = hits
+            self._held[slot] = list(hits)
+            st = _SlotState(req=req, admit_seq=self._admit_seq,
+                            prompt_eff=prompt_eff, prior=list(prior),
+                            base=len(hits) * self.block_size)
+            self._admit_seq += 1
+            active[slot] = st
+            joiners.append((slot, st))
             admission_log.append((req.uid, slot))
+            self.admitted += 1
+            self.prompt_tokens += plen
+            self.prefix_hit_tokens += st.base
         return joiners
 
     def serve(self, requests: Sequence[Request],
               eos_token_id: Optional[int] = None,
-              admission_log: Optional[list] = None) -> Dict[Any, np.ndarray]:
+              admission_log: Optional[list] = None,
+              step_log: Optional[list] = None) -> Dict[Any, np.ndarray]:
         """Run a request trace to completion; returns ``uid -> [prompt +
         completion]`` int32 arrays, padded to ``prompt + max_new_tokens``
         with eos back-fill (HF semantics, same as ``generate``).
 
         ``admission_log``, when given, collects ``(uid, slot)`` in admission
-        order — the scheduler-determinism tests read it.
-        """
+        order — the scheduler-determinism tests read it.  ``step_log``
+        collects one dict per iteration (admitted / evicted / blocks_in_use
+        per step) for observability."""
         for r in requests:
             total = len(r.prompt) + r.max_new_tokens
             if total > self.max_seq_len:
@@ -249,92 +471,197 @@ class ServingEngine:
                     f"request {r.uid!r}: prompt ({len(r.prompt)}) + "
                     f"max_new_tokens ({r.max_new_tokens}) = {total} exceeds "
                     f"max_seq_len {self.max_seq_len}")
-            self._bucket_for(len(r.prompt))  # raises if no bucket fits
+            if not self.chunked_prefill:
+                self._bucket_for(len(r.prompt))  # raises if no bucket fits
         uids = [r.uid for r in requests]
         if len(set(uids)) != len(uids):
             raise ValueError("duplicate request uids")
 
         params = self.engine.params
-        pending = deque(requests)
+        pending = deque((r, []) for r in requests)
         active: Dict[int, _SlotState] = {}
+        self._blocked_gate = None          # ids are fresh for this trace
         if admission_log is None:
             admission_log = []
         results: Dict[Any, np.ndarray] = {}
-        # host-side mirrors of the device step inputs: the token each slot
-        # feeds next, and how many tokens its cache already holds
-        tokens = np.zeros(self.slots + 1, np.int32)
-        lengths = np.zeros(self.slots + 1, np.int32)
 
         def finish(slot):
             st = active.pop(slot)
             req = st.req
-            out = np.full(req.max_new_tokens, 0, np.int32)
-            gen = np.asarray(st.out, np.int32)
+            gen = np.asarray(st.prior + st.out, np.int32)
+            out = np.zeros(req.max_new_tokens, np.int32)
             out[:gen.size] = gen
             if eos_token_id is not None and gen.size and \
                     gen[-1] == eos_token_id:
                 out[gen.size:] = eos_token_id  # back-fill (HF semantics)
             results[req.uid] = np.concatenate([req.prompt, out])
-            tokens[slot] = 0
-            lengths[slot] = 0
+            self._release_slot(slot)
 
         while pending or active:
             self.iterations += 1
-            joiners = self._admit(pending, active, admission_log)
+            admitted0, preempted0 = self.admitted, self.preempted
+            self._admit(pending, active, admission_log)
+            self._run_prefill(active, pending, params, eos_token_id, finish)
 
-            # bucketed prefill, fixed-J groups per bucket
-            by_bucket: Dict[int, list] = {}
-            for slot, req in joiners:
-                by_bucket.setdefault(self._bucket_for(len(req.prompt)),
-                                     []).append((slot, req))
-            for bucket in sorted(by_bucket):
-                group = by_bucket[bucket]
-                for i in range(0, len(group), self.prefill_batch):
-                    chunk = group[i:i + self.prefill_batch]
-                    first = self._run_prefill(bucket, chunk, params)
-                    self.prefill_calls += 1
-                    for row, (slot, req) in enumerate(chunk):
-                        tok = int(first[row])
-                        active[slot].out.append(tok)
-                        tokens[slot] = tok
-                        lengths[slot] = len(req.prompt)
-                        if (eos_token_id is not None
-                                and tok == eos_token_id) \
-                                or req.max_new_tokens <= 1:
-                            finish(slot)
-
-            # one decode step over every slot (per-sequence positions)
-            if active:
+            # one decode step over every slot (per-sequence positions);
+            # prefilling/empty slots point at the scratch block
+            dec = sorted(
+                (s for s, st in active.items() if st.phase == "decode"),
+                key=lambda s: active[s].admit_seq)
+            for slot in dec:
+                if slot in active:
+                    self._ensure_blocks(slot, active, pending,
+                                        int(self._lengths[slot]) + 1)
+            dec = sorted(s for s, st in active.items()
+                         if st.phase == "decode")
+            if dec:
+                bt = np.zeros_like(self._tables)
+                bt[dec] = self._tables[dec]
                 nxt, self._cache = self._get_decode_fn()(
-                    params, self._cache, jnp.asarray(tokens),
-                    jnp.asarray(lengths))
+                    params, self._cache, jnp.asarray(self._tokens),
+                    jnp.asarray(self._lengths), jnp.asarray(bt))
                 nxt = np.asarray(nxt)
                 self.decode_steps += 1
-                for slot in sorted(active):
+                for slot in dec:
                     st = active[slot]
-                    lengths[slot] += 1       # the fed token is now cached
+                    self._lengths[slot] += 1   # the fed token is now cached
                     tok = int(nxt[slot])
                     st.out.append(tok)
                     if (eos_token_id is not None and tok == eos_token_id) \
-                            or len(st.out) >= st.req.max_new_tokens:
+                            or st.gen_count >= st.req.max_new_tokens:
                         finish(slot)
                     else:
-                        tokens[slot] = tok
+                        self._tokens[slot] = tok
+            if step_log is not None:
+                step_log.append({
+                    "iteration": self.iterations,
+                    "admitted": self.admitted - admitted0,
+                    "evicted": self.preempted - preempted0,
+                    "blocks_in_use": self._alloc.blocks_in_use,
+                })
         return results
 
-    def _run_prefill(self, bucket, chunk, params):
-        """Prefill one fixed-J group of joiners into their slots; returns
-        the first generated token per row (np.int32 [J])."""
+    # ---------------------------------------------------------------- prefill
+    def _run_prefill(self, active, pending, params, eos_token_id, finish):
+        """Advance prefilling slots: one fixed-width chunk per slot per
+        iteration (chunked mode), or the whole prompt in its bucket's
+        program (bucketed fallback).  Both modes run ``prefill_batch`` rows
+        per call; pad rows write to scratch."""
+        pre = [s for s, st in sorted(active.items(),
+                                     key=lambda kv: kv[1].admit_seq)
+               if st.phase == "prefill"]
+        if not pre:
+            return
+        if self.chunked_prefill:
+            groups = []
+            ready = []
+            for slot in pre:
+                if slot not in active:
+                    continue               # preempted by an earlier alloc
+                st = active[slot]
+                v = min(self.prefill_chunk, st.plen_eff - st.base)
+                if self._ensure_blocks(slot, active, pending, st.base + v):
+                    ready.append(slot)
+            for i in range(0, len(ready), self.prefill_batch):
+                group = [s for s in ready[i:i + self.prefill_batch]
+                         if s in active]
+                if group:
+                    groups.append((self.prefill_chunk, group))
+        else:
+            by_bucket: Dict[int, list] = {}
+            for slot in pre:
+                if slot not in active:
+                    continue
+                st = active[slot]
+                if self._ensure_blocks(slot, active, pending, st.plen_eff):
+                    by_bucket.setdefault(self._prefill_width(st.plen_eff),
+                                         []).append(slot)
+            groups = []
+            for bucket in sorted(by_bucket):
+                grp = by_bucket[bucket]
+                for i in range(0, len(grp), self.prefill_batch):
+                    group = [s for s in grp[i:i + self.prefill_batch]
+                             if s in active]
+                    if group:
+                        groups.append((bucket, group))
+
+        for width, group in groups:
+            group = [s for s in group if s in active]
+            if not group:
+                continue
+            self._run_prefill_group(width, group, active, params,
+                                    eos_token_id, finish)
+
+    def _run_prefill_group(self, width, group, active, params,
+                           eos_token_id, finish):
+        """One prefill call: each row advances its slot by ``min(width,
+        remaining prompt)`` tokens from its own base.  Rows whose window
+        reaches the last prompt token yield that slot's first generated
+        token (logits are gathered per row at ``valid - 1``)."""
         j = self.prefill_batch
-        ids = np.zeros((j, bucket), np.int32)
-        slot_idx = np.full(j, self.slots, np.int32)      # pad -> scratch
-        lens = np.ones(j, np.int32)
-        for row, (slot, req) in enumerate(chunk):
-            plen = len(req.prompt)
-            ids[row, :plen] = req.prompt
-            slot_idx[row] = slot
-            lens[row] = plen
-        first, self._cache = self._get_prefill_fn(bucket)(
-            params, self._cache, jnp.asarray(ids), jnp.asarray(slot_idx),
-            jnp.asarray(lens))
-        return np.asarray(first)
+        ids = np.zeros((j, width), np.int32)
+        bt = np.zeros((j, self._nbper), np.int32)
+        base = np.zeros(j, np.int32)
+        valid = np.zeros(j, np.int32)
+        rows = []
+        for row, slot in enumerate(group):
+            st = active[slot]
+            v = min(width, st.plen_eff - st.base)
+            ids[row, :v] = st.prompt_eff[st.base:st.base + v]
+            bt[row] = self._tables[slot]
+            base[row] = st.base
+            valid[row] = v
+            rows.append((slot, v))
+        first, self._cache = self._get_prefill_fn(width)(
+            params, self._cache, jnp.asarray(ids), jnp.asarray(bt),
+            jnp.asarray(base), jnp.asarray(valid))
+        first = np.asarray(first)
+        self.prefill_calls += 1
+        for row, (slot, v) in enumerate(rows):
+            st = active[slot]
+            st.base += v
+            if st.base < st.plen_eff:
+                continue                   # more chunks to go
+            st.phase = "decode"
+            if self._prefix is not None:
+                # cache the prompt's FULL blocks (the trailing partial block
+                # will also hold generated tokens — never shared)
+                nfull = st.plen_eff // self.block_size
+                if nfull:
+                    self._prefix.register(st.prompt_eff,
+                                          self._tables[slot, :nfull],
+                                          self._alloc)
+            tok = int(first[row])
+            st.out.append(tok)
+            self._tokens[slot] = tok
+            self._lengths[slot] = st.plen_eff
+            if (eos_token_id is not None and tok == eos_token_id) \
+                    or st.gen_count >= st.req.max_new_tokens:
+                finish(slot)
+
+    # ------------------------------------------------------------------ stats
+    def stats(self) -> Dict[str, Any]:
+        """Serving-loop observability: compile probe, prefix-cache hit
+        rate, block occupancy, and admission/eviction counters."""
+        return {
+            "mode": "chunked" if self.chunked_prefill else "bucketed",
+            "compile_count": self.compile_count,
+            "iterations": self.iterations,
+            "decode_steps": self.decode_steps,
+            "prefill_calls": self.prefill_calls,
+            "admitted": self.admitted,
+            "evicted": self.preempted,
+            "prompt_tokens": self.prompt_tokens,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "prefix_cache_hit_rate": (
+                self.prefix_hit_tokens / self.prompt_tokens
+                if self.prompt_tokens else 0.0),
+            "prefix_cache_entries": len(self._prefix)
+            if self._prefix is not None else 0,
+            "prefix_cache_evictions": self._prefix.evictions
+            if self._prefix is not None else 0,
+            "blocks_in_use": self._alloc.blocks_in_use,
+            "free_blocks": self._alloc.free_blocks,
+            "num_blocks": self._alloc.num_blocks,
+            "block_size": self.block_size,
+        }

@@ -388,14 +388,37 @@ def cache_update(ck, cv, k, v, pos):
     return ck, cv
 
 
-def _block_cached_body(cfg: GPT2Config, x, get, mm, ck, cv, pos):
+def _cached_attention(q, k, v, ck, cv, pos, block_tables=None,
+                      chunk_valid=None):
+    """Write new KV + attend, on either cache layout.  Contiguous
+    (``block_tables is None``): ck/cv are [B, H, S, hd] per-sequence
+    regions.  Paged: ck/cv are the shared [NB, H, bs, hd] pool and each
+    row reaches its tokens through ``block_tables`` int32 [B, NBPER];
+    ``chunk_valid`` (int32 [B]) marks how many of a T>1 chunk's tokens are
+    real — pads write to the scratch block.  Shared by every decode-hook
+    model family."""
+    from ..ops.decode_attention import decode_attention, \
+        paged_decode_attention
+
+    if block_tables is None:
+        ck, cv = cache_update(ck, cv, k, v, pos)
+        return decode_attention(q, ck, cv, pos), ck, cv
+    from ..ops.paged_kv import paged_cache_update
+
+    ck, cv = paged_cache_update(ck, cv, k, v, pos, block_tables,
+                                valid=chunk_valid)
+    return paged_decode_attention(q, ck, cv, block_tables, pos), ck, cv
+
+
+def _block_cached_body(cfg: GPT2Config, x, get, mm, ck, cv, pos,
+                       block_tables=None, chunk_valid=None):
     """One block with KV-cache read/write, parameterized by weight access
     (``get(name)`` small leaf, ``mm(y, name, dtype)`` matmul) so the scan
     and layer-indexed decode paths share the math.  x: [B, T, D]; ck/cv:
-    [B, H, S, hd]; pos: traced global position of x[:, 0] — scalar, or
-    int32 [B] per-row positions (continuous-batching decode, T=1)."""
-    from ..ops.decode_attention import decode_attention
-
+    [B, H, S, hd] — or the paged pool slice [NB, H, bs, hd] when
+    ``block_tables`` is given; pos: traced global position of x[:, 0] —
+    scalar, or int32 [B] per-row positions (continuous-batching decode
+    T=1, or paged chunked-prefill bases T>1)."""
     b, t, d = x.shape
     h, hd = cfg.num_heads, cfg.head_dim
 
@@ -405,8 +428,8 @@ def _block_cached_body(cfg: GPT2Config, x, get, mm, ck, cv, pos):
     q = q.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
     k = k.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
     v = v.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
-    ck, cv = cache_update(ck, cv, k, v, pos)
-    attn = decode_attention(q, ck, cv, pos)
+    attn, ck, cv = _cached_attention(q, k, v, ck, cv, pos, block_tables,
+                                     chunk_valid)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, t, d)
     x = x + mm(attn, "o_w", x.dtype) + get("o_b").astype(x.dtype)
 
@@ -467,7 +490,7 @@ def decode_over_layers(body, x, blocks, cache_k, cache_v, num_layers,
 
 
 def forward_cached(cfg: GPT2Config, params, input_ids, cache, pos,
-                   lengths=None):
+                   lengths=None, block_tables=None):
     """Incremental forward: logits for the LAST input position + updated
     cache.
 
@@ -481,6 +504,15 @@ def forward_cached(cfg: GPT2Config, params, input_ids, cache, pos,
        the pad positions unreachable from valid queries, and the returned
        logits are gathered at each row's own last prompt token
        (``lengths[b] - 1``) instead of column T-1.
+
+    ``block_tables`` (optional int32 [B, NBPER]) switches the cache to the
+    block-paged layout (``ops/paged_kv.py``): cache leaves are the shared
+    ``[L, NB, H, block_size, hd]`` pool and each row reaches its tokens
+    through its table.  T == 1 keeps the decode contract above; T > 1 is a
+    *chunked-prefill* window — ``pos`` may then be int32 [B] per-row chunk
+    bases (tokens already cached, e.g. a reused prefix) and ``lengths`` the
+    per-row count of real tokens in the window (pad tokens write to the
+    scratch block).
     """
     params = _dequant_resident(params)
     b, t = input_ids.shape
@@ -491,14 +523,24 @@ def forward_cached(cfg: GPT2Config, params, input_ids, cache, pos,
         lengths = jnp.asarray(lengths, jnp.int32)
         step_pos = lengths
         wpe = params["wpe"][jnp.clip(lengths, 0, cfg.max_seq_len - 1)][:, None]
+    elif block_tables is not None and pos.ndim == 1:
+        # chunked prefill: per-row base positions for a T-token window
+        step_pos = pos
+        idx = jnp.clip(pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :],
+                       0, cfg.max_seq_len - 1)
+        wpe = params["wpe"][idx]                                  # [B, T, D]
     else:
         step_pos = pos
         wpe = jax.lax.dynamic_slice(params["wpe"], (pos, 0), (t, d))
     x = (params["wte"][input_ids] + wpe).astype(params["wte"].dtype)
 
+    chunk_valid = jnp.asarray(lengths, jnp.int32) \
+        if (block_tables is not None and lengths is not None and t > 1) \
+        else None
     x, ks, vs = decode_over_layers(
-        lambda x, get, mm, ck, cv: _block_cached_body(cfg, x, get, mm, ck,
-                                                      cv, step_pos),
+        lambda x, get, mm, ck, cv: _block_cached_body(
+            cfg, x, get, mm, ck, cv, step_pos, block_tables=block_tables,
+            chunk_valid=chunk_valid),
         x, params["blocks"], cache["k"], cache["v"], cfg.num_layers)
     x = _gather_last(x, lengths if not per_row else None)
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
@@ -773,13 +815,17 @@ def build(cfg: Optional[GPT2Config] = None, **overrides) -> ModelSpec:
     decode_hooks = {
         "init_cache": lambda b, s, dtype=jnp.bfloat16: init_cache(cfg, b, s,
                                                                   dtype),
-        "forward_cached": lambda params, ids, cache, pos, lengths=None:
-            forward_cached(cfg, params, ids, cache, pos, lengths),
+        "forward_cached": lambda params, ids, cache, pos, lengths=None,
+            block_tables=None:
+            forward_cached(cfg, params, ids, cache, pos, lengths,
+                           block_tables),
         # learned absolute positions: decoding past this silently clamps the
         # wpe dynamic_slice, so the engine must reject it up front
         "max_seq_len": cfg.max_seq_len,
         # per-sequence decode positions (continuous-batching serving)
         "supports_lengths": True,
+        # block-paged KV layout + chunked prefill (paged serving)
+        "supports_paged": True,
     }
 
     return ModelSpec(

@@ -244,13 +244,13 @@ def _rope_cached(cfg: LlamaConfig, x, pos):
 
 
 def _block_cached_body(cfg: LlamaConfig, x, get, mm, ck, cv, pos,
-                       mlp=None):
+                       mlp=None, block_tables=None, chunk_valid=None):
     """Cached-attention block parameterized by weight access (``get(name)``
     small leaf, ``mm(y, name, dtype)`` matmul — shared by the scan and
     layer-indexed quantized decode paths, see gpt2.decode_over_layers).
-    ``mlp(y) -> y`` overrides the dense SwiGLU (mixtral's MoE FFN)."""
-    from ..ops.decode_attention import decode_attention
-
+    ``mlp(y) -> y`` overrides the dense SwiGLU (mixtral's MoE FFN).
+    ``block_tables``/``chunk_valid`` switch ck/cv to the paged-pool layout
+    (contract in gpt2._cached_attention)."""
     b, t, d = x.shape
     h, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
@@ -261,10 +261,10 @@ def _block_cached_body(cfg: LlamaConfig, x, get, mm, ck, cv, pos,
     q = _rope_cached(cfg, q.transpose(0, 2, 1, 3), pos)
     k = _rope_cached(cfg, k.transpose(0, 2, 1, 3), pos)
     v = v.transpose(0, 2, 1, 3)
-    from .gpt2 import cache_update
+    from .gpt2 import _cached_attention
 
-    ck, cv = cache_update(ck, cv, k, v, pos)
-    attn = decode_attention(q, ck, cv, pos)
+    attn, ck, cv = _cached_attention(q, k, v, ck, cv, pos, block_tables,
+                                     chunk_valid)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, t, h * hd)
     x = x + mm(attn, "o_w", x.dtype)
 
@@ -277,16 +277,18 @@ def _block_cached_body(cfg: LlamaConfig, x, get, mm, ck, cv, pos,
     return x, ck, cv
 
 
-def _block_cached(cfg: LlamaConfig, x, layer, ck, cv, pos, mlp_fn=None):
+def _block_cached(cfg: LlamaConfig, x, layer, ck, cv, pos, mlp_fn=None,
+                  block_tables=None, chunk_valid=None):
     from .gpt2 import layer_accessors
 
     return _block_cached_body(
         cfg, x, *layer_accessors(layer), ck, cv, pos,
-        mlp=None if mlp_fn is None else (lambda y: mlp_fn(layer, y)))
+        mlp=None if mlp_fn is None else (lambda y: mlp_fn(layer, y)),
+        block_tables=block_tables, chunk_valid=chunk_valid)
 
 
 def forward_cached(cfg: LlamaConfig, params, input_ids, cache, pos,
-                   lengths=None, mlp_fn=None):
+                   lengths=None, block_tables=None, mlp_fn=None):
     """Incremental forward: logits for the LAST input position + updated
     cache.  ``mlp_fn`` threads through to :func:`_block_cached` (mixtral
     delegates here with its MoE FFN).  Quantized serving (no mlp_fn) takes
@@ -296,19 +298,27 @@ def forward_cached(cfg: LlamaConfig, params, input_ids, cache, pos,
     continuous-batching slots — T == 1 decodes each row at its own position
     ``lengths[b]`` (rope offset, cache write, attention prefix); T > 1 is
     ragged right-padded prefill, gathering each row's logits at
-    ``lengths[b] - 1`` (see gpt2.forward_cached for the full contract)."""
+    ``lengths[b] - 1`` (see gpt2.forward_cached for the full contract).
+    ``block_tables`` (optional int32 [B, NBPER]) switches to the block-paged
+    cache layout; with T > 1 ``pos`` may be int32 [B] per-row chunk bases
+    (the rope offsets follow each row's base — chunked prefill)."""
     from .gpt2 import _dequant_resident, _gather_last, decode_over_layers
 
     params = _dequant_resident(params)
     pos = jnp.asarray(pos, jnp.int32)
-    per_row = lengths is not None and input_ids.shape[1] == 1
+    t = input_ids.shape[1]
+    per_row = lengths is not None and t == 1
     step_pos = jnp.asarray(lengths, jnp.int32) if per_row else pos
+    chunk_valid = jnp.asarray(lengths, jnp.int32) \
+        if (block_tables is not None and lengths is not None and t > 1) \
+        else None
     x = params["embed"][input_ids].astype(params["embed"].dtype)
 
     if mlp_fn is None:
         x, ks, vs = decode_over_layers(
             lambda x, get, mm, ck, cv: _block_cached_body(
-                cfg, x, get, mm, ck, cv, step_pos),
+                cfg, x, get, mm, ck, cv, step_pos,
+                block_tables=block_tables, chunk_valid=chunk_valid),
             x, params["blocks"], cache["k"], cache["v"], cfg.num_layers,
             probe="q_w")
     else:
@@ -316,7 +326,9 @@ def forward_cached(cfg: LlamaConfig, params, input_ids, cache, pos,
         def body(x, xs):
             layer, ck, cv = xs
             x, ck, cv = _block_cached(cfg, x, layer, ck, cv, step_pos,
-                                      mlp_fn=mlp_fn)
+                                      mlp_fn=mlp_fn,
+                                      block_tables=block_tables,
+                                      chunk_valid=chunk_valid)
             return x, (ck, cv)
 
         x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"],
@@ -406,9 +418,12 @@ def build(cfg: Optional[LlamaConfig] = None, **overrides) -> ModelSpec:
         decode_hooks={
             "init_cache": lambda b, s, dtype=jnp.bfloat16: init_cache(
                 cfg, b, s, dtype),
-            "forward_cached": lambda params, ids, cache, pos, lengths=None:
-                forward_cached(cfg, params, ids, cache, pos, lengths),
+            "forward_cached": lambda params, ids, cache, pos, lengths=None,
+                block_tables=None:
+                forward_cached(cfg, params, ids, cache, pos, lengths,
+                               block_tables),
             "supports_lengths": True,
+            "supports_paged": True,
         },
         quant_aware=True,  # per-layer point-of-use dequant / w8a8 records
         name=f"llama-{cfg.num_layers}l-{cfg.hidden_size}d")

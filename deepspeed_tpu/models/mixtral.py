@@ -158,14 +158,16 @@ def _moe_ffn(cfg: MixtralConfig, layer, y, train: bool):
 
 
 def forward_cached(cfg: MixtralConfig, params, input_ids, cache, pos,
-                   lengths=None):
+                   lengths=None, block_tables=None):
     """Incremental MoE forward (reference ``moe_inference.py``: expert
     routing runs per decode token too) — llama's cached path with the MoE
     FFN hooked in.  ``lengths`` (per-sequence positions for
-    continuous-batching slots) passes straight through: expert routing is
-    position-independent."""
+    continuous-batching slots) and ``block_tables`` (block-paged cache
+    layout) pass straight through: expert routing is position- and
+    layout-independent."""
     return L.forward_cached(
         cfg, params, input_ids, cache, pos, lengths=lengths,
+        block_tables=block_tables,
         mlp_fn=lambda lyr, y: _moe_ffn(cfg, lyr, y, train=False)[0])
 
 
@@ -197,10 +199,13 @@ def build(cfg: Optional[MixtralConfig] = None, **overrides) -> ModelSpec:
     decode_hooks = {
         "init_cache": lambda b, s, dtype=jnp.bfloat16: L.init_cache(
             cfg, b, s, dtype),
-        "forward_cached": lambda params, ids, cache, pos, lengths=None:
-            forward_cached(cfg, params, ids, cache, pos, lengths),
+        "forward_cached": lambda params, ids, cache, pos, lengths=None,
+            block_tables=None:
+            forward_cached(cfg, params, ids, cache, pos, lengths,
+                           block_tables),
         "max_seq_len": cfg.max_seq_len,
         "supports_lengths": True,
+        "supports_paged": True,
     }
 
     return ModelSpec(

@@ -234,8 +234,9 @@ def _block(cfg: OPTConfig, x, layer):
 
 def _embed(cfg: OPTConfig, params, input_ids, pos0: int = 0):
     """Token + learned position embeddings.  ``pos0``: shared base position
-    (scalar), or int32 [B] per-sequence positions (T must be 1 — each
-    continuous-batching slot decodes at its own offset)."""
+    (scalar), or int32 [B] per-sequence offsets — T == 1 for
+    continuous-batching decode, T > 1 for paged chunked prefill (each
+    row's window starts at its own base)."""
     s = input_ids.shape[1]
     x = params["embed_tokens"][input_ids]
     if cfg.has_proj:
@@ -245,11 +246,15 @@ def _embed(cfg: OPTConfig, params, input_ids, pos0: int = 0):
         pos = jax.lax.dynamic_slice(
             params["embed_positions"], (pos0 + _POS_OFFSET, 0),
             (s, cfg.hidden_size))
-    else:
-        assert s == 1, "per-sequence positions require T == 1"
+    elif s == 1:
         idx = jnp.clip(pos0 + _POS_OFFSET, 0,
                        params["embed_positions"].shape[0] - 1)
         pos = params["embed_positions"][idx][:, None]      # [B, 1, D]
+    else:
+        idx = jnp.clip(pos0[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+                       + _POS_OFFSET, 0,
+                       params["embed_positions"].shape[0] - 1)
+        pos = params["embed_positions"][idx]               # [B, S, D]
     return (x + pos).astype(params["embed_tokens"].dtype)
 
 
@@ -286,13 +291,14 @@ def init_cache(cfg: OPTConfig, batch_size: int, max_len: int,
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def _block_cached_body(cfg: OPTConfig, x, get, mm, ck, cv, pos):
+def _block_cached_body(cfg: OPTConfig, x, get, mm, ck, cv, pos,
+                       block_tables=None, chunk_valid=None):
     """One decoder layer over a KV cache, parameterized by how per-layer
     weights are fetched: ``get(name)`` returns a small leaf, ``mm(y, name,
     dtype)`` runs ``y @ weight`` — the scan path indexes a pre-sliced layer
-    dict, the quantized indexed path selects the layer in-kernel."""
-    from ..ops.decode_attention import decode_attention
-
+    dict, the quantized indexed path selects the layer in-kernel.
+    ``block_tables``/``chunk_valid`` switch ck/cv to the paged-pool layout
+    (contract in gpt2._cached_attention)."""
     b, t, d = x.shape
     h, hd = cfg.num_heads, cfg.head_dim
 
@@ -304,10 +310,10 @@ def _block_cached_body(cfg: OPTConfig, x, get, mm, ck, cv, pos):
     q = q.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
     k = k.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
     v = v.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
-    from .gpt2 import cache_update
+    from .gpt2 import _cached_attention
 
-    ck, cv = cache_update(ck, cv, k, v, pos)
-    attn = decode_attention(q, ck, cv, pos)
+    attn, ck, cv = _cached_attention(q, k, v, ck, cv, pos, block_tables,
+                                     chunk_valid)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, t, d)
     x = res + mm(attn, "o_w", x.dtype) + get("o_b").astype(x.dtype)
     if not cfg.do_layer_norm_before:
@@ -330,7 +336,7 @@ def _block_cached(cfg: OPTConfig, x, layer, ck, cv, pos):
 
 
 def forward_cached(cfg: OPTConfig, params, input_ids, cache, pos,
-                   lengths=None):
+                   lengths=None, block_tables=None):
     """Incremental forward: logits for the LAST position + updated cache.
     Quantized serving runs the layer-indexed loop (stacked s8 kernel,
     gpt2.decode_over_layers) instead of the scan.
@@ -338,18 +344,26 @@ def forward_cached(cfg: OPTConfig, params, input_ids, cache, pos,
     ``lengths`` (optional int32 [B]): per-sequence valid lengths for
     continuous-batching slots — T == 1 decodes each row at position
     ``lengths[b]``; T > 1 is ragged right-padded prefill with per-row logit
-    gather at ``lengths[b] - 1`` (contract in gpt2.forward_cached)."""
+    gather at ``lengths[b] - 1`` (contract in gpt2.forward_cached).
+    ``block_tables`` (optional int32 [B, NBPER]) switches to the block-paged
+    cache layout; with T > 1 ``pos`` may be int32 [B] per-row chunk bases
+    (learned position embeddings follow each row's base)."""
     from .gpt2 import _dequant_resident, _gather_last, decode_over_layers
 
     params = _dequant_resident(params)
     pos = jnp.asarray(pos, jnp.int32)
-    per_row = lengths is not None and input_ids.shape[1] == 1
+    t = input_ids.shape[1]
+    per_row = lengths is not None and t == 1
     step_pos = jnp.asarray(lengths, jnp.int32) if per_row else pos
+    chunk_valid = jnp.asarray(lengths, jnp.int32) \
+        if (block_tables is not None and lengths is not None and t > 1) \
+        else None
     x = _embed(cfg, params, input_ids, pos0=step_pos)
 
     x, ks, vs = decode_over_layers(
-        lambda x, get, mm, ck, cv: _block_cached_body(cfg, x, get, mm, ck,
-                                                      cv, step_pos),
+        lambda x, get, mm, ck, cv: _block_cached_body(
+            cfg, x, get, mm, ck, cv, step_pos, block_tables=block_tables,
+            chunk_valid=chunk_valid),
         x, params["blocks"], cache["k"], cache["v"], cfg.num_layers)
     logits = _head(cfg, params, _gather_last(
         x, lengths if not per_row else None))
@@ -503,10 +517,13 @@ def build(cfg: Optional[OPTConfig] = None, **overrides) -> ModelSpec:
     decode_hooks = {
         "init_cache": lambda b, s, dtype=jnp.bfloat16: init_cache(cfg, b, s,
                                                                   dtype),
-        "forward_cached": lambda params, ids, cache, pos, lengths=None:
-            forward_cached(cfg, params, ids, cache, pos, lengths),
+        "forward_cached": lambda params, ids, cache, pos, lengths=None,
+            block_tables=None:
+            forward_cached(cfg, params, ids, cache, pos, lengths,
+                           block_tables),
         "max_seq_len": cfg.max_seq_len,
         "supports_lengths": True,
+        "supports_paged": True,
     }
 
     def _stream_embed(params, ids, pos):

@@ -186,3 +186,107 @@ def decode_attention(q, k_cache, v_cache, q_pos, *,
                                        sm_scale=sm_scale)
     return decode_attention_reference(q, k_cache, v_cache, q_pos,
                                       sm_scale=sm_scale)
+
+
+# ---------------------------------------------------------------------------
+# Block-paged attention (vLLM PagedAttention layout; ops/paged_kv.py holds
+# the layout contract).  KV lives in a shared pool [NB, HKV, bs, D]; each
+# row reaches its tokens through an int32 [B, NBPER] block table.
+# ---------------------------------------------------------------------------
+def paged_decode_attention_reference(q, k_pool, v_pool, block_tables, q_pos,
+                                     *, sm_scale: Optional[float] = None):
+    """Gather-based paged attention (pure XLA): materialize each row's
+    logical cache view through its block table, then run the contiguous
+    reference path.  Serves prefill (T > 1) and the CPU decode path.
+
+    q:            [B, H, T, D]
+    k/v_pool:     [NB, HKV, block_size, D] shared pool
+    block_tables: int32 [B, NBPER]
+    q_pos:        scalar or int32 [B] — global position of q[:, :, 0]
+    """
+    from .paged_kv import paged_gather
+
+    k = paged_gather(k_pool, block_tables)
+    v = paged_gather(v_pool, block_tables)
+    return decode_attention_reference(q, k, v, q_pos, sm_scale=sm_scale)
+
+
+def _paged_decode_kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, sm_scale: float,
+                         block_size: int):
+    """Grid: (B, HKV, NBPER), logical blocks innermost so scratch carries.
+
+    ``pos_ref`` int32 [B] and ``bt_ref`` int32 [B, NBPER] arrive via scalar
+    prefetch — the k/v BlockSpec index maps read ``bt_ref[b, i]`` so each
+    grid step DMAs the row's *physical* block straight from the pool.  The
+    paging indirection lives entirely in those index maps: the body is the
+    contiguous kernel's online softmax unchanged (a logical block at grid
+    step ``kb`` holds positions ``kb*block_size ..``, exactly like a
+    contiguous chunk), including the ``pl.when`` skip of blocks past the
+    row's valid prefix.
+    """
+    del bt_ref                       # consumed by the BlockSpec index maps
+    _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+                   acc_scr, sm_scale=sm_scale, block_k=block_size)
+
+
+def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables, q_pos, *,
+                                  sm_scale: Optional[float] = None,
+                                  interpret: Optional[bool] = None):
+    """Single-token paged decode: q [B, H, 1, D] against the block pool,
+    walking each row's block table in-kernel via scalar prefetch."""
+    b, h, t, d = q.shape
+    assert t == 1, "pallas paged decode is single-token; use the XLA path"
+    nb, hkv, bs, _ = k_pool.shape
+    rep = h // hkv
+    nbper = block_tables.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = _use_interpret()
+
+    qg = q[:, :, 0, :].reshape(b, hkv, rep, d)        # [B, HKV, rep, D]
+    pos = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1), (b,))
+    bt = jnp.maximum(jnp.asarray(block_tables, jnp.int32), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                        # pos, block table
+        grid=(b, hkv, nbper),
+        in_specs=[
+            pl.BlockSpec((1, 1, rep, d),
+                         lambda i, j, k, pos_ref, bt_ref: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, bs, d),
+                         lambda i, j, k, pos_ref, bt_ref:
+                         (bt_ref[i, k], j, 0, 0)),
+            pl.BlockSpec((1, 1, bs, d),
+                         lambda i, j, k, pos_ref, bt_ref:
+                         (bt_ref[i, k], j, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, rep, d),
+                               lambda i, j, k, pos_ref, bt_ref: (i, j, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rep, LANES), jnp.float32),    # m
+            pltpu.VMEM((rep, LANES), jnp.float32),    # l
+            pltpu.VMEM((rep, d), jnp.float32),        # acc
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, sm_scale=scale,
+                          block_size=bs),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rep, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(pos, bt, qg, k_pool, v_pool)
+    return out.reshape(b, h, 1, d)
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, q_pos, *,
+                           sm_scale: Optional[float] = None):
+    """Dispatch: block-table-walking Pallas kernel for single-token decode
+    on TPU; gather + XLA reference otherwise (prefill chunks, CPU-sim)."""
+    if q.shape[2] == 1 and jax.default_backend() == "tpu":
+        return paged_decode_attention_pallas(q, k_pool, v_pool, block_tables,
+                                             q_pos, sm_scale=sm_scale)
+    return paged_decode_attention_reference(q, k_pool, v_pool, block_tables,
+                                            q_pos, sm_scale=sm_scale)

@@ -1,0 +1,71 @@
+"""Device-side ops for the block-paged KV cache (vLLM PagedAttention
+layout, JAX/TPU edition).
+
+Layout contract (per layer slice of the stacked pool):
+
+ - pool leaf: ``[NB, HKV, block_size, hd]`` — the batch dim of the
+   contiguous layout becomes the physical-block dim and the length dim
+   becomes the in-block offset, so the models' ``init_cache(num_blocks,
+   block_size, dtype)`` hook builds a pool unchanged.
+ - block table: ``int32 [B, NBPER]`` — each row maps a sequence's logical
+   block index (``position // block_size``) to a physical block.  Entry 0
+   is the reserved scratch block (``inference/paged.py``), which doubles as
+   the "unset" marker: reads of unset blocks are masked by position, writes
+   of invalid tokens are routed there explicitly.
+
+Everything here is pure XLA (scatter / gather), shared by prefill and the
+CPU/correctness decode path; the TPU decode kernel that walks the block
+table in-kernel lives in ``ops/decode_attention.py``
+(``paged_decode_attention_pallas``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def paged_cache_update(ck, cv, k, v, pos, block_tables, valid=None):
+    """Scatter a window of new keys/values into the paged pool.
+
+    ck/cv:         [NB, HKV, block_size, hd] pool (one layer)
+    k/v:           [B, HKV, T, hd] — T new tokens per row
+    pos:           int32 scalar or [B] — global position of ``k[:, :, 0]``
+                   per row (T == 1 decode: each row's own position; T > 1
+                   chunked prefill: each row's chunk base)
+    block_tables:  int32 [B, NBPER]
+    valid:         optional int32 [B] — tokens of the T-window that are
+                   real (default all T).  Invalid tokens, and positions
+                   past the table's reach, write to scratch block 0.
+    """
+    b, hkv, t, hd = k.shape
+    bs = ck.shape[2]
+    nbper = block_tables.shape[1]
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (b,))
+    p = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]      # [B, T]
+    ok = (jnp.arange(t, dtype=jnp.int32)[None, :] <
+          jnp.asarray(valid, jnp.int32)[:, None]) if valid is not None \
+        else jnp.ones((b, t), bool)
+    li = p // bs
+    ok = ok & (li >= 0) & (li < nbper)
+    phys = jnp.take_along_axis(block_tables.astype(jnp.int32),
+                               jnp.clip(li, 0, nbper - 1), axis=1)
+    phys = jnp.where(ok, jnp.maximum(phys, 0), 0)                   # [B, T]
+    off = jnp.where(ok, p % bs, 0)                                  # [B, T]
+    # advanced indices at dims 0 and 2 around the ':' slice put the [B, T]
+    # index shape in front: value layout is [B, T, HKV, hd].  Duplicate
+    # targets only ever occur on the scratch block (any write order is fine
+    # — scratch is never read unmasked).
+    ck = ck.at[phys, :, off].set(k.transpose(0, 2, 1, 3).astype(ck.dtype))
+    cv = cv.at[phys, :, off].set(v.transpose(0, 2, 1, 3).astype(cv.dtype))
+    return ck, cv
+
+
+def paged_gather(pool_leaf, block_tables):
+    """Materialize each row's logical cache view from the pool:
+    ``[NB, HKV, bs, hd]`` through ``int32 [B, NBPER]`` tables ->
+    ``[B, HKV, NBPER*bs, hd]``.  Unset (scratch) entries gather garbage
+    that sits past every row's valid length — callers mask by position."""
+    nb, hkv, bs, hd = pool_leaf.shape
+    b, nbper = block_tables.shape
+    g = pool_leaf[jnp.maximum(block_tables, 0)]     # [B, NBPER, HKV, bs, hd]
+    return g.transpose(0, 2, 1, 3, 4).reshape(b, hkv, nbper * bs, hd)

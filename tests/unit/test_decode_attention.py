@@ -10,8 +10,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from deepspeed_tpu.ops.decode_attention import (decode_attention_pallas,
-                                                decode_attention_reference)
+from deepspeed_tpu.ops.decode_attention import (
+    decode_attention_pallas, decode_attention_reference,
+    paged_decode_attention_pallas, paged_decode_attention_reference)
 
 pytestmark = pytest.mark.slow  # Pallas interpret mode: minutes on CPU
 
@@ -233,3 +234,71 @@ def test_generate_kv_cache_matches_recompute():
         params=engine.params)
     out_full = engine2.generate(ids, max_new_tokens=8)
     np.testing.assert_array_equal(out_cached, out_full)
+
+
+# ---------------------------------------------------------- paged decode kernel
+def _paged_from_contiguous(kc, vc, nb, bs, rng):
+    """Scatter a contiguous [B, HKV, S, D] cache into a pool of ``nb``
+    blocks via random (non-overlapping) block tables."""
+    b, hkv, s, d = kc.shape
+    nbper = s // bs
+    bt = rng.permutation(np.arange(1, nb))[:b * nbper] \
+        .reshape(b, nbper).astype(np.int32)
+    kp = np.zeros((nb, hkv, bs, d), kc.dtype)
+    vp = np.zeros((nb, hkv, bs, d), vc.dtype)
+    for row in range(b):
+        for i in range(nbper):
+            kp[bt[row, i]] = kc[row, :, i * bs:(i + 1) * bs]
+            vp[bt[row, i]] = vc[row, :, i * bs:(i + 1) * bs]
+    return kp, vp, bt
+
+
+@pytest.mark.parametrize("h,hkv", [(4, 4), (8, 2)])
+def test_paged_pallas_kernel_matches_reference(h, hkv):
+    """The block-table-walking kernel (scalar prefetch) == the gather-based
+    reference == the contiguous kernel, with per-row ragged positions
+    (including a zero-length slot)."""
+    rng = np.random.default_rng(10)
+    b, s, d, bs = 4, 256, 64, 64
+    kc = rng.standard_normal((b, hkv, s, d)).astype(np.float32)
+    vc = rng.standard_normal((b, hkv, s, d)).astype(np.float32)
+    kp, vp, bt = _paged_from_contiguous(kc, vc, 2 * b * (s // bs), bs, rng)
+    q = jnp.asarray(rng.standard_normal((b, h, 1, d)), jnp.float32)
+    lengths = jnp.asarray([0, 17, 200, 255], jnp.int32)
+    want = decode_attention_reference(q, jnp.asarray(kc), jnp.asarray(vc),
+                                      lengths)
+    ref = paged_decode_attention_reference(
+        q, jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(bt), lengths)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+    got = paged_decode_attention_pallas(
+        q, jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(bt), lengths,
+        interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_pallas_kernel_under_jit_traced_tables():
+    """One compiled program serves every (lengths, block_table) pair — the
+    serving loop's decode contract."""
+    rng = np.random.default_rng(11)
+    b, h, s, d, bs = 2, 4, 128, 32, 32
+    kc = rng.standard_normal((b, h, s, d)).astype(np.float32)
+    vc = rng.standard_normal((b, h, s, d)).astype(np.float32)
+    q = jnp.asarray(rng.standard_normal((b, h, 1, d)), jnp.float32)
+
+    @jax.jit
+    def step(q, kp, vp, bt, lengths):
+        return paged_decode_attention_pallas(q, kp, vp, bt, lengths,
+                                             interpret=True)
+
+    for seed, lens in ((0, [0, 127]), (1, [64, 5])):
+        r2 = np.random.default_rng(100 + seed)
+        kp, vp, bt = _paged_from_contiguous(kc, vc, 2 * b * (s // bs), bs, r2)
+        lengths = jnp.asarray(lens, jnp.int32)
+        got = step(q, jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(bt),
+                   lengths)
+        want = decode_attention_reference(q, jnp.asarray(kc),
+                                          jnp.asarray(vc), lengths)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)

@@ -219,6 +219,24 @@ def test_default_buckets_ladder():
     assert default_buckets(32) == (32,)
 
 
+def test_default_buckets_edge_cases():
+    """lo above max_seq_len clamps to one bucket, non-power-of-two tails
+    appear exactly once, and degenerate inputs raise instead of looping."""
+    assert default_buckets(16) == (16,)                 # lo 32 > max 16
+    assert default_buckets(64, lo=100) == (64,)         # explicit lo > max
+    assert default_buckets(1) == (1,)
+    assert default_buckets(48, lo=48) == (48,)          # lo == max, non-pow2
+    assert default_buckets(96, lo=3) == (3, 6, 12, 24, 48, 96)
+    for ladder in (default_buckets(96), default_buckets(640, lo=10),
+                   default_buckets(100, lo=25)):
+        assert len(set(ladder)) == len(ladder), ladder  # no duplicate tail
+        assert list(ladder) == sorted(ladder)
+    with pytest.raises(ValueError, match="lo"):
+        default_buckets(64, lo=0)                       # would loop forever
+    with pytest.raises(ValueError, match="max_seq_len"):
+        default_buckets(0)
+
+
 # ------------------------------------------------- generate early-exit satellite
 def test_generate_early_exit_matches_full_loop():
     """The eos-keyed while_loop generate == fori_loop generate + back-fill,

@@ -24,12 +24,38 @@ def test_serving_bench_speedup_parity_and_compiles():
     res = serving_bench.run_bench(requests=32, slots=8, layers=2, hidden=64,
                                   heads=4, vocab=512, seed=0)
     assert res["token_parity"], res["mismatched_uids"]
-    # O(#buckets): at most one prefill program per ladder rung + one decode
+    # chunked prefill: exactly 1 prefill + 1 decode program for the trace
+    assert res["serving"]["compiled_programs"] == 2
+    # ... no worse than the bucketed fallback's O(#buckets)+1
     assert res["serving"]["compiled_programs"] <= \
-        len(serving_bench.PROMPT_GRID) + 1
+        res["serving_bucketed"]["compiled_programs"]
     # the sequential path compiled one program per request SHAPE instead
     # (LRU-capped at 32 entries)
     assert res["sequential"]["compiled_programs"] > \
         res["serving"]["compiled_programs"]
     # acceptance: >= 1.5x aggregate tokens/sec on the mixed-length trace
     assert res["speedup"] >= 1.5, res
+
+
+def test_serving_bench_prefix_heavy_trace():
+    """The PagedAttention/RadixAttention acceptance lane: a 64-request
+    trace sharing a 256-token system prompt.  Paged + chunked prefill +
+    prefix cache must beat the PR 1-style bucketed slot-pool path >= 1.5x
+    in the compile-warm steady state, with exact greedy parity and no more
+    compiled programs than the bucket ladder."""
+    import serving_bench
+
+    res = serving_bench.run_bench(requests=64, slots=8, layers=2, hidden=128,
+                                  heads=4, vocab=2048, seed=0,
+                                  prefix_len=256, prefill_chunk=64)
+    assert res["token_parity"], res["mismatched_uids"]
+    assert res["serving"]["compiled_programs"] == 2
+    assert res["serving"]["compiled_programs"] <= \
+        res["serving_bucketed"]["compiled_programs"]
+    stats = res["serving"]["stats"]
+    # the shared prefix is reused: most prompt tokens never recompute
+    assert stats["prefix_cache_hit_rate"] >= 0.5, stats
+    # steady state (compile-warm on both sides): the paged/prefix win
+    assert res["speedup_vs_bucketed_warm"] >= 1.5, res
+    # compiles included, the paged path must still not lose
+    assert res["speedup_vs_bucketed"] >= 1.0, res
