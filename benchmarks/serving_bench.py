@@ -14,6 +14,12 @@ tokens/sec plus compile counts and the paged engine's ``stats()``:
    chunked win isolated from the continuous-batching win.
  - **sequential**: one-shot ``InferenceEngine.generate``, one request at a
    time, one compiled program per exact request shape.
+ - **serving_speculative** (``--speculative K``): the chunked engine with
+   speculative decoding — the n-gram prompt-lookup proposer drafts K
+   tokens per slot per iteration and one K+1-token paged verify pass
+   scores them (<= 3 compiled programs; 2 in n-gram mode).  Outputs stay
+   token-exact with plain greedy decode; ``speedup_spec_vs_chunked`` is
+   the draft–verify win over the single-token decode loop.
 
 Methodology (PROFILE.md "continuous-batching serving" entry): the default
 trace draws ARBITRARY prompt lengths in [32, 512] and completion budgets in
@@ -29,10 +35,14 @@ shape grid that fits the sequential LRU and reports a compile-warm
 sequential pass too.  Greedy decoding; the bench asserts all serving
 outputs are token-identical to sequential before reporting numbers.
 
+``--decode-heavy`` draws short prompts and long completion budgets — the
+decode-bound traffic speculative decoding targets (BENCH_r05 lane:
+``--decode-heavy --speculative 4``).
+
 Usage:
   python benchmarks/serving_bench.py [--requests 64] [--slots 8]
-      [--prefix-len 256] [--grid] [--layers 2] [--hidden 128] [--seed 0]
-      [--json out.json]
+      [--prefix-len 256] [--grid] [--decode-heavy] [--speculative K]
+      [--layers 2] [--hidden 128] [--seed 0] [--json out.json]
 """
 
 from __future__ import annotations
@@ -49,6 +59,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 PROMPT_RANGE = (32, 512)
 NEW_TOKEN_RANGE = (16, 64)
+#: --decode-heavy: short prompts, long completions — decode steps dominate
+#: wall-clock (the BENCH_r04 147-decode-vs-55-prefill regime, amplified)
+DECODE_HEAVY_PROMPT_RANGE = (16, 48)
+DECODE_HEAVY_NEW_RANGE = (96, 160)
 #: --prefix-len mode: unique tail length / completion budget ranges —
 #: long shared context, short unique tail and output (the classification /
 #: extraction-style traffic prefix caching exists for)
@@ -61,14 +75,20 @@ NEW_TOKEN_GRID = (16, 32, 64)
 
 
 def build_trace(n_requests: int, vocab: int, seed: int, grid: bool,
-                prefix_len: int = 0):
+                prefix_len: int = 0, decode_heavy: bool = False):
     from deepspeed_tpu.inference.serving import Request
 
     rng = np.random.default_rng(seed)
     prefix = rng.integers(0, vocab, prefix_len) if prefix_len else None
     reqs = []
     for i in range(n_requests):
-        if prefix_len:
+        if decode_heavy:
+            prompt = rng.integers(
+                0, vocab, int(rng.integers(DECODE_HEAVY_PROMPT_RANGE[0],
+                                           DECODE_HEAVY_PROMPT_RANGE[1] + 1)))
+            mnew = int(rng.integers(DECODE_HEAVY_NEW_RANGE[0],
+                                    DECODE_HEAVY_NEW_RANGE[1] + 1))
+        elif prefix_len:
             tail = rng.integers(0, vocab,
                                 int(rng.integers(TAIL_RANGE[0],
                                                  TAIL_RANGE[1] + 1)))
@@ -101,12 +121,15 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
               layers: int = 2, hidden: int = 128, heads: int = 4,
               vocab: int = 2048, seed: int = 0, dtype: str = "fp32",
               grid: bool = False, prefix_len: int = 0,
-              block_size: int = 32, prefill_chunk: int = 128):
+              block_size: int = 32, prefill_chunk: int = 128,
+              speculative: int = 0, decode_heavy: bool = False):
     import deepspeed_tpu
     from deepspeed_tpu.inference.serving import ServingEngine
     from deepspeed_tpu.models import gpt2
 
-    if prefix_len:
+    if decode_heavy:
+        max_total = max(DECODE_HEAVY_PROMPT_RANGE) + max(DECODE_HEAVY_NEW_RANGE)
+    elif prefix_len:
         max_total = prefix_len + max(TAIL_RANGE) + max(PREFIX_NEW_RANGE)
     else:
         max_total = max(PROMPT_GRID) + max(NEW_TOKEN_GRID)
@@ -116,7 +139,7 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
     engine = deepspeed_tpu.init_inference(
         gpt2.build(cfg), config={"dtype": dtype,
                                  "tensor_parallel": {"tp_size": 1}})
-    reqs = build_trace(requests, vocab, seed, grid, prefix_len)
+    reqs = build_trace(requests, vocab, seed, grid, prefix_len, decode_heavy)
     gen_tokens = sum(r.max_new_tokens for r in reqs)
 
     # --- sequential pass 1: per-shape compiles included — this IS the
@@ -160,6 +183,34 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
     srv_outs2 = srv.serve(reqs)
     srv_warm = time.perf_counter() - t0
 
+    # --- speculative draft–verify on the same chunked engine config:
+    # n-gram proposer drafts K per slot, one K+1 verify pass scores them
+    spec_res = None
+    if speculative:
+        srv_s = ServingEngine(engine, slots=slots, max_seq_len=max_total,
+                              prefill_batch=prefill_batch,
+                              block_size=block_size,
+                              prefill_chunk=prefill_chunk,
+                              spec_tokens=speculative)
+        t0 = time.perf_counter()
+        spec_outs = srv_s.serve(reqs)
+        spec_cold = time.perf_counter() - t0
+        spec_stats_cold = srv_s.stats()
+        t0 = time.perf_counter()
+        spec_outs2 = srv_s.serve(reqs)
+        spec_warm = time.perf_counter() - t0
+        spec_res = {
+            "tok_s": gen_tokens / spec_cold,
+            "wall_s": spec_cold,
+            "tok_s_warm": gen_tokens / spec_warm,
+            "wall_warm_s": spec_warm,
+            "compiled_programs": srv_s.compile_count,
+            "spec_tokens": speculative,
+            "acceptance_rate": spec_stats_cold["acceptance_rate"],
+            "stats": spec_stats_cold,
+            "stats_after_warm_pass": srv_s.stats(),
+        }
+
     mismatches = [r.uid for r in reqs
                   if not (np.array_equal(seq_outs[r.uid], srv_outs[r.uid])
                           and np.array_equal(seq_outs[r.uid],
@@ -167,9 +218,16 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
                           and np.array_equal(seq_outs[r.uid],
                                              bkt_outs[r.uid])
                           and np.array_equal(seq_outs[r.uid],
-                                             bkt_outs2[r.uid]))]
+                                             bkt_outs2[r.uid])
+                          and (speculative == 0 or
+                               (np.array_equal(seq_outs[r.uid],
+                                               spec_outs[r.uid])
+                                and np.array_equal(seq_outs[r.uid],
+                                                   spec_outs2[r.uid]))))]
     result = {
-        "trace": (f"shared {prefix_len}-token prefix, tails {TAIL_RANGE}, "
+        "trace": (f"decode-heavy prompts {DECODE_HEAVY_PROMPT_RANGE}, "
+                  f"new {DECODE_HEAVY_NEW_RANGE}") if decode_heavy else
+                 (f"shared {prefix_len}-token prefix, tails {TAIL_RANGE}, "
                   f"new {PREFIX_NEW_RANGE}") if prefix_len else
                  ("shape-grid" if grid else
                   f"arbitrary prompts {PROMPT_RANGE}, new {NEW_TOKEN_RANGE}"),
@@ -210,6 +268,12 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
         # pool: compiles included, and the compile-warm steady state
         "speedup_vs_bucketed": bkt_cold / srv_cold,
         "speedup_vs_bucketed_warm": bkt_warm / srv_warm,
+        "serving_speculative": spec_res,
+        # the draft–verify win over single-token decode, same engine config
+        "speedup_spec_vs_chunked": (srv_cold / spec_res["wall_s"])
+        if spec_res else None,
+        "speedup_spec_vs_chunked_warm": (srv_warm / spec_res["wall_warm_s"])
+        if spec_res else None,
         "token_parity": not mismatches,
         "mismatched_uids": mismatches,
         "model": f"gpt2-{layers}l-{hidden}d-{vocab}v ({dtype})",
@@ -237,6 +301,12 @@ def main():
     ap.add_argument("--grid", action="store_true",
                     help="snap the trace to a small shape grid and report a "
                          "compile-warm second pass for both paths")
+    ap.add_argument("--decode-heavy", action="store_true",
+                    help="short prompts, long completions — the decode-bound "
+                         "trace speculative decoding targets")
+    ap.add_argument("--speculative", type=int, default=0, metavar="K",
+                    help="add a speculative lane: n-gram proposer drafting "
+                         "K tokens per slot per iteration (0 = off)")
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
 
@@ -245,7 +315,9 @@ def main():
                     hidden=args.hidden, heads=args.heads, vocab=args.vocab,
                     seed=args.seed, dtype=args.dtype, grid=args.grid,
                     prefix_len=args.prefix_len, block_size=args.block_size,
-                    prefill_chunk=args.prefill_chunk)
+                    prefill_chunk=args.prefill_chunk,
+                    speculative=args.speculative,
+                    decode_heavy=args.decode_heavy)
     print(json.dumps(res, indent=2))
     if args.json:
         with open(args.json, "w") as f:

@@ -130,7 +130,8 @@ def init_inference(model=None, config=None, params=None, **kwargs):
 def init_serving(model=None, config=None, params=None, *, slots=8,
                  max_seq_len=None, prompt_buckets=None, prefill_batch=4,
                  block_size=32, num_blocks=None, chunked_prefill=None,
-                 prefill_chunk=128, prefix_caching=True, **kwargs):
+                 prefill_chunk=128, prefix_caching=True, spec_tokens=0,
+                 draft=None, ngram_max=3, ngram_min=1, **kwargs):
     """Continuous-batching serving entry: an ``init_inference`` engine
     wrapped in the block-paged scheduler (``inference/serving.py``).
     Mixed-length request traces run at iteration-level granularity over a
@@ -139,7 +140,15 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
     with zero recompute, and prompts prefill in fixed chunks (one compiled
     prefill program) — instead of ``generate``'s run-to-longest static
     batches.  Passing ``prompt_buckets`` selects the bucket-ladder prefill
-    fallback (no prefix reuse)."""
+    fallback (no prefix reuse).
+
+    ``spec_tokens=K`` turns on speculative decoding (chunked mode only):
+    each decode iteration drafts K tokens per slot — with a small
+    same-tokenizer ``draft`` model (ModelSpec or ``init_inference``
+    engine), or the model-free n-gram prompt-lookup proposer — and
+    verifies the K+1 window in one batched target pass, committing the
+    longest target-matching prefix.  Outputs stay token-exact with plain
+    greedy decode at any acceptance rate."""
     from .inference.serving import ServingEngine
 
     engine = init_inference(model, config, params, **kwargs)
@@ -149,4 +158,6 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
                          num_blocks=num_blocks,
                          chunked_prefill=chunked_prefill,
                          prefill_chunk=prefill_chunk,
-                         prefix_caching=prefix_caching)
+                         prefix_caching=prefix_caching,
+                         spec_tokens=spec_tokens, draft=draft,
+                         ngram_max=ngram_max, ngram_min=ngram_min)

@@ -24,6 +24,16 @@ Copy-on-write is never needed: lookups are capped below the full prompt
 only, so a sequence's next write position always lands in a privately
 owned block — shared blocks are read-only by construction.
 
+Speculative decoding adds no allocator state: the scheduler allocates a
+slot's table entries up to ``min(committed + K + 1, pos_cap)`` before each
+verify round — ``pos_cap`` (prompt + completion budget) bounds demand, and
+window positions past it scatter to the scratch block instead of
+allocating.  Rolling back rejected draft tokens therefore never increfs,
+decrefs, or frees anything; the scheduler's host-side length simply stays
+at the committed value and the same blocks are rewritten in place next
+round.  Preempting mid-window releases the slot's blocks exactly like the
+non-speculative path (refcounts make prefix-shared blocks survive).
+
 All of this is plain Python/numpy on the host; the device-side scatter /
 gather twins live in ``ops/paged_kv.py`` and ``ops/decode_attention.py``.
 """

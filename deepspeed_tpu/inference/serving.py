@@ -46,14 +46,38 @@ all slots with per-sequence positions (``lengths: int32[B]``).
 ``compile_count`` / ``compiled_programs`` remain the compile probe;
 ``stats()`` adds prefix-hit, block-occupancy, and preemption counters.
 
+**Speculative decoding** (``spec_tokens=K > 0``, chunked mode only)
+replaces the single-token decode step with a draft–verify round
+(``inference/spec.py``): a proposer guesses K tokens per decode slot — a
+small same-family draft model running K greedy steps in ONE compiled
+program over its own paged pool (sharing the target's block tables, so
+allocation/preemption/prefix-reuse bookkeeping is written once), or the
+model-free n-gram prompt-lookup fallback (zero programs) — and the target
+scores the K+1-token window in one fixed-shape paged forward through the
+chunked-prefill T>1 path (``all_positions`` verify head).  Greedy
+verification commits the longest target-matching draft prefix plus the
+target's correction token, so outputs stay token-exact with plain greedy
+decode; rejected tokens roll back for free (host lengths stay at the
+committed value — stale KV is position-masked and overwritten in place,
+blocks stay allocated, refcounts never move).  Block demand past a
+request's remaining completion budget is never allocated: those window
+positions scatter to the scratch block instead.  The whole trace compiles
+at most **3 programs** — prefill (fused target+draft in draft mode), the
+draft K-step rollout, and the verify pass.  ``stats()`` adds drafted/
+accepted counters and acceptance rate, plus per-request TTFT/TPOT
+percentiles (recorded for plain serving too).
+
 Greedy decoding only: per-request outputs are token-identical to
-sequential ``generate`` (pinned in ``tests/unit/test_serving.py`` and
-``tests/unit/test_paged_serving.py``).
+sequential ``generate`` (pinned in ``tests/unit/test_serving.py``,
+``tests/unit/test_paged_serving.py``, and
+``tests/unit/test_spec_decode.py``).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import inspect
+import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -62,9 +86,58 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..ops.paged_kv import blocks_for
 from ..utils.logging import log_dist
 from ..utils.lru import LRUCache
 from .paged import BlockAllocator, PrefixCache
+from .spec import NGramProposer, greedy_accept
+
+
+def _validate_decode_hooks(module, *, speculative: bool = False,
+                           role: str = "model"):
+    """Fail fast at engine construction, naming the exact missing hook,
+    instead of a TypeError deep inside the first prefill call.  Checks the
+    hook dict AND the ``forward_cached`` signature (a family can carry a
+    stale flag without the matching kwarg)."""
+    hooks = getattr(module, "decode_hooks", None)
+    name = getattr(module, "name", "<model>")
+    if not hooks:
+        raise ValueError(
+            f"continuous batching needs decode_hooks; {role} {name} has "
+            "none")
+    for key in ("init_cache", "forward_cached"):
+        if key not in hooks:
+            raise ValueError(
+                f"{role} {name}: decode_hooks is missing the '{key}' hook")
+    if not hooks.get("supports_lengths"):
+        raise ValueError(
+            f"{role} {name}'s decode hooks predate per-sequence lengths "
+            "(supports_lengths) — update its forward_cached to the lengths "
+            "contract first")
+    if not hooks.get("supports_paged"):
+        raise ValueError(
+            f"{role} {name}'s decode hooks predate the block-paged cache "
+            "(supports_paged) — thread block_tables through its "
+            "forward_cached first")
+    if speculative and not hooks.get("supports_verify"):
+        raise ValueError(
+            f"{role} {name}'s decode hooks lack the speculative verify "
+            "head (supports_verify) — add all-position logits "
+            "(all_positions=True) to its forward_cached first")
+    try:
+        sig = inspect.signature(hooks["forward_cached"])
+    except (TypeError, ValueError):        # builtins / C callables: trust flags
+        sig = None
+    if sig is not None:
+        need = ["lengths", "block_tables"] + \
+            (["all_positions"] if speculative else [])
+        missing = [kw for kw in need if kw not in sig.parameters]
+        if missing:
+            raise ValueError(
+                f"{role} {name}: forward_cached does not accept the "
+                f"{missing} keyword(s) its hook flags promise "
+                f"(signature: forward_cached{sig})")
+    return hooks
 
 
 def default_buckets(max_seq_len: int, lo: int = 32) -> Tuple[int, ...]:
@@ -122,6 +195,15 @@ class _SlotState:
     def gen_count(self) -> int:
         return len(self.prior) + len(self.out)
 
+    @property
+    def pos_cap(self) -> int:
+        """One past the highest cache position this request can ever
+        commit: original prompt + completion budget.  Speculative windows
+        reaching past the cap scatter to the scratch block instead of
+        allocating blocks the request can never use (rollback-aware
+        accounting — see ops/paged_kv.py)."""
+        return self.plen_eff - len(self.prior) + self.req.max_new_tokens
+
 
 class ServingEngine:
     """Iteration-level (continuous-batching) scheduler over an
@@ -152,6 +234,16 @@ class ServingEngine:
     prefill_batch:  sequences per prefill call (both modes); short groups
                     pad with scratch-routed rows.
     prefix_caching: enable the block trie (chunked mode only).
+    spec_tokens:    speculative draft length K (0 = off; chunked mode
+                    only).  Each decode iteration proposes K tokens per
+                    slot and verifies them in one K+1-token target pass.
+    draft:          draft proposer model — an ``init_inference`` engine or
+                    a bare ModelSpec (wrapped with the target's inference
+                    config) of a small same-family/same-tokenizer model.
+                    ``None`` selects the model-free n-gram prompt-lookup
+                    proposer (zero extra compiled programs).
+    ngram_max/min:  n-gram match lengths for the lookup proposer (longest
+                    match first, most recent occurrence wins).
     """
 
     def __init__(self, engine, *, slots: int = 8,
@@ -162,22 +254,20 @@ class ServingEngine:
                  num_blocks: Optional[int] = None,
                  chunked_prefill: Optional[bool] = None,
                  prefill_chunk: int = 128,
-                 prefix_caching: bool = True):
-        hooks = engine.module.decode_hooks
-        if not hooks:
+                 prefix_caching: bool = True,
+                 spec_tokens: int = 0,
+                 draft=None,
+                 ngram_max: int = 3,
+                 ngram_min: int = 1):
+        self.spec_tokens = int(spec_tokens)
+        if self.spec_tokens < 0:
+            raise ValueError(f"spec_tokens must be >= 0, got {spec_tokens}")
+        if draft is not None and not self.spec_tokens:
             raise ValueError(
-                f"continuous batching needs decode_hooks; model "
-                f"{engine.module.name} has none")
-        if not hooks.get("supports_lengths"):
-            raise ValueError(
-                f"model {engine.module.name}'s decode hooks predate "
-                "per-sequence lengths (supports_lengths) — update its "
-                "forward_cached to the lengths contract first")
-        if not hooks.get("supports_paged"):
-            raise ValueError(
-                f"model {engine.module.name}'s decode hooks predate the "
-                "block-paged cache (supports_paged) — thread block_tables "
-                "through its forward_cached first")
+                "a draft model was given but spec_tokens is 0 — pass "
+                "spec_tokens=K to enable speculative decoding")
+        hooks = _validate_decode_hooks(engine.module,
+                                       speculative=bool(self.spec_tokens))
         self.engine = engine
         self._fwd = hooks["forward_cached"]
         self._init_cache = hooks["init_cache"]
@@ -194,7 +284,8 @@ class ServingEngine:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         self.block_size = int(block_size)
         # logical per-sequence capacity, rounded up to whole blocks
-        self._cache_len = -(-self.max_seq_len // block_size) * block_size
+        self._cache_len = blocks_for(self.max_seq_len, block_size) \
+            * block_size
         self._nbper = self._cache_len // block_size      # block-table width
 
         self.chunked_prefill = (prompt_buckets is None) \
@@ -247,9 +338,46 @@ class ServingEngine:
         self._prefill_fns = LRUCache(
             capacity=max(16, len(self.prompt_buckets) + 1))
         self._decode_fn = None
+        self._verify_fn = None
+        self._draft_fn = None
         #: compile probe — one entry per traced program; chunked mode stays
-        #: at 1 prefill + 1 decode for an entire trace
+        #: at 1 prefill + 1 decode for an entire trace (speculative: 1
+        #: prefill + 1 verify [+ 1 draft rollout] — never more than 3)
         self.compiled_programs: List[Any] = []
+
+        # ----- speculative decoding state
+        self._draft = None                 # draft InferenceEngine
+        self._dcache = None                # draft paged pool (shares tables)
+        self._proposer = None              # host-side n-gram fallback
+        if self.spec_tokens:
+            if not self.chunked_prefill:
+                raise ValueError(
+                    "speculative decoding requires chunked-prefill mode — "
+                    "drop prompt_buckets / pass chunked_prefill=True")
+            if draft is not None:
+                from .engine import InferenceEngine
+
+                if not isinstance(draft, InferenceEngine):
+                    draft = InferenceEngine(draft, engine._config)
+                _validate_decode_hooks(draft.module, role="draft model")
+                tv = getattr(engine.module.model_config, "vocab_size", None)
+                dv = getattr(draft.module.model_config, "vocab_size", None)
+                if tv is not None and dv is not None and tv != dv:
+                    raise ValueError(
+                        f"draft model vocab size {dv} != target vocab size "
+                        f"{tv} — speculative decoding needs a shared "
+                        "tokenizer")
+                self._draft = draft
+                self._dcache = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(x, rep),
+                    draft.module.decode_hooks["init_cache"](
+                        num_blocks, self.block_size,
+                        draft._config.jnp_dtype))
+            else:
+                self._proposer = NGramProposer(self.spec_tokens,
+                                               max_n=ngram_max,
+                                               min_n=ngram_min)
+
         # scheduler counters (stats())
         self.iterations = 0
         self.decode_steps = 0
@@ -258,6 +386,11 @@ class ServingEngine:
         self.preempted = 0
         self.prompt_tokens = 0
         self.prefix_hit_tokens = 0
+        self.spec_rounds = 0
+        self.drafted_tokens = 0
+        self.accepted_tokens = 0
+        self._latencies: List[Dict[str, Any]] = []   # per finished request
+        self._trace_times: Dict[Any, Dict[str, Any]] = {}
         self._admit_seq = 0
         self._blocked_gate = None          # (head id, resume len, version)
         log_dist(
@@ -267,7 +400,10 @@ class ServingEngine:
             + (f"chunked prefill (chunk={self.prefill_chunk}, prefix_cache="
                f"{self._prefix is not None})" if self.chunked_prefill
                else f"bucketed prefill {self.prompt_buckets}")
-            + f", prefill_batch={self.prefill_batch}", ranks=[0])
+            + f", prefill_batch={self.prefill_batch}"
+            + (f", speculative K={self.spec_tokens} "
+               f"({'draft ' + self._draft.module.name if self._draft else 'n-gram'})"
+               if self.spec_tokens else ""), ranks=[0])
 
     # ------------------------------------------------------------ compiled fns
     @property
@@ -295,8 +431,12 @@ class ServingEngine:
 
     def _get_prefill_fn(self, width: int):
         """One compiled prefill program per window length: chunked mode uses
-        a single ``prefill_chunk`` width, bucketed mode one per bucket."""
+        a single ``prefill_chunk`` width, bucketed mode one per bucket.
+        With a draft model, the draft's prefill is FUSED into the same
+        program (both caches advance through the identical window/table
+        contract), so speculative prefill still costs one program."""
         fwd, prepare = self._fwd, self.engine._prepare
+        draft = self._draft
 
         def build():
             def prefill(params, cache, ids, block_tables, base, valid):
@@ -307,12 +447,82 @@ class ServingEngine:
                                     lengths=valid, block_tables=block_tables)
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
-            return jax.jit(prefill, donate_argnums=self._donate())
+            if draft is None:
+                return jax.jit(prefill, donate_argnums=self._donate())
+            dfwd = draft.module.decode_hooks["forward_cached"]
+            dprepare = draft._prepare
+
+            def prefill_fused(params, dparams, cache, dcache, ids,
+                              block_tables, base, valid):
+                first, cache = prefill(params, cache, ids, block_tables,
+                                       base, valid)
+                _, dcache = dfwd(dprepare(dparams), ids, dcache, base,
+                                 lengths=valid, block_tables=block_tables)
+                return first, cache, dcache
+
+            return jax.jit(
+                prefill_fused,
+                donate_argnums=(2, 3) if self._donate() else ())
 
         return self._prefill_fns.get_or_build(
             width, build,
             on_build=lambda _: self.compiled_programs.append(
                 ("prefill", width, self.prefill_batch)))
+
+    def _get_verify_fn(self):
+        """The speculative K+1 verify program: one fixed-shape paged
+        forward through the chunked-prefill T>1 path, returning the
+        target's greedy argmax at EVERY window position (the
+        ``all_positions`` verify head) — this replaces the single-token
+        decode program entirely in speculative mode."""
+        if self._verify_fn is None:
+            fwd, prepare = self._fwd, self.engine._prepare
+
+            def verify(params, cache, ids, block_tables, base, valid):
+                """ids [slots, K+1] = [pending, d_1..d_K] per row; base
+                int32 [slots] committed lengths; valid int32 [slots] real
+                window tokens (0 for non-decode rows — all writes land in
+                scratch)."""
+                logits, cache = fwd(prepare(params), ids, cache, base,
+                                    lengths=valid, block_tables=block_tables,
+                                    all_positions=True)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+            self._verify_fn = jax.jit(verify, donate_argnums=self._donate())
+            self.compiled_programs.append(
+                ("verify", self.slots, self.spec_tokens + 1))
+        return self._verify_fn
+
+    def _get_draft_fn(self):
+        """The draft rollout program: K greedy single-token steps of the
+        draft model inside ONE ``lax.scan`` — the whole proposal costs one
+        compiled program per trace, and the draft pool advances through the
+        target's own block tables."""
+        if self._draft_fn is None:
+            draft = self._draft
+            dfwd = draft.module.decode_hooks["forward_cached"]
+            dprepare = draft._prepare
+            k = self.spec_tokens
+
+            def propose(dparams, dcache, tokens, lengths, block_tables):
+                dp = dprepare(dparams)
+
+                def step(carry, _):
+                    tok, lens, cache = carry
+                    logits, cache = dfwd(dp, tok[:, None], cache, 0,
+                                         lengths=lens,
+                                         block_tables=block_tables)
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return (nxt, lens + 1, cache), nxt
+
+                (_, _, dcache), drafts = jax.lax.scan(
+                    step, (tokens, lengths, dcache), None, length=k)
+                return drafts.T, dcache            # [slots, K]
+
+            self._draft_fn = jax.jit(
+                propose, donate_argnums=(1,) if self._donate() else ())
+            self.compiled_programs.append(("draft", self.slots, k))
+        return self._draft_fn
 
     # ----------------------------------------------------------- block plumbing
     def _release_slot(self, slot: int) -> None:
@@ -355,7 +565,7 @@ class ServingEngine:
     def _ensure_blocks(self, slot: int, active, pending, upto: int) -> bool:
         """Make the slot's table cover positions ``[0, upto)``; may preempt
         other slots (or the slot itself — returns False)."""
-        for li in range(-(-upto // self.block_size)):
+        for li in range(blocks_for(upto, self.block_size)):
             if slot not in active:
                 return False
             if self._tables[slot, li] == 0:
@@ -409,7 +619,7 @@ class ServingEngine:
             plen = int(prompt_eff.size)
             # gate on a non-mutating probe first: while the queue head is
             # blocked, iterations must not churn refcounts / LRU recency
-            total_need = -(-(plen + 1) // self.block_size)
+            total_need = blocks_for(plen + 1, self.block_size)
             n_hit = self._prefix.probe(prompt_eff, plen - 1) \
                 if self._prefix is not None else 0
 
@@ -438,6 +648,10 @@ class ServingEngine:
             reserved += max(need, 0)
             pending.popleft()
             slot = free.pop(0)
+            # latency probes: admit stamped once per request per trace (a
+            # preemption resume keeps the original admission time)
+            self._trace_times.setdefault(
+                req.uid, {"admit": time.perf_counter(), "first": None})
             self._tables[slot, :len(hits)] = hits
             self._held[slot] = list(hits)
             st = _SlotState(req=req, admit_seq=self._admit_seq,
@@ -481,6 +695,7 @@ class ServingEngine:
         pending = deque((r, []) for r in requests)
         active: Dict[int, _SlotState] = {}
         self._blocked_gate = None          # ids are fresh for this trace
+        self._trace_times = {}             # uids are unique per trace
         if admission_log is None:
             admission_log = []
         results: Dict[Any, np.ndarray] = {}
@@ -495,6 +710,16 @@ class ServingEngine:
                     gen[-1] == eos_token_id:
                 out[gen.size:] = eos_token_id  # back-fill (HF semantics)
             results[req.uid] = np.concatenate([req.prompt, out])
+            tm = self._trace_times.get(req.uid)
+            if tm is not None and tm["first"] is not None:
+                done = time.perf_counter()
+                self._latencies.append({
+                    "uid": req.uid,
+                    "new_tokens": int(gen.size),
+                    "ttft_s": tm["first"] - tm["admit"],
+                    "tpot_s": ((done - tm["first"]) / (gen.size - 1))
+                    if gen.size > 1 else 0.0,
+                })
             self._release_slot(slot)
 
         while pending or active:
@@ -504,34 +729,15 @@ class ServingEngine:
             self._run_prefill(active, pending, params, eos_token_id, finish)
 
             # one decode step over every slot (per-sequence positions);
-            # prefilling/empty slots point at the scratch block
-            dec = sorted(
-                (s for s, st in active.items() if st.phase == "decode"),
-                key=lambda s: active[s].admit_seq)
-            for slot in dec:
-                if slot in active:
-                    self._ensure_blocks(slot, active, pending,
-                                        int(self._lengths[slot]) + 1)
-            dec = sorted(s for s, st in active.items()
-                         if st.phase == "decode")
-            if dec:
-                bt = np.zeros_like(self._tables)
-                bt[dec] = self._tables[dec]
-                nxt, self._cache = self._get_decode_fn()(
-                    params, self._cache, jnp.asarray(self._tokens),
-                    jnp.asarray(self._lengths), jnp.asarray(bt))
-                nxt = np.asarray(nxt)
-                self.decode_steps += 1
-                for slot in dec:
-                    st = active[slot]
-                    self._lengths[slot] += 1   # the fed token is now cached
-                    tok = int(nxt[slot])
-                    st.out.append(tok)
-                    if (eos_token_id is not None and tok == eos_token_id) \
-                            or st.gen_count >= st.req.max_new_tokens:
-                        finish(slot)
-                    else:
-                        self._tokens[slot] = tok
+            # prefilling/empty slots point at the scratch block.  In
+            # speculative mode the single-token step is replaced by a
+            # draft–verify round committing up to K+1 tokens per slot.
+            if self.spec_tokens:
+                self._run_spec_decode(active, pending, params,
+                                      eos_token_id, finish)
+            else:
+                self._run_plain_decode(active, pending, params,
+                                       eos_token_id, finish)
             if step_log is not None:
                 step_log.append({
                     "iteration": self.iterations,
@@ -540,6 +746,123 @@ class ServingEngine:
                     "blocks_in_use": self._alloc.blocks_in_use,
                 })
         return results
+
+    # ----------------------------------------------------------------- decode
+    def _mark_first(self, st: _SlotState) -> None:
+        tm = self._trace_times.get(st.req.uid)
+        if tm is not None and tm["first"] is None:
+            tm["first"] = time.perf_counter()
+
+    def _run_plain_decode(self, active, pending, params, eos_token_id,
+                          finish):
+        """One single-token decode step over every decode-phase slot."""
+        dec = sorted(
+            (s for s, st in active.items() if st.phase == "decode"),
+            key=lambda s: active[s].admit_seq)
+        for slot in dec:
+            if slot in active:
+                self._ensure_blocks(slot, active, pending,
+                                    int(self._lengths[slot]) + 1)
+        dec = sorted(s for s, st in active.items()
+                     if st.phase == "decode")
+        if not dec:
+            return
+        bt = np.zeros_like(self._tables)
+        bt[dec] = self._tables[dec]
+        nxt, self._cache = self._get_decode_fn()(
+            params, self._cache, jnp.asarray(self._tokens),
+            jnp.asarray(self._lengths), jnp.asarray(bt))
+        nxt = np.asarray(nxt)
+        self.decode_steps += 1
+        for slot in dec:
+            st = active[slot]
+            self._lengths[slot] += 1   # the fed token is now cached
+            tok = int(nxt[slot])
+            st.out.append(tok)
+            self._mark_first(st)
+            if (eos_token_id is not None and tok == eos_token_id) \
+                    or st.gen_count >= st.req.max_new_tokens:
+                finish(slot)
+            else:
+                self._tokens[slot] = tok
+
+    def _run_spec_decode(self, active, pending, params, eos_token_id,
+                         finish):
+        """One speculative draft–verify round over every decode-phase slot.
+
+        Propose K tokens per row (the draft model's one-program K-step
+        rollout, or the host-side n-gram lookup), scatter+score the
+        K+1-token window ``[pending, d_1..d_K]`` in ONE verify pass at each
+        row's own base position, then commit the longest target-matching
+        draft prefix plus the target's correction token
+        (``spec.greedy_accept`` — token-exact with plain greedy decode).
+        Rollback of rejected tokens is just *not advancing* the host
+        lengths past the committed value: stale tail KV stays position-
+        masked and is overwritten in place by the next round (blocks stay
+        allocated, refcounts untouched).  Block demand is capped at each
+        request's remaining completion budget (``pos_cap``) — window
+        positions past the cap scatter to scratch instead of allocating.
+        """
+        k = self.spec_tokens
+        dec = sorted(
+            (s for s, st in active.items() if st.phase == "decode"),
+            key=lambda s: active[s].admit_seq)
+        for slot in dec:
+            if slot in active and active[slot].phase == "decode":
+                st = active[slot]
+                ln = int(self._lengths[slot])
+                cap = max(st.pos_cap, ln + 1)
+                self._ensure_blocks(slot, active, pending,
+                                    min(ln + k + 1, cap, self._cache_len))
+        dec = sorted(s for s, st in active.items()
+                     if st.phase == "decode")
+        if not dec:
+            return
+        bt = np.zeros_like(self._tables)
+        bt[dec] = self._tables[dec]
+        if self._draft is not None:
+            drafts, self._dcache = self._get_draft_fn()(
+                self._draft.params, self._dcache,
+                jnp.asarray(self._tokens), jnp.asarray(self._lengths),
+                jnp.asarray(bt))
+            drafts = np.asarray(drafts)
+        else:
+            drafts = np.zeros((self.slots, k), np.int32)
+            for slot in dec:
+                st = active[slot]
+                drafts[slot] = self._proposer.propose(
+                    np.concatenate([st.prompt_eff,
+                                    np.asarray(st.out, np.int32)]))
+        ids = np.zeros((self.slots, k + 1), np.int32)
+        valid = np.zeros(self.slots, np.int32)
+        ids[dec, 0] = self._tokens[dec]
+        ids[dec, 1:] = drafts[dec]
+        valid[dec] = k + 1
+        scored, self._cache = self._get_verify_fn()(
+            params, self._cache, jnp.asarray(ids), jnp.asarray(bt),
+            jnp.asarray(self._lengths), jnp.asarray(valid))
+        scored = np.asarray(scored)
+        self.spec_rounds += 1
+        # a draft-model proposer caps acceptance at K-1: the K-th draft's
+        # KV was never written to the draft pool, so accepting it would
+        # desync the draft's next feed position (n-gram has no such state)
+        max_accept = k - 1 if self._draft is not None else k
+        for slot in dec:
+            st = active[slot]
+            emitted, accepted, finished = greedy_accept(
+                ids[slot].tolist(), scored[slot].tolist(), max_accept,
+                eos_token_id, st.req.max_new_tokens - st.gen_count)
+            self.drafted_tokens += k
+            self.accepted_tokens += accepted
+            st.out.extend(emitted)
+            self._mark_first(st)
+            if finished:
+                finish(slot)
+            else:
+                # commit = pending + accepted drafts now durable in-cache;
+                # the correction token becomes the new pending feed
+                self._lengths[slot] += accepted + 1
+                self._tokens[slot] = emitted[-1]
 
     # ---------------------------------------------------------------- prefill
     def _run_prefill(self, active, pending, params, eos_token_id, finish):
@@ -612,9 +935,15 @@ class ServingEngine:
             base[row] = st.base
             valid[row] = v
             rows.append((slot, v))
-        first, self._cache = self._get_prefill_fn(width)(
-            params, self._cache, jnp.asarray(ids), jnp.asarray(bt),
-            jnp.asarray(base), jnp.asarray(valid))
+        if self._draft is not None:
+            first, self._cache, self._dcache = self._get_prefill_fn(width)(
+                params, self._draft.params, self._cache, self._dcache,
+                jnp.asarray(ids), jnp.asarray(bt), jnp.asarray(base),
+                jnp.asarray(valid))
+        else:
+            first, self._cache = self._get_prefill_fn(width)(
+                params, self._cache, jnp.asarray(ids), jnp.asarray(bt),
+                jnp.asarray(base), jnp.asarray(valid))
         first = np.asarray(first)
         self.prefill_calls += 1
         for row, (slot, v) in enumerate(rows):
@@ -633,6 +962,7 @@ class ServingEngine:
                                           self._alloc)
             tok = int(first[row])
             st.out.append(tok)
+            self._mark_first(st)
             self._tokens[slot] = tok
             self._lengths[slot] = st.plen_eff
             if (eos_token_id is not None and tok == eos_token_id) \
@@ -640,10 +970,23 @@ class ServingEngine:
                 finish(slot)
 
     # ------------------------------------------------------------------ stats
+    def _latency_stats(self) -> Dict[str, Any]:
+        """TTFT/TPOT percentiles over every finished request (cumulative
+        across serve calls, like the other counters)."""
+        out: Dict[str, Any] = {"requests_finished": len(self._latencies)}
+        for key in ("ttft", "tpot"):
+            vals = [m[f"{key}_s"] for m in self._latencies]
+            for q in (50, 95):
+                out[f"{key}_p{q}_s"] = (
+                    float(np.percentile(vals, q)) if vals else None)
+        return out
+
     def stats(self) -> Dict[str, Any]:
         """Serving-loop observability: compile probe, prefix-cache hit
-        rate, block occupancy, and admission/eviction counters."""
-        return {
+        rate, block occupancy, admission/eviction counters, per-request
+        latency percentiles, and — in speculative mode — draft/accept
+        counters and the acceptance rate."""
+        st = {
             "mode": "chunked" if self.chunked_prefill else "bucketed",
             "compile_count": self.compile_count,
             "iterations": self.iterations,
@@ -664,4 +1007,16 @@ class ServingEngine:
             "free_blocks": self._alloc.free_blocks,
             "num_blocks": self._alloc.num_blocks,
             "block_size": self.block_size,
+            "speculative": (
+                None if not self.spec_tokens else
+                f"draft:{self._draft.module.name}" if self._draft
+                else "ngram"),
+            "spec_tokens": self.spec_tokens,
+            "spec_rounds": self.spec_rounds,
+            "drafted_tokens": self.drafted_tokens,
+            "accepted_tokens": self.accepted_tokens,
+            "acceptance_rate": (self.accepted_tokens / self.drafted_tokens
+                                if self.drafted_tokens else 0.0),
         }
+        st.update(self._latency_stats())
+        return st

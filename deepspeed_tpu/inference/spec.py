@@ -1,0 +1,131 @@
+"""Speculative decoding building blocks: draft proposers + greedy
+verification (Leviathan et al., "Fast Inference from Transformers via
+Speculative Decoding"; vLLM's draft–verify scheduler).
+
+The serving engine's decode steps are memory-bound single-token passes, so
+N sequential target-model steps cost N full weight reads.  Speculative
+decoding spends one cheap *proposal* (a small draft model, or a model-free
+n-gram lookup over the request's own tokens) to guess K tokens, then
+scores all K+1 positions in ONE batched target forward (the paged
+chunked-prefill T>1 path).  Greedy verification keeps exactly the tokens
+the target itself would have produced — the accepted prefix of the draft
+plus the target's correction token — so the output stream is token-exact
+with plain greedy decode at any acceptance rate.
+
+This module is the host-side, device-free part: the n-gram proposer and
+the accept/rollback arithmetic.  Device wiring (the draft-model K-step
+program, the K+1 verify program, block accounting) lives in
+``inference/serving.py``; the KV-layout properties that make rollback free
+are documented in ``ops/paged_kv.py``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def greedy_accept(window: Sequence[int], scored: Sequence[int],
+                  max_accept: int, eos_token_id: Optional[int],
+                  budget: int) -> Tuple[List[int], int, bool]:
+    """Greedy draft verification for one sequence.
+
+    window: the K+1 tokens fed to the verify pass — ``[pending, d_1 ..
+            d_K]`` (the pending token is the last committed-but-unfed
+            output token; ``d_i`` are draft proposals).
+    scored: the target's greedy argmax at each window position —
+            ``scored[i]`` is the target's next token after ``window[:i+1]``
+            plus the committed history.
+    max_accept: cap on accepted drafts.  ``K`` for model-free proposers;
+            ``K - 1`` when a draft KV cache must stay position-aligned (the
+            K-th draft's KV was never written, so accepting it would leave
+            a hole at the draft's next feed position).
+    eos_token_id / budget: emission stops at the first eos or when
+            ``budget`` (the request's remaining ``max_new_tokens``) runs
+            out — eos *inside* an accepted window truncates it.
+
+    Returns ``(emitted, accepted, finished)``: the tokens to append to the
+    request's output this round, the number of accepted draft tokens
+    actually EMITTED — eos/budget truncation caps it, so the
+    drafted/accepted stats never count draft matches past the stopping
+    point (where ``scored`` may even be scratch-routed garbage: positions
+    past the request's block budget never allocate) — (cache-commit
+    advance is ``accepted + 1``: the pending token plus the accepted
+    drafts; when not finished, ``emitted[-1]`` is the new pending token —
+    the target's correction, whose KV is not yet written), and whether the
+    request is done.  Every emitted token equals what plain greedy decode
+    would produce, by construction: token ``i`` of the round is the
+    target's argmax given the identical committed prefix.
+    """
+    if budget < 1:
+        raise ValueError(f"budget must be >= 1, got {budget}")
+    k1 = len(window)
+    if len(scored) != k1:
+        raise ValueError(f"scored has {len(scored)} entries for a "
+                         f"{k1}-token window")
+    a = 0
+    while a < max_accept and a + 1 < k1 and \
+            int(window[a + 1]) == int(scored[a]):
+        a += 1
+    candidate = [int(t) for t in window[1:a + 1]] + [int(scored[a])]
+    emitted: List[int] = []
+    finished = False
+    for tok in candidate:
+        emitted.append(tok)
+        if (eos_token_id is not None and tok == eos_token_id) or \
+                len(emitted) >= budget:
+            finished = True
+            break
+    return emitted, min(a, len(emitted)), finished
+
+
+class NGramProposer:
+    """Model-free prompt-lookup drafting (Saxena's prompt-lookup decoding;
+    vLLM's ``[ngram]`` speculator): propose the continuation of the most
+    recent earlier occurrence of the sequence's current tail n-gram.
+
+    Greedy decoding loves to quote — retrieval answers copy prompt spans,
+    code repeats identifiers, and degenerate loops repeat themselves — and
+    every quoted span is a free draft: no second model, no extra compiled
+    program, no device memory.  Wrong guesses cost nothing but wasted
+    verify lanes (verification keeps output token-exact regardless).
+    """
+
+    #: compiled programs this proposer adds to the serving trace
+    programs = 0
+
+    def __init__(self, k: int, max_n: int = 3, min_n: int = 1):
+        if k < 1:
+            raise ValueError(f"draft length k must be >= 1, got {k}")
+        if min_n < 1 or max_n < min_n:
+            raise ValueError(
+                f"need 1 <= min_n <= max_n, got min_n={min_n} max_n={max_n}")
+        self.k = int(k)
+        self.max_n = int(max_n)
+        self.min_n = int(min_n)
+
+    def propose(self, ctx) -> np.ndarray:
+        """Draft ``k`` tokens continuing ``ctx`` (int array, prompt +
+        generated output, most recent last).
+
+        Longest-match-first: try the tail ``max_n``-gram, back off to
+        shorter n-grams, and take the MOST RECENT earlier occurrence (the
+        repetition most likely to still be live).  With no match anywhere,
+        fall back to repeating the final token — never wrong, just
+        low-yield draft slots."""
+        ctx = np.asarray(ctx, np.int32).reshape(-1)
+        m = int(ctx.size)
+        if m == 0:
+            return np.zeros(self.k, np.int32)
+        out = np.full(self.k, int(ctx[-1]), np.int32)
+        for n in range(min(self.max_n, m - 1), self.min_n - 1, -1):
+            pat = ctx[m - n:]
+            wins = np.lib.stride_tricks.sliding_window_view(ctx[:-1], n)
+            hits = np.nonzero((wins == pat).all(axis=1))[0]
+            if hits.size:
+                start = int(hits[-1]) + n
+                cont = ctx[start:start + self.k]
+                out[:cont.size] = cont
+                break
+        return out

@@ -207,21 +207,100 @@ def init_cache(cfg: BloomConfig, batch_size: int, max_len: int,
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def forward_cached(cfg: BloomConfig, params, input_ids, cache, pos):
-    from .gpt2 import _dequant_resident, decode_over_layers
+def _alibi_cached_attention(cfg: BloomConfig, q, k, v, ck, cv, pos,
+                            block_tables=None, chunk_valid=None):
+    """Write new KV + ALiBi attention, on either cache layout (contract in
+    gpt2._cached_attention).  Pure XLA on both layouts: the additive ALiBi
+    bias rules out the shared position-masked decode kernels, so the paged
+    path gathers each row's logical view through its block table and biases
+    by absolute positions (``pos`` scalar, or int32 [B] per-row — decode
+    offsets, chunked-prefill bases, or speculative verify-window bases)."""
+    from ..ops.paged_kv import paged_cache_update, paged_gather
+    from .gpt2 import cache_update
+
+    if block_tables is None:
+        ck, cv = cache_update(ck, cv, k, v, pos)
+        kk, vv = ck, cv
+    else:
+        ck, cv = paged_cache_update(ck, cv, k, v, pos, block_tables,
+                                    valid=chunk_valid)
+        kk = paged_gather(ck, block_tables)
+        vv = paged_gather(cv, block_tables)
+
+    t, s = q.shape[2], kk.shape[2]
+    pos = jnp.asarray(pos, jnp.int32)
+    qpos = pos.reshape(-1, 1) + jnp.arange(t, dtype=jnp.int32)[None, :]
+    kpos = jnp.arange(s, dtype=jnp.int32)                 # qpos: [B | 1, T]
+    scores = jnp.einsum("bhtd,bhsd->bhts", q, kk) / math.sqrt(cfg.head_dim)
+    rel = (kpos[None, None, :] - qpos[:, :, None]).astype(jnp.float32)
+    slopes = jnp.asarray(alibi_slopes(cfg.num_heads))
+    scores = scores.astype(jnp.float32) + \
+        slopes[None, :, None, None] * rel[:, None]
+    mask = kpos[None, None, :] <= qpos[:, :, None]        # [B | 1, T, S]
+    scores = jnp.where(mask[:, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bhsd->bhtd", probs, vv), ck, cv
+
+
+def _block_cached_body(cfg: BloomConfig, x, get, mm, ck, cv, pos,
+                       block_tables=None, chunk_valid=None):
+    """One BLOOM block over a KV cache, parameterized by weight access
+    (same shape as gpt2._block_cached_body so the scan and layer-indexed
+    quantized decode paths share it)."""
+    b, t, d = x.shape
+    h, hd = cfg.num_heads, cfg.head_dim
+
+    y = _layer_norm(x, get("ln1_scale"), get("ln1_bias"))
+    qkv = mm(y, "qkv_w", None) + get("qkv_b").astype(y.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
+    attn, ck, cv = _alibi_cached_attention(cfg, q, k, v, ck, cv, pos,
+                                           block_tables, chunk_valid)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, t, d)
+    x = x + mm(attn, "o_w", x.dtype) + get("o_b").astype(x.dtype)
+
+    y = _layer_norm(x, get("ln2_scale"), get("ln2_bias"))
+    hid = jax.nn.gelu(mm(y, "fc_w", None) + get("fc_b").astype(y.dtype),
+                      approximate=False)
+    x = x + mm(hid, "proj_w", x.dtype) + get("proj_b").astype(x.dtype)
+    return x, ck, cv
+
+
+def forward_cached(cfg: BloomConfig, params, input_ids, cache, pos,
+                   lengths=None, block_tables=None, all_positions=False):
+    """Incremental forward: logits for the LAST input position + updated
+    cache — or every position when ``all_positions`` is set ([B, T, V],
+    speculative verify head).
+
+    Follows the gpt2.forward_cached contract: ``lengths`` (int32 [B]) gives
+    per-sequence positions for continuous-batching slots (T == 1 decode at
+    ``lengths[b]``; T > 1 ragged prefill with per-row logit gather at
+    ``lengths[b] - 1``); ``block_tables`` switches to the block-paged cache
+    layout with ``pos`` as per-row window bases.  ALiBi has no position
+    table, so only the attention bias (absolute positions) moves with the
+    per-row offsets — the embedding is position-free."""
+    from .gpt2 import _dequant_resident, _gather_last, decode_over_layers
 
     params = _dequant_resident(params)
     pos = jnp.asarray(pos, jnp.int32)
+    t = input_ids.shape[1]
+    per_row = lengths is not None and t == 1
+    step_pos = jnp.asarray(lengths, jnp.int32) if per_row else pos
+    chunk_valid = jnp.asarray(lengths, jnp.int32) \
+        if (block_tables is not None and lengths is not None and t > 1) \
+        else None
     x = _embed(cfg, params, input_ids)
 
-    def body(x, get, mm, ck, cv):
-        x, (ck, cv) = _block(cfg, x, None, pos=pos, cache=(ck, cv),
-                             get=get, mm=mm)
-        return x, ck, cv
-
-    x, ks, vs = decode_over_layers(body, x, params["blocks"], cache["k"],
-                                   cache["v"], cfg.num_layers)
-    x = _layer_norm(x[:, -1], params["lnf_scale"], params["lnf_bias"])
+    x, ks, vs = decode_over_layers(
+        lambda x, get, mm, ck, cv: _block_cached_body(
+            cfg, x, get, mm, ck, cv, step_pos, block_tables=block_tables,
+            chunk_valid=chunk_valid),
+        x, params["blocks"], cache["k"], cache["v"], cfg.num_layers)
+    if not all_positions:
+        x = _gather_last(x, lengths if not per_row else None)
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
     return x @ params["word_embeddings"].T.astype(x.dtype), \
         {"k": ks, "v": vs}
 
@@ -340,11 +419,16 @@ def build(cfg: Optional[BloomConfig] = None, **overrides) -> ModelSpec:
     decode_hooks = {
         "init_cache": lambda b, s, dtype=jnp.bfloat16: init_cache(
             cfg, b, s, dtype),
-        "forward_cached": lambda params, ids, cache, pos: forward_cached(
-            cfg, params, ids, cache, pos),
+        "forward_cached": lambda params, ids, cache, pos, lengths=None,
+            block_tables=None, all_positions=False:
+            forward_cached(cfg, params, ids, cache, pos, lengths,
+                           block_tables, all_positions),
         # ALiBi has no learned position table: the context is bounded only
         # by the KV workspace
         "max_seq_len": None,
+        "supports_lengths": True,
+        "supports_paged": True,
+        "supports_verify": True,
     }
 
     pipeline_hooks = {

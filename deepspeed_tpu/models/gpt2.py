@@ -490,9 +490,12 @@ def decode_over_layers(body, x, blocks, cache_k, cache_v, num_layers,
 
 
 def forward_cached(cfg: GPT2Config, params, input_ids, cache, pos,
-                   lengths=None, block_tables=None):
+                   lengths=None, block_tables=None, all_positions=False):
     """Incremental forward: logits for the LAST input position + updated
-    cache.
+    cache — or for EVERY input position when ``all_positions`` is set (the
+    speculative-decoding verify head: a K+1-token window is scored in one
+    pass, returning [B, T, V] so the scheduler can compare the target's
+    greedy choice at each draft position).
 
     ``lengths`` (optional int32 [B]) is the per-sequence valid length for
     continuous-batching slots:
@@ -542,7 +545,8 @@ def forward_cached(cfg: GPT2Config, params, input_ids, cache, pos,
             cfg, x, get, mm, ck, cv, step_pos, block_tables=block_tables,
             chunk_valid=chunk_valid),
         x, params["blocks"], cache["k"], cache["v"], cfg.num_layers)
-    x = _gather_last(x, lengths if not per_row else None)
+    if not all_positions:
+        x = _gather_last(x, lengths if not per_row else None)
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
     logits = x @ params["wte"].T.astype(x.dtype)
     return logits, {"k": ks, "v": vs}
@@ -816,9 +820,9 @@ def build(cfg: Optional[GPT2Config] = None, **overrides) -> ModelSpec:
         "init_cache": lambda b, s, dtype=jnp.bfloat16: init_cache(cfg, b, s,
                                                                   dtype),
         "forward_cached": lambda params, ids, cache, pos, lengths=None,
-            block_tables=None:
+            block_tables=None, all_positions=False:
             forward_cached(cfg, params, ids, cache, pos, lengths,
-                           block_tables),
+                           block_tables, all_positions),
         # learned absolute positions: decoding past this silently clamps the
         # wpe dynamic_slice, so the engine must reject it up front
         "max_seq_len": cfg.max_seq_len,
@@ -826,6 +830,8 @@ def build(cfg: Optional[GPT2Config] = None, **overrides) -> ModelSpec:
         "supports_lengths": True,
         # block-paged KV layout + chunked prefill (paged serving)
         "supports_paged": True,
+        # all-position logits over a K+1 window (speculative verify head)
+        "supports_verify": True,
     }
 
     return ModelSpec(

@@ -288,9 +288,11 @@ def _block_cached(cfg: LlamaConfig, x, layer, ck, cv, pos, mlp_fn=None,
 
 
 def forward_cached(cfg: LlamaConfig, params, input_ids, cache, pos,
-                   lengths=None, block_tables=None, mlp_fn=None):
+                   lengths=None, block_tables=None, mlp_fn=None,
+                   all_positions=False):
     """Incremental forward: logits for the LAST input position + updated
-    cache.  ``mlp_fn`` threads through to :func:`_block_cached` (mixtral
+    cache — or for EVERY position when ``all_positions`` is set ([B, T, V],
+    the speculative-verify head).  ``mlp_fn`` threads through to :func:`_block_cached` (mixtral
     delegates here with its MoE FFN).  Quantized serving (no mlp_fn) takes
     the layer-indexed stacked-kernel path via gpt2.decode_over_layers.
 
@@ -333,7 +335,8 @@ def forward_cached(cfg: LlamaConfig, params, input_ids, cache, pos,
 
         x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"],
                                              cache["v"]))
-    x = _gather_last(x, lengths if not per_row else None)
+    if not all_positions:
+        x = _gather_last(x, lengths if not per_row else None)
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     return x @ params["lm_head"].astype(x.dtype), {"k": ks, "v": vs}
 
@@ -419,11 +422,12 @@ def build(cfg: Optional[LlamaConfig] = None, **overrides) -> ModelSpec:
             "init_cache": lambda b, s, dtype=jnp.bfloat16: init_cache(
                 cfg, b, s, dtype),
             "forward_cached": lambda params, ids, cache, pos, lengths=None,
-                block_tables=None:
+                block_tables=None, all_positions=False:
                 forward_cached(cfg, params, ids, cache, pos, lengths,
-                               block_tables),
+                               block_tables, all_positions=all_positions),
             "supports_lengths": True,
             "supports_paged": True,
+            "supports_verify": True,
         },
         quant_aware=True,  # per-layer point-of-use dequant / w8a8 records
         name=f"llama-{cfg.num_layers}l-{cfg.hidden_size}d")

@@ -158,17 +158,19 @@ def _moe_ffn(cfg: MixtralConfig, layer, y, train: bool):
 
 
 def forward_cached(cfg: MixtralConfig, params, input_ids, cache, pos,
-                   lengths=None, block_tables=None):
+                   lengths=None, block_tables=None, all_positions=False):
     """Incremental MoE forward (reference ``moe_inference.py``: expert
     routing runs per decode token too) — llama's cached path with the MoE
     FFN hooked in.  ``lengths`` (per-sequence positions for
-    continuous-batching slots) and ``block_tables`` (block-paged cache
-    layout) pass straight through: expert routing is position- and
+    continuous-batching slots), ``block_tables`` (block-paged cache
+    layout), and ``all_positions`` (speculative K+1 verify head) pass
+    straight through: expert routing is position- and
     layout-independent."""
     return L.forward_cached(
         cfg, params, input_ids, cache, pos, lengths=lengths,
         block_tables=block_tables,
-        mlp_fn=lambda lyr, y: _moe_ffn(cfg, lyr, y, train=False)[0])
+        mlp_fn=lambda lyr, y: _moe_ffn(cfg, lyr, y, train=False)[0],
+        all_positions=all_positions)
 
 
 def tp_rules(cfg: MixtralConfig, abstract_params: PyTree) -> PyTree:
@@ -200,12 +202,13 @@ def build(cfg: Optional[MixtralConfig] = None, **overrides) -> ModelSpec:
         "init_cache": lambda b, s, dtype=jnp.bfloat16: L.init_cache(
             cfg, b, s, dtype),
         "forward_cached": lambda params, ids, cache, pos, lengths=None,
-            block_tables=None:
+            block_tables=None, all_positions=False:
             forward_cached(cfg, params, ids, cache, pos, lengths,
-                           block_tables),
+                           block_tables, all_positions),
         "max_seq_len": cfg.max_seq_len,
         "supports_lengths": True,
         "supports_paged": True,
+        "supports_verify": True,
     }
 
     return ModelSpec(

@@ -336,10 +336,11 @@ def _block_cached(cfg: OPTConfig, x, layer, ck, cv, pos):
 
 
 def forward_cached(cfg: OPTConfig, params, input_ids, cache, pos,
-                   lengths=None, block_tables=None):
-    """Incremental forward: logits for the LAST position + updated cache.
-    Quantized serving runs the layer-indexed loop (stacked s8 kernel,
-    gpt2.decode_over_layers) instead of the scan.
+                   lengths=None, block_tables=None, all_positions=False):
+    """Incremental forward: logits for the LAST position + updated cache —
+    or for EVERY position when ``all_positions`` is set ([B, T, V], the
+    speculative-verify head).  Quantized serving runs the layer-indexed
+    loop (stacked s8 kernel, gpt2.decode_over_layers) instead of the scan.
 
     ``lengths`` (optional int32 [B]): per-sequence valid lengths for
     continuous-batching slots — T == 1 decodes each row at position
@@ -365,9 +366,9 @@ def forward_cached(cfg: OPTConfig, params, input_ids, cache, pos,
             cfg, x, get, mm, ck, cv, step_pos, block_tables=block_tables,
             chunk_valid=chunk_valid),
         x, params["blocks"], cache["k"], cache["v"], cfg.num_layers)
-    logits = _head(cfg, params, _gather_last(
-        x, lengths if not per_row else None))
-    return logits, {"k": ks, "v": vs}
+    if not all_positions:
+        x = _gather_last(x, lengths if not per_row else None)
+    return _head(cfg, params, x), {"k": ks, "v": vs}
 
 
 def _ce_from_logits(logits, targets):
@@ -518,12 +519,13 @@ def build(cfg: Optional[OPTConfig] = None, **overrides) -> ModelSpec:
         "init_cache": lambda b, s, dtype=jnp.bfloat16: init_cache(cfg, b, s,
                                                                   dtype),
         "forward_cached": lambda params, ids, cache, pos, lengths=None,
-            block_tables=None:
+            block_tables=None, all_positions=False:
             forward_cached(cfg, params, ids, cache, pos, lengths,
-                           block_tables),
+                           block_tables, all_positions),
         "max_seq_len": cfg.max_seq_len,
         "supports_lengths": True,
         "supports_paged": True,
+        "supports_verify": True,
     }
 
     def _stream_embed(params, ids, pos):

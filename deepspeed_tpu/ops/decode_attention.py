@@ -281,12 +281,138 @@ def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables, q_pos, *,
     return out.reshape(b, h, 1, d)
 
 
+def _paged_verify_kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, sm_scale: float,
+                         block_size: int, t: int):
+    """Multi-token (T = K+1 speculative verify window) variant of the paged
+    decode kernel.  Grid: (B, HKV, NBPER), logical blocks innermost.
+
+    q_ref: [1, 1, rep*T, D] — query row ``r*T + i`` is head ``r`` of this KV
+    group at window offset ``i``, so its global position is ``base + i``
+    with ``base = pos_ref[b]`` (the row's committed length — the verify
+    window was just scattered at ``base .. base+T-1``).  The causal mask is
+    per query ROW (``key <= base + row % T``): every verify query sees the
+    row's history plus the window prefix up to itself, never the
+    yet-unverified draft tail.  Blocks wholly past ``base + T - 1`` are
+    skipped, so FLOPs track each row's own valid length.
+    """
+    del bt_ref                       # consumed by the BlockSpec index maps
+    kb = pl.program_id(2)
+    nk = pl.num_programs(2)
+    base = pos_ref[pl.program_id(0)]
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    start = kb * block_size
+
+    @pl.when(start <= base + t - 1)  # skip blocks past the window's last row
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)           # [rep*T, D]
+        k = k_ref[0, 0].astype(jnp.float32)           # [bk, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale                              # [rep*T, bk]
+        key_idx = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        q_off = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) % t
+        s = jnp.where(key_idx <= base + q_off, s, NEG_INF)
+
+        m_prev = m_scr[...][:, :1]                    # [rep*T, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                        # [rep*T, bk]
+        l_new = l_scr[...][:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)           # [bk, D]
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(kb == nk - 1)
+    def _finish():
+        l = l_scr[...][:, :1]
+        o_ref[0, 0] = (acc_scr[...] /
+                       jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+#: widest window the verify kernel takes; larger T (chunked prefill) uses
+#: the gather-based reference path
+VERIFY_T_MAX = 16
+
+
+def paged_verify_attention_pallas(q, k_pool, v_pool, block_tables, q_pos, *,
+                                  sm_scale: Optional[float] = None,
+                                  interpret: Optional[bool] = None):
+    """Speculative-verify paged attention: q [B, H, T, D] with T = K+1
+    window positions per row, each row's window starting at its own
+    ``q_pos[b]`` base (scalar q_pos broadcasts).  Same scalar-prefetch
+    block-table walk as the single-token kernel; the T query rows ride in
+    the row dim of one [rep*T, D] tile per (row, KV-head) grid step."""
+    b, h, t, d = q.shape
+    assert 1 <= t <= VERIFY_T_MAX, \
+        f"verify kernel takes windows up to {VERIFY_T_MAX}, got T={t}"
+    nb, hkv, bs, _ = k_pool.shape
+    rep = h // hkv
+    nbper = block_tables.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = _use_interpret()
+
+    # [B, H, T, D] -> [B, HKV, rep*T, D]: row r*T + i = (head r of the KV
+    # group, window offset i) — matches the repeat-based GQA grouping
+    qg = q.reshape(b, hkv, rep, t, d).reshape(b, hkv, rep * t, d)
+    pos = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1), (b,))
+    bt = jnp.maximum(jnp.asarray(block_tables, jnp.int32), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                        # pos, block table
+        grid=(b, hkv, nbper),
+        in_specs=[
+            pl.BlockSpec((1, 1, rep * t, d),
+                         lambda i, j, k, pos_ref, bt_ref: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, bs, d),
+                         lambda i, j, k, pos_ref, bt_ref:
+                         (bt_ref[i, k], j, 0, 0)),
+            pl.BlockSpec((1, 1, bs, d),
+                         lambda i, j, k, pos_ref, bt_ref:
+                         (bt_ref[i, k], j, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, rep * t, d),
+                               lambda i, j, k, pos_ref, bt_ref: (i, j, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rep * t, LANES), jnp.float32),    # m
+            pltpu.VMEM((rep * t, LANES), jnp.float32),    # l
+            pltpu.VMEM((rep * t, d), jnp.float32),        # acc
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_verify_kernel, sm_scale=scale,
+                          block_size=bs, t=t),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rep * t, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(pos, bt, qg, k_pool, v_pool)
+    return out.reshape(b, hkv, rep, t, d).reshape(b, h, t, d)
+
+
 def paged_decode_attention(q, k_pool, v_pool, block_tables, q_pos, *,
                            sm_scale: Optional[float] = None):
-    """Dispatch: block-table-walking Pallas kernel for single-token decode
-    on TPU; gather + XLA reference otherwise (prefill chunks, CPU-sim)."""
-    if q.shape[2] == 1 and jax.default_backend() == "tpu":
-        return paged_decode_attention_pallas(q, k_pool, v_pool, block_tables,
-                                             q_pos, sm_scale=sm_scale)
+    """Dispatch: block-table-walking Pallas kernels on TPU — single-token
+    decode (T == 1) or the speculative K+1 verify window (T <=
+    ``VERIFY_T_MAX``); gather + XLA reference otherwise (prefill chunks,
+    CPU-sim)."""
+    if jax.default_backend() == "tpu":
+        if q.shape[2] == 1:
+            return paged_decode_attention_pallas(
+                q, k_pool, v_pool, block_tables, q_pos, sm_scale=sm_scale)
+        if q.shape[2] <= VERIFY_T_MAX:
+            return paged_verify_attention_pallas(
+                q, k_pool, v_pool, block_tables, q_pos, sm_scale=sm_scale)
     return paged_decode_attention_reference(q, k_pool, v_pool, block_tables,
                                             q_pos, sm_scale=sm_scale)

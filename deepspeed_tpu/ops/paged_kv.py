@@ -13,15 +13,38 @@ Layout contract (per layer slice of the stacked pool):
    the "unset" marker: reads of unset blocks are masked by position, writes
    of invalid tokens are routed there explicitly.
 
+Speculative-decoding windows lean on two properties of this contract:
+
+ - **Scratch routing is the write-side safety net**: a T = K+1 verify
+   window may reach positions past a row's allocated table entries (the
+   tail of a draft that cannot fit the request's remaining budget) — those
+   writes land in scratch block 0 and are never read back unmasked, so the
+   verify program keeps one fixed shape for every row regardless of how
+   much budget each row has left.
+ - **Rollback is free**: rejected draft tokens leave stale KV at positions
+   ``committed_len .. committed_len + K``.  Nothing is copied or zeroed —
+   the scheduler just keeps its host-side length at the committed value;
+   position-based causal masking hides the stale tail from every read, and
+   the next committed write at a position deterministically overwrites it
+   (``pos // block_size`` / ``pos % block_size`` addressing — same block,
+   same offset).  Refcounts never move on rollback.
+
 Everything here is pure XLA (scatter / gather), shared by prefill and the
-CPU/correctness decode path; the TPU decode kernel that walks the block
-table in-kernel lives in ``ops/decode_attention.py``
-(``paged_decode_attention_pallas``).
+CPU/correctness decode path; the TPU kernels that walk the block table
+in-kernel live in ``ops/decode_attention.py``
+(``paged_decode_attention_pallas`` / ``paged_verify_attention_pallas``).
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
+
+
+def blocks_for(num_tokens: int, block_size: int) -> int:
+    """Blocks needed to cover ``num_tokens`` positions (ceil division) —
+    the one accounting formula the allocator, scheduler, and speculative
+    budget caps must all agree on."""
+    return -(-int(num_tokens) // int(block_size))
 
 
 def paged_cache_update(ck, cv, k, v, pos, block_tables, valid=None):

@@ -12,7 +12,8 @@ import pytest
 
 from deepspeed_tpu.ops.decode_attention import (
     decode_attention_pallas, decode_attention_reference,
-    paged_decode_attention_pallas, paged_decode_attention_reference)
+    paged_decode_attention_pallas, paged_decode_attention_reference,
+    paged_verify_attention_pallas)
 
 pytestmark = pytest.mark.slow  # Pallas interpret mode: minutes on CPU
 
@@ -276,6 +277,60 @@ def test_paged_pallas_kernel_matches_reference(h, hkv):
         interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("h,hkv,t", [(4, 4, 4), (8, 2, 5)])
+def test_paged_verify_pallas_kernel_matches_reference(h, hkv, t):
+    """The K+1 speculative verify window (T query rows per slot, each row's
+    window starting at its own base) == the gather-based reference == the
+    contiguous dense path, with ragged bases including 0 and a window that
+    straddles a block boundary."""
+    rng = np.random.default_rng(12)
+    b, s, d, bs = 4, 256, 64, 64
+    kc = rng.standard_normal((b, hkv, s, d)).astype(np.float32)
+    vc = rng.standard_normal((b, hkv, s, d)).astype(np.float32)
+    kp, vp, bt = _paged_from_contiguous(kc, vc, 2 * b * (s // bs), bs, rng)
+    q = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    # bases: fresh slot, mid-block, window straddling the 64-boundary, and
+    # a window ending at the last cached position
+    bases = jnp.asarray([0, 17, 62, 256 - t], jnp.int32)
+    want = decode_attention_reference(q, jnp.asarray(kc), jnp.asarray(vc),
+                                      bases)
+    ref = paged_decode_attention_reference(
+        q, jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(bt), bases)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+    got = paged_verify_attention_pallas(
+        q, jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(bt), bases,
+        interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_verify_pallas_kernel_under_jit_traced_bases():
+    """One compiled verify program serves every (bases, block_table) pair —
+    the speculative serving loop's contract."""
+    rng = np.random.default_rng(13)
+    b, h, s, d, bs, t = 2, 4, 128, 32, 32, 3
+    kc = rng.standard_normal((b, h, s, d)).astype(np.float32)
+    vc = rng.standard_normal((b, h, s, d)).astype(np.float32)
+    q = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+
+    @jax.jit
+    def step(q, kp, vp, bt, bases):
+        return paged_verify_attention_pallas(q, kp, vp, bt, bases,
+                                             interpret=True)
+
+    for seed, bases in ((0, [0, 100]), (1, [31, 125 - t])):
+        r2 = np.random.default_rng(200 + seed)
+        kp, vp, bt = _paged_from_contiguous(kc, vc, 2 * b * (s // bs), bs, r2)
+        bases = jnp.asarray(bases, jnp.int32)
+        got = step(q, jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(bt),
+                   bases)
+        want = decode_attention_reference(q, jnp.asarray(kc),
+                                          jnp.asarray(vc), bases)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
 
 
 def test_paged_pallas_kernel_under_jit_traced_tables():

@@ -37,6 +37,28 @@ def test_serving_bench_speedup_parity_and_compiles():
     assert res["speedup"] >= 1.5, res
 
 
+def test_serving_bench_speculative_decode_heavy_trace():
+    """The BENCH_r05 acceptance lane: a decode-heavy trace (short prompts,
+    long completions) with the n-gram speculative lane.  Draft–verify must
+    beat the non-speculative chunked path >= 1.3x aggregate decode tok/s in
+    the compile-warm steady state, with exact greedy parity, a reported
+    acceptance rate, and the bounded compile contract (n-gram: 2 programs)."""
+    import serving_bench
+
+    res = serving_bench.run_bench(requests=32, slots=8, layers=2, hidden=64,
+                                  heads=4, vocab=512, seed=0,
+                                  decode_heavy=True, speculative=4)
+    assert res["token_parity"], res["mismatched_uids"]
+    spec = res["serving_speculative"]
+    assert spec["compiled_programs"] == 2          # prefill + verify
+    assert 0.0 <= spec["acceptance_rate"] <= 1.0
+    assert spec["stats"]["drafted_tokens"] > 0
+    # steady state (compile-warm on both sides): the draft–verify win
+    assert res["speedup_spec_vs_chunked_warm"] >= 1.3, res
+    # compiles included, speculation must still not lose
+    assert res["speedup_spec_vs_chunked"] >= 1.0, res
+
+
 def test_serving_bench_prefix_heavy_trace():
     """The PagedAttention/RadixAttention acceptance lane: a 64-request
     trace sharing a 256-token system prompt.  Paged + chunked prefill +
