@@ -20,6 +20,15 @@ tokens/sec plus compile counts and the paged engine's ``stats()``:
    scores them (<= 3 compiled programs; 2 in n-gram mode).  Outputs stay
    token-exact with plain greedy decode; ``speedup_spec_vs_chunked`` is
    the draft–verify win over the single-token decode loop.
+ - **serving_tp** (``--tp N``): the same chunked trace on a tensor-
+   parallel engine — weights Megatron-sharded and the paged KV pool
+   sharded over the KV-head dim (``inference/serving.py`` tp section), so
+   each chip stores ``HKV/N`` heads.  Reports per-chip KV pool bytes
+   (the headline: ~N× smaller than the replicated layout) and asserts
+   token parity vs sequential.  Includes a speculative pass when
+   ``--speculative`` is also given.  Needs >= N devices — on CPU set
+   ``XLA_FLAGS=--xla_force_host_platform_device_count=8``; CPU-sim tok/s
+   under tp is emulation overhead, not a hardware prediction.
 
 Methodology (PROFILE.md "continuous-batching serving" entry): the default
 trace draws ARBITRARY prompt lengths in [32, 512] and completion budgets in
@@ -42,7 +51,7 @@ decode-bound traffic speculative decoding targets (BENCH_r05 lane:
 Usage:
   python benchmarks/serving_bench.py [--requests 64] [--slots 8]
       [--prefix-len 256] [--grid] [--decode-heavy] [--speculative K]
-      [--layers 2] [--hidden 128] [--seed 0] [--json out.json]
+      [--tp N] [--layers 2] [--hidden 128] [--seed 0] [--json out.json]
 """
 
 from __future__ import annotations
@@ -122,7 +131,8 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
               vocab: int = 2048, seed: int = 0, dtype: str = "fp32",
               grid: bool = False, prefix_len: int = 0,
               block_size: int = 32, prefill_chunk: int = 128,
-              speculative: int = 0, decode_heavy: bool = False):
+              speculative: int = 0, decode_heavy: bool = False,
+              tp: int = 1):
     import deepspeed_tpu
     from deepspeed_tpu.inference.serving import ServingEngine
     from deepspeed_tpu.models import gpt2
@@ -211,6 +221,72 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
             "stats_after_warm_pass": srv_s.stats(),
         }
 
+    # --- tensor-parallel lane (--tp N): same chunked trace, weights
+    # Megatron-sharded and the paged KV pool head-sharded over the tp mesh
+    # axis.  The headline is per-chip KV pool bytes (~N× below the
+    # replicated layout); CPU-sim tok/s under tp measures emulation
+    # overhead, not hardware.  Token parity vs sequential is asserted.
+    tp_res = None
+    tp_outs = {}
+    if tp > 1:
+        import jax
+
+        ndev = len(jax.devices())
+        if ndev % tp:
+            raise SystemExit(
+                f"--tp {tp} does not divide the {ndev} visible devices — on "
+                "CPU set XLA_FLAGS=--xla_force_host_platform_device_count=8")
+        deepspeed_tpu.comm.reset_topology()
+        engine_tp = deepspeed_tpu.init_inference(
+            gpt2.build(cfg), config={"dtype": dtype,
+                                     "tensor_parallel": {"tp_size": tp}})
+        srv_tp = ServingEngine(engine_tp, slots=slots, max_seq_len=max_total,
+                               prefill_batch=prefill_batch,
+                               block_size=block_size,
+                               prefill_chunk=prefill_chunk)
+        t0 = time.perf_counter()
+        tp_outs = srv_tp.serve(reqs)
+        tp_cold = time.perf_counter() - t0
+        tp_stats = srv_tp.stats()
+        t0 = time.perf_counter()
+        tp_outs2 = srv_tp.serve(reqs)
+        tp_warm = time.perf_counter() - t0
+        tp_res = {
+            "tp": tp,
+            "tok_s": gen_tokens / tp_cold,
+            "wall_s": tp_cold,
+            "tok_s_warm": gen_tokens / tp_warm,
+            "wall_warm_s": tp_warm,
+            "compiled_programs": srv_tp.compile_count,
+            "kv_sharded": tp_stats["kv_sharded"],
+            "kv_pool_shape": tp_stats["kv_pool_shape"],
+            "kv_pool_bytes": tp_stats["kv_pool_bytes"],
+            "kv_pool_bytes_per_chip": tp_stats["kv_pool_bytes_per_chip"],
+            "stats": tp_stats,
+        }
+        if speculative:
+            srv_tp_s = ServingEngine(engine_tp, slots=slots,
+                                     max_seq_len=max_total,
+                                     prefill_batch=prefill_batch,
+                                     block_size=block_size,
+                                     prefill_chunk=prefill_chunk,
+                                     spec_tokens=speculative)
+            t0 = time.perf_counter()
+            tp_spec_outs = srv_tp_s.serve(reqs)
+            tp_spec_cold = time.perf_counter() - t0
+            tp_res["speculative"] = {
+                "tok_s": gen_tokens / tp_spec_cold,
+                "wall_s": tp_spec_cold,
+                "compiled_programs": srv_tp_s.compile_count,
+                "acceptance_rate": srv_tp_s.stats()["acceptance_rate"],
+                "kv_pool_bytes_per_chip":
+                    srv_tp_s.stats()["kv_pool_bytes_per_chip"],
+            }
+            tp_outs = {u: (tp_outs[u], tp_spec_outs[u]) for u in tp_outs}
+        else:
+            tp_outs = {u: (tp_outs[u],) for u in tp_outs}
+        tp_outs = {u: list(v) + [tp_outs2[u]] for u, v in tp_outs.items()}
+
     mismatches = [r.uid for r in reqs
                   if not (np.array_equal(seq_outs[r.uid], srv_outs[r.uid])
                           and np.array_equal(seq_outs[r.uid],
@@ -219,6 +295,8 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
                                              bkt_outs[r.uid])
                           and np.array_equal(seq_outs[r.uid],
                                              bkt_outs2[r.uid])
+                          and all(np.array_equal(seq_outs[r.uid], o)
+                                  for o in tp_outs.get(r.uid, ()))
                           and (speculative == 0 or
                                (np.array_equal(seq_outs[r.uid],
                                                spec_outs[r.uid])
@@ -274,6 +352,16 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
         if spec_res else None,
         "speedup_spec_vs_chunked_warm": (srv_warm / spec_res["wall_warm_s"])
         if spec_res else None,
+        "serving_tp": tp_res,
+        # the memory headline: per-chip KV pool bytes, replicated vs
+        # head-sharded — sharding shrinks the per-chip share by ~tp
+        "kv_bytes_per_chip_replicated":
+            stats_cold["kv_pool_bytes_per_chip"],
+        "kv_bytes_per_chip_tp": tp_res["kv_pool_bytes_per_chip"]
+        if tp_res else None,
+        "kv_per_chip_shrink": (stats_cold["kv_pool_bytes_per_chip"] /
+                               tp_res["kv_pool_bytes_per_chip"])
+        if tp_res else None,
         "token_parity": not mismatches,
         "mismatched_uids": mismatches,
         "model": f"gpt2-{layers}l-{hidden}d-{vocab}v ({dtype})",
@@ -307,6 +395,11 @@ def main():
     ap.add_argument("--speculative", type=int, default=0, metavar="K",
                     help="add a speculative lane: n-gram proposer drafting "
                          "K tokens per slot per iteration (0 = off)")
+    ap.add_argument("--tp", type=int, default=1, metavar="N",
+                    help="add a tensor-parallel lane: weights + paged KV "
+                         "pool sharded over an N-way tp mesh axis (needs "
+                         ">= N devices; on CPU set XLA_FLAGS="
+                         "--xla_force_host_platform_device_count=8)")
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
 
@@ -317,7 +410,7 @@ def main():
                     prefix_len=args.prefix_len, block_size=args.block_size,
                     prefill_chunk=args.prefill_chunk,
                     speculative=args.speculative,
-                    decode_heavy=args.decode_heavy)
+                    decode_heavy=args.decode_heavy, tp=args.tp)
     print(json.dumps(res, indent=2))
     if args.json:
         with open(args.json, "w") as f:

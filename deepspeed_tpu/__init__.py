@@ -131,7 +131,8 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
                  max_seq_len=None, prompt_buckets=None, prefill_batch=4,
                  block_size=32, num_blocks=None, chunked_prefill=None,
                  prefill_chunk=128, prefix_caching=True, spec_tokens=0,
-                 draft=None, ngram_max=3, ngram_min=1, **kwargs):
+                 draft=None, ngram_max=3, ngram_min=1, shard_kv=None,
+                 topology=None, **kwargs):
     """Continuous-batching serving entry: an ``init_inference`` engine
     wrapped in the block-paged scheduler (``inference/serving.py``).
     Mixed-length request traces run at iteration-level granularity over a
@@ -148,9 +149,30 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
     engine), or the model-free n-gram prompt-lookup proposer — and
     verifies the K+1 window in one batched target pass, committing the
     longest target-matching prefix.  Outputs stay token-exact with plain
-    greedy decode at any acceptance rate."""
+    greedy decode at any acceptance rate.
+
+    **Multi-chip serving**: ``topology=N`` (or ``{"tp": N}``) is shorthand
+    for ``config={"tensor_parallel": {"tp_size": N}}`` (overriding any
+    ``tensor_parallel`` already present) — the engine shards
+    weights Megatron-style over the ``tp`` mesh axis, and the serving
+    engine shards the paged KV pool over the KV-head dim so each chip
+    stores ``HKV/N`` heads (N× the servable blocks/context).  ``shard_kv``
+    (default auto) controls the pool sharding — see
+    :class:`~deepspeed_tpu.inference.serving.ServingEngine`."""
     from .inference.serving import ServingEngine
 
+    if topology is not None:
+        tp = int(topology) if not isinstance(topology, dict) else \
+            int(topology.get("tp", topology.get("tp_size", 1)))
+        # topology= wins over any tensor_parallel already in config/kwargs,
+        # and never mutates a caller-owned config object
+        if isinstance(config, dict):
+            config = {**config, "tensor_parallel": {"tp_size": tp}}
+        elif config is None:
+            kwargs["tensor_parallel"] = {"tp_size": tp}
+        else:
+            config = config.model_copy(deep=True)
+            config.tensor_parallel.tp_size = tp
     engine = init_inference(model, config, params, **kwargs)
     return ServingEngine(engine, slots=slots, max_seq_len=max_seq_len,
                          prompt_buckets=prompt_buckets,
@@ -160,4 +182,5 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
                          prefill_chunk=prefill_chunk,
                          prefix_caching=prefix_caching,
                          spec_tokens=spec_tokens, draft=draft,
-                         ngram_max=ngram_max, ngram_min=ngram_min)
+                         ngram_max=ngram_max, ngram_min=ngram_min,
+                         shard_kv=shard_kv)

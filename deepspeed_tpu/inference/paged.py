@@ -36,6 +36,18 @@ non-speculative path (refcounts make prefix-shared blocks survive).
 
 All of this is plain Python/numpy on the host; the device-side scatter /
 gather twins live in ``ops/paged_kv.py`` and ``ops/decode_attention.py``.
+
+**Tensor parallelism**: everything in this module is per-host and
+head-sharding-invariant.  Block ids, refcounts, and trie keys index
+PHYSICAL BLOCKS (position spans), never attention heads — when the
+serving engine shards the device pool over the KV-head dim
+(``NamedSharding(mesh, P(None, None, "tp"))``), every chip holds the same
+``num_blocks`` blocks, just a head slice of each, and the SAME block
+table drives every shard's scatter/gather.  Allocator and trie state
+therefore needs no replication, synchronization, or tp-aware branching:
+one host-side instance is correct at any tp degree, and scheduling
+decisions (admission, eviction, preemption) are bit-identical across
+topologies.
 """
 
 from __future__ import annotations

@@ -67,10 +67,28 @@ draft K-step rollout, and the verify pass.  ``stats()`` adds drafted/
 accepted counters and acceptance rate, plus per-request TTFT/TPOT
 percentiles (recorded for plain serving too).
 
+**Tensor parallelism** (``shard_kv``, default auto): when the engine's
+mesh carries a ``tp`` axis and the model's KV head count divides it, the
+paged pool (and the draft pool) is committed sharded over the KV-HEAD dim
+— ``NamedSharding(mesh, P(None, None, "tp"))`` on the stacked ``[L, NB,
+HKV, bs, hd]`` buffer — so each chip stores ``HKV/tp`` heads (pool
+capacity and decode memory bandwidth scale with the tp degree instead of
+being replicated).  Every device call runs under ``ops/paged_kv
+.tp_context``: the paged scatter/gather/attention ops trace inside
+``shard_map`` on their head shard with ZERO per-step KV collectives (the
+one tensor-parallel all-reduce stays after the output projection, exactly
+like the Megatron matmul path).  Block tables, the allocator, the prefix
+trie, and all scheduler state are host-side and head-sharding-invariant —
+they index blocks, never heads — so scheduling is bit-identical at any tp
+degree and the compile contract is unchanged.  GQA pools whose ``HKV``
+does not divide tp fall back to the replicated tp=1 layout (head groups
+are shared across chips); ``shard_kv=True`` then raises instead of
+silently replicating.
+
 Greedy decoding only: per-request outputs are token-identical to
 sequential ``generate`` (pinned in ``tests/unit/test_serving.py``,
-``tests/unit/test_paged_serving.py``, and
-``tests/unit/test_spec_decode.py``).
+``tests/unit/test_paged_serving.py``, ``tests/unit/test_spec_decode.py``,
+and — across tp degrees — ``tests/unit/test_tp_serving.py``).
 """
 
 from __future__ import annotations
@@ -86,7 +104,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..ops import paged_kv
 from ..ops.paged_kv import blocks_for
+from ..parallel.topology import TP_AXIS
 from ..utils.logging import log_dist
 from ..utils.lru import LRUCache
 from .paged import BlockAllocator, PrefixCache
@@ -237,6 +257,12 @@ class ServingEngine:
     spec_tokens:    speculative draft length K (0 = off; chunked mode
                     only).  Each decode iteration proposes K tokens per
                     slot and verifies them in one K+1-token target pass.
+    shard_kv:       shard the paged pool over the mesh's ``tp`` axis
+                    (KV-head dim — module docstring).  Default ``None`` =
+                    auto: shard iff tp > 1 and the KV head count divides
+                    it.  ``True`` additionally raises when the head count
+                    does not divide (instead of silently replicating);
+                    ``False`` forces the replicated tp=1 layout.
     draft:          draft proposer model — an ``init_inference`` engine or
                     a bare ModelSpec (wrapped with the target's inference
                     config) of a small same-family/same-tokenizer model.
@@ -258,7 +284,8 @@ class ServingEngine:
                  spec_tokens: int = 0,
                  draft=None,
                  ngram_max: int = 3,
-                 ngram_min: int = 1):
+                 ngram_min: int = 1,
+                 shard_kv: Optional[bool] = None):
         self.spec_tokens = int(spec_tokens)
         if self.spec_tokens < 0:
             raise ValueError(f"spec_tokens must be >= 0, got {spec_tokens}")
@@ -319,13 +346,30 @@ class ServingEngine:
         self._prefix = PrefixCache(self.block_size) \
             if (prefix_caching and self.chunked_prefill) else None
 
-        # single pool, committed replicated on the engine mesh so the very
-        # first step sees the same placement as every later one
+        # ----- tensor parallelism: one pool, committed on the engine mesh so
+        # the very first step sees the same placement as every later one —
+        # sharded over the KV-HEAD dim (``P(None, None, "tp")`` on the
+        # stacked [L, NB, HKV, bs, hd] buffer) when the mesh carries a tp
+        # axis the head count divides, else replicated (module docstring)
+        self.tp_degree = int(dict(engine.mesh.shape).get(TP_AXIS, 1))
+        pool = self._init_cache(num_blocks, self.block_size,
+                                engine._config.jnp_dtype)
+        self._pool_shape = tuple(jax.tree_util.tree_leaves(pool)[0].shape)
+        hkv = int(self._pool_shape[2])
+        divisible = self.tp_degree > 1 and hkv % self.tp_degree == 0
+        if shard_kv and self.tp_degree > 1 and not divisible:
+            raise ValueError(
+                f"shard_kv=True but the model's KV head count ({hkv}) does "
+                f"not divide the mesh tp axis ({self.tp_degree}) — GQA "
+                "pools with HKV < tp serve replicated (head groups are "
+                "shared across chips); drop shard_kv or lower tp_size")
+        self.kv_sharded = divisible if shard_kv is None else \
+            (bool(shard_kv) and divisible)
         rep = NamedSharding(engine.mesh, P())
+        pool_sharding = NamedSharding(engine.mesh, P(None, None, TP_AXIS)) \
+            if self.kv_sharded else rep
         self._cache = jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, rep),
-            self._init_cache(num_blocks, self.block_size,
-                             engine._config.jnp_dtype))
+            lambda x: jax.device_put(x, pool_sharding), pool)
         # host-side block tables; entry 0 = scratch doubles as "unset"
         self._tables = np.zeros((self.slots, self._nbper), np.int32)
         self._held: List[List[int]] = [[] for _ in range(self.slots)]
@@ -348,6 +392,7 @@ class ServingEngine:
         # ----- speculative decoding state
         self._draft = None                 # draft InferenceEngine
         self._dcache = None                # draft paged pool (shares tables)
+        self._dcache_sharded = False
         self._proposer = None              # host-side n-gram fallback
         if self.spec_tokens:
             if not self.chunked_prefill:
@@ -368,11 +413,28 @@ class ServingEngine:
                         f"{tv} — speculative decoding needs a shared "
                         "tokenizer")
                 self._draft = draft
+                dpool = draft.module.decode_hooks["init_cache"](
+                    num_blocks, self.block_size, draft._config.jnp_dtype)
+                dhkv = int(jax.tree_util.tree_leaves(dpool)[0].shape[2])
+                d_div = dhkv % self.tp_degree == 0
+                if self.kv_sharded and not d_div:
+                    if shard_kv:
+                        raise ValueError(
+                            f"shard_kv=True but the draft model's KV head "
+                            f"count ({dhkv}) does not divide the mesh tp "
+                            f"axis ({self.tp_degree}) — pick a draft whose "
+                            "heads divide tp, or drop shard_kv")
+                    log_dist(
+                        f"ServingEngine: draft KV head count {dhkv} does "
+                        f"not divide tp={self.tp_degree}; draft pool stays "
+                        "replicated (target pool is sharded)", ranks=[0])
+                # the draft pool rides the target's sharding story: same
+                # head-dim spec when its HKV divides tp, else replicated
+                # (the paged ops fall back per-shape — ops/paged_kv.py)
+                self._dcache_sharded = self.kv_sharded and d_div
+                dsharding = pool_sharding if self._dcache_sharded else rep
                 self._dcache = jax.tree_util.tree_map(
-                    lambda x: jax.device_put(x, rep),
-                    draft.module.decode_hooks["init_cache"](
-                        num_blocks, self.block_size,
-                        draft._config.jnp_dtype))
+                    lambda x: jax.device_put(x, dsharding), dpool)
             else:
                 self._proposer = NGramProposer(self.spec_tokens,
                                                max_n=ngram_max,
@@ -403,7 +465,20 @@ class ServingEngine:
             + f", prefill_batch={self.prefill_batch}"
             + (f", speculative K={self.spec_tokens} "
                f"({'draft ' + self._draft.module.name if self._draft else 'n-gram'})"
-               if self.spec_tokens else ""), ranks=[0])
+               if self.spec_tokens else "")
+            + (f", kv sharded over tp={self.tp_degree} "
+               f"({hkv // self.tp_degree} heads/chip)" if self.kv_sharded
+               else (f", kv replicated (tp={self.tp_degree})"
+                     if self.tp_degree > 1 else "")), ranks=[0])
+
+    def _tp_ctx(self):
+        """Context every compiled-fn invocation runs under: tracing happens
+        inside the call, so the paged device ops (``ops/paged_kv.py``,
+        ``ops/decode_attention.py``) bake THIS engine's mesh — or none —
+        into the program, even when engines of different tp degrees coexist
+        in one process."""
+        return paged_kv.tp_context(
+            self.engine.mesh if self.kv_sharded else None)
 
     # ------------------------------------------------------------ compiled fns
     @property
@@ -769,9 +844,10 @@ class ServingEngine:
             return
         bt = np.zeros_like(self._tables)
         bt[dec] = self._tables[dec]
-        nxt, self._cache = self._get_decode_fn()(
-            params, self._cache, jnp.asarray(self._tokens),
-            jnp.asarray(self._lengths), jnp.asarray(bt))
+        with self._tp_ctx():
+            nxt, self._cache = self._get_decode_fn()(
+                params, self._cache, jnp.asarray(self._tokens),
+                jnp.asarray(self._lengths), jnp.asarray(bt))
         nxt = np.asarray(nxt)
         self.decode_steps += 1
         for slot in dec:
@@ -821,10 +897,11 @@ class ServingEngine:
         bt = np.zeros_like(self._tables)
         bt[dec] = self._tables[dec]
         if self._draft is not None:
-            drafts, self._dcache = self._get_draft_fn()(
-                self._draft.params, self._dcache,
-                jnp.asarray(self._tokens), jnp.asarray(self._lengths),
-                jnp.asarray(bt))
+            with self._tp_ctx():
+                drafts, self._dcache = self._get_draft_fn()(
+                    self._draft.params, self._dcache,
+                    jnp.asarray(self._tokens), jnp.asarray(self._lengths),
+                    jnp.asarray(bt))
             drafts = np.asarray(drafts)
         else:
             drafts = np.zeros((self.slots, k), np.int32)
@@ -838,9 +915,10 @@ class ServingEngine:
         ids[dec, 0] = self._tokens[dec]
         ids[dec, 1:] = drafts[dec]
         valid[dec] = k + 1
-        scored, self._cache = self._get_verify_fn()(
-            params, self._cache, jnp.asarray(ids), jnp.asarray(bt),
-            jnp.asarray(self._lengths), jnp.asarray(valid))
+        with self._tp_ctx():
+            scored, self._cache = self._get_verify_fn()(
+                params, self._cache, jnp.asarray(ids), jnp.asarray(bt),
+                jnp.asarray(self._lengths), jnp.asarray(valid))
         scored = np.asarray(scored)
         self.spec_rounds += 1
         # a draft-model proposer caps acceptance at K-1: the K-th draft's
@@ -936,14 +1014,17 @@ class ServingEngine:
             valid[row] = v
             rows.append((slot, v))
         if self._draft is not None:
-            first, self._cache, self._dcache = self._get_prefill_fn(width)(
-                params, self._draft.params, self._cache, self._dcache,
-                jnp.asarray(ids), jnp.asarray(bt), jnp.asarray(base),
-                jnp.asarray(valid))
+            with self._tp_ctx():
+                first, self._cache, self._dcache = \
+                    self._get_prefill_fn(width)(
+                        params, self._draft.params, self._cache,
+                        self._dcache, jnp.asarray(ids), jnp.asarray(bt),
+                        jnp.asarray(base), jnp.asarray(valid))
         else:
-            first, self._cache = self._get_prefill_fn(width)(
-                params, self._cache, jnp.asarray(ids), jnp.asarray(bt),
-                jnp.asarray(base), jnp.asarray(valid))
+            with self._tp_ctx():
+                first, self._cache = self._get_prefill_fn(width)(
+                    params, self._cache, jnp.asarray(ids), jnp.asarray(bt),
+                    jnp.asarray(base), jnp.asarray(valid))
         first = np.asarray(first)
         self.prefill_calls += 1
         for row, (slot, v) in enumerate(rows):
@@ -970,6 +1051,31 @@ class ServingEngine:
                 finish(slot)
 
     # ------------------------------------------------------------------ stats
+    def _kv_footprint(self) -> Dict[str, Any]:
+        """KV memory accounting: pool shape, total logical bytes, and each
+        chip's share — ``total / tp`` when the pool is head-sharded, the
+        whole pool when replicated (the pool replicates across every other
+        mesh axis, so tp is the only divisor)."""
+        def _bytes(tree):
+            return int(sum(x.size * x.dtype.itemsize
+                           for x in jax.tree_util.tree_leaves(tree)))
+
+        total = _bytes(self._cache)
+        out = {
+            "tp_degree": self.tp_degree,
+            "kv_sharded": self.kv_sharded,
+            "kv_pool_shape": list(self._pool_shape),
+            "kv_pool_bytes": total,
+            "kv_pool_bytes_per_chip": total //
+            (self.tp_degree if self.kv_sharded else 1),
+        }
+        if self._dcache is not None:
+            dtotal = _bytes(self._dcache)
+            out["draft_pool_bytes"] = dtotal
+            out["draft_pool_bytes_per_chip"] = dtotal // \
+                (self.tp_degree if self._dcache_sharded else 1)
+        return out
+
     def _latency_stats(self) -> Dict[str, Any]:
         """TTFT/TPOT percentiles over every finished request (cumulative
         across serve calls, like the other counters)."""
@@ -984,8 +1090,9 @@ class ServingEngine:
     def stats(self) -> Dict[str, Any]:
         """Serving-loop observability: compile probe, prefix-cache hit
         rate, block occupancy, admission/eviction counters, per-request
-        latency percentiles, and — in speculative mode — draft/accept
-        counters and the acceptance rate."""
+        latency percentiles, the KV memory footprint (pool shape, total
+        bytes, bytes per chip under tp sharding), and — in speculative
+        mode — draft/accept counters and the acceptance rate."""
         st = {
             "mode": "chunked" if self.chunked_prefill else "bucketed",
             "compile_count": self.compile_count,
@@ -1018,5 +1125,6 @@ class ServingEngine:
             "acceptance_rate": (self.accepted_tokens / self.drafted_tokens
                                 if self.drafted_tokens else 0.0),
         }
+        st.update(self._kv_footprint())
         st.update(self._latency_stats())
         return st

@@ -32,6 +32,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from .paged_kv import _paged_gather, head_shard_map, head_shards, tp_axis
 
 NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 LANES = 128
@@ -192,7 +195,28 @@ def decode_attention(q, k_cache, v_cache, q_pos, *,
 # Block-paged attention (vLLM PagedAttention layout; ops/paged_kv.py holds
 # the layout contract).  KV lives in a shared pool [NB, HKV, bs, D]; each
 # row reaches its tokens through an int32 [B, NBPER] block table.
+#
+# Tensor parallelism: when ops/paged_kv carries a configured tp context and
+# the head counts divide its axis, each paged-attention entry point runs
+# its body inside shard_map — every chip attends its own HKV/tp (and H/tp
+# query) head shard against its own pool shard, block tables and positions
+# replicated.  Attention is embarrassingly parallel over heads, so no
+# collective appears here; the tensor-parallel all-reduce happens after the
+# model's output projection, exactly like the Megatron matmul path.
 # ---------------------------------------------------------------------------
+def _tp_shard_heads(body, q, k_pool, v_pool, block_tables, q_pos):
+    """Run ``body(q, k_pool, v_pool, bt, pos)`` sharded over the head dims
+    when the configured tp context divides them, else directly."""
+    n = head_shards(k_pool.shape[1], q.shape[1])
+    if n <= 1:
+        return body(q, k_pool, v_pool, block_tables, q_pos)
+    pos = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1),
+                           (q.shape[0],))
+    hs = P(None, tp_axis())
+    return head_shard_map(body, (hs, hs, hs, P(), P()), hs)(
+        q, k_pool, v_pool, jnp.asarray(block_tables, jnp.int32), pos)
+
+
 def paged_decode_attention_reference(q, k_pool, v_pool, block_tables, q_pos,
                                      *, sm_scale: Optional[float] = None):
     """Gather-based paged attention (pure XLA): materialize each row's
@@ -204,11 +228,14 @@ def paged_decode_attention_reference(q, k_pool, v_pool, block_tables, q_pos,
     block_tables: int32 [B, NBPER]
     q_pos:        scalar or int32 [B] — global position of q[:, :, 0]
     """
-    from .paged_kv import paged_gather
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
 
-    k = paged_gather(k_pool, block_tables)
-    v = paged_gather(v_pool, block_tables)
-    return decode_attention_reference(q, k, v, q_pos, sm_scale=sm_scale)
+    def body(q, kp, vp, bt, pos):
+        k = _paged_gather(kp, bt)
+        v = _paged_gather(vp, bt)
+        return decode_attention_reference(q, k, v, pos, sm_scale=scale)
+
+    return _tp_shard_heads(body, q, k_pool, v_pool, block_tables, q_pos)
 
 
 def _paged_decode_kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
@@ -230,19 +257,16 @@ def _paged_decode_kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
                    acc_scr, sm_scale=sm_scale, block_k=block_size)
 
 
-def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables, q_pos, *,
-                                  sm_scale: Optional[float] = None,
-                                  interpret: Optional[bool] = None):
-    """Single-token paged decode: q [B, H, 1, D] against the block pool,
-    walking each row's block table in-kernel via scalar prefetch."""
+def _paged_decode_pallas(q, k_pool, v_pool, block_tables, q_pos, *,
+                         sm_scale: float, interpret: bool):
+    """Single-shard kernel launch of :func:`paged_decode_attention_pallas`
+    (shapes may be the full head count or one tp shard's slice — the grid
+    and GQA grouping are computed from the local arrays either way)."""
     b, h, t, d = q.shape
-    assert t == 1, "pallas paged decode is single-token; use the XLA path"
     nb, hkv, bs, _ = k_pool.shape
     rep = h // hkv
     nbper = block_tables.shape[1]
-    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
-    if interpret is None:
-        interpret = _use_interpret()
+    scale = sm_scale
 
     qg = q[:, :, 0, :].reshape(b, hkv, rep, d)        # [B, HKV, rep, D]
     pos = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1), (b,))
@@ -279,6 +303,23 @@ def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables, q_pos, *,
         interpret=interpret,
     )(pos, bt, qg, k_pool, v_pool)
     return out.reshape(b, h, 1, d)
+
+
+def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables, q_pos, *,
+                                  sm_scale: Optional[float] = None,
+                                  interpret: Optional[bool] = None):
+    """Single-token paged decode: q [B, H, 1, D] against the block pool,
+    walking each row's block table in-kernel via scalar prefetch.  Under a
+    configured tp context each chip launches the kernel on its own head
+    shard of q and the pool."""
+    assert q.shape[2] == 1, \
+        "pallas paged decode is single-token; use the XLA path"
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = _use_interpret()
+    body = functools.partial(_paged_decode_pallas, sm_scale=scale,
+                             interpret=interpret)
+    return _tp_shard_heads(body, q, k_pool, v_pool, block_tables, q_pos)
 
 
 def _paged_verify_kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
@@ -344,23 +385,15 @@ def _paged_verify_kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
 VERIFY_T_MAX = 16
 
 
-def paged_verify_attention_pallas(q, k_pool, v_pool, block_tables, q_pos, *,
-                                  sm_scale: Optional[float] = None,
-                                  interpret: Optional[bool] = None):
-    """Speculative-verify paged attention: q [B, H, T, D] with T = K+1
-    window positions per row, each row's window starting at its own
-    ``q_pos[b]`` base (scalar q_pos broadcasts).  Same scalar-prefetch
-    block-table walk as the single-token kernel; the T query rows ride in
-    the row dim of one [rep*T, D] tile per (row, KV-head) grid step."""
+def _paged_verify_pallas(q, k_pool, v_pool, block_tables, q_pos, *,
+                         sm_scale: float, interpret: bool):
+    """Single-shard kernel launch of :func:`paged_verify_attention_pallas`
+    (shapes may be the full head count or one tp shard's slice)."""
     b, h, t, d = q.shape
-    assert 1 <= t <= VERIFY_T_MAX, \
-        f"verify kernel takes windows up to {VERIFY_T_MAX}, got T={t}"
     nb, hkv, bs, _ = k_pool.shape
     rep = h // hkv
     nbper = block_tables.shape[1]
-    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
-    if interpret is None:
-        interpret = _use_interpret()
+    scale = sm_scale
 
     # [B, H, T, D] -> [B, HKV, rep*T, D]: row r*T + i = (head r of the KV
     # group, window offset i) — matches the repeat-based GQA grouping
@@ -399,6 +432,27 @@ def paged_verify_attention_pallas(q, k_pool, v_pool, block_tables, q_pos, *,
         interpret=interpret,
     )(pos, bt, qg, k_pool, v_pool)
     return out.reshape(b, hkv, rep, t, d).reshape(b, h, t, d)
+
+
+def paged_verify_attention_pallas(q, k_pool, v_pool, block_tables, q_pos, *,
+                                  sm_scale: Optional[float] = None,
+                                  interpret: Optional[bool] = None):
+    """Speculative-verify paged attention: q [B, H, T, D] with T = K+1
+    window positions per row, each row's window starting at its own
+    ``q_pos[b]`` base (scalar q_pos broadcasts).  Same scalar-prefetch
+    block-table walk as the single-token kernel; the T query rows ride in
+    the row dim of one [rep*T, D] tile per (row, KV-head) grid step.
+    Under a configured tp context each chip launches the kernel on its own
+    head shard of q and the pool."""
+    t = q.shape[2]
+    assert 1 <= t <= VERIFY_T_MAX, \
+        f"verify kernel takes windows up to {VERIFY_T_MAX}, got T={t}"
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = _use_interpret()
+    body = functools.partial(_paged_verify_pallas, sm_scale=scale,
+                             interpret=interpret)
+    return _tp_shard_heads(body, q, k_pool, v_pool, block_tables, q_pos)
 
 
 def paged_decode_attention(q, k_pool, v_pool, block_tables, q_pos, *,

@@ -73,6 +73,40 @@ def _undo_bias_correction(b1: float, b2: float) -> optax.GradientTransformation:
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def scale_by_clamped_trust_ratio(
+        min_coeff: float = 0.01,
+        max_coeff: float = 0.3) -> optax.GradientTransformation:
+    """``optax.scale_by_trust_ratio`` with the reference LAMB kernel's
+    coefficient clamp (``fused_lamb_cuda_kernel.cu``): per-leaf trust ratio
+    ``||p|| / ||u||`` clamped to ``[min_coeff, max_coeff]``; a zero param
+    or update norm keeps the kernel's neutral ratio of 1 (unclamped — there
+    is nothing to trust-scale)."""
+    if not 0.0 < min_coeff <= max_coeff:
+        raise ValueError(
+            f"need 0 < min_coeff <= max_coeff, got [{min_coeff}, "
+            f"{max_coeff}]")
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("scale_by_clamped_trust_ratio needs params")
+
+        def scale(u, p):
+            p_norm = jnp.linalg.norm(p.astype(jnp.float32))
+            u_norm = jnp.linalg.norm(u.astype(jnp.float32))
+            ratio = jnp.clip(p_norm / jnp.where(u_norm == 0.0, 1.0, u_norm),
+                             min_coeff, max_coeff)
+            ratio = jnp.where((p_norm == 0.0) | (u_norm == 0.0), 1.0, ratio)
+            return (u * ratio.astype(u.dtype)).astype(u.dtype)
+
+        return jax.tree_util.tree_map(scale, updates, params), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def fused_lamb(lr: ScalarOrSchedule = 1e-3, betas: Tuple[float, float] = (0.9, 0.999),
                eps: float = 1e-8, weight_decay: float = 0.0,
                min_coeff: float = 0.01,
@@ -80,11 +114,10 @@ def fused_lamb(lr: ScalarOrSchedule = 1e-3, betas: Tuple[float, float] = (0.9, 0
     """LAMB with the reference's trust-ratio clamp (``fused_lamb_cuda_kernel.cu``
     clamps the coefficient to [min_coeff, max_coeff])."""
     b1, b2 = betas
-    del min_coeff, max_coeff  # trust ratio clamp TODO: recompose scale_by_trust_ratio
     return optax.chain(
         optax.scale_by_adam(b1=b1, b2=b2, eps=eps),
         optax.add_decayed_weights(weight_decay),
-        optax.scale_by_trust_ratio(),
+        scale_by_clamped_trust_ratio(min_coeff, max_coeff),
         _scale_by_learning_rate(lr))
 
 

@@ -29,15 +29,99 @@ Speculative-decoding windows lean on two properties of this contract:
    (``pos // block_size`` / ``pos % block_size`` addressing — same block,
    same offset).  Refcounts never move on rollback.
 
+**Tensor parallelism** (Megatron-style ``tp`` mesh axis): the serving
+engine commits the pool sharded over the KV-HEAD dim
+(``NamedSharding(mesh, P(None, None, "tp"))`` on the stacked ``[L, NB,
+HKV, bs, hd]`` buffer) and installs its mesh here via :func:`configure`.
+Every paged op then runs inside ``shard_map`` — each chip scatters/
+gathers/attends over only its own ``HKV/tp`` head shard of the pool, with
+ZERO per-step KV collectives (the head dim is fully data-parallel across
+chips; the one all-reduce of tensor-parallel attention happens after the
+output projection, outside these ops, exactly like the matmul path).
+Block ids, tables, and positions are head-invariant, so they replicate
+into every shard unchanged.  Pools whose head count does not divide the
+axis (GQA with HKV < tp) simply skip the wrapping — ``head_shards``
+returns 1 and the op runs replicated, bit-identical to tp=1.
+
 Everything here is pure XLA (scatter / gather), shared by prefill and the
 CPU/correctness decode path; the TPU kernels that walk the block table
 in-kernel live in ``ops/decode_attention.py``
-(``paged_decode_attention_pallas`` / ``paged_verify_attention_pallas``).
+(``paged_decode_attention_pallas`` / ``paged_verify_attention_pallas``)
+and shard through the same context.
 """
 
 from __future__ import annotations
 
+import contextlib
+
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+# ------------------------------------------------------------- tp context
+#: Mesh the paged ops shard over (``None`` = replicated pools, plain XLA
+#: ops, the tp=1 behavior).  Installed by ``ServingEngine`` when it commits
+#: a head-sharded pool; module-level — like ``ops/quantized_matmul
+#: .configure`` — so the model families' ``forward_cached`` stay
+#: mesh-agnostic.
+_TP_MESH = None
+_TP_AXIS = "tp"
+
+
+def configure(mesh=None, axis: str = "tp") -> None:
+    """Install (mesh + axis name) or clear (``None``) the tensor-parallel
+    context for the paged device ops.  With a mesh installed, every paged
+    op whose head dims divide the axis runs inside ``shard_map`` on its own
+    KV-head shard."""
+    global _TP_MESH, _TP_AXIS
+    _TP_MESH = mesh
+    _TP_AXIS = axis
+
+
+@contextlib.contextmanager
+def tp_context(mesh, axis: str = "tp"):
+    """Scoped :func:`configure`: install the tp context for the duration of
+    a block and restore whatever was there before.  The serving engine
+    wraps every device invocation in this — tracing happens inside the
+    call, so each engine's programs bake in ITS mesh (or none) even when
+    engines of different tp degrees coexist in one process."""
+    prev = (_TP_MESH, _TP_AXIS)
+    configure(mesh, axis)
+    try:
+        yield
+    finally:
+        configure(*prev)
+
+
+def tp_mesh():
+    return _TP_MESH
+
+
+def tp_axis() -> str:
+    return _TP_AXIS
+
+
+def head_shards(*head_counts: int) -> int:
+    """Shard count the configured tp context puts on the given head dims:
+    the mesh's tp-axis size when EVERY count divides it, else 1 — the
+    replicated fallback for GQA pools with fewer KV heads than chips (head
+    groups are shared) and for odd head counts."""
+    if _TP_MESH is None:
+        return 1
+    n = int(dict(_TP_MESH.shape).get(_TP_AXIS, 1))
+    if n <= 1:
+        return 1
+    return n if all(int(h) % n == 0 for h in head_counts) else 1
+
+
+def head_shard_map(fn, in_specs, out_specs):
+    """``shard_map`` over the configured mesh.  Callers place
+    :func:`tp_axis` on HEAD dims only, so the body is embarrassingly
+    parallel across chips — no collective ever appears inside
+    (``check_rep=False``: outputs are sharded, not replicated)."""
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(fn, mesh=_TP_MESH, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
 
 
 def blocks_for(num_tokens: int, block_size: int) -> int:
@@ -47,19 +131,9 @@ def blocks_for(num_tokens: int, block_size: int) -> int:
     return -(-int(num_tokens) // int(block_size))
 
 
-def paged_cache_update(ck, cv, k, v, pos, block_tables, valid=None):
-    """Scatter a window of new keys/values into the paged pool.
-
-    ck/cv:         [NB, HKV, block_size, hd] pool (one layer)
-    k/v:           [B, HKV, T, hd] — T new tokens per row
-    pos:           int32 scalar or [B] — global position of ``k[:, :, 0]``
-                   per row (T == 1 decode: each row's own position; T > 1
-                   chunked prefill: each row's chunk base)
-    block_tables:  int32 [B, NBPER]
-    valid:         optional int32 [B] — tokens of the T-window that are
-                   real (default all T).  Invalid tokens, and positions
-                   past the table's reach, write to scratch block 0.
-    """
+def _paged_cache_update(ck, cv, k, v, pos, block_tables, valid=None):
+    """Single-shard scatter body of :func:`paged_cache_update` — also the
+    whole op when the pool is replicated (tp=1 / GQA fallback)."""
     b, hkv, t, hd = k.shape
     bs = ck.shape[2]
     nbper = block_tables.shape[1]
@@ -83,12 +157,57 @@ def paged_cache_update(ck, cv, k, v, pos, block_tables, valid=None):
     return ck, cv
 
 
-def paged_gather(pool_leaf, block_tables):
-    """Materialize each row's logical cache view from the pool:
-    ``[NB, HKV, bs, hd]`` through ``int32 [B, NBPER]`` tables ->
-    ``[B, HKV, NBPER*bs, hd]``.  Unset (scratch) entries gather garbage
-    that sits past every row's valid length — callers mask by position."""
+def paged_cache_update(ck, cv, k, v, pos, block_tables, valid=None):
+    """Scatter a window of new keys/values into the paged pool.
+
+    ck/cv:         [NB, HKV, block_size, hd] pool (one layer)
+    k/v:           [B, HKV, T, hd] — T new tokens per row
+    pos:           int32 scalar or [B] — global position of ``k[:, :, 0]``
+                   per row (T == 1 decode: each row's own position; T > 1
+                   chunked prefill: each row's chunk base)
+    block_tables:  int32 [B, NBPER]
+    valid:         optional int32 [B] — tokens of the T-window that are
+                   real (default all T).  Invalid tokens, and positions
+                   past the table's reach, write to scratch block 0.
+
+    Under a configured tp context (module docstring) the scatter runs in
+    ``shard_map``: each chip writes its own head shard of the pool (the
+    k/v window arrives already head-sharded from the column-parallel kv
+    projections); positions/tables replicate.
+    """
+    b = k.shape[0]
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (b,))
+    n = head_shards(ck.shape[1], k.shape[1])
+    if n <= 1:
+        return _paged_cache_update(ck, cv, k, v, pos, block_tables, valid)
+    hs = P(None, _TP_AXIS)
+    valid = jnp.full((b,), k.shape[2], jnp.int32) if valid is None \
+        else jnp.asarray(valid, jnp.int32)
+    return head_shard_map(
+        _paged_cache_update, (hs, hs, hs, hs, P(), P(), P()), (hs, hs))(
+            ck, cv, k, v, pos, jnp.asarray(block_tables, jnp.int32), valid)
+
+
+def _paged_gather(pool_leaf, block_tables):
+    """Single-shard gather body of :func:`paged_gather` — called directly
+    by the in-``shard_map`` attention bodies (``ops/decode_attention.py``)
+    so sharded callers never re-enter the wrapper."""
     nb, hkv, bs, hd = pool_leaf.shape
     b, nbper = block_tables.shape
     g = pool_leaf[jnp.maximum(block_tables, 0)]     # [B, NBPER, HKV, bs, hd]
     return g.transpose(0, 2, 1, 3, 4).reshape(b, hkv, nbper * bs, hd)
+
+
+def paged_gather(pool_leaf, block_tables):
+    """Materialize each row's logical cache view from the pool:
+    ``[NB, HKV, bs, hd]`` through ``int32 [B, NBPER]`` tables ->
+    ``[B, HKV, NBPER*bs, hd]``.  Unset (scratch) entries gather garbage
+    that sits past every row's valid length — callers mask by position.
+    Under a configured tp context each chip gathers only its own head
+    shard (output sharded ``[B, HKV/tp, S, hd]`` per chip)."""
+    n = head_shards(pool_leaf.shape[1])
+    if n <= 1:
+        return _paged_gather(pool_leaf, block_tables)
+    hs = P(None, _TP_AXIS)
+    return head_shard_map(_paged_gather, (hs, P()), hs)(
+        pool_leaf, jnp.asarray(block_tables, jnp.int32))

@@ -357,3 +357,86 @@ def test_paged_pallas_kernel_under_jit_traced_tables():
                                           jnp.asarray(vc), lengths)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-5, atol=2e-5)
+
+
+# -------------------------------------------- tensor-parallel kernel shards
+def _tp_mesh(n):
+    """A (1,1,1,1,n) mesh over the first n CPU-sim devices — the tp slice
+    of the engine topology the serving engine installs via tp_context."""
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:n]).reshape(1, 1, 1, 1, n)
+    return Mesh(devs, ("pp", "dp", "ep", "sp", "tp"))
+
+
+@pytest.mark.parametrize("h,hkv,tp", [(4, 4, 2), (8, 4, 4), (8, 2, 2)])
+def test_paged_pallas_kernel_sharded_matches_reference(h, hkv, tp):
+    """Under a configured tp context each chip launches the decode kernel
+    on its own HKV/tp head shard of q and the pool; the assembled global
+    output equals the unsharded reference bit-for-tolerance."""
+    from deepspeed_tpu.ops import paged_kv
+
+    rng = np.random.default_rng(20)
+    b, s, d, bs = 4, 256, 64, 64
+    kc = rng.standard_normal((b, hkv, s, d)).astype(np.float32)
+    vc = rng.standard_normal((b, hkv, s, d)).astype(np.float32)
+    kp, vp, bt = _paged_from_contiguous(kc, vc, 2 * b * (s // bs), bs, rng)
+    q = jnp.asarray(rng.standard_normal((b, h, 1, d)), jnp.float32)
+    lengths = jnp.asarray([0, 17, 200, 255], jnp.int32)
+    want = decode_attention_reference(q, jnp.asarray(kc), jnp.asarray(vc),
+                                      lengths)
+    with paged_kv.tp_context(_tp_mesh(tp)):
+        got = jax.jit(
+            lambda *a: paged_decode_attention_pallas(*a, interpret=True))(
+            q, jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(bt), lengths)
+        ref = jax.jit(paged_decode_attention_reference)(
+            q, jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(bt), lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("h,hkv,tp,t", [(4, 4, 2, 4), (8, 2, 2, 5)])
+def test_paged_verify_pallas_kernel_sharded_matches_reference(h, hkv, tp, t):
+    """The K+1 verify window shards over heads exactly like single-token
+    decode (the T query rows ride inside each head-shard's tile)."""
+    from deepspeed_tpu.ops import paged_kv
+
+    rng = np.random.default_rng(21)
+    b, s, d, bs = 4, 256, 64, 64
+    kc = rng.standard_normal((b, hkv, s, d)).astype(np.float32)
+    vc = rng.standard_normal((b, hkv, s, d)).astype(np.float32)
+    kp, vp, bt = _paged_from_contiguous(kc, vc, 2 * b * (s // bs), bs, rng)
+    q = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    bases = jnp.asarray([0, 17, 62, 256 - t], jnp.int32)
+    want = decode_attention_reference(q, jnp.asarray(kc), jnp.asarray(vc),
+                                      bases)
+    with paged_kv.tp_context(_tp_mesh(tp)):
+        got = jax.jit(
+            lambda *a: paged_verify_attention_pallas(*a, interpret=True))(
+            q, jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(bt), bases)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_ops_gqa_below_tp_fall_back_replicated():
+    """HKV smaller than the tp axis cannot shard: head_shards reports 1 and
+    the ops run the replicated path — identical results, no error."""
+    from deepspeed_tpu.ops import paged_kv
+
+    rng = np.random.default_rng(22)
+    b, h, hkv, s, d, bs = 2, 8, 2, 128, 32, 32
+    kc = rng.standard_normal((b, hkv, s, d)).astype(np.float32)
+    vc = rng.standard_normal((b, hkv, s, d)).astype(np.float32)
+    kp, vp, bt = _paged_from_contiguous(kc, vc, 2 * b * (s // bs), bs, rng)
+    q = jnp.asarray(rng.standard_normal((b, h, 1, d)), jnp.float32)
+    lengths = jnp.asarray([5, 100], jnp.int32)
+    want = decode_attention_reference(q, jnp.asarray(kc), jnp.asarray(vc),
+                                      lengths)
+    with paged_kv.tp_context(_tp_mesh(4)):
+        assert paged_kv.head_shards(hkv, h) == 1      # 2 % 4 != 0
+        got = paged_decode_attention_reference(
+            q, jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(bt), lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)

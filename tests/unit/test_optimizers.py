@@ -1,0 +1,82 @@
+"""Optimizer ops: the LAMB trust-ratio clamp (reference
+``fused_lamb_cuda_kernel.cu`` clamps the per-leaf coefficient to
+``[min_coeff, max_coeff]``) and the fused_lamb chain around it."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.optimizers import (fused_lamb,
+                                          scale_by_clamped_trust_ratio)
+
+
+def _apply(tx, updates, params):
+    state = tx.init(params)
+    out, _ = tx.update(updates, state, params)
+    return out
+
+
+def test_trust_ratio_clamps_low_edge():
+    """||p||/||u|| below min_coeff scales by exactly min_coeff."""
+    tx = scale_by_clamped_trust_ratio(0.01, 0.3)
+    p = {"w": jnp.full((4,), 0.0005)}           # ||p|| = 0.001
+    u = {"w": jnp.full((4,), 0.5)}              # ||u|| = 1.0
+    out = _apply(tx, u, p)
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               np.asarray(u["w"]) * 0.01, rtol=1e-6)
+
+
+def test_trust_ratio_clamps_high_edge():
+    """||p||/||u|| above max_coeff scales by exactly max_coeff."""
+    tx = scale_by_clamped_trust_ratio(0.01, 0.3)
+    p = {"w": jnp.full((4,), 50.0)}             # ||p|| = 100
+    u = {"w": jnp.full((4,), 0.5)}              # ||u|| = 1.0
+    out = _apply(tx, u, p)
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               np.asarray(u["w"]) * 0.3, rtol=1e-6)
+
+
+def test_trust_ratio_in_range_passes_through():
+    tx = scale_by_clamped_trust_ratio(0.01, 0.3)
+    p = {"w": jnp.full((4,), 0.05)}             # ||p|| = 0.1
+    u = {"w": jnp.full((4,), 0.5)}              # ||u|| = 1.0
+    out = _apply(tx, u, p)
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               np.asarray(u["w"]) * 0.1, rtol=1e-6)
+
+
+def test_trust_ratio_zero_norms_stay_neutral():
+    """A zero param or update norm keeps ratio 1 (kernel semantics) — in
+    particular a zero update must stay zero, not become NaN."""
+    tx = scale_by_clamped_trust_ratio(0.01, 0.3)
+    p = {"a": jnp.zeros((3,)), "b": jnp.ones((3,))}
+    u = {"a": jnp.ones((3,)), "b": jnp.zeros((3,))}
+    out = _apply(tx, u, p)
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.ones(3))
+    np.testing.assert_array_equal(np.asarray(out["b"]), np.zeros(3))
+
+
+def test_trust_ratio_validates_bounds_and_params():
+    with pytest.raises(ValueError, match="min_coeff"):
+        scale_by_clamped_trust_ratio(0.0, 0.3)
+    with pytest.raises(ValueError, match="min_coeff"):
+        scale_by_clamped_trust_ratio(0.5, 0.3)
+    tx = scale_by_clamped_trust_ratio()
+    with pytest.raises(ValueError, match="params"):
+        tx.update({"w": jnp.ones(2)}, tx.init({"w": jnp.ones(2)}), None)
+
+
+def test_fused_lamb_step_applies_clamped_ratio():
+    """End-to-end: with huge params the unclamped ratio would be enormous;
+    the clamp caps the step at max_coeff * lr * adam_direction."""
+    lr, max_coeff = 0.1, 0.3
+    tx = fused_lamb(lr=lr, weight_decay=0.0, max_coeff=max_coeff)
+    p = {"w": jnp.full((4,), 1e6)}
+    g = {"w": jnp.full((4,), 1.0)}
+    state = tx.init(p)
+    upd, _ = tx.update(g, state, p)
+    # first adam step normalizes to ~1 per element -> ||u|| ~ 2; ratio
+    # ||p||/||u|| ~ 1e6 >> max_coeff -> clamped
+    np.testing.assert_allclose(np.asarray(upd["w"]),
+                               -lr * max_coeff * np.ones(4), rtol=1e-3)
+    assert np.all(np.isfinite(np.asarray(upd["w"])))

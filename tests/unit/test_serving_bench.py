@@ -81,3 +81,19 @@ def test_serving_bench_prefix_heavy_trace():
     assert res["speedup_vs_bucketed_warm"] >= 1.5, res
     # compiles included, the paged path must still not lose
     assert res["speedup_vs_bucketed"] >= 1.0, res
+
+
+def test_serving_bench_tp_lane_shrinks_per_chip_kv():
+    """The BENCH_r06 acceptance lane (small edition): the --tp lane serves
+    the same trace token-exactly on a tensor-parallel mesh with the paged
+    pool head-sharded — per-chip KV bytes shrink by exactly tp and the
+    2-program compile contract holds."""
+    import serving_bench
+
+    res = serving_bench.run_bench(requests=8, slots=4, layers=1, hidden=64,
+                                  heads=4, vocab=512, seed=0, tp=2)
+    assert res["token_parity"], res["mismatched_uids"]
+    tp = res["serving_tp"]
+    assert tp["kv_sharded"] and tp["compiled_programs"] == 2
+    assert res["kv_per_chip_shrink"] == 2.0
+    assert res["kv_bytes_per_chip_tp"] * 2 == res["kv_bytes_per_chip_replicated"]

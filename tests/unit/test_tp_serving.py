@@ -1,0 +1,275 @@
+"""Tensor-parallel paged serving: the KV pool and paged-attention ops
+shard over the mesh ``tp`` axis (KV-head dim) with token-exact parity.
+
+Tier-1 (fast) CPU-sim coverage on the 8-device mesh (conftest):
+ - tp=1 vs tp=4 exact-token parity: plain chunked, prefix-heavy,
+   speculative (n-gram), and under preemption pressure.
+ - per-chip pool placement: ``addressable_shards`` carry ``HKV/tp`` heads
+   and the sharding survives a full serve (the compiled programs hand the
+   pool back with the same layout they received).
+ - compile contract under tp: 2 programs plain, <= 3 speculative.
+ - GQA head-divisibility: HKV < tp auto-falls-back to the replicated
+   layout (parity intact); ``shard_kv=True`` then raises instead; a
+   divisible GQA pool (tp=2, HKV=2) shards.
+ - ``stats()`` KV footprint: ``kv_pool_bytes_per_chip`` scales 1/tp.
+
+The scheduler (allocator, prefix trie, block tables) is host-side and
+head-sharding-invariant, so admission order and compile counts are
+bit-identical across tp degrees — the parity tests exercise exactly that.
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.serving import Request, ServingEngine
+from deepspeed_tpu.models import gpt2, llama
+
+
+def _mk_engine(tp, cfg):
+    deepspeed_tpu.comm.reset_topology()
+    return deepspeed_tpu.init_inference(
+        gpt2.build(cfg),
+        config={"dtype": "fp32", "tensor_parallel": {"tp_size": tp}})
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return gpt2.GPT2Config.tiny(max_seq_len=128)
+
+
+@pytest.fixture(scope="module")
+def tp1_engine(tiny_cfg):
+    return _mk_engine(1, tiny_cfg)
+
+
+@pytest.fixture(scope="module")
+def tp4_engine(tiny_cfg):
+    return _mk_engine(4, tiny_cfg)
+
+
+def _trace(cfg, n, prefix_len=24, seed=0, tail=(3, 10), max_new=(2, 10)):
+    rng = np.random.default_rng(seed)
+    prefix = rng.integers(0, cfg.vocab_size, prefix_len)
+    return [Request(uid=i,
+                    prompt=np.concatenate(
+                        [prefix, rng.integers(0, cfg.vocab_size,
+                                              int(rng.integers(*tail)))]),
+                    max_new_tokens=int(rng.integers(*max_new)))
+            for i in range(n)]
+
+
+def _serve_pair(e1, e4, cfg, seed, **srv_kw):
+    """Serve the same trace at tp=1 and tp=4; return both result dicts and
+    the two engines' ServingEngines."""
+    kw = dict(slots=4, max_seq_len=128, block_size=8, prefill_chunk=16,
+              prefill_batch=2)
+    kw.update(srv_kw)
+    s1 = ServingEngine(e1, **kw)
+    s4 = ServingEngine(e4, **kw)
+    reqs = _trace(cfg, 6, seed=seed)
+    r1 = s1.serve(reqs)
+    r4 = s4.serve(_trace(cfg, 6, seed=seed))   # fresh Request objects
+    return r1, r4, s1, s4
+
+
+def test_tp4_parity_prefix_heavy_and_pool_shards(tp1_engine, tp4_engine,
+                                                 tiny_cfg):
+    """Acceptance: tp=4 serving is token-exact vs tp=1 (and vs sequential
+    generate) on a prefix-heavy trace; the pool's per-chip shard is HKV/4
+    heads before AND after the serve; compile contract stays 2 programs."""
+    r1, r4, s1, s4 = _serve_pair(tp1_engine, tp4_engine, tiny_cfg, seed=0)
+    assert s4.kv_sharded and s4.tp_degree == 4
+    hkv = tiny_cfg.num_heads
+    for leaf in (s4._cache["k"], s4._cache["v"]):
+        assert leaf.shape[2] == hkv
+        for shard in leaf.addressable_shards:
+            assert shard.data.shape[2] == hkv // 4
+    for r in _trace(tiny_cfg, 6, seed=0):
+        want = tp1_engine.generate(r.prompt[None, :],
+                                   max_new_tokens=r.max_new_tokens)[0]
+        np.testing.assert_array_equal(r1[r.uid], want, err_msg=f"tp1 {r.uid}")
+        np.testing.assert_array_equal(r4[r.uid], want, err_msg=f"tp4 {r.uid}")
+    assert s4.compile_count == 2, s4.compiled_programs
+    # scheduler state is head-sharding-invariant: identical counters
+    assert s4.prefix_hit_tokens == s1.prefix_hit_tokens
+    assert s4.decode_steps == s1.decode_steps
+
+
+def test_tp4_parity_speculative_and_compile_contract(tp1_engine, tp4_engine,
+                                                     tiny_cfg):
+    """Speculative (n-gram) serving under tp=4: token-exact vs tp=1 and
+    the <= 3-program contract holds unchanged (2 in n-gram mode)."""
+    r1, r4, s1, s4 = _serve_pair(tp1_engine, tp4_engine, tiny_cfg, seed=1,
+                                 spec_tokens=3)
+    for uid in r1:
+        np.testing.assert_array_equal(r1[uid], r4[uid], err_msg=f"uid {uid}")
+    assert s4.compile_count <= 3, s4.compiled_programs
+    assert s4.compile_count == s1.compile_count
+    assert s4.spec_rounds == s1.spec_rounds
+
+
+def test_tp4_parity_under_preemption(tp1_engine, tp4_engine, tiny_cfg):
+    """Block pressure (preemption + recompute) resolves identically at any
+    tp degree — the allocator never sees head counts."""
+    kw = dict(slots=3, max_seq_len=64, block_size=8, prefill_chunk=32,
+              prefill_batch=2, num_blocks=12)
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, tiny_cfg.vocab_size, 17) for _ in range(5)]
+    s1 = ServingEngine(tp1_engine, **kw)
+    s4 = ServingEngine(tp4_engine, **kw)
+    r1 = s1.serve([Request(uid=i, prompt=p, max_new_tokens=28)
+                   for i, p in enumerate(prompts)])
+    r4 = s4.serve([Request(uid=i, prompt=p, max_new_tokens=28)
+                   for i, p in enumerate(prompts)])
+    assert s4.preempted > 0 and s4.preempted == s1.preempted
+    for uid in r1:
+        np.testing.assert_array_equal(r1[uid], r4[uid], err_msg=f"uid {uid}")
+
+
+def test_shard_kv_false_forces_replicated(tp4_engine):
+    srv = ServingEngine(tp4_engine, slots=2, max_seq_len=64, block_size=8,
+                        shard_kv=False)
+    assert not srv.kv_sharded
+    leaf = srv._cache["k"]
+    for shard in leaf.addressable_shards:
+        assert shard.data.shape == leaf.shape      # fully replicated
+
+
+def test_stats_kv_footprint_scales_with_tp(tp1_engine, tp4_engine):
+    kw = dict(slots=2, max_seq_len=64, block_size=8)
+    st1 = ServingEngine(tp1_engine, **kw).stats()
+    st4 = ServingEngine(tp4_engine, **kw).stats()
+    assert st1["tp_degree"] == 1 and not st1["kv_sharded"]
+    assert st4["tp_degree"] == 4 and st4["kv_sharded"]
+    assert st1["kv_pool_bytes"] == st4["kv_pool_bytes"]
+    assert st1["kv_pool_bytes_per_chip"] == st1["kv_pool_bytes"]
+    assert st4["kv_pool_bytes_per_chip"] * 4 == st4["kv_pool_bytes"]
+    assert tuple(st4["kv_pool_shape"]) == tuple(st1["kv_pool_shape"])
+
+
+def test_gqa_indivisible_heads_fall_back_or_raise():
+    """llama-tiny has HKV=2: tp=4 cannot shard it — auto mode serves
+    replicated with parity intact, shard_kv=True raises naming the counts."""
+    deepspeed_tpu.comm.reset_topology()
+    cfg = llama.LlamaConfig.tiny()
+    engine = deepspeed_tpu.init_inference(
+        llama.build(cfg),
+        config={"dtype": "fp32", "tensor_parallel": {"tp_size": 4}})
+    srv = ServingEngine(engine, slots=2, max_seq_len=64, block_size=8,
+                        prefill_chunk=16, prefill_batch=2)
+    assert not srv.kv_sharded and srv.tp_degree == 4
+    prompt = np.arange(10) % cfg.vocab_size
+    res = srv.serve([Request(uid=0, prompt=prompt, max_new_tokens=5)])
+    want = engine.generate(prompt[None, :], max_new_tokens=5)[0]
+    np.testing.assert_array_equal(res[0], want)
+    with pytest.raises(ValueError, match="KV head count .2. does not divide"):
+        ServingEngine(engine, slots=2, max_seq_len=64, block_size=8,
+                      shard_kv=True)
+
+
+def test_gqa_divisible_heads_shard():
+    """tp=2 divides llama-tiny's HKV=2: the GQA pool shards (1 head/chip)
+    and decode stays token-exact."""
+    deepspeed_tpu.comm.reset_topology()
+    cfg = llama.LlamaConfig.tiny()
+    engine = deepspeed_tpu.init_inference(
+        llama.build(cfg),
+        config={"dtype": "fp32", "tensor_parallel": {"tp_size": 2}})
+    srv = ServingEngine(engine, slots=2, max_seq_len=64, block_size=8,
+                        prefill_chunk=16, prefill_batch=2)
+    assert srv.kv_sharded and srv.tp_degree == 2
+    assert srv._cache["k"].addressable_shards[0].data.shape[2] == 1
+    prompt = np.arange(12) % cfg.vocab_size
+    res = srv.serve([Request(uid=0, prompt=prompt, max_new_tokens=6)])
+    want = engine.generate(prompt[None, :], max_new_tokens=6)[0]
+    np.testing.assert_array_equal(res[0], want)
+
+
+def test_draft_pool_shards_with_target(tp4_engine, tiny_cfg):
+    """A draft model whose HKV divides tp gets a sharded draft pool; the
+    fused-prefill + rollout + verify trace stays token-exact vs the tp=1
+    n-gram reference and within the 3-program contract."""
+    dcfg = gpt2.GPT2Config(vocab_size=tiny_cfg.vocab_size, max_seq_len=128,
+                           num_layers=1, num_heads=4, hidden_size=64)
+    srv = ServingEngine(tp4_engine, slots=4, max_seq_len=128, block_size=8,
+                        prefill_chunk=16, prefill_batch=2, spec_tokens=3,
+                        draft=gpt2.build(dcfg))
+    assert srv._dcache_sharded
+    assert srv._dcache["k"].addressable_shards[0].data.shape[2] == 1
+    reqs = _trace(tiny_cfg, 4, seed=2)
+    res = srv.serve(reqs)
+    assert srv.compile_count <= 3, srv.compiled_programs
+    for r in reqs:
+        want = tp4_engine.generate(r.prompt[None, :],
+                                   max_new_tokens=r.max_new_tokens)[0]
+        np.testing.assert_array_equal(res[r.uid], want, err_msg=f"{r.uid}")
+
+
+def test_draft_indivisible_heads_raise_with_shard_kv(tp4_engine, tiny_cfg):
+    """shard_kv=True + a draft whose HKV does not divide tp fails fast in
+    the ctor, naming the draft's head count."""
+    dcfg = gpt2.GPT2Config(vocab_size=tiny_cfg.vocab_size, max_seq_len=128,
+                           num_layers=1, num_heads=3, hidden_size=48)
+    with pytest.raises(ValueError, match="draft model's KV head count"):
+        ServingEngine(tp4_engine, slots=2, max_seq_len=128, block_size=8,
+                      prefill_chunk=16, spec_tokens=3,
+                      draft=gpt2.build(dcfg), shard_kv=True)
+
+
+def test_init_serving_topology_overrides_config(tiny_cfg):
+    """``init_serving(topology=N)`` wins over a conflicting
+    ``tensor_parallel`` in a dict config, and never mutates a caller-owned
+    config object."""
+    deepspeed_tpu.comm.reset_topology()
+    srv = deepspeed_tpu.init_serving(
+        gpt2.build(tiny_cfg),
+        config={"dtype": "fp32", "tensor_parallel": {"tp_size": 1}},
+        topology=4, slots=2, max_seq_len=128, block_size=8)
+    assert srv.tp_degree == 4 and srv.kv_sharded
+
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    obj = DeepSpeedInferenceConfig(dtype="fp32")
+    deepspeed_tpu.comm.reset_topology()
+    deepspeed_tpu.init_serving(gpt2.build(tiny_cfg), config=obj, topology=2,
+                               slots=2, max_seq_len=128, block_size=8)
+    assert obj.tensor_parallel.tp_size == 1
+
+
+@pytest.mark.slow  # two engine builds per family
+@pytest.mark.parametrize("family", ["opt", "bloom", "mixtral"])
+def test_tp_parity_other_families(family):
+    """The sharded-cache path holds across the remaining serving families
+    (gpt2/llama are tier-1 above): opt's offset learned positions, bloom's
+    ALiBi gather path, mixtral's GQA + MoE blocks — tp=2 serving is
+    token-exact vs tp=1."""
+    if family == "opt":
+        from deepspeed_tpu.models import opt as m
+        cfg = m.OPTConfig.tiny()
+    elif family == "bloom":
+        from deepspeed_tpu.models import bloom as m
+        cfg = m.BloomConfig.tiny()
+    else:
+        from deepspeed_tpu.models import mixtral as m
+        cfg = m.MixtralConfig.tiny()
+
+    def build(tp):
+        deepspeed_tpu.comm.reset_topology()
+        return deepspeed_tpu.init_inference(
+            m.build(cfg),
+            config={"dtype": "fp32", "tensor_parallel": {"tp_size": tp}})
+
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(0, cfg.vocab_size, int(rng.integers(5, 14)))
+               for _ in range(4)]
+    kw = dict(slots=3, max_seq_len=64, block_size=8, prefill_chunk=16,
+              prefill_batch=2)
+    r1 = ServingEngine(build(1), **kw).serve(
+        [Request(uid=i, prompt=p, max_new_tokens=6)
+         for i, p in enumerate(prompts)])
+    s2 = ServingEngine(build(2), **kw)
+    r2 = s2.serve([Request(uid=i, prompt=p, max_new_tokens=6)
+                   for i, p in enumerate(prompts)])
+    assert s2.kv_sharded
+    for uid in r1:
+        np.testing.assert_array_equal(r1[uid], r2[uid], err_msg=f"uid {uid}")
