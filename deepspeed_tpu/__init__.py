@@ -132,7 +132,7 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
                  block_size=32, num_blocks=None, chunked_prefill=None,
                  prefill_chunk=128, prefix_caching=True, spec_tokens=0,
                  draft=None, ngram_max=3, ngram_min=1, shard_kv=None,
-                 topology=None, **kwargs):
+                 topology=None, debug_checks=False, **kwargs):
     """Continuous-batching serving entry: an ``init_inference`` engine
     wrapped in the block-paged scheduler (``inference/serving.py``).
     Mixed-length request traces run at iteration-level granularity over a
@@ -158,7 +158,14 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
     engine shards the paged KV pool over the KV-head dim so each chip
     stores ``HKV/N`` heads (N× the servable blocks/context).  ``shard_kv``
     (default auto) controls the pool sharding — see
-    :class:`~deepspeed_tpu.inference.serving.ServingEngine`."""
+    :class:`~deepspeed_tpu.inference.serving.ServingEngine`.
+
+    ``debug_checks=True`` turns on the correctness tooling
+    (``deepspeed_tpu/analysis/``): the recompile sentry raises on any
+    trace past the engine's compile budget (with an abstract-signature
+    diff of the retrace), and the paged-state invariant audit runs after
+    every scheduler iteration; off, both are free and ``stats()`` still
+    reports ``retraces_observed``."""
     from .inference.serving import ServingEngine
 
     if topology is not None:
@@ -183,4 +190,4 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
                          prefix_caching=prefix_caching,
                          spec_tokens=spec_tokens, draft=draft,
                          ngram_max=ngram_max, ngram_min=ngram_min,
-                         shard_kv=shard_kv)
+                         shard_kv=shard_kv, debug_checks=debug_checks)

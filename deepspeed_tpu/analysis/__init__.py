@@ -1,0 +1,48 @@
+"""Static + runtime correctness tooling for the TPU hot paths.
+
+Three coordinated passes turn the conventions the serving/training
+engines document into checked contracts:
+
+ - :mod:`deepspeed_tpu.analysis.lint` — ``graft-lint``, a stdlib-only AST
+   pass over the package flagging recompile/host-sync hazards (rules
+   GL001..GL005, ``# graft: noqa(GLxxx)`` pragmas, ``bin/graft-lint``
+   CLI wired into CI).
+ - :mod:`deepspeed_tpu.analysis.sentry` — the recompile sentry: jitted
+   entry points register their Python bodies, trace counts are checked
+   against each engine's declared compile budget, and ``debug_checks``
+   mode raises at trace time with an abstract-signature diff.
+ - :mod:`deepspeed_tpu.analysis.invariants` — O(blocks) paged-state
+   audit (refcount conservation, free-list disjointness, scratch
+   aliasing, trie structure, table/length consistency) run after every
+   scheduler round under ``debug_checks``.
+
+``lint`` stays importable without jax (the CI lint job runs bare);
+import the runtime pieces from their submodules or via the lazy
+attributes here.
+"""
+
+from __future__ import annotations
+
+_RUNTIME_EXPORTS = {
+    "RecompileSentry": "sentry",
+    "RetraceError": "sentry",
+    "abstract_signature": "sentry",
+    "install_compile_listener": "sentry",
+    "backend_compiles": "sentry",
+    "PagedStateError": "invariants",
+    "audit_paged_state": "invariants",
+    "audit_serving_engine": "invariants",
+}
+
+__all__ = sorted(_RUNTIME_EXPORTS) + ["lint"]
+
+
+def __getattr__(name):
+    # lazy: importing deepspeed_tpu.analysis.lint alone must not pull jax
+    if name in _RUNTIME_EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(
+            f".{_RUNTIME_EXPORTS[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,247 @@
+"""Paged-state invariant checker: an O(blocks) audit of the serving
+engine's host-side bookkeeping after every scheduler round.
+
+``inference/paged.py`` documents the invariants the block allocator, the
+prefix trie, and the scheduler's block tables maintain *by convention* —
+refcounts mirror owners, the free list never aliases live blocks, scratch
+block 0 is never owned, trie chains stay walkable.  Every ROADMAP
+direction that touches the pool (quantized KV, tiered offload,
+multi-replica routing) mutates exactly this state, and a single leaked
+refcount surfaces as an un-debuggable OOM (pool "full" of unowned
+blocks) or — worse — two sequences silently sharing a writable block.
+This module turns the prose into a checked contract.
+
+Named invariants (the :class:`PagedStateError` ``invariant`` field, also
+the fault-injection test matrix in ``tests/unit/test_analysis.py``):
+
+``refcount-conservation``
+    Every block's refcount equals the number of holders that can ever
+    decref it: slot ``held`` lists + prefix-trie entries.  A higher count
+    is a leak (the block can never return to the free list); a lower one
+    is a double-free in waiting.
+``free-list-disjoint``
+    The free list is duplicate-free, contains only refcount-0 blocks,
+    never the scratch block, and shares no block with any holder; and
+    every refcount-0 non-scratch block IS on the free list (nothing
+    leaks out of the pool entirely).
+``scratch-aliasing``
+    Scratch block 0 is never held, never cached in the trie, and never
+    addressed by the *allocated* span of a live table (table entry 0
+    doubles as the "unset" marker, so an unset entry inside a span the
+    sequence needs means its KV is silently landing in — and reading
+    garbage from — the scratch block).
+``trie-parent-child``
+    Chains stay walkable (every entry's parent is a live entry) and
+    ``children`` counters match the live child count — the two facts
+    ``evict_one``'s leaf-first drain depends on.  Note the *naive*
+    strengthening "parent block refcount >= child block refcount" is NOT
+    an invariant: ``register``'s first-writer-wins dedup means a request
+    that independently prefilled duplicate content holds its own copy of
+    the parent span while the trie caches the child span's fresh block —
+    a legal state where the child's block out-refs the parent's (pinned
+    by a tier-1 eos-parity trace).  Trie-claimed references do chain
+    whole, but refcounts cannot isolate them from duplicate holders.
+``length-occupancy``
+    Per active slot: the table's nonzero entries form one contiguous
+    leading span, that span matches the slot's ``held`` blocks exactly
+    (no divergence between the device-visible table and the host's
+    ownership record), no physical block appears twice in a slot, and
+    the span covers every token the slot has committed (``lengths`` /
+    prefill base).  Inactive slots are fully zeroed.
+
+The audit reads pure host state (numpy + lists) — no device sync — and
+runs in O(num_blocks + trie entries).  ``ServingEngine`` calls it after
+every scheduler iteration when ``debug_checks`` is on, and tier-1 serving
+tests run with it unconditionally; with the flag off the cost is one
+branch per iteration.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+#: mirror of ``inference.paged.SCRATCH_BLOCK`` — importing it would cycle
+#: (serving imports this module; the inference package imports serving);
+#: pinned by a tier-1 test instead
+SCRATCH_BLOCK = 0
+
+
+class PagedStateError(RuntimeError):
+    """A paged-KV bookkeeping invariant does not hold; ``invariant`` names
+    which one (see module docstring)."""
+
+    def __init__(self, invariant: str, detail: str):
+        super().__init__(
+            f"paged-state invariant '{invariant}' violated: {detail}")
+        self.invariant = invariant
+        self.detail = detail
+
+
+def _blocks_for(num_tokens: int, block_size: int) -> int:
+    return -(-int(num_tokens) // int(block_size))
+
+
+def audit_paged_state(allocator, tables, held, *,
+                      prefix=None,
+                      active_needs: Optional[Dict[int, int]] = None,
+                      block_size: int = 1) -> None:
+    """Verify every invariant over one engine's host state; raises
+    :class:`PagedStateError` naming the first violated invariant.
+
+    allocator:     :class:`~deepspeed_tpu.inference.paged.BlockAllocator`.
+    tables:        int array ``[slots, nbper]`` of physical block ids
+                   (entry 0 = scratch doubles as "unset").
+    held:          per-slot list of owned block ids (the host ownership
+                   record the release path decrefs).
+    prefix:        optional :class:`PrefixCache` (``None`` in bucketed /
+                   prefix-off mode).
+    active_needs:  ``slot -> committed token count`` for live slots; slots
+                   absent from the map must be fully released.
+    block_size:    tokens per block (converts needs to table spans).
+    """
+    ref, free = allocator.snapshot()
+    num_blocks = allocator.num_blocks
+    entries = prefix.entries() if prefix is not None else []
+    active_needs = active_needs or {}
+
+    # ---- refcount-conservation: owners (held lists + trie) == refcounts
+    expected = [0] * num_blocks
+    for slot, blocks in enumerate(held):
+        for b in blocks:
+            if not (0 <= int(b) < num_blocks):
+                raise PagedStateError(
+                    "refcount-conservation",
+                    f"slot {slot} holds out-of-range block {b} "
+                    f"(pool has {num_blocks})")
+            expected[int(b)] += 1
+    for e in entries:
+        if not (0 <= int(e.block) < num_blocks):
+            raise PagedStateError(
+                "refcount-conservation",
+                f"trie entry uid={e.uid} caches out-of-range block "
+                f"{e.block} (pool has {num_blocks})")
+        expected[int(e.block)] += 1
+    for b in range(num_blocks):
+        if b == SCRATCH_BLOCK:
+            continue
+        if ref[b] != expected[b]:
+            kind = "leaked (unreclaimable)" if ref[b] > expected[b] \
+                else "under-counted (double-free in waiting)"
+            raise PagedStateError(
+                "refcount-conservation",
+                f"block {b}: refcount {ref[b]} != {expected[b]} owners "
+                f"(held lists + trie entries) — {kind}")
+    if ref[SCRATCH_BLOCK] != 0 or expected[SCRATCH_BLOCK] != 0:
+        raise PagedStateError(
+            "scratch-aliasing",
+            f"scratch block {SCRATCH_BLOCK} is owned (refcount "
+            f"{ref[SCRATCH_BLOCK]}, {expected[SCRATCH_BLOCK]} holders) — "
+            "it must stay unallocated")
+
+    # ---- free-list-disjoint
+    free_set = set(int(b) for b in free)
+    if len(free_set) != len(free):
+        raise PagedStateError("free-list-disjoint",
+                              "free list contains duplicate block ids")
+    if SCRATCH_BLOCK in free_set:
+        raise PagedStateError("free-list-disjoint",
+                              "scratch block is on the free list")
+    for b in free_set:
+        if ref[b] != 0:
+            raise PagedStateError(
+                "free-list-disjoint",
+                f"block {b} is on the free list with refcount {ref[b]}")
+        if expected[b] != 0:
+            raise PagedStateError(
+                "free-list-disjoint",
+                f"block {b} is on the free list but has {expected[b]} "
+                "live holder(s)")
+    for b in range(1, num_blocks):
+        if ref[b] == 0 and b not in free_set:
+            raise PagedStateError(
+                "free-list-disjoint",
+                f"block {b} has refcount 0 but is not on the free list "
+                "(leaked out of the pool)")
+
+    # ---- trie-parent-child
+    live = set(id(e) for e in entries)
+    child_count: Dict[int, int] = {}
+    for e in entries:
+        if int(e.block) == SCRATCH_BLOCK:
+            raise PagedStateError(
+                "scratch-aliasing",
+                f"trie entry uid={e.uid} caches the scratch block")
+        if e.parent is not None:
+            if id(e.parent) not in live:
+                raise PagedStateError(
+                    "trie-parent-child",
+                    f"trie entry uid={e.uid} has an evicted parent "
+                    f"(uid={e.parent.uid}) — chain no longer walkable")
+            child_count[id(e.parent)] = child_count.get(id(e.parent), 0) + 1
+    for e in entries:
+        actual = child_count.get(id(e), 0)
+        if e.children != actual:
+            raise PagedStateError(
+                "trie-parent-child",
+                f"trie entry uid={e.uid}: children counter {e.children} "
+                f"!= {actual} live children")
+        # a parent with live children must keep its own cache hold (its
+        # refcount can never drop below the 1 the conservation pass
+        # attributes to the entry itself) — the weakest sound form of
+        # "no child outlives its parent"; see module docstring for why
+        # "parent refs >= child refs" is NOT sound
+        if actual and ref[int(e.block)] < 1:
+            raise PagedStateError(
+                "trie-parent-child",
+                f"trie entry uid={e.uid} has {actual} live children but "
+                f"its block {e.block} is unreferenced")
+
+    # ---- length-occupancy + scratch-aliasing over the tables
+    nslots = len(tables)
+    for slot in range(nslots):
+        row = tables[slot]
+        span = 0
+        while span < len(row) and int(row[span]) != SCRATCH_BLOCK:
+            span += 1
+        for li in range(span, len(row)):
+            if int(row[li]) != SCRATCH_BLOCK:
+                raise PagedStateError(
+                    "length-occupancy",
+                    f"slot {slot}: table entry {li} set after an unset "
+                    f"entry at {span} — allocated span must be contiguous")
+        owned = sorted(int(b) for b in held[slot])
+        mapped = sorted(int(row[li]) for li in range(span))
+        if len(set(mapped)) != len(mapped):
+            raise PagedStateError(
+                "length-occupancy",
+                f"slot {slot}: a physical block appears twice in its "
+                f"table span {mapped}")
+        if owned != mapped:
+            raise PagedStateError(
+                "length-occupancy",
+                f"slot {slot}: table span blocks {mapped} diverge from "
+                f"the held record {owned}")
+        if slot in active_needs:
+            need_span = _blocks_for(active_needs[slot], block_size)
+            if span < need_span:
+                raise PagedStateError(
+                    "scratch-aliasing",
+                    f"slot {slot}: {active_needs[slot]} committed tokens "
+                    f"need {need_span} table entries but only {span} are "
+                    "set — writes past the span land in the scratch block")
+        elif span or held[slot]:
+            raise PagedStateError(
+                "length-occupancy",
+                f"slot {slot} is inactive but still maps {span} table "
+                f"entr(ies) / holds {len(held[slot])} block(s)")
+
+
+def audit_serving_engine(srv, active) -> None:
+    """Engine-facing wrapper: pulls the :class:`ServingEngine` fields and
+    derives each active slot's committed-token count (decode: host
+    ``lengths``; prefill: the chunk base already written)."""
+    needs = {slot: max(int(srv._lengths[slot]), st.base)
+             for slot, st in active.items()}
+    audit_paged_state(srv._alloc, srv._tables, srv._held,
+                      prefix=srv._prefix, active_needs=needs,
+                      block_size=srv.block_size)

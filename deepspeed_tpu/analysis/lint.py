@@ -1,0 +1,541 @@
+"""graft-lint: rule-based static AST lint for TPU/JAX recompile and
+host-sync hazards (``bin/graft-lint``).
+
+The serving and training hot paths live and die by a handful of tracing
+conventions that nothing in Python enforces: jitted bodies must never
+materialize traced values on the host (a silent device sync per step),
+never bake closure-captured shapes into a program (a retrace — or worse,
+a stale shape — per new input), always donate the KV pool they update (a
+full pool copy per step otherwise), and only ever name mesh axes that the
+engines actually build.  This module turns each of those conventions into
+a numbered, suppressible lint rule:
+
+========  =============================================================
+GL001     host-side materialization of a traced value inside a
+          jit/shard_map body (``.item()`` / ``.tolist()`` /
+          ``float()`` / ``int()`` / ``bool()`` / ``np.asarray`` on a
+          traced argument) — forces a device sync and breaks tracing.
+GL002     Python-scalar shape/string leakage into a jit body: f-strings
+          or ``str()`` over traced values (concretization error at trace
+          time), and ``.shape`` reads of arrays captured from an
+          enclosing *non-jit* builder's arguments (the shape is baked at
+          closure creation — a new input shape silently reuses it).
+GL003     ``jax.jit`` of a pool/cache/state-updating function without a
+          ``donate_argnums``/``donate_argnames`` decision — XLA keeps
+          the input buffer alive and every step pays a full copy.  An
+          explicit empty tuple counts as a decision (the serving engine
+          passes ``donate_argnums=()`` on CPU where donation is
+          ignored).
+GL004     mesh-axis string literal that is not one of the axes the
+          engines build (default: {tp, dp, pp, ep, sp, batch, model,
+          data, pipe}) in a collective call or a ``PartitionSpec`` — a
+          typo here raises only at trace time, on device, deep inside a
+          compiled program.
+GL005     traced-array comparison (or bare truthiness) as an ``if`` /
+          ``while`` test inside a jit body — `TracerBoolConversionError`
+          at best, silently trace-time-constant control flow at worst.
+          ``is`` / ``is not`` (None checks) are static and exempt.
+========  =============================================================
+
+Suppression: append ``# graft: noqa(GLxxx)`` (one or more codes,
+comma-separated) to the offending line, with a short justification after
+it; a bare ``# graft: noqa`` suppresses every rule on that line.  The
+runner exits nonzero on any *unsuppressed* finding — CI wires
+``bin/graft-lint deepspeed_tpu/`` as a device-free job.
+
+A "jit body" is any function (a) decorated with ``jit``/``pjit``/
+``shard_map``/``partial(jax.jit, ...)``, (b) referenced by name anywhere
+inside the arguments of such a call — including through wrappers like
+``jax.jit(sentry.wrap(step, "decode"), ...)`` — or (c) lexically nested
+inside one.  Traced names are the body's own parameters plus those of
+enclosing jit bodies (closures over tracers).
+
+Everything here is stdlib-only on purpose: the CI lint job and
+``bin/graft-lint`` run without jax installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+#: axis names the engines actually build (parallel/topology.py builds the
+#: short spelling, runtime/pipe builds the long one)
+DEFAULT_MESH_AXES = frozenset(
+    {"tp", "dp", "pp", "ep", "sp", "batch", "model", "data", "pipe"})
+
+#: callables whose function-valued arguments become traced bodies
+_JIT_WRAPPERS = frozenset({"jit", "pjit", "shard_map", "head_shard_map"})
+
+#: collectives whose axis argument is an axis NAME (positional index 1 or
+#: the ``axis_name=`` keyword)
+_COLLECTIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "pshuffle", "axis_index", "pswapaxes", "psum_scatter"})
+
+#: attribute chains through these never leave trace-static land
+_STATIC_ATTRS = frozenset({"shape", "ndim", "size", "dtype"})
+
+#: parameter names that mark a jitted function as pool/cache-updating
+_POOLISH_PARAMS = frozenset(
+    {"cache", "dcache", "kv_cache", "pool", "kv_pool", "state"})
+
+RULES: Dict[str, str] = {
+    "GL001": "host-side materialization of a traced value in a jit body",
+    "GL002": "shape/string leakage of traced or closure-captured arrays "
+             "into a jit body",
+    "GL003": "jax.jit of a pool/cache/state-updating function without a "
+             "donate_argnums decision",
+    "GL004": "mesh-axis string literal unknown to the engine meshes",
+    "GL005": "traced-array comparison or truthiness as an if/while test "
+             "in a jit body",
+}
+
+_NOQA_RE = re.compile(
+    r"#\s*graft:\s*noqa(?:\s*\(\s*([A-Za-z0-9_,\s]+)\s*\))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}: " \
+               f"{self.code} {self.message}"
+
+
+def _func_tail(node: ast.AST) -> Optional[str]:
+    """Last attribute segment of a call target: ``jax.lax.psum`` -> "psum",
+    ``jit`` -> "jit"; None for anything not Name/Attribute-shaped."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """Leftmost Name of an Attribute/Subscript/Call-free access chain:
+    ``x.shape[0]`` -> "x"; None when the chain roots in a call/literal."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _chain_attrs(node: ast.AST) -> Set[str]:
+    """All attribute names along an access chain (``x.shape[0]`` ->
+    {"shape"}) — used to whitelist static ``.shape``-style reads."""
+    attrs: Set[str] = set()
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if isinstance(node, ast.Attribute):
+            attrs.add(node.attr)
+        node = node.value
+    return attrs
+
+
+class _Scope:
+    """One function's lint context inside the scope tree."""
+
+    def __init__(self, node, parent: Optional["_Scope"]):
+        self.node = node
+        self.parent = parent
+        self.is_jit = False
+        args = node.args
+        names = [a.arg for a in getattr(args, "posonlyargs", [])]
+        names += [a.arg for a in args.args + args.kwonlyargs]
+        for special in (args.vararg, args.kwarg):
+            if special is not None:
+                names.append(special.arg)
+        self.params: Set[str] = set(names)
+        #: names bound by assignment inside the body (not traced roots)
+        self.locals: Set[str] = set()
+
+    def traced_names(self) -> Set[str]:
+        """Parameters of this jit body and of every enclosing jit body
+        (closures over tracers stay traced)."""
+        out: Set[str] = set()
+        scope: Optional[_Scope] = self
+        while scope is not None:
+            if scope.is_jit:
+                out |= scope.params - self.locals
+            scope = scope.parent
+        return out
+
+    def builder_params(self) -> Set[str]:
+        """Parameters of enclosing NON-jit functions: concrete values whose
+        shapes get baked into the traced program at closure creation."""
+        out: Set[str] = set()
+        scope = self.parent
+        while scope is not None:
+            if not scope.is_jit:
+                out |= scope.params
+            scope = scope.parent
+        return (out - self.params) - self.locals
+
+
+class _Analyzer:
+    def __init__(self, tree: ast.Module, path: str,
+                 axes: frozenset = DEFAULT_MESH_AXES):
+        self.path = path
+        self.axes = axes
+        self.findings: List[Finding] = []
+        self._scopes: Dict[ast.AST, _Scope] = {}
+        self._by_name: Dict[str, List[ast.AST]] = {}
+        self._build_scopes(tree, None)
+        self._mark_jit_bodies(tree)
+
+    # ------------------------------------------------------------ scope pass
+    def _build_scopes(self, node: ast.AST, parent: Optional[_Scope]) -> None:
+        scope = parent
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scope = _Scope(node, parent)
+            self._scopes[node] = scope
+            self._by_name.setdefault(node.name, []).append(node)
+        elif isinstance(node, ast.Lambda):
+            scope = _Scope(node, parent)
+            self._scopes[node] = scope
+        elif scope is not None and isinstance(node, ast.Name) and \
+                isinstance(node.ctx, ast.Store):
+            scope.locals.add(node.id)
+        for child in ast.iter_child_nodes(node):
+            self._build_scopes(child, scope)
+
+    def _mark_jit_bodies(self, tree: ast.Module) -> None:
+        jitted: Set[ast.AST] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self._is_jit_expr(dec):
+                        jitted.add(node)
+            elif isinstance(node, ast.Call) and \
+                    _func_tail(node.func) in _JIT_WRAPPERS:
+                for arg in self._callable_args(node):
+                    for name_node in ast.walk(arg):
+                        if isinstance(name_node, ast.Name):
+                            for fn in self._by_name.get(name_node.id, []):
+                                jitted.add(fn)
+                        elif isinstance(name_node, ast.Lambda):
+                            jitted.add(name_node)
+        # lexical closure: everything nested inside a jit body is traced too
+        for fn in jitted:
+            self._scopes[fn].is_jit = True
+        for scope in self._scopes.values():
+            parent = scope.parent
+            while parent is not None:
+                if parent.is_jit:
+                    scope.is_jit = True
+                    break
+                parent = parent.parent
+
+    @staticmethod
+    def _callable_args(call: ast.Call) -> List[ast.AST]:
+        """The expressions that may carry the traced callable: every
+        positional arg plus f=/fun=/fn= keywords (``shard_map(fn, mesh=...,
+        in_specs=...)`` and ``jax.jit(wrapper(step), ...)`` both resolve)."""
+        out = list(call.args)
+        out += [kw.value for kw in call.keywords
+                if kw.arg in ("f", "fun", "fn")]
+        return out
+
+    def _is_jit_expr(self, dec: ast.AST) -> bool:
+        if _func_tail(dec) in _JIT_WRAPPERS:
+            return True
+        if isinstance(dec, ast.Call):
+            if _func_tail(dec.func) in _JIT_WRAPPERS:
+                return True
+            if _func_tail(dec.func) == "partial" and dec.args and \
+                    _func_tail(dec.args[0]) in _JIT_WRAPPERS:
+                return True
+        return False
+
+    # --------------------------------------------------------------- helpers
+    def _emit(self, node: ast.AST, code: str, message: str) -> None:
+        self.findings.append(Finding(self.path, node.lineno, node.col_offset,
+                                     code, message))
+
+    def _enclosing_scope(self, stack: List[_Scope]) -> Optional[_Scope]:
+        return stack[-1] if stack else None
+
+    def _is_traced(self, expr: ast.AST, scope: _Scope) -> bool:
+        root = _root_name(expr)
+        return root is not None and root in scope.traced_names()
+
+    # ------------------------------------------------------------- main walk
+    def analyze(self, tree: ast.Module) -> List[Finding]:
+        self._walk(tree, [])
+        return self.findings
+
+    def _walk(self, node: ast.AST, stack: List[_Scope]) -> None:
+        scope = self._scopes.get(node)
+        if scope is not None:
+            stack = stack + [scope]
+        cur = self._enclosing_scope(stack)
+        in_jit = cur is not None and cur.is_jit
+
+        if isinstance(node, ast.Call):
+            self._check_call(node, cur, in_jit)
+        elif isinstance(node, ast.JoinedStr) and in_jit:
+            self._check_fstring(node, cur)
+        elif isinstance(node, ast.Attribute) and in_jit:
+            self._check_shape_capture(node, cur)
+        elif isinstance(node, (ast.If, ast.While)) and in_jit:
+            self._check_branch(node, cur)
+
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, stack)
+
+    # ----------------------------------------------------------------- rules
+    def _check_call(self, node: ast.Call, scope, in_jit: bool) -> None:
+        tail = _func_tail(node.func)
+        # GL003 runs everywhere (the jit CALL lives in host code)
+        if tail in ("jit", "pjit"):
+            self._check_donation(node)
+        if tail in _COLLECTIVES:
+            self._check_axis_literal(node)
+        if tail in ("PartitionSpec", "P"):
+            self._check_pspec_literals(node)
+        if not in_jit:
+            return
+        # GL001: device->host materialization in a traced body
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("item", "tolist") and not node.args:
+            self._emit(node, "GL001",
+                       f".{node.func.attr}() in a jit body forces a host "
+                       "sync (use the traced value directly)")
+        elif isinstance(node.func, ast.Name) and \
+                node.func.id in ("float", "int", "bool") and node.args:
+            arg = node.args[0]
+            if self._is_traced(arg, scope) and \
+                    not (_chain_attrs(arg) & _STATIC_ATTRS):
+                self._emit(node, "GL001",
+                           f"{node.func.id}() on traced value "
+                           f"'{_root_name(arg)}' in a jit body (host "
+                           "concretization; use jnp casts)")
+        elif tail in ("asarray", "array") and \
+                isinstance(node.func, ast.Attribute) and \
+                _root_name(node.func) in ("np", "numpy") and node.args and \
+                self._is_traced(node.args[0], scope):
+            self._emit(node, "GL001",
+                       f"np.{tail}() on traced value "
+                       f"'{_root_name(node.args[0])}' in a jit body "
+                       "(host materialization; use jnp.asarray)")
+        elif isinstance(node.func, ast.Name) and node.func.id == "str" and \
+                node.args and self._is_traced(node.args[0], scope):
+            self._emit(node, "GL002",
+                       f"str() of traced value '{_root_name(node.args[0])}' "
+                       "in a jit body concretizes at trace time")
+
+    def _check_donation(self, node: ast.Call) -> None:
+        kwargs = {kw.arg for kw in node.keywords}
+        if "donate_argnums" in kwargs or "donate_argnames" in kwargs:
+            return
+        for arg in self._callable_args(node):
+            for name_node in ast.walk(arg):
+                if not isinstance(name_node, ast.Name):
+                    continue
+                for fn in self._by_name.get(name_node.id, []):
+                    pools = self._scopes[fn].params & _POOLISH_PARAMS
+                    if pools:
+                        self._emit(
+                            node, "GL003",
+                            f"jax.jit({name_node.id}) updates "
+                            f"{sorted(pools)} but makes no donate_argnums "
+                            "decision — every step copies the buffer "
+                            "(pass donate_argnums=(...) or an explicit ())")
+                        return
+
+    def _check_axis_literal(self, node: ast.Call) -> None:
+        cand: List[ast.AST] = []
+        # axis_index(axis_name) takes the name as its SOLE positional arg;
+        # the data-carrying collectives take it at index 1
+        pos = 0 if _func_tail(node.func) == "axis_index" else 1
+        if len(node.args) > pos:
+            cand.append(node.args[pos])
+        cand += [kw.value for kw in node.keywords
+                 if kw.arg in ("axis_name", "axis")]
+        for expr in cand:
+            exprs = expr.elts if isinstance(expr, ast.Tuple) else [expr]
+            for e in exprs:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str) \
+                        and e.value not in self.axes:
+                    self._emit(
+                        e, "GL004",
+                        f"axis name '{e.value}' is not a mesh axis the "
+                        f"engines build ({', '.join(sorted(self.axes))})")
+
+    def _check_pspec_literals(self, node: ast.Call) -> None:
+        for arg in node.args:
+            elts = arg.elts if isinstance(arg, ast.Tuple) else [arg]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str) \
+                        and e.value not in self.axes:
+                    self._emit(
+                        e, "GL004",
+                        f"PartitionSpec axis '{e.value}' is not a mesh "
+                        "axis the engines build")
+
+    def _check_fstring(self, node: ast.JoinedStr, scope) -> None:
+        for value in node.values:
+            if not isinstance(value, ast.FormattedValue):
+                continue
+            if self._is_traced(value.value, scope):
+                root = _root_name(value.value)
+                what = "shape of" if "shape" in _chain_attrs(value.value) \
+                    else "value of"
+                self._emit(node, "GL002",
+                           f"f-string over traced {what} '{root}' in a jit "
+                           "body concretizes at trace time")
+
+    def _check_shape_capture(self, node: ast.Attribute, scope) -> None:
+        if node.attr != "shape":
+            return
+        root = _root_name(node)
+        if root is None or self._is_traced(node, scope):
+            return                      # own traced arg: shapes are static
+        if root in scope.builder_params():
+            self._emit(node, "GL002",
+                       f"'.shape' of '{root}' captured from an enclosing "
+                       "builder's arguments — the shape is baked into the "
+                       "program at closure creation (pass the array into "
+                       "the jit body instead)")
+
+    @staticmethod
+    def _truthy_parts(expr):
+        """Subexpressions evaluated for their truth value by a test:
+        ``a and not b`` -> [a, b] (BoolOp operands and ``not`` bodies are
+        truthiness positions too)."""
+        if isinstance(expr, ast.BoolOp):
+            for v in expr.values:
+                yield from _Analyzer._truthy_parts(v)
+        elif isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.Not):
+            yield from _Analyzer._truthy_parts(expr.operand)
+        else:
+            yield expr
+
+    def _check_branch(self, node, scope) -> None:
+        test = node.test
+        kind = "if" if isinstance(node, ast.If) else "while"
+        for part in self._truthy_parts(test):
+            if isinstance(part, (ast.Name, ast.Attribute, ast.Subscript)) \
+                    and self._is_traced(part, scope) and \
+                    not (_chain_attrs(part) & _STATIC_ATTRS):
+                self._emit(node, "GL005",
+                           f"truthiness of traced value "
+                           f"'{_root_name(part)}' as an {kind} test in a "
+                           "jit body (use jnp.where / lax.cond)")
+                return
+        for comp in ast.walk(test):
+            if not isinstance(comp, ast.Compare):
+                continue
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in comp.ops):
+                continue                # None checks are static Python
+            operands = [comp.left] + list(comp.comparators)
+            for operand in operands:
+                if self._is_traced(operand, scope) and \
+                        not (_chain_attrs(operand) & _STATIC_ATTRS):
+                    self._emit(
+                        node, "GL005",
+                        f"comparison on traced value "
+                        f"'{_root_name(operand)}' as an {kind} test in a "
+                        "jit body (ambiguous array truth value; use "
+                        "jnp.where / lax.cond)")
+                    return
+
+
+# ------------------------------------------------------------------ driver
+def _suppressed(finding: Finding, lines: Sequence[str]) -> bool:
+    if not (1 <= finding.line <= len(lines)):
+        return False
+    m = _NOQA_RE.search(lines[finding.line - 1])
+    if not m:
+        return False
+    if m.group(1) is None:
+        return True
+    codes = {c.strip().upper() for c in m.group(1).split(",")}
+    return finding.code in codes
+
+
+def check_source(source: str, path: str = "<string>",
+                 axes: frozenset = DEFAULT_MESH_AXES,
+                 keep_suppressed: bool = False) -> List[Finding]:
+    """Lint one module's source text; returns unsuppressed findings (all
+    findings with ``keep_suppressed=True``)."""
+    tree = ast.parse(source, filename=path)
+    findings = _Analyzer(tree, path, axes).analyze(tree)
+    if keep_suppressed:
+        return findings
+    lines = source.splitlines()
+    return [f for f in findings if not _suppressed(f, lines)]
+
+
+def iter_py_files(paths: Sequence[str]) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            out.extend(sorted(path.rglob("*.py")))
+        elif path.suffix == ".py":
+            out.append(path)
+    return out
+
+
+def lint_paths(paths: Sequence[str],
+               axes: frozenset = DEFAULT_MESH_AXES
+               ) -> Tuple[List[Finding], int]:
+    """Lint every ``*.py`` under ``paths``; returns (findings, files)."""
+    findings: List[Finding] = []
+    files = iter_py_files(paths)
+    for f in files:
+        try:
+            source = f.read_text(encoding="utf-8")
+            findings.extend(check_source(source, str(f), axes))
+        except SyntaxError as e:
+            findings.append(Finding(str(f), e.lineno or 0, 0, "GL000",
+                                    f"syntax error: {e.msg}"))
+    return findings, len(files)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graft-lint",
+        description="TPU/JAX recompile + host-sync hazard lint "
+                    "(rules GL001..GL005; suppress with "
+                    "'# graft: noqa(GLxxx)')")
+    ap.add_argument("paths", nargs="*", default=["deepspeed_tpu"],
+                    help="files/dirs to lint (default: deepspeed_tpu)")
+    ap.add_argument("--axes", default=None,
+                    help="comma-separated mesh axis allowlist "
+                         "(default: %s)" % ",".join(sorted(DEFAULT_MESH_AXES)))
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for code in sorted(RULES):
+            print(f"{code}  {RULES[code]}")
+        return 0
+    axes = frozenset(a.strip() for a in args.axes.split(",")) \
+        if args.axes else DEFAULT_MESH_AXES
+    paths = args.paths or ["deepspeed_tpu"]
+    findings, nfiles = lint_paths(paths, axes)
+    if nfiles == 0:
+        # a typo'd path must fail loudly, not turn the CI gate into a no-op
+        print(f"graft-lint: no Python files under {paths}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f.render())
+    status = f"graft-lint: {nfiles} files, {len(findings)} finding(s)"
+    print(status, file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

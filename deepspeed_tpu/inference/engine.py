@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import comm as dist
+from ..analysis.sentry import RecompileSentry
 from ..parallel.topology import MeshTopology
 from ..runtime.model import ModelSpec
 from ..utils.logging import log_dist
@@ -271,8 +272,14 @@ class InferenceEngine:
             lambda x, s: jax.device_put(x, s), params, shardings)
 
         prepare = self._prepare
-        self._forward_fn = jax.jit(
-            lambda p, batch: model.apply_fn(prepare(p), batch, None))
+        # recompile sentry (analysis/sentry.py): forward legitimately
+        # specializes per batch shape (budget=None, count only); each
+        # shape-keyed generate program below declares budget 1 — a retrace
+        # of an already-built program is always contract drift
+        self.sentry = RecompileSentry(name=f"inference:{model.name}")
+        self._forward_fn = jax.jit(self.sentry.wrap(
+            lambda p, batch: model.apply_fn(prepare(p), batch, None),
+            "forward", budget=None))
         # bounded per-shape jit cache; hot shapes survive eviction pressure
         # (utils/lru.py — same policy as ServingEngine's prefill-fn cache)
         self._generate_fns = LRUCache(capacity=32)
@@ -390,6 +397,14 @@ class InferenceEngine:
         out = np.array(out)  # writable host copy (np.asarray view is read-only)
         return _fill_after_eos(out, prompt_len, eos_token_id)
 
+    @staticmethod
+    def _gen_name(kind, b, prompt_len, total, sample_cfg, eos_token_id):
+        """Sentry entry name for a shape-keyed generate program: each key
+        compiles once (budget 1) — same identity as ``_generate_fns``'
+        cache key, so an LRU-evicted rebuild is visible as a recount."""
+        return (f"generate/{kind}[b={b},plen={prompt_len},total={total},"
+                f"sample={sample_cfg},eos={eos_token_id}]")
+
     def _build_recompute_gen(self, b, prompt_len, total, sample_cfg=None,
                              eos_token_id=None):
         """Full-recompute fallback for models without decode hooks.  With an
@@ -430,7 +445,8 @@ class InferenceEngine:
                 (buf, jnp.int32(prompt_len), jnp.zeros((b,), bool)))
             return buf
 
-        return jax.jit(gen)
+        return jax.jit(self.sentry.wrap(gen, self._gen_name(
+            "recompute", b, prompt_len, total, sample_cfg, eos_token_id)))
 
     def _build_kv_cache_gen(self, b, prompt_len, total, sample_cfg=None,
                             eos_token_id=None):
@@ -489,7 +505,8 @@ class InferenceEngine:
                              first == eos_token_id))
             return buf
 
-        return jax.jit(gen)
+        return jax.jit(self.sentry.wrap(gen, self._gen_name(
+            "kv", b, prompt_len, total, sample_cfg, eos_token_id)))
 
     def profile_model_time(self, use_cuda_events: bool = True):
         """Enable per-forward wall-clock capture (reference

@@ -104,6 +104,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..analysis.invariants import audit_serving_engine
+from ..analysis.sentry import (RecompileSentry, backend_compiles,
+                               install_compile_listener)
 from ..ops import paged_kv
 from ..ops.paged_kv import blocks_for
 from ..parallel.topology import TP_AXIS
@@ -270,6 +273,16 @@ class ServingEngine:
                     proposer (zero extra compiled programs).
     ngram_max/min:  n-gram match lengths for the lookup proposer (longest
                     match first, most recent occurrence wins).
+    debug_checks:   turn the documented contracts into enforced ones
+                    (``analysis/``): the recompile sentry RAISES at trace
+                    time on any retrace past the engine's compile budget
+                    (with an abstract-signature diff), and the paged-state
+                    invariant audit (refcounts, free list, scratch, trie,
+                    table spans) runs after every scheduler iteration.
+                    Off (default): the sentry still counts traces (zero
+                    runtime cost — the wrapped body only executes while
+                    tracing) and ``stats()['retraces_observed']`` reports
+                    drift; the audit is one skipped branch per iteration.
     """
 
     def __init__(self, engine, *, slots: int = 8,
@@ -285,7 +298,8 @@ class ServingEngine:
                  draft=None,
                  ngram_max: int = 3,
                  ngram_min: int = 1,
-                 shard_kv: Optional[bool] = None):
+                 shard_kv: Optional[bool] = None,
+                 debug_checks: bool = False):
         self.spec_tokens = int(spec_tokens)
         if self.spec_tokens < 0:
             raise ValueError(f"spec_tokens must be >= 0, got {spec_tokens}")
@@ -388,6 +402,31 @@ class ServingEngine:
         #: at 1 prefill + 1 decode for an entire trace (speculative: 1
         #: prefill + 1 verify [+ 1 draft rollout] — never more than 3)
         self.compiled_programs: List[Any] = []
+
+        # ----- correctness tooling (analysis/): the recompile sentry wraps
+        # every jitted body below so trace counts are enforced against the
+        # declared budget — 2 chunked (1 prefill + 1 decode; the n-gram
+        # speculative verify replaces decode), 3 with a draft model (fused
+        # prefill + rollout + verify), O(#buckets)+2 bucketed (ladder +
+        # full-cache-width preemption fallback + decode).  debug_checks
+        # additionally raises at trace time and audits the paged host state
+        # every scheduler iteration.
+        self.debug_checks = bool(debug_checks)
+        if self.spec_tokens:
+            self.compile_budget = 3 if draft is not None else 2
+        elif self.chunked_prefill:
+            self.compile_budget = 2
+        else:
+            self.compile_budget = len(self.prompt_buckets) + 2
+        self.sentry = RecompileSentry(name="serving",
+                                      strict=self.debug_checks,
+                                      total_budget=self.compile_budget)
+        self.invariant_checks_run = 0
+        if self.debug_checks:
+            # process-wide jax.monitoring compile counter (idempotent):
+            # corroborates the sentry by also seeing programs built OUTSIDE
+            # registered entry points; surfaced as stats()["backend_compiles"]
+            install_compile_listener()
 
         # ----- speculative decoding state
         self._draft = None                 # draft InferenceEngine
@@ -500,7 +539,8 @@ class ServingEngine:
                                     block_tables=block_tables)
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
-            self._decode_fn = jax.jit(step, donate_argnums=self._donate())
+            self._decode_fn = jax.jit(self.sentry.wrap(step, "decode"),
+                                      donate_argnums=self._donate())
             self.compiled_programs.append(("decode", self.slots))
         return self._decode_fn
 
@@ -523,7 +563,9 @@ class ServingEngine:
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
             if draft is None:
-                return jax.jit(prefill, donate_argnums=self._donate())
+                return jax.jit(
+                    self.sentry.wrap(prefill, f"prefill[w{width}]"),
+                    donate_argnums=self._donate())
             dfwd = draft.module.decode_hooks["forward_cached"]
             dprepare = draft._prepare
 
@@ -536,7 +578,7 @@ class ServingEngine:
                 return first, cache, dcache
 
             return jax.jit(
-                prefill_fused,
+                self.sentry.wrap(prefill_fused, f"prefill[w{width}]"),
                 donate_argnums=(2, 3) if self._donate() else ())
 
         return self._prefill_fns.get_or_build(
@@ -563,7 +605,8 @@ class ServingEngine:
                                     all_positions=True)
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
-            self._verify_fn = jax.jit(verify, donate_argnums=self._donate())
+            self._verify_fn = jax.jit(self.sentry.wrap(verify, "verify"),
+                                      donate_argnums=self._donate())
             self.compiled_programs.append(
                 ("verify", self.slots, self.spec_tokens + 1))
         return self._verify_fn
@@ -595,7 +638,8 @@ class ServingEngine:
                 return drafts.T, dcache            # [slots, K]
 
             self._draft_fn = jax.jit(
-                propose, donate_argnums=(1,) if self._donate() else ())
+                self.sentry.wrap(propose, "draft"),
+                donate_argnums=(1,) if self._donate() else ())
             self.compiled_programs.append(("draft", self.slots, k))
         return self._draft_fn
 
@@ -744,7 +788,8 @@ class ServingEngine:
     def serve(self, requests: Sequence[Request],
               eos_token_id: Optional[int] = None,
               admission_log: Optional[list] = None,
-              step_log: Optional[list] = None) -> Dict[Any, np.ndarray]:
+              step_log: Optional[list] = None,
+              debug_checks: Optional[bool] = None) -> Dict[Any, np.ndarray]:
         """Run a request trace to completion; returns ``uid -> [prompt +
         completion]`` int32 arrays, padded to ``prompt + max_new_tokens``
         with eos back-fill (HF semantics, same as ``generate``).
@@ -752,7 +797,14 @@ class ServingEngine:
         ``admission_log``, when given, collects ``(uid, slot)`` in admission
         order — the scheduler-determinism tests read it.  ``step_log``
         collects one dict per iteration (admitted / evicted / blocks_in_use
-        per step) for observability."""
+        per step) for observability.  ``debug_checks`` overrides the
+        engine-level flag from here on (ctor docstring): per-iteration
+        paged-state audits + strict recompile-sentry enforcement."""
+        if debug_checks is not None:
+            self.debug_checks = bool(debug_checks)
+            self.sentry.strict = self.debug_checks
+            if self.debug_checks:
+                install_compile_listener()
         for r in requests:
             total = len(r.prompt) + r.max_new_tokens
             if total > self.max_seq_len:
@@ -820,6 +872,12 @@ class ServingEngine:
                     "evicted": self.preempted - preempted0,
                     "blocks_in_use": self._alloc.blocks_in_use,
                 })
+            if self.debug_checks:
+                # O(blocks) host-state audit between scheduler rounds —
+                # the scheduler's state is only guaranteed consistent at
+                # iteration boundaries (analysis/invariants.py)
+                audit_serving_engine(self, active)
+                self.invariant_checks_run += 1
         return results
 
     # ----------------------------------------------------------------- decode
@@ -1096,6 +1154,13 @@ class ServingEngine:
         st = {
             "mode": "chunked" if self.chunked_prefill else "bucketed",
             "compile_count": self.compile_count,
+            "compile_budget": self.compile_budget,
+            "debug_checks": self.debug_checks,
+            "invariant_checks_run": self.invariant_checks_run,
+            "retraces_observed": self.sentry.retraces_observed,
+            # process-wide, cumulative since the listener was installed
+            # (None until a debug_checks engine installs it)
+            "backend_compiles": backend_compiles(),
             "iterations": self.iterations,
             "decode_steps": self.decode_steps,
             "prefill_calls": self.prefill_calls,

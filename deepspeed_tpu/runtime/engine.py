@@ -39,6 +39,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import comm as dist
+from ..analysis.sentry import RecompileSentry
 from ..ops.optimizers import get_optimizer
 from ..parallel.topology import (DATA_AXES, SP_AXIS, MeshTopology,
                                  topology_from_config)
@@ -876,6 +877,12 @@ class DeepSpeedEngine:
                 ("loss", "grad_norm", "overflow", "loss_scale", "skipped")}
 
     def _build_step_fns(self) -> None:
+        # recompile sentry (analysis/sentry.py): the config pins batch
+        # shapes, so the fused train step compiles exactly once (budget 1)
+        # and any retrace is contract drift — visible in sentry.report() /
+        # retraces_observed.  multi-step/eval legitimately specialize per
+        # shape (scan length = leading batch dim): budget None, count only.
+        self.sentry = RecompileSentry(name="training")
         gas = self.gradient_accumulation_steps()
         fp16 = self.fp16_enabled
         micro_loss = self._micro_loss_closure()
@@ -985,11 +992,11 @@ class DeepSpeedEngine:
             return jax.lax.scan(body, state, batches)
 
         self._train_multi_fn = jax.jit(
-            multi_step,
+            self.sentry.wrap(multi_step, "train_multi", budget=None),
             out_shardings=(self.state_shardings, metrics_shardings),
             donate_argnums=(0,))
         self._train_step_fn = jax.jit(
-            train_step,
+            self.sentry.wrap(train_step, "train_step"),
             out_shardings=(self.state_shardings, metrics_shardings),
             donate_argnums=(0,))
         if self.onebit_comm_enabled and self._onebit_compressed:
@@ -1000,9 +1007,14 @@ class DeepSpeedEngine:
             offload_out = (self.grad_shardings,
                            {"step": rep, "scaler": scaler_rep},
                            metrics_shardings)
+            # donate_argnums=() is deliberate: these return grads + a
+            # partial {step, scaler} — state itself outlives the call (the
+            # host optimizer applies the update and params are rebuilt)
             self._offload_grads_fn = jax.jit(offload_grads_step,
+                                             donate_argnums=(),
                                              out_shardings=offload_out)
             self._offload_finish_fn = jax.jit(offload_finish,
+                                              donate_argnums=(),
                                               out_shardings=offload_out)
         self._micro_grads_fn = jax.jit(
             micro_grads, out_shardings=(rep, self.grad_shardings),
@@ -1011,7 +1023,8 @@ class DeepSpeedEngine:
             apply_update,
             out_shardings=(self.state_shardings, metrics_shardings),
             donate_argnums=(0,))
-        self._eval_step_fn = jax.jit(eval_step)
+        self._eval_step_fn = jax.jit(
+            self.sentry.wrap(eval_step, "eval_step", budget=None))
         self._tree_add_fn = jax.jit(
             lambda a, b: jax.tree_util.tree_map(jnp.add, a, b),
             donate_argnums=(0,))
@@ -1110,11 +1123,11 @@ class DeepSpeedEngine:
                 lambda st, b: train_step(st, b, base_rng), state, batches)
 
         self._train_step_fn = jax.jit(
-            train_step,
+            self.sentry.wrap(train_step, "train_step_onebit"),
             out_shardings=(self.state_shardings, metrics_shardings),
             donate_argnums=(0,))
         self._train_multi_fn = jax.jit(
-            multi_step,
+            self.sentry.wrap(multi_step, "train_multi_onebit", budget=None),
             out_shardings=(self.state_shardings, metrics_shardings),
             donate_argnums=(0,))
 

@@ -226,7 +226,8 @@ def test_chunked_serving_matches_sequential_generate(tiny_engine):
     and the stats() / step_log observability probes fire."""
     engine, cfg = tiny_engine
     srv = ServingEngine(engine, slots=4, max_seq_len=128, block_size=8,
-                        prefill_chunk=16, prefill_batch=2)
+                        prefill_chunk=16, prefill_batch=2,
+                        debug_checks=True)
     reqs = _shared_prefix_trace(cfg, 6)
     steps = []
     res = srv.serve(reqs, step_log=steps)
@@ -241,8 +242,13 @@ def test_chunked_serving_matches_sequential_generate(tiny_engine):
     assert st["prefix_hit_tokens"] % srv.block_size == 0
     for key in ("prefix_cache_hit_rate", "blocks_in_use", "compile_count",
                 "admitted", "evicted", "decode_steps", "prefill_calls",
-                "num_blocks", "free_blocks"):
+                "num_blocks", "free_blocks", "compile_budget",
+                "debug_checks", "invariant_checks_run",
+                "retraces_observed"):
         assert key in st, key
+    # debug_checks=True: every iteration audited, zero retrace drift
+    assert st["debug_checks"] and st["invariant_checks_run"] > 0
+    assert st["retraces_observed"] == 0
     assert st["admitted"] == len(reqs)
     assert steps and sum(s["admitted"] for s in steps) == len(reqs)
     assert all("blocks_in_use" in s and "evicted" in s for s in steps)
@@ -251,7 +257,8 @@ def test_chunked_serving_matches_sequential_generate(tiny_engine):
 def test_chunked_serving_parity_with_eos(tiny_engine):
     engine, cfg = tiny_engine
     srv = ServingEngine(engine, slots=3, max_seq_len=128, block_size=8,
-                        prefill_chunk=16, prefill_batch=2)
+                        prefill_chunk=16, prefill_batch=2,
+                        debug_checks=True)
     reqs = _shared_prefix_trace(cfg, 4, seed=1, max_new=(4, 10))
     probe = engine.generate(reqs[0].prompt[None, :], max_new_tokens=1)
     eos = int(probe[0, len(reqs[0].prompt)])
@@ -296,10 +303,15 @@ def test_chunked_serving_parity_other_families(family):
 def test_chunked_compile_count_is_two_programs(tiny_engine):
     """Acceptance: the chunked serving loop compiles exactly 1 prefill + 1
     decode program for a whole mixed-shape trace — and stays there for new
-    shapes."""
+    shapes.  Enforced LIVE by the recompile sentry (debug_checks=True
+    raises at trace time past the budget of 2), which also replaces the
+    old per-fn ``_cache_size`` retrace probe: the sentry counts actual
+    Python-body traces, so silent retraces can't hide."""
     engine, cfg = tiny_engine
     srv = ServingEngine(engine, slots=4, max_seq_len=128, block_size=8,
-                        prefill_chunk=16, prefill_batch=2)
+                        prefill_chunk=16, prefill_batch=2,
+                        debug_checks=True)
+    assert srv.compile_budget == 2
     rng = np.random.default_rng(3)
     reqs = [Request(uid=i, prompt=rng.integers(0, cfg.vocab_size,
                                                int(rng.integers(3, 40))),
@@ -313,11 +325,9 @@ def test_chunked_compile_count_is_two_programs(tiny_engine):
              for i in range(6)]
     srv.serve(reqs2)                           # new shapes: no new programs
     assert srv.compile_count == 2, srv.compiled_programs
-    # each jitted fn has exactly one executable (no silent retraces)
-    for fn in list(srv._prefill_fns.values()) + [srv._decode_fn]:
-        cache_size = getattr(fn, "_cache_size", None)
-        if cache_size is not None:
-            assert cache_size() == 1
+    # sentry ledger: exactly one trace per program, zero beyond budget
+    assert srv.sentry.traces == 2, srv.sentry.report()
+    assert srv.sentry.retraces_observed == 0
 
 
 def test_prefix_cache_reuse_across_serve_calls(tiny_engine):
@@ -325,7 +335,8 @@ def test_prefix_cache_reuse_across_serve_calls(tiny_engine):
     the second serve call's hit tokens cover the registered prefix."""
     engine, cfg = tiny_engine
     srv = ServingEngine(engine, slots=2, max_seq_len=128, block_size=8,
-                        prefill_chunk=32, prefill_batch=2)
+                        prefill_chunk=32, prefill_batch=2,
+                        debug_checks=True)
     rng = np.random.default_rng(4)
     prefix = rng.integers(0, cfg.vocab_size, 32)      # 4 full blocks
 
@@ -351,9 +362,12 @@ def test_preemption_under_block_pressure_keeps_parity(tiny_engine):
     and the eviction counters fire."""
     engine, cfg = tiny_engine
     # nbper = 64/8 = 8; 3 slots want up to 6 blocks each (17 prompt + 28
-    # new -> 45 tokens) but only 11 usable blocks exist
+    # new -> 45 tokens) but only 11 usable blocks exist.  debug_checks
+    # audits the allocator/trie/table invariants through every eviction +
+    # preemption round — the hardest path for refcount conservation.
     srv = ServingEngine(engine, slots=3, max_seq_len=64, block_size=8,
-                        prefill_chunk=32, prefill_batch=2, num_blocks=12)
+                        prefill_chunk=32, prefill_batch=2, num_blocks=12,
+                        debug_checks=True)
     rng = np.random.default_rng(5)
     reqs = [Request(uid=i, prompt=rng.integers(0, cfg.vocab_size, 17),
                     max_new_tokens=28) for i in range(5)]
@@ -388,9 +402,12 @@ def test_bucketed_preemption_resume_outgrows_ladder():
     # nbper = 8; 3 slots want 6 blocks each (20 prompt + 24 new) but only
     # 11 usable exist -> preemption; resumes reach 20+k > 24 tokens, past
     # the (24,)-ladder
+    # bucketed budget = len(buckets) + 2 (ladder + full-cache-width
+    # preemption fallback + decode) — the sentry enforces it live
     srv = ServingEngine(engine, slots=3, max_seq_len=64, block_size=8,
                         prompt_buckets=(24,), prefill_batch=2,
-                        num_blocks=12)
+                        num_blocks=12, debug_checks=True)
+    assert srv.compile_budget == 3
     rng = np.random.default_rng(7)
     reqs = [Request(uid=i, prompt=rng.integers(0, cfg.vocab_size, 20),
                     max_new_tokens=24) for i in range(4)]

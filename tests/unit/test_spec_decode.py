@@ -132,9 +132,11 @@ def test_spec_ngram_matches_plain_and_sequential(tiny_engine):
     engine, cfg = tiny_engine
     reqs = _trace(cfg, 6)
     plain = ServingEngine(engine, slots=4, max_seq_len=128, block_size=8,
-                          prefill_chunk=16, prefill_batch=2)
+                          prefill_chunk=16, prefill_batch=2,
+                          debug_checks=True)
     spec = ServingEngine(engine, slots=4, max_seq_len=128, block_size=8,
-                         prefill_chunk=16, prefill_batch=2, spec_tokens=4)
+                         prefill_chunk=16, prefill_batch=2, spec_tokens=4,
+                         debug_checks=True)
     res_p = plain.serve(reqs)
     res_s = spec.serve(reqs)
     for r in reqs:
@@ -168,7 +170,7 @@ def test_spec_draft_model_matches_sequential(tiny_engine):
                            num_layers=1, num_heads=2, hidden_size=32)
     spec = ServingEngine(engine, slots=3, max_seq_len=128, block_size=8,
                          prefill_chunk=16, prefill_batch=2, spec_tokens=3,
-                         draft=gpt2.build(dcfg))
+                         draft=gpt2.build(dcfg), debug_checks=True)
     reqs = _trace(cfg, 5, seed=1)
     res = spec.serve(reqs)
     for r in reqs:
@@ -190,7 +192,8 @@ def test_spec_eos_inside_window_end_to_end(tiny_engine):
     probe = engine.generate(reqs[0].prompt[None, :], max_new_tokens=6)
     eos = int(probe[0, len(reqs[0].prompt) + 3])   # mid-stream token as eos
     spec = ServingEngine(engine, slots=3, max_seq_len=128, block_size=8,
-                         prefill_chunk=16, prefill_batch=2, spec_tokens=4)
+                         prefill_chunk=16, prefill_batch=2, spec_tokens=4,
+                         debug_checks=True)
     res = spec.serve(reqs, eos_token_id=eos)
     for r in reqs:
         want = engine.generate(r.prompt[None, :],
@@ -206,7 +209,8 @@ def test_spec_compile_contract_holds_across_traces(tiny_engine):
     in a second serve call add none."""
     engine, cfg = tiny_engine
     spec = ServingEngine(engine, slots=4, max_seq_len=128, block_size=8,
-                         prefill_chunk=16, prefill_batch=2, spec_tokens=4)
+                         prefill_chunk=16, prefill_batch=2, spec_tokens=4,
+                         debug_checks=True)
     spec.serve(_trace(cfg, 6, seed=3))
     assert spec.compile_count == 2, spec.compiled_programs
     assert sorted(p[0] for p in spec.compiled_programs) == \
@@ -214,11 +218,11 @@ def test_spec_compile_contract_holds_across_traces(tiny_engine):
     spec.serve(_trace(cfg, 4, seed=4, plen=(30, 60), max_new=(2, 30)))
     assert spec.compile_count == 2, spec.compiled_programs
     assert spec.compile_count <= 3
-    # no silent retraces inside the jitted fns either
-    for fn in list(spec._prefill_fns.values()) + [spec._verify_fn]:
-        cache_size = getattr(fn, "_cache_size", None)
-        if cache_size is not None:
-            assert cache_size() == 1
+    # no silent retraces inside the jitted fns either: the sentry counts
+    # actual Python-body traces against the 2-program budget (and, with
+    # debug_checks on above, would have raised at trace time)
+    assert spec.sentry.traces == 2, spec.sentry.report()
+    assert spec.sentry.retraces_observed == 0
 
 
 def test_spec_preemption_pressure_keeps_parity(tiny_engine):
@@ -227,7 +231,7 @@ def test_spec_preemption_pressure_keeps_parity(tiny_engine):
     engine, cfg = tiny_engine
     srv = ServingEngine(engine, slots=3, max_seq_len=64, block_size=8,
                         prefill_chunk=32, prefill_batch=2, num_blocks=12,
-                        spec_tokens=4)
+                        spec_tokens=4, debug_checks=True)
     rng = np.random.default_rng(5)
     reqs = [Request(uid=i, prompt=rng.integers(0, cfg.vocab_size, 17),
                     max_new_tokens=28) for i in range(5)]
@@ -257,7 +261,8 @@ def test_spec_parity_bloom_family():
                     max_new_tokens=int(rng.integers(3, 10)))
             for i in range(4)]
     spec = ServingEngine(engine, slots=3, max_seq_len=64, block_size=8,
-                         prefill_chunk=16, prefill_batch=2, spec_tokens=3)
+                         prefill_chunk=16, prefill_batch=2, spec_tokens=3,
+                         debug_checks=True)
     res = spec.serve(reqs)
     for r in reqs:
         want = engine.generate(r.prompt[None, :],

@@ -16,6 +16,11 @@ Tier-1 (fast) CPU-sim coverage on the 8-device mesh (conftest):
 The scheduler (allocator, prefix trie, block tables) is host-side and
 head-sharding-invariant, so admission order and compile counts are
 bit-identical across tp degrees — the parity tests exercise exactly that.
+
+Every trace here runs with ``debug_checks=True``: the recompile sentry
+enforces the compile budget at trace time and the paged-state invariants
+are audited every scheduler iteration (``analysis/``), so each parity
+test doubles as a retrace + bookkeeping regression test.
 """
 
 import numpy as np
@@ -63,7 +68,7 @@ def _serve_pair(e1, e4, cfg, seed, **srv_kw):
     """Serve the same trace at tp=1 and tp=4; return both result dicts and
     the two engines' ServingEngines."""
     kw = dict(slots=4, max_seq_len=128, block_size=8, prefill_chunk=16,
-              prefill_batch=2)
+              prefill_batch=2, debug_checks=True)
     kw.update(srv_kw)
     s1 = ServingEngine(e1, **kw)
     s4 = ServingEngine(e4, **kw)
@@ -113,7 +118,7 @@ def test_tp4_parity_under_preemption(tp1_engine, tp4_engine, tiny_cfg):
     """Block pressure (preemption + recompute) resolves identically at any
     tp degree — the allocator never sees head counts."""
     kw = dict(slots=3, max_seq_len=64, block_size=8, prefill_chunk=32,
-              prefill_batch=2, num_blocks=12)
+              prefill_batch=2, num_blocks=12, debug_checks=True)
     rng = np.random.default_rng(5)
     prompts = [rng.integers(0, tiny_cfg.vocab_size, 17) for _ in range(5)]
     s1 = ServingEngine(tp1_engine, **kw)
@@ -157,7 +162,8 @@ def test_gqa_indivisible_heads_fall_back_or_raise():
         llama.build(cfg),
         config={"dtype": "fp32", "tensor_parallel": {"tp_size": 4}})
     srv = ServingEngine(engine, slots=2, max_seq_len=64, block_size=8,
-                        prefill_chunk=16, prefill_batch=2)
+                        prefill_chunk=16, prefill_batch=2,
+                        debug_checks=True)
     assert not srv.kv_sharded and srv.tp_degree == 4
     prompt = np.arange(10) % cfg.vocab_size
     res = srv.serve([Request(uid=0, prompt=prompt, max_new_tokens=5)])
@@ -177,7 +183,8 @@ def test_gqa_divisible_heads_shard():
         llama.build(cfg),
         config={"dtype": "fp32", "tensor_parallel": {"tp_size": 2}})
     srv = ServingEngine(engine, slots=2, max_seq_len=64, block_size=8,
-                        prefill_chunk=16, prefill_batch=2)
+                        prefill_chunk=16, prefill_batch=2,
+                        debug_checks=True)
     assert srv.kv_sharded and srv.tp_degree == 2
     assert srv._cache["k"].addressable_shards[0].data.shape[2] == 1
     prompt = np.arange(12) % cfg.vocab_size
@@ -194,7 +201,7 @@ def test_draft_pool_shards_with_target(tp4_engine, tiny_cfg):
                            num_layers=1, num_heads=4, hidden_size=64)
     srv = ServingEngine(tp4_engine, slots=4, max_seq_len=128, block_size=8,
                         prefill_chunk=16, prefill_batch=2, spec_tokens=3,
-                        draft=gpt2.build(dcfg))
+                        draft=gpt2.build(dcfg), debug_checks=True)
     assert srv._dcache_sharded
     assert srv._dcache["k"].addressable_shards[0].data.shape[2] == 1
     reqs = _trace(tiny_cfg, 4, seed=2)
