@@ -29,6 +29,20 @@ tokens/sec plus compile counts and the paged engine's ``stats()``:
    ``--speculative`` is also given.  Needs >= N devices — on CPU set
    ``XLA_FLAGS=--xla_force_host_platform_device_count=8``; CPU-sim tok/s
    under tp is emulation overhead, not a hardware prediction.
+ - **serving_quant** (``--quantize kv8[,w8a8[,w8a8+kv8]]``): quantized
+   serving lanes on the same trace — int8 KV pool with per-block scales
+   (``kv8``), K-grouped int8 weights on the s8 decode kernels
+   (``w8a8``), or both.  Each lane reports tok/s, the quant-adjusted
+   per-chip pool bytes, ``servable_blocks_per_chip_vs_bf16`` (bf16 pool
+   bytes / quant pool bytes — the memory headline; ~1.9x for ``kv8``),
+   and the measured token match rate vs full-precision sequential
+   (bounded-divergence contract, ``tests/unit/quant_divergence.py`` —
+   quantized lanes are NOT exact-parity lanes).  With ``--tp N`` a
+   ``kv8`` lane also runs on the tp engine (the tp × kv8 combo: per-chip
+   pool bytes divide by BOTH factors).  CPU-sim tok/s measures XLA-CPU
+   op mixes, not HBM bandwidth — the on-chip bandwidth argument is
+   PROFILE.md's (+32-34% w8a8 decode; int8 KV halves decode's dominant
+   traffic term).
 
 Methodology (PROFILE.md "continuous-batching serving" entry): the default
 trace draws ARBITRARY prompt lengths in [32, 512] and completion budgets in
@@ -48,10 +62,20 @@ outputs are token-identical to sequential before reporting numbers.
 decode-bound traffic speculative decoding targets (BENCH_r05 lane:
 ``--decode-heavy --speculative 4``).
 
+``--quant-suite`` runs the BENCH_r07 protocol: the mixed, prefix-heavy,
+and decode-heavy traces each with the quantized lanes, plus the tp × kv8
+combo, merged into one JSON.  Recommended at ``--dtype bf16`` (the
+production serving dtype the memory/throughput headlines are quoted
+against); bf16 runs gate the unquantized baseline on per-request
+agreement instead of bit parity (see ``main`` — bf16 near-tie argmax
+flips between equally valid compute shapes), fp32 runs keep the exact
+gate.
+
 Usage:
   python benchmarks/serving_bench.py [--requests 64] [--slots 8]
       [--prefix-len 256] [--grid] [--decode-heavy] [--speculative K]
-      [--tp N] [--layers 2] [--hidden 128] [--seed 0] [--json out.json]
+      [--tp N] [--quantize kv8,w8a8+kv8 | --quant-suite]
+      [--layers 2] [--hidden 128] [--seed 0] [--json out.json]
 """
 
 from __future__ import annotations
@@ -132,7 +156,7 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
               grid: bool = False, prefix_len: int = 0,
               block_size: int = 32, prefill_chunk: int = 128,
               speculative: int = 0, decode_heavy: bool = False,
-              tp: int = 1):
+              tp: int = 1, quantize: tuple = ()):
     import deepspeed_tpu
     from deepspeed_tpu.inference.serving import ServingEngine
     from deepspeed_tpu.models import gpt2
@@ -287,6 +311,93 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
             tp_outs = {u: (tp_outs[u],) for u in tp_outs}
         tp_outs = {u: list(v) + [tp_outs2[u]] for u, v in tp_outs.items()}
 
+    # --- quantized lanes (--quantize): int8 KV pool / w8a8 weights on the
+    # same trace and engine config.  Bounded divergence replaces exact
+    # parity here: the token match rate vs full-precision sequential is
+    # measured and recorded (quantized greedy is a different — equally
+    # valid — greedy model, so a near-tie argmax flip cascades).
+    quant_res = {}
+    if quantize:
+        tu = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tests", "unit")
+        if tu not in sys.path:     # idempotent: --quant-suite re-enters
+            sys.path.insert(0, tu)
+        from quant_divergence import token_match_rate
+
+        for mode in quantize:
+            eng_q = engine
+            if "w8a8" in mode:
+                deepspeed_tpu.comm.reset_topology()
+                eng_q = deepspeed_tpu.init_inference(
+                    gpt2.build(cfg),
+                    config={"dtype": dtype,
+                            "quant": {"enabled": True, "type": "w8a8"},
+                            "tensor_parallel": {"tp_size": 1}})
+            srv_q = ServingEngine(eng_q, slots=slots, max_seq_len=max_total,
+                                  prefill_batch=prefill_batch,
+                                  block_size=block_size,
+                                  prefill_chunk=prefill_chunk,
+                                  quantize=mode)
+            t0 = time.perf_counter()
+            q_outs = srv_q.serve(reqs)
+            q_cold = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            srv_q.serve(reqs)
+            q_warm = time.perf_counter() - t0
+            qst = srv_q.stats()
+            # bf16 yardstick for the memory headline: the pool's payload
+            # element count at 2 bytes (identical to a bf16 pool's actual
+            # bytes; the parity baseline above runs fp32, which would
+            # flatter the ratio by 2x)
+            bf16_bytes = 2 * 2 * int(np.prod(qst["kv_pool_shape"]))
+            quant_res[mode] = {
+                "tok_s": gen_tokens / q_cold,
+                "wall_s": q_cold,
+                "tok_s_warm": gen_tokens / q_warm,
+                "wall_warm_s": q_warm,
+                "compiled_programs": srv_q.compile_count,
+                "kv_dtype": qst["kv_dtype"],
+                "weight_quant": qst["weight_quant"],
+                "kv_pool_bytes": qst["kv_pool_bytes"],
+                "kv_scale_bytes": qst["kv_scale_bytes"],
+                "kv_pool_bytes_per_chip": qst["kv_pool_bytes_per_chip"],
+                "servable_blocks_per_chip_vs_bf16":
+                    bf16_bytes / qst["kv_pool_bytes"]
+                    if qst["kv_dtype"] == "int8" else 1.0,
+                "token_match_rate_vs_sequential":
+                    token_match_rate(seq_outs, q_outs),
+                "tok_s_vs_serving": (gen_tokens / q_cold) /
+                    (gen_tokens / srv_cold),
+                "tok_s_warm_vs_serving": srv_warm / q_warm,
+            }
+        if tp > 1 and any("kv8" in m for m in quant_res):
+            # tp x kv8 combo: the per-chip pool divides by BOTH factors
+            srv_tpq = ServingEngine(engine_tp, slots=slots,
+                                    max_seq_len=max_total,
+                                    prefill_batch=prefill_batch,
+                                    block_size=block_size,
+                                    prefill_chunk=prefill_chunk,
+                                    quantize="kv8")
+            t0 = time.perf_counter()
+            tpq_outs = srv_tpq.serve(reqs)
+            tpq_cold = time.perf_counter() - t0
+            tpq_st = srv_tpq.stats()
+            bf16_rep_per_chip = 2 * 2 * int(np.prod(tpq_st["kv_pool_shape"]))
+            quant_res["kv8+tp"] = {
+                "tp": tp,
+                "tok_s": gen_tokens / tpq_cold,
+                "wall_s": tpq_cold,
+                "kv_sharded": tpq_st["kv_sharded"],
+                "kv_pool_bytes_per_chip":
+                    tpq_st["kv_pool_bytes_per_chip"],
+                "servable_blocks_per_chip_vs_bf16_replicated":
+                    bf16_rep_per_chip / tpq_st["kv_pool_bytes_per_chip"],
+                "token_match_rate_vs_sequential":
+                    token_match_rate(seq_outs, tpq_outs),
+                "compiled_programs": srv_tpq.compile_count,
+            }
+
     mismatches = [r.uid for r in reqs
                   if not (np.array_equal(seq_outs[r.uid], srv_outs[r.uid])
                           and np.array_equal(seq_outs[r.uid],
@@ -353,6 +464,7 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
         "speedup_spec_vs_chunked_warm": (srv_warm / spec_res["wall_warm_s"])
         if spec_res else None,
         "serving_tp": tp_res,
+        "serving_quant": quant_res or None,
         # the memory headline: per-chip KV pool bytes, replicated vs
         # head-sharded — sharding shrinks the per-chip share by ~tp
         "kv_bytes_per_chip_replicated":
@@ -400,22 +512,87 @@ def main():
                          "pool sharded over an N-way tp mesh axis (needs "
                          ">= N devices; on CPU set XLA_FLAGS="
                          "--xla_force_host_platform_device_count=8)")
+    ap.add_argument("--quantize", default=None, metavar="MODES",
+                    help="comma list of quantized lanes to add: kv8, w8a8, "
+                         "w8a8+kv8 (bounded divergence, not exact parity)")
+    ap.add_argument("--quant-suite", action="store_true",
+                    help="run the BENCH_r07 protocol: mixed + prefix-heavy "
+                         "+ decode-heavy traces with quantized lanes and a "
+                         "tp=4 x kv8 combo point, merged into one JSON")
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
 
-    res = run_bench(requests=args.requests, slots=args.slots,
-                    prefill_batch=args.prefill_batch, layers=args.layers,
-                    hidden=args.hidden, heads=args.heads, vocab=args.vocab,
-                    seed=args.seed, dtype=args.dtype, grid=args.grid,
-                    prefix_len=args.prefix_len, block_size=args.block_size,
-                    prefill_chunk=args.prefill_chunk,
-                    speculative=args.speculative,
-                    decode_heavy=args.decode_heavy, tp=args.tp)
+    quantize = tuple(m for m in (args.quantize or "").split(",") if m)
+    kw = dict(requests=args.requests, slots=args.slots,
+              prefill_batch=args.prefill_batch, layers=args.layers,
+              hidden=args.hidden, heads=args.heads, vocab=args.vocab,
+              seed=args.seed, dtype=args.dtype, block_size=args.block_size,
+              prefill_chunk=args.prefill_chunk)
+    if args.quant_suite:
+        modes = quantize or ("kv8", "w8a8", "w8a8+kv8")
+        # the protocol PROMISES a tp x kv8 combo point: default to tp=4
+        # when --tp wasn't raised (needs >= 4 devices — run_bench exits
+        # with the XLA_FLAGS hint otherwise) so the artifact can't
+        # silently ship without it
+        suite_tp = args.tp if args.tp > 1 else 4
+        res = {
+            "protocol": "quantized paged serving (PR 7): tok/s + servable "
+                        "blocks-per-chip vs bf16 per trace; bounded "
+                        "token divergence vs full-precision sequential "
+                        "(tests/unit/quant_divergence.py)",
+            "mixed": run_bench(quantize=modes, tp=suite_tp, **kw),
+            "prefix_heavy": run_bench(prefix_len=256, quantize=modes,
+                                      **kw),
+            "decode_heavy": run_bench(decode_heavy=True, quantize=modes,
+                                      **kw),
+        }
+        # the suite's recommended dtype is bf16 (the production serving
+        # dtype the headlines are quoted against).  At bf16 even the
+        # UNQUANTIZED serving-vs-sequential comparison can see rare
+        # near-tie argmax flips — chunked prefill and one-shot generate
+        # reduce in different shapes/orders, both equally valid bf16
+        # greedy outputs — so bf16 runs gate on a >= 0.95 per-request
+        # agreement floor and record the rate; fp32 runs keep the exact
+        # bit-parity gate the non-quant benches pin.
+        bf16 = str(args.dtype).replace("torch.", "") in (
+            "bf16", "bfloat16")
+        ok = True
+        # the documented divergence bounds (tests/unit/quant_divergence.py
+        # / README): a quant lane shipping below its bound must fail the
+        # run, not silently land in the committed artifact
+        bounds = {"kv8": 0.85, "kv8+tp": 0.85}
+        for t in ("mixed", "prefix_heavy", "decode_heavy"):
+            frac = 1.0 - len(res[t]["mismatched_uids"]) / res[t]["requests"]
+            res[t]["baseline_request_agreement"] = frac
+            ok &= res[t]["token_parity"] if not bf16 else frac >= 0.95
+            for mode, lane in (res[t].get("serving_quant") or {}).items():
+                rate = lane.get("token_match_rate_vs_sequential")
+                if rate is None:
+                    continue
+                floor = bounds.get(mode, 0.70)   # w8a8 lanes: 0.70
+                lane["token_match_bound"] = floor
+                if rate < floor:
+                    print(f"WARNING: {t}/{mode} token match {rate:.3f} "
+                          f"below the documented bound {floor}",
+                          file=sys.stderr)
+                    ok = False
+        res["baseline_parity_note"] = (
+            "bf16 run: unquantized serving vs sequential is agreement-"
+            "gated (>= 0.95 of requests token-exact) — bf16 near-tie "
+            "argmax flips between equally valid compute shapes are not a "
+            "serving bug; fp32 runs assert exact parity" if bf16 else
+            "fp32 run: unquantized lanes assert exact token parity")
+    else:
+        res = run_bench(grid=args.grid, prefix_len=args.prefix_len,
+                        speculative=args.speculative,
+                        decode_heavy=args.decode_heavy, tp=args.tp,
+                        quantize=quantize, **kw)
+        ok = res["token_parity"]
     print(json.dumps(res, indent=2))
     if args.json:
         with open(args.json, "w") as f:
             json.dump(res, f, indent=2)
-    if not res["token_parity"]:
+    if not ok:
         print("WARNING: serving outputs diverged from sequential generate",
               file=sys.stderr)
         return 1

@@ -48,6 +48,14 @@ the fault-injection test matrix in ``tests/unit/test_analysis.py``):
     ownership record), no physical block appears twice in a slot, and
     the span covers every token the slot has committed (``lengths`` /
     prefill base).  Inactive slots are fully zeroed.
+``scale-lockstep``
+    int8-KV engines only (``quantize="kv8"``): the per-block scale table
+    is allocated and retired in lockstep with the blocks.  The engine's
+    host ledger of live-scale blocks must cover every owner-held block
+    (a held block outside the ledger means its reads would dequantize a
+    previous owner's stale scales), contain only blocks with a nonzero
+    refcount (a ledger entry surviving the free is a stale scale row
+    waiting to be trusted), and never the scratch block.
 
 The audit reads pure host state (numpy + lists) — no device sync — and
 runs in O(num_blocks + trie entries).  ``ServingEngine`` calls it after
@@ -84,7 +92,8 @@ def _blocks_for(num_tokens: int, block_size: int) -> int:
 def audit_paged_state(allocator, tables, held, *,
                       prefix=None,
                       active_needs: Optional[Dict[int, int]] = None,
-                      block_size: int = 1) -> None:
+                      block_size: int = 1,
+                      scale_live=None) -> None:
     """Verify every invariant over one engine's host state; raises
     :class:`PagedStateError` naming the first violated invariant.
 
@@ -98,6 +107,9 @@ def audit_paged_state(allocator, tables, held, *,
     active_needs:  ``slot -> committed token count`` for live slots; slots
                    absent from the map must be fully released.
     block_size:    tokens per block (converts needs to table spans).
+    scale_live:    optional set of block ids whose int8-KV scale rows are
+                   live (``quantize="kv8"`` engines); ``None`` skips the
+                   ``scale-lockstep`` check entirely.
     """
     ref, free = allocator.snapshot()
     num_blocks = allocator.num_blocks
@@ -196,6 +208,28 @@ def audit_paged_state(allocator, tables, held, *,
                 f"trie entry uid={e.uid} has {actual} live children but "
                 f"its block {e.block} is unreferenced")
 
+    # ---- scale-lockstep (int8 KV only): scale rows live <=> block owned
+    if scale_live is not None:
+        if SCRATCH_BLOCK in scale_live:
+            raise PagedStateError(
+                "scale-lockstep",
+                "the scratch block is in the live-scale ledger — scratch "
+                "is never owned, its scale row is write-only garbage")
+        for b in scale_live:
+            if not (0 <= int(b) < num_blocks) or ref[int(b)] == 0:
+                raise PagedStateError(
+                    "scale-lockstep",
+                    f"block {b} is in the live-scale ledger but has no "
+                    "owner (refcount 0) — a stale scale row survived the "
+                    "block free")
+        for b in range(1, num_blocks):
+            if (ref[b] > 0 or expected[b] > 0) and b not in scale_live:
+                raise PagedStateError(
+                    "scale-lockstep",
+                    f"block {b} is owned (refcount {ref[b]}) but missing "
+                    "from the live-scale ledger — its reads would "
+                    "dequantize stale scales")
+
     # ---- length-occupancy + scratch-aliasing over the tables
     nslots = len(tables)
     for slot in range(nslots):
@@ -244,4 +278,7 @@ def audit_serving_engine(srv, active) -> None:
              for slot, st in active.items()}
     audit_paged_state(srv._alloc, srv._tables, srv._held,
                       prefix=srv._prefix, active_needs=needs,
-                      block_size=srv.block_size)
+                      block_size=srv.block_size,
+                      scale_live=(srv._kv_scale_live
+                                  if getattr(srv, "kv_quant", False)
+                                  else None))

@@ -230,9 +230,12 @@ class PrefixCache:
         return sum(1 for e in self._entries.values()
                    if allocator.refcount(e.block) == 1)
 
-    def evict_one(self, allocator: BlockAllocator) -> bool:
-        """Release the LRU leaf entry only the cache still holds; True if a
-        block was freed."""
+    def evict_one(self, allocator: BlockAllocator) -> Optional[int]:
+        """Release the LRU leaf entry only the cache still holds; returns
+        the freed block id (truthy — block 0 is scratch and never cached)
+        or ``None``.  The id lets the caller retire per-block side state in
+        lockstep with the free (the serving engine's int8-KV scale ledger,
+        ``serving.py``)."""
         for key, e in self._entries.items():    # oldest first
             if e.children == 0 and allocator.refcount(e.block) == 1:
                 del self._entries[key]
@@ -240,5 +243,5 @@ class PrefixCache:
                     e.parent.children -= 1
                 allocator.decref(e.block)
                 self.evictions += 1
-                return True
-        return False
+                return int(e.block)
+        return None

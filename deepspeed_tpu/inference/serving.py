@@ -85,10 +85,34 @@ does not divide tp fall back to the replicated tp=1 layout (head groups
 are shared across chips); ``shard_kv=True`` then raises instead of
 silently replicating.
 
+**Quantized serving** (``quantize=``, default off): decode is memory-
+bandwidth-bound, and the KV pool plus the weights are the traffic.
+``"kv8"`` stores the paged pool (and the speculative draft pool) as int8
+with a per-block scale table (``ops/paged_kv.py`` quantized pool
+records): the scatter quantizes on write, the gather and all three paged
+Pallas kernels dequantize on read, so HBM moves codes + scales — ~2x
+servable blocks per chip and ~2x decode KV bandwidth, multiplicative
+with the tp head-shard.  ``"w8a8"`` requires the wrapped engine to carry
+K-grouped int8 weights (``init_serving(quantize=...)`` wires the config;
+``quant: {enabled, type: "w8a8"}``) — decode matmuls then run the s8-MXU
+stacked kernels (``ops/quantized_matmul``), while prefill/verify rows
+fall back to the exact dequant path.  ``"w8a8+kv8"`` composes both.  The
+host side is quant-invariant: allocator, trie, tables, and scheduling
+are byte-identical to the float pool (scales ride under block ids), the
+≤2/≤3-program compile contracts hold unchanged, and ``quantize=None``
+traces the exact pre-quantization programs bit-for-bit.  Greedy parity
+becomes a *bounded-divergence* contract on quantized lanes (int8
+rounding can flip near-tie argmaxes): ``tests/unit/test_quant_serving.py``
+pins token-match-rate and logit-RMSE bounds instead of bit equality.
+A host-side ledger tracks which blocks own live scale rows; the
+``debug_checks`` audit enforces it (``scale-lockstep`` invariant,
+``analysis/invariants.py``).
+
 Greedy decoding only: per-request outputs are token-identical to
 sequential ``generate`` (pinned in ``tests/unit/test_serving.py``,
 ``tests/unit/test_paged_serving.py``, ``tests/unit/test_spec_decode.py``,
-and — across tp degrees — ``tests/unit/test_tp_serving.py``).
+and — across tp degrees — ``tests/unit/test_tp_serving.py``); quantized
+lanes are bounded-divergence instead (above).
 """
 
 from __future__ import annotations
@@ -116,8 +140,26 @@ from .paged import BlockAllocator, PrefixCache
 from .spec import NGramProposer, greedy_accept
 
 
+#: legal ``quantize=`` values (order-normalized; ``None`` = full precision)
+_QUANT_MODES = ("kv8", "w8a8", "w8a8+kv8")
+
+
+def _parse_quantize(quantize):
+    """Normalize the ``quantize=`` knob -> ``(normalized str | None,
+    kv_quant bool, want_w8a8 bool)``; raises naming the legal values."""
+    if quantize is None or quantize == "":
+        return None, False, False
+    parts = sorted(str(quantize).split("+"))
+    if not set(parts) <= {"kv8", "w8a8"} or len(set(parts)) != len(parts):
+        raise ValueError(
+            f"quantize={quantize!r} — expected one of {_QUANT_MODES} "
+            "(or None for full precision)")
+    norm = "+".join(p for p in ("w8a8", "kv8") if p in parts)
+    return norm, "kv8" in parts, "w8a8" in parts
+
+
 def _validate_decode_hooks(module, *, speculative: bool = False,
-                           role: str = "model"):
+                           kv_quant: bool = False, role: str = "model"):
     """Fail fast at engine construction, naming the exact missing hook,
     instead of a TypeError deep inside the first prefill call.  Checks the
     hook dict AND the ``forward_cached`` signature (a family can carry a
@@ -147,6 +189,12 @@ def _validate_decode_hooks(module, *, speculative: bool = False,
             f"{role} {name}'s decode hooks lack the speculative verify "
             "head (supports_verify) — add all-position logits "
             "(all_positions=True) to its forward_cached first")
+    if kv_quant and not hooks.get("supports_kv_quant"):
+        raise ValueError(
+            f"{role} {name}'s decode hooks do not declare int8-KV support "
+            "(supports_kv_quant) — a family qualifies when every pool "
+            "read/write goes through ops/paged_kv (record-aware); set the "
+            "flag after verifying that, or drop quantize='kv8'")
     try:
         sig = inspect.signature(hooks["forward_cached"])
     except (TypeError, ValueError):        # builtins / C callables: trust flags
@@ -266,6 +314,14 @@ class ServingEngine:
                     it.  ``True`` additionally raises when the head count
                     does not divide (instead of silently replicating);
                     ``False`` forces the replicated tp=1 layout.
+    quantize:       serving-path quantization (module docstring): ``None``
+                    (default, bit-identical to pre-quantization behavior),
+                    ``"kv8"`` (int8 paged KV pool + per-block scale
+                    table), ``"w8a8"`` (requires an engine whose params
+                    carry K-grouped int8 records — ``init_serving`` wires
+                    the config), or ``"w8a8+kv8"``.  Quantized lanes trade
+                    exact greedy parity for a bounded token-divergence /
+                    logit-error contract.
     draft:          draft proposer model — an ``init_inference`` engine or
                     a bare ModelSpec (wrapped with the target's inference
                     config) of a small same-family/same-tokenizer model.
@@ -295,6 +351,7 @@ class ServingEngine:
                  prefill_chunk: int = 128,
                  prefix_caching: bool = True,
                  spec_tokens: int = 0,
+                 quantize: Optional[str] = None,
                  draft=None,
                  ngram_max: int = 3,
                  ngram_min: int = 1,
@@ -307,8 +364,18 @@ class ServingEngine:
             raise ValueError(
                 "a draft model was given but spec_tokens is 0 — pass "
                 "spec_tokens=K to enable speculative decoding")
+        self.quantize, self.kv_quant, want_w8a8 = _parse_quantize(quantize)
+        qcfg = engine._config.quant
+        self.weight_quant = qcfg.type if qcfg.enabled else None
+        if want_w8a8 and self.weight_quant != "w8a8":
+            raise ValueError(
+                "quantize includes 'w8a8' but the wrapped engine carries "
+                f"{self.weight_quant or 'full-precision'} weights — build "
+                "it with config={'quant': {'enabled': True, 'type': "
+                "'w8a8'}} (init_serving(quantize=...) does this for you)")
         hooks = _validate_decode_hooks(engine.module,
-                                       speculative=bool(self.spec_tokens))
+                                       speculative=bool(self.spec_tokens),
+                                       kv_quant=self.kv_quant)
         self.engine = engine
         self._fwd = hooks["forward_cached"]
         self._init_cache = hooks["init_cache"]
@@ -366,9 +433,31 @@ class ServingEngine:
         # stacked [L, NB, HKV, bs, hd] buffer) when the mesh carries a tp
         # axis the head count divides, else replicated (module docstring)
         self.tp_degree = int(dict(engine.mesh.shape).get(TP_AXIS, 1))
-        pool = self._init_cache(num_blocks, self.block_size,
-                                engine._config.jnp_dtype)
-        self._pool_shape = tuple(jax.tree_util.tree_leaves(pool)[0].shape)
+        if self.kv_quant:
+            # int8 pool records {qp, ps} (ops/paged_kv): codes + per-block
+            # scale table, built from the float pool's ABSTRACT shapes
+            # (eval_shape) — materializing the bf16 pool first would cost
+            # a transient bf16+int8 double footprint at exactly the
+            # near-full-HBM block counts kv8 exists to serve.  Host
+            # bookkeeping below is layout-invariant — scales ride under
+            # block ids — but the engine keeps a ledger of which blocks
+            # own LIVE scale rows so the debug audit can prove scale
+            # allocation stays in lockstep with blocks (scale-lockstep
+            # invariant, analysis/invariants.py)
+            abstract = jax.eval_shape(
+                lambda: self._init_cache(num_blocks, self.block_size,
+                                         engine._config.jnp_dtype))
+            pool = paged_kv.quantize_pool(abstract)
+            self._kv_dtype = "int8"
+        else:
+            pool = self._init_cache(num_blocks, self.block_size,
+                                    engine._config.jnp_dtype)
+            self._kv_dtype = jnp.dtype(
+                jax.tree_util.tree_leaves(pool)[0].dtype).name
+        self._pool_shape = tuple(paged_kv.pool_payload(
+            jax.tree_util.tree_leaves(
+                pool, is_leaf=paged_kv.is_quantized_pool)[0]).shape)
+        self._kv_scale_live: set = set()
         hkv = int(self._pool_shape[2])
         divisible = self.tp_degree > 1 and hkv % self.tp_degree == 0
         if shard_kv and self.tp_degree > 1 and not divisible:
@@ -443,7 +532,8 @@ class ServingEngine:
 
                 if not isinstance(draft, InferenceEngine):
                     draft = InferenceEngine(draft, engine._config)
-                _validate_decode_hooks(draft.module, role="draft model")
+                _validate_decode_hooks(draft.module, role="draft model",
+                                       kv_quant=self.kv_quant)
                 tv = getattr(engine.module.model_config, "vocab_size", None)
                 dv = getattr(draft.module.model_config, "vocab_size", None)
                 if tv is not None and dv is not None and tv != dv:
@@ -452,9 +542,20 @@ class ServingEngine:
                         f"{tv} — speculative decoding needs a shared "
                         "tokenizer")
                 self._draft = draft
-                dpool = draft.module.decode_hooks["init_cache"](
+                mk_dpool = lambda: draft.module.decode_hooks["init_cache"](
                     num_blocks, self.block_size, draft._config.jnp_dtype)
-                dhkv = int(jax.tree_util.tree_leaves(dpool)[0].shape[2])
+                if self.kv_quant:
+                    # the draft pool shares the target's block tables AND
+                    # its quantization story — rollout reads/writes move
+                    # int8 + scales too (abstract build, same double-
+                    # footprint argument as the target pool above)
+                    dpool = paged_kv.quantize_pool(jax.eval_shape(mk_dpool))
+                else:
+                    dpool = mk_dpool()
+                dhkv = int(paged_kv.pool_payload(
+                    jax.tree_util.tree_leaves(
+                        dpool,
+                        is_leaf=paged_kv.is_quantized_pool)[0]).shape[2])
                 d_div = dhkv % self.tp_degree == 0
                 if self.kv_sharded and not d_div:
                     if shard_kv:
@@ -508,7 +609,9 @@ class ServingEngine:
             + (f", kv sharded over tp={self.tp_degree} "
                f"({hkv // self.tp_degree} heads/chip)" if self.kv_sharded
                else (f", kv replicated (tp={self.tp_degree})"
-                     if self.tp_degree > 1 else "")), ranks=[0])
+                     if self.tp_degree > 1 else ""))
+            + (f", quantize={self.quantize}" if self.quantize else ""),
+            ranks=[0])
 
     def _tp_ctx(self):
         """Context every compiled-fn invocation runs under: tracing happens
@@ -644,9 +747,17 @@ class ServingEngine:
         return self._draft_fn
 
     # ----------------------------------------------------------- block plumbing
+    def _decref(self, b: int) -> None:
+        """Release one reference; when the block actually frees, retire
+        its scale-ledger entry in the same step (kv8 — the device scale
+        row is stale from here until the next owner's first write)."""
+        self._alloc.decref(b)
+        if self._alloc.refcount(b) == 0:
+            self._kv_scale_live.discard(b)
+
     def _release_slot(self, slot: int) -> None:
         for b in self._held[slot]:
-            self._alloc.decref(b)
+            self._decref(b)
         self._held[slot] = []
         self._tables[slot] = 0
         self._tokens[slot] = 0
@@ -668,10 +779,14 @@ class ServingEngine:
         while True:
             b = self._alloc.alloc()
             if b is not None:
+                if self.kv_quant:
+                    self._kv_scale_live.add(b)
                 return b
-            if self._prefix is not None and \
-                    self._prefix.evict_one(self._alloc):
-                continue
+            if self._prefix is not None:
+                evicted = self._prefix.evict_one(self._alloc)
+                if evicted:
+                    self._kv_scale_live.discard(evicted)
+                    continue
             victim = max(active, key=lambda s: active[s].admit_seq)
             if victim == requester and len(active) == 1:
                 # cannot happen when num_blocks >= nbper+1 (ctor check)
@@ -760,7 +875,7 @@ class ServingEngine:
             need = total_need - len(hits)
             if need > _avail():
                 for b in hits:             # unclaim and wait for pressure
-                    self._alloc.decref(b)  # to drain
+                    self._decref(b)        # to drain
                 self._blocked_gate = (id(req), len(prior),
                                       self._alloc.version)
                 break
@@ -1110,18 +1225,29 @@ class ServingEngine:
 
     # ------------------------------------------------------------------ stats
     def _kv_footprint(self) -> Dict[str, Any]:
-        """KV memory accounting: pool shape, total logical bytes, and each
+        """KV memory accounting: pool shape, total logical bytes (quant-
+        adjusted — int8 codes + the scale table when ``kv8``), and each
         chip's share — ``total / tp`` when the pool is head-sharded, the
         whole pool when replicated (the pool replicates across every other
-        mesh axis, so tp is the only divisor)."""
+        mesh axis, so tp is the only divisor; the scale table carries the
+        head dim too, so it shards with the codes)."""
         def _bytes(tree):
             return int(sum(x.size * x.dtype.itemsize
                            for x in jax.tree_util.tree_leaves(tree)))
 
         total = _bytes(self._cache)
+        scale_bytes = int(sum(
+            leaf["ps"].size * leaf["ps"].dtype.itemsize
+            for leaf in jax.tree_util.tree_leaves(
+                self._cache, is_leaf=paged_kv.is_quantized_pool)
+            if paged_kv.is_quantized_pool(leaf)))
         out = {
             "tp_degree": self.tp_degree,
             "kv_sharded": self.kv_sharded,
+            "quantize": self.quantize,
+            "kv_dtype": self._kv_dtype,
+            "weight_quant": self.weight_quant,
+            "kv_scale_bytes": scale_bytes,
             "kv_pool_shape": list(self._pool_shape),
             "kv_pool_bytes": total,
             "kv_pool_bytes_per_chip": total //

@@ -224,8 +224,10 @@ def _alibi_cached_attention(cfg: BloomConfig, q, k, v, ck, cv, pos,
     else:
         ck, cv = paged_cache_update(ck, cv, k, v, pos, block_tables,
                                     valid=chunk_valid)
-        kk = paged_gather(ck, block_tables)
-        vv = paged_gather(cv, block_tables)
+        # int8 records dequantize to the query dtype (kv8 serving) so the
+        # residual stream keeps the model's compute dtype
+        kk = paged_gather(ck, block_tables, out_dtype=q.dtype)
+        vv = paged_gather(cv, block_tables, out_dtype=q.dtype)
 
     t, s = q.shape[2], kk.shape[2]
     pos = jnp.asarray(pos, jnp.int32)
@@ -429,6 +431,9 @@ def build(cfg: Optional[BloomConfig] = None, **overrides) -> ModelSpec:
         "supports_lengths": True,
         "supports_paged": True,
         "supports_verify": True,
+        # _alibi_cached_attention reads the pool only through paged_gather
+        # (which dequantizes int8 records), so kv8 serving is supported
+        "supports_kv_quant": True,
     }
 
     pipeline_hooks = {

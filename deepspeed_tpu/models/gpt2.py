@@ -470,11 +470,22 @@ def decode_over_layers(body, x, blocks, cache_k, cache_v, num_layers,
             def mm(y, name, dtype):
                 return _qmm_indexed(y, blocks[name], l, dtype)
 
-            ck = jax.lax.dynamic_index_in_dim(ck_all, l, keepdims=False)
-            cv = jax.lax.dynamic_index_in_dim(cv_all, l, keepdims=False)
+            # cache leaves may be int8 pool records (dicts of codes +
+            # scales, ops/paged_kv) — index/update every leaf of the layer
+            # slice; plain arrays are single-leaf trees, identical HLO
+            ck = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, l, keepdims=False),
+                ck_all)
+            cv = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, l, keepdims=False),
+                cv_all)
             x, ck, cv = body(x, get, mm, ck, cv)
-            ck_all = jax.lax.dynamic_update_index_in_dim(ck_all, ck, l, 0)
-            cv_all = jax.lax.dynamic_update_index_in_dim(cv_all, cv, l, 0)
+            ck_all = jax.tree_util.tree_map(
+                lambda a, u: jax.lax.dynamic_update_index_in_dim(a, u, l, 0),
+                ck_all, ck)
+            cv_all = jax.tree_util.tree_map(
+                lambda a, u: jax.lax.dynamic_update_index_in_dim(a, u, l, 0),
+                cv_all, cv)
             return x, ck_all, cv_all
 
         return jax.lax.fori_loop(0, num_layers, ibody,
@@ -832,6 +843,10 @@ def build(cfg: Optional[GPT2Config] = None, **overrides) -> ModelSpec:
         "supports_paged": True,
         # all-position logits over a K+1 window (speculative verify head)
         "supports_verify": True,
+        # int8 pool records flow through this family's cached attention
+        # untouched (all KV reads/writes go through ops/paged_kv), so the
+        # serving engine may quantize the pool (quantize="kv8")
+        "supports_kv_quant": True,
     }
 
     return ModelSpec(

@@ -428,6 +428,10 @@ def build(cfg: Optional[LlamaConfig] = None, **overrides) -> ModelSpec:
             "supports_lengths": True,
             "supports_paged": True,
             "supports_verify": True,
+            # int8 KV pool records pass through ops/paged_kv untouched by
+            # this family (rope applies before the cache write), so the
+            # serving engine may quantize the pool (quantize="kv8")
+            "supports_kv_quant": True,
         },
         quant_aware=True,  # per-layer point-of-use dequant / w8a8 records
         name=f"llama-{cfg.num_layers}l-{cfg.hidden_size}d")

@@ -89,18 +89,23 @@ def init_params(cfg: MixtralConfig, rng) -> PyTree:
 
 
 def _moe_block(cfg: MixtralConfig, layer: PyTree, x, cos, sin, train: bool = True):
-    """Llama attention + MoE FFN; returns (x, aux_loss)."""
+    """Llama attention + MoE FFN; returns (x, aux_loss).  Matmuls route
+    through gpt2._qmm: dense leaves trace to the identical HLO, INT8
+    records (quant-aware serving) dequantize / run the s8 kernel at point
+    of use instead of crashing on a dict leaf."""
+    from .gpt2 import _qmm
+
     b, s, d = x.shape
     y = L.rms_norm(x, layer["attn_norm"], cfg.rms_eps)
     h, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
-    q = (y @ layer["q_w"].astype(y.dtype)).reshape(b, s, h, hd)
-    k = (y @ layer["k_w"].astype(y.dtype)).reshape(b, s, hkv, hd)
-    v = (y @ layer["v_w"].astype(y.dtype)).reshape(b, s, hkv, hd)
+    q = _qmm(y, layer["q_w"]).reshape(b, s, h, hd)
+    k = _qmm(y, layer["k_w"]).reshape(b, s, hkv, hd)
+    v = _qmm(y, layer["v_w"]).reshape(b, s, hkv, hd)
     q = L.apply_rope(q.transpose(0, 2, 1, 3), cos, sin)
     k = L.apply_rope(k.transpose(0, 2, 1, 3), cos, sin)
     attn = L._attention(cfg, q, k, v.transpose(0, 2, 1, 3))
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
-    x = x + attn @ layer["o_w"].astype(x.dtype)
+    x = x + _qmm(attn, layer["o_w"], x.dtype)
 
     y = L.rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
     moe_out, aux = _moe_ffn(cfg, layer, y, train=train)
@@ -209,6 +214,9 @@ def build(cfg: Optional[MixtralConfig] = None, **overrides) -> ModelSpec:
         "supports_lengths": True,
         "supports_paged": True,
         "supports_verify": True,
+        # the MoE path reads the pool only through the shared llama cached
+        # attention (ops/paged_kv), so int8 records pass through untouched
+        "supports_kv_quant": True,
     }
 
     return ModelSpec(
@@ -217,4 +225,10 @@ def build(cfg: Optional[MixtralConfig] = None, **overrides) -> ModelSpec:
         flops_per_token=6.0 * (cfg.num_params() / cfg.num_experts *
                                (cfg.top_k + 1)),
         decode_hooks=decode_hooks,
+        # w8a8 serving: attention projections run the s8 path through the
+        # shared mm accessors; stacked expert weights store int8 and
+        # dequantize per layer at point of use inside moe_apply (the MoE
+        # dispatch einsums have no K-grouped kernel — yet)
+        quant_aware=True,
+        blocks_key=("blocks",),
         name=f"mixtral-{cfg.num_layers}l-{cfg.num_experts}e")

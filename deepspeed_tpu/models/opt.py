@@ -526,6 +526,9 @@ def build(cfg: Optional[OPTConfig] = None, **overrides) -> ModelSpec:
         "supports_lengths": True,
         "supports_paged": True,
         "supports_verify": True,
+        # int8 KV pool records flow through ops/paged_kv untouched
+        # (quantize="kv8" in the serving engine)
+        "supports_kv_quant": True,
     }
 
     def _stream_embed(params, ids, pos):

@@ -130,7 +130,17 @@ def moe_apply(cfg: MoEConfig, params: PyTree, x, rng=None, train: bool = True
     expert_in = dispatch_tokens(x3, dispatch)         # [E, G, C, D]
     expert_in = _maybe_constrain(expert_in, P(EP_AXIS))  # all-to-all boundary
     e, g_, c, _ = expert_in.shape
-    w = jax.tree_util.tree_map(lambda a: a.astype(x3.dtype), params["experts"])
+    # expert leaves may arrive as INT8 records (quant-aware w8a8 serving,
+    # mixtral): expand them here, per layer at point of use — the vmapped
+    # expert einsums have no K-grouped kernel, so storage stays int8 and
+    # the math is the exact dequant+matmul fallback
+    from ..ops import quantization as quant
+
+    w = jax.tree_util.tree_map(
+        lambda a: (quant.dequantize_k(a, x3.dtype) if quant.is_k_quantized(a)
+                   else quant.dequantize(a, x3.dtype) if quant.is_quantized(a)
+                   else a.astype(x3.dtype)),
+        params["experts"], is_leaf=quant.is_record)
     expert_out = jax.vmap(lambda we, xe: _expert_mlp(cfg, we, xe.reshape(-1, d))
                           .reshape(g_, c, d))(w, expert_in)
     expert_out = _maybe_constrain(expert_out, P(EP_AXIS))

@@ -34,7 +34,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
-from .paged_kv import _paged_gather, head_shard_map, head_shards, tp_axis
+from .paged_kv import (_paged_gather, head_shard_map, head_shards,
+                       is_quantized_pool, pool_payload, tp_axis)
 
 NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 LANES = 128
@@ -88,7 +89,8 @@ def decode_attention_reference(q, k_cache, v_cache, q_pos, *,
 # Pallas single-token decode kernel
 # ---------------------------------------------------------------------------
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-                   *, sm_scale: float, block_k: int):
+                   *, sm_scale: float, block_k: int,
+                   ks_ref=None, vs_ref=None):
     """Grid: (B, HKV, S // block_k), KV innermost so scratch carries across.
 
     q_ref: [1, 1, rep, D] — the ``rep`` query heads sharing this KV head.
@@ -96,6 +98,14 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
     pos_ref: int32 [B] in SMEM — per-row query position (a scalar q_pos is
     broadcast before the call), read for the row this grid step covers, so
     chunk skipping scales FLOPs with each slot's own valid length.
+
+    ``ks_ref``/``vs_ref`` (int8-KV pools only): [1, 1, block_k] per-token
+    dequant scales riding next to the code chunks.  They fold into the
+    math on its 2-D lane-dim tiles — ``q·(code*s_k) = (q·code)*s_k`` on
+    the score columns, ``Σ p·(code*s_v) = (p*s_v)·code`` on the prob
+    columns — so no dequantized [bk, D] copy is ever materialized and the
+    online softmax (which normalizes over UNscaled probabilities) is
+    untouched.
     """
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -116,6 +126,8 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale                              # [rep, bk]
+        if ks_ref is not None:
+            s = s * ks_ref[...].reshape(1, -1).astype(jnp.float32)
         idx = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(idx <= pos, s, NEG_INF)
 
@@ -126,8 +138,11 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
         p = jnp.exp(s - m_new)                        # [rep, bk]
         l_new = l_scr[...][:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         v = v_ref[0, 0].astype(jnp.float32)           # [bk, D]
+        pv = p * vs_ref[...].reshape(1, -1).astype(jnp.float32) \
+            if vs_ref is not None else p
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            pv, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -206,8 +221,10 @@ def decode_attention(q, k_cache, v_cache, q_pos, *,
 # ---------------------------------------------------------------------------
 def _tp_shard_heads(body, q, k_pool, v_pool, block_tables, q_pos):
     """Run ``body(q, k_pool, v_pool, bt, pos)`` sharded over the head dims
-    when the configured tp context divides them, else directly."""
-    n = head_shards(k_pool.shape[1], q.shape[1])
+    when the configured tp context divides them, else directly.  Int8 pool
+    records shard whole: codes and their scale table both carry the head
+    dim at index 1, so the one head spec broadcasts over the record."""
+    n = head_shards(pool_payload(k_pool).shape[1], q.shape[1])
     if n <= 1:
         return body(q, k_pool, v_pool, block_tables, q_pos)
     pos = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1),
@@ -231,16 +248,18 @@ def paged_decode_attention_reference(q, k_pool, v_pool, block_tables, q_pos,
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
 
     def body(q, kp, vp, bt, pos):
-        k = _paged_gather(kp, bt)
-        v = _paged_gather(vp, bt)
+        # int8 records dequantize to the query dtype so downstream
+        # residual math keeps the model's compute dtype (float pools
+        # ignore the hint — reads stay bit-identical)
+        k = _paged_gather(kp, bt, out_dtype=q.dtype)
+        v = _paged_gather(vp, bt, out_dtype=q.dtype)
         return decode_attention_reference(q, k, v, pos, sm_scale=scale)
 
     return _tp_shard_heads(body, q, k_pool, v_pool, block_tables, q_pos)
 
 
-def _paged_decode_kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
-                         m_scr, l_scr, acc_scr, *, sm_scale: float,
-                         block_size: int):
+def _paged_decode_kernel(pos_ref, bt_ref, q_ref, *refs, sm_scale: float,
+                         block_size: int, quant: bool = False):
     """Grid: (B, HKV, NBPER), logical blocks innermost so scratch carries.
 
     ``pos_ref`` int32 [B] and ``bt_ref`` int32 [B, NBPER] arrive via scalar
@@ -251,10 +270,37 @@ def _paged_decode_kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
     step ``kb`` holds positions ``kb*block_size ..``, exactly like a
     contiguous chunk), including the ``pl.when`` skip of blocks past the
     row's valid prefix.
+
+    ``quant``: the pool is int8 — two extra scale operands ([1, 1, bs]
+    rows of the per-block scale table, same index maps) ride next to the
+    code blocks and dequantize in-kernel, so HBM traffic is codes +
+    scales only.
     """
     del bt_ref                       # consumed by the BlockSpec index maps
+    if quant:
+        k_ref, ks_ref, v_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+        ks_ref = vs_ref = None
     _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
-                   acc_scr, sm_scale=sm_scale, block_k=block_size)
+                   acc_scr, sm_scale=sm_scale, block_k=block_size,
+                   ks_ref=ks_ref, vs_ref=vs_ref)
+
+
+def _paged_pool_operands(k_pool, v_pool, bs, d):
+    """(operand list, BlockSpec list, quant flag) for a k/v pool pair —
+    float pools contribute two operands, int8 records four (codes +
+    per-block scale rows), all walking the same ``bt_ref[i, k]`` physical-
+    block index map."""
+    quant = is_quantized_pool(k_pool)
+    blk = pl.BlockSpec((1, 1, bs, d),
+                       lambda i, j, k, pos_ref, bt_ref: (bt_ref[i, k], j, 0, 0))
+    if not quant:
+        return [k_pool, v_pool], [blk, blk], False
+    sblk = pl.BlockSpec((1, 1, bs),
+                        lambda i, j, k, pos_ref, bt_ref: (bt_ref[i, k], j, 0))
+    return ([k_pool["qp"], k_pool["ps"], v_pool["qp"], v_pool["ps"]],
+            [blk, sblk, blk, sblk], True)
 
 
 def _paged_decode_pallas(q, k_pool, v_pool, block_tables, q_pos, *,
@@ -263,7 +309,7 @@ def _paged_decode_pallas(q, k_pool, v_pool, block_tables, q_pos, *,
     (shapes may be the full head count or one tp shard's slice — the grid
     and GQA grouping are computed from the local arrays either way)."""
     b, h, t, d = q.shape
-    nb, hkv, bs, _ = k_pool.shape
+    nb, hkv, bs, _ = pool_payload(k_pool).shape
     rep = h // hkv
     nbper = block_tables.shape[1]
     scale = sm_scale
@@ -271,6 +317,7 @@ def _paged_decode_pallas(q, k_pool, v_pool, block_tables, q_pos, *,
     qg = q[:, :, 0, :].reshape(b, hkv, rep, d)        # [B, HKV, rep, D]
     pos = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1), (b,))
     bt = jnp.maximum(jnp.asarray(block_tables, jnp.int32), 0)
+    pools, pool_specs, quant = _paged_pool_operands(k_pool, v_pool, bs, d)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,                        # pos, block table
@@ -278,13 +325,7 @@ def _paged_decode_pallas(q, k_pool, v_pool, block_tables, q_pos, *,
         in_specs=[
             pl.BlockSpec((1, 1, rep, d),
                          lambda i, j, k, pos_ref, bt_ref: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, bs, d),
-                         lambda i, j, k, pos_ref, bt_ref:
-                         (bt_ref[i, k], j, 0, 0)),
-            pl.BlockSpec((1, 1, bs, d),
-                         lambda i, j, k, pos_ref, bt_ref:
-                         (bt_ref[i, k], j, 0, 0)),
-        ],
+        ] + pool_specs,
         out_specs=pl.BlockSpec((1, 1, rep, d),
                                lambda i, j, k, pos_ref, bt_ref: (i, j, 0, 0)),
         scratch_shapes=[
@@ -295,13 +336,13 @@ def _paged_decode_pallas(q, k_pool, v_pool, block_tables, q_pos, *,
     )
     out = pl.pallas_call(
         functools.partial(_paged_decode_kernel, sm_scale=scale,
-                          block_size=bs),
+                          block_size=bs, quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, rep, d), q.dtype),
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(pos, bt, qg, k_pool, v_pool)
+    )(pos, bt, qg, *pools)
     return out.reshape(b, h, 1, d)
 
 
@@ -322,9 +363,8 @@ def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables, q_pos, *,
     return _tp_shard_heads(body, q, k_pool, v_pool, block_tables, q_pos)
 
 
-def _paged_verify_kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
-                         m_scr, l_scr, acc_scr, *, sm_scale: float,
-                         block_size: int, t: int):
+def _paged_verify_kernel(pos_ref, bt_ref, q_ref, *refs, sm_scale: float,
+                         block_size: int, t: int, quant: bool = False):
     """Multi-token (T = K+1 speculative verify window) variant of the paged
     decode kernel.  Grid: (B, HKV, NBPER), logical blocks innermost.
 
@@ -336,8 +376,17 @@ def _paged_verify_kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
     row's history plus the window prefix up to itself, never the
     yet-unverified draft tail.  Blocks wholly past ``base + T - 1`` are
     skipped, so FLOPs track each row's own valid length.
+
+    ``quant``: int8 pool — [1, 1, bs] scale rows ride next to the code
+    blocks and fold into the score/prob columns exactly like the decode
+    kernel (``_decode_kernel`` docstring).
     """
     del bt_ref                       # consumed by the BlockSpec index maps
+    if quant:
+        k_ref, ks_ref, v_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+        ks_ref = vs_ref = None
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
     base = pos_ref[pl.program_id(0)]
@@ -357,6 +406,8 @@ def _paged_verify_kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale                              # [rep*T, bk]
+        if ks_ref is not None:
+            s = s * ks_ref[...].reshape(1, -1).astype(jnp.float32)
         key_idx = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         q_off = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) % t
         s = jnp.where(key_idx <= base + q_off, s, NEG_INF)
@@ -368,8 +419,11 @@ def _paged_verify_kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
         p = jnp.exp(s - m_new)                        # [rep*T, bk]
         l_new = l_scr[...][:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         v = v_ref[0, 0].astype(jnp.float32)           # [bk, D]
+        pv = p * vs_ref[...].reshape(1, -1).astype(jnp.float32) \
+            if vs_ref is not None else p
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            pv, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -390,7 +444,7 @@ def _paged_verify_pallas(q, k_pool, v_pool, block_tables, q_pos, *,
     """Single-shard kernel launch of :func:`paged_verify_attention_pallas`
     (shapes may be the full head count or one tp shard's slice)."""
     b, h, t, d = q.shape
-    nb, hkv, bs, _ = k_pool.shape
+    nb, hkv, bs, _ = pool_payload(k_pool).shape
     rep = h // hkv
     nbper = block_tables.shape[1]
     scale = sm_scale
@@ -400,6 +454,7 @@ def _paged_verify_pallas(q, k_pool, v_pool, block_tables, q_pos, *,
     qg = q.reshape(b, hkv, rep, t, d).reshape(b, hkv, rep * t, d)
     pos = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1), (b,))
     bt = jnp.maximum(jnp.asarray(block_tables, jnp.int32), 0)
+    pools, pool_specs, quant = _paged_pool_operands(k_pool, v_pool, bs, d)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,                        # pos, block table
@@ -407,13 +462,7 @@ def _paged_verify_pallas(q, k_pool, v_pool, block_tables, q_pos, *,
         in_specs=[
             pl.BlockSpec((1, 1, rep * t, d),
                          lambda i, j, k, pos_ref, bt_ref: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, bs, d),
-                         lambda i, j, k, pos_ref, bt_ref:
-                         (bt_ref[i, k], j, 0, 0)),
-            pl.BlockSpec((1, 1, bs, d),
-                         lambda i, j, k, pos_ref, bt_ref:
-                         (bt_ref[i, k], j, 0, 0)),
-        ],
+        ] + pool_specs,
         out_specs=pl.BlockSpec((1, 1, rep * t, d),
                                lambda i, j, k, pos_ref, bt_ref: (i, j, 0, 0)),
         scratch_shapes=[
@@ -424,13 +473,13 @@ def _paged_verify_pallas(q, k_pool, v_pool, block_tables, q_pos, *,
     )
     out = pl.pallas_call(
         functools.partial(_paged_verify_kernel, sm_scale=scale,
-                          block_size=bs, t=t),
+                          block_size=bs, t=t, quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, rep * t, d), q.dtype),
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(pos, bt, qg, k_pool, v_pool)
+    )(pos, bt, qg, *pools)
     return out.reshape(b, hkv, rep, t, d).reshape(b, h, t, d)
 
 

@@ -43,6 +43,33 @@ into every shard unchanged.  Pools whose head count does not divide the
 axis (GQA with HKV < tp) simply skip the wrapping — ``head_shards``
 returns 1 and the op runs replicated, bit-identical to tp=1.
 
+**Quantized pool records (int8 KV, PR 7)**: a pool leaf may be a dict
+``{"qp": int8 [NB, HKV, bs, hd], "ps": bf16 [NB, HKV, bs]}`` instead of a
+float array — int8 codes plus a per-block scale table whose rows live and
+die with the blocks (one ``[HKV, bs]`` scale row per block per K/V per
+layer; within the row each (head, slot) token vector carries its own
+scale).  The granularity is chosen by two constraints:
+
+ - *append-only writes*: a token is quantized once, at scatter time, from
+   its own ``hd`` values (``ops/quantization.quantize_kv``).  A scalar
+   per-block scale would force a read-modify-write requantization of the
+   whole block whenever a later token raised the block absmax; per-token
+   scales make the write side exactly the int8 payload + one scale.
+ - *head-locality under tp*: scales sit under the pool's own head dim, so
+   the sharded scatter computes them from the chip's local head shard —
+   no cross-chip absmax, zero per-step collectives, and codes/scales are
+   bit-identical to the replicated layout (the tp parity argument of
+   PR 5 carries over unchanged).
+
+Scatter quantizes on write, gather (and the paged Pallas kernels in
+``ops/decode_attention.py``) dequantizes on read, so HBM only ever moves
+int8 codes + scales.  Scale rows of freed blocks hold stale values by
+design — reads are position-masked until the next owner rewrites them —
+and the serving engine's host-side ledger + ``analysis/invariants.py``
+``scale-lockstep`` audit enforce that no live read can reach one.
+Rollback of rejected speculative tokens stays free: re-quantizing the
+same deterministic values yields the same codes and scales.
+
 Everything here is pure XLA (scatter / gather), shared by prefill and the
 CPU/correctness decode path; the TPU kernels that walk the block table
 in-kernel live in ``ops/decode_attention.py``
@@ -54,6 +81,7 @@ from __future__ import annotations
 
 import contextlib
 
+import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -131,11 +159,65 @@ def blocks_for(num_tokens: int, block_size: int) -> int:
     return -(-int(num_tokens) // int(block_size))
 
 
+# -------------------------------------------------- quantized pool records
+#: scale-table dtype (module docstring: range-safe, 2^-9 rounding below
+#: the int8 error); exported so tests and stats agree on the layout
+SCALE_DTYPE = jnp.bfloat16
+
+_PQ_KEYS = frozenset({"qp", "ps"})
+
+
+def is_quantized_pool(leaf) -> bool:
+    """True for an int8 pool record ``{"qp": codes, "ps": scales}``
+    (module docstring has the layout contract)."""
+    return isinstance(leaf, dict) and set(leaf) == _PQ_KEYS
+
+
+def pool_payload(leaf):
+    """The code/payload array of a pool leaf: ``qp`` for quantized
+    records, the leaf itself otherwise — shape/dtype probes go here."""
+    return leaf["qp"] if is_quantized_pool(leaf) else leaf
+
+
+def quantize_pool(pool, scale_dtype=None):
+    """Convert a freshly built float pool (``init_cache`` output, any
+    nesting) into int8 records: zero codes plus a zero scale table shaped
+    ``payload.shape[:-1]`` (one scale per token vector, rows indexed by
+    block — the per-block scale table).  Zero scales dequantize unwritten
+    slots to exactly 0.0, matching the float pool's zero init."""
+    scale_dtype = scale_dtype or SCALE_DTYPE
+
+    def one(leaf):
+        return {"qp": jnp.zeros(leaf.shape, jnp.int8),
+                "ps": jnp.zeros(leaf.shape[:-1], scale_dtype)}
+
+    return jax.tree_util.tree_map(one, pool)
+
+
+def _scatter_one(pool, win, phys, off):
+    """Scatter a [B, HKV, T, ...] window into one pool leaf at the [B, T]
+    (physical block, in-block offset) targets — quantizing on write when
+    the leaf is an int8 record.  Advanced indices at dims 0 and 2 around
+    the ':' slice put the [B, T] index shape in front: value layout is
+    [B, T, HKV, ...].  Duplicate targets only ever occur on the scratch
+    block (any write order is fine — scratch is never read unmasked)."""
+    if not is_quantized_pool(pool):
+        return pool.at[phys, :, off].set(
+            win.transpose(0, 2, 1, 3).astype(pool.dtype))
+    from . import quantization as quant
+
+    codes, scale = quant.quantize_kv(win, pool["ps"].dtype)
+    return {"qp": pool["qp"].at[phys, :, off].set(
+                codes.transpose(0, 2, 1, 3)),
+            "ps": pool["ps"].at[phys, :, off].set(
+                scale.transpose(0, 2, 1))}
+
+
 def _paged_cache_update(ck, cv, k, v, pos, block_tables, valid=None):
     """Single-shard scatter body of :func:`paged_cache_update` — also the
     whole op when the pool is replicated (tp=1 / GQA fallback)."""
     b, hkv, t, hd = k.shape
-    bs = ck.shape[2]
+    bs = pool_payload(ck).shape[2]
     nbper = block_tables.shape[1]
     pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (b,))
     p = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]      # [B, T]
@@ -148,12 +230,8 @@ def _paged_cache_update(ck, cv, k, v, pos, block_tables, valid=None):
                                jnp.clip(li, 0, nbper - 1), axis=1)
     phys = jnp.where(ok, jnp.maximum(phys, 0), 0)                   # [B, T]
     off = jnp.where(ok, p % bs, 0)                                  # [B, T]
-    # advanced indices at dims 0 and 2 around the ':' slice put the [B, T]
-    # index shape in front: value layout is [B, T, HKV, hd].  Duplicate
-    # targets only ever occur on the scratch block (any write order is fine
-    # — scratch is never read unmasked).
-    ck = ck.at[phys, :, off].set(k.transpose(0, 2, 1, 3).astype(ck.dtype))
-    cv = cv.at[phys, :, off].set(v.transpose(0, 2, 1, 3).astype(cv.dtype))
+    ck = _scatter_one(ck, k, phys, off)
+    cv = _scatter_one(cv, v, phys, off)
     return ck, cv
 
 
@@ -177,9 +255,13 @@ def paged_cache_update(ck, cv, k, v, pos, block_tables, valid=None):
     """
     b = k.shape[0]
     pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (b,))
-    n = head_shards(ck.shape[1], k.shape[1])
+    n = head_shards(pool_payload(ck).shape[1], k.shape[1])
     if n <= 1:
         return _paged_cache_update(ck, cv, k, v, pos, block_tables, valid)
+    # P(None, tp) is a valid spec for every record leaf too: qp
+    # [NB, HKV, bs, hd] and ps [NB, HKV, bs] both carry the head dim at
+    # index 1, and shard_map broadcasts a PartitionSpec leaf over the
+    # record's pytree prefix
     hs = P(None, _TP_AXIS)
     valid = jnp.full((b,), k.shape[2], jnp.int32) if valid is None \
         else jnp.asarray(valid, jnp.int32)
@@ -188,26 +270,45 @@ def paged_cache_update(ck, cv, k, v, pos, block_tables, valid=None):
             ck, cv, k, v, pos, jnp.asarray(block_tables, jnp.int32), valid)
 
 
-def _paged_gather(pool_leaf, block_tables):
+def _paged_gather(pool_leaf, block_tables, out_dtype=None):
     """Single-shard gather body of :func:`paged_gather` — called directly
     by the in-``shard_map`` attention bodies (``ops/decode_attention.py``)
-    so sharded callers never re-enter the wrapper."""
+    so sharded callers never re-enter the wrapper.  Quantized records
+    gather codes + scales and dequantize (f32 expand, one cast to
+    ``out_dtype`` — pass the query/compute dtype so bf16 models keep a
+    bf16 residual stream, exactly like a float pool of that dtype); HBM
+    moves int8 + scales, the expansion happens on-chip.  ``out_dtype``
+    never touches a float pool (bit-identical reads)."""
+    if is_quantized_pool(pool_leaf):
+        from . import quantization as quant
+
+        codes = _paged_gather(pool_leaf["qp"], block_tables)  # [B,HKV,S,hd]
+        b, nbper = block_tables.shape
+        hkv, bs = pool_leaf["ps"].shape[1], pool_leaf["ps"].shape[2]
+        s = pool_leaf["ps"][jnp.maximum(block_tables, 0)]  # [B,NBPER,HKV,bs]
+        s = s.transpose(0, 2, 1, 3).reshape(b, hkv, nbper * bs)
+        return quant.dequantize_kv(codes, s, out_dtype or jnp.float32)
     nb, hkv, bs, hd = pool_leaf.shape
     b, nbper = block_tables.shape
     g = pool_leaf[jnp.maximum(block_tables, 0)]     # [B, NBPER, HKV, bs, hd]
     return g.transpose(0, 2, 1, 3, 4).reshape(b, hkv, nbper * bs, hd)
 
 
-def paged_gather(pool_leaf, block_tables):
+def paged_gather(pool_leaf, block_tables, out_dtype=None):
     """Materialize each row's logical cache view from the pool:
     ``[NB, HKV, bs, hd]`` through ``int32 [B, NBPER]`` tables ->
-    ``[B, HKV, NBPER*bs, hd]``.  Unset (scratch) entries gather garbage
-    that sits past every row's valid length — callers mask by position.
-    Under a configured tp context each chip gathers only its own head
-    shard (output sharded ``[B, HKV/tp, S, hd]`` per chip)."""
-    n = head_shards(pool_leaf.shape[1])
+    ``[B, HKV, NBPER*bs, hd]`` (int8 records dequantize — to ``out_dtype``
+    when given, f32 otherwise; float pools ignore ``out_dtype``).  Unset
+    (scratch) entries gather garbage that sits past every row's valid
+    length — callers mask by position.  Under a configured tp context each
+    chip gathers only its own head shard (output sharded
+    ``[B, HKV/tp, S, hd]`` per chip)."""
+    import functools
+
+    n = head_shards(pool_payload(pool_leaf).shape[1])
     if n <= 1:
-        return _paged_gather(pool_leaf, block_tables)
+        return _paged_gather(pool_leaf, block_tables, out_dtype)
     hs = P(None, _TP_AXIS)
-    return head_shard_map(_paged_gather, (hs, P()), hs)(
-        pool_leaf, jnp.asarray(block_tables, jnp.int32))
+    return head_shard_map(
+        functools.partial(_paged_gather, out_dtype=out_dtype),
+        (hs, P()), hs)(pool_leaf, jnp.asarray(block_tables, jnp.int32))

@@ -232,6 +232,41 @@ def quantize_k_grouped(w, k_group: int = 256) -> dict:
     return {"qk": qk.reshape(shape), "kscale": scale}
 
 
+# ------------------------------------------------------------- int8 KV cache
+# Per-vector symmetric absmax quantization for the paged KV pool
+# (ops/paged_kv quantized pool records): each (layer, block, head, slot)
+# token vector of ``hd`` values carries its own scale, computed at WRITE
+# time from that vector alone.  Tokens are written exactly once (paged
+# writes are append-only; speculative rollback overwrites a position with
+# the same deterministic codes), so no stored code is ever re-scaled —
+# unlike a per-block scalar scale, which would force a read-modify-write
+# requantization of the whole block every time a later token raised the
+# block's absmax.  Scales store in bf16: absmax/127 of activation-range
+# values always fits, and the 2^-9 relative rounding sits below the int8
+# quantization error itself (same argument as the w8a8 kernel's bf16
+# dequant, ops/quantized_matmul).
+
+
+def quantize_kv(x, scale_dtype=jnp.bfloat16):
+    """Symmetric int8 quantization over the LAST axis of ``x``: returns
+    ``(codes int8 x.shape, scale scale_dtype x.shape[:-1])`` with
+    ``dequant = codes * scale[..., None]``.  Codes are computed against
+    the ROUNDED stored scale so write and read agree exactly; all-zero
+    vectors store scale 1 (codes 0)."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = jnp.where(amax == 0, 1.0, amax / 127.0).astype(scale_dtype)
+    codes = jnp.clip(jnp.round(x32 / scale[..., None].astype(jnp.float32)),
+                     -127, 127).astype(jnp.int8)
+    return codes, scale
+
+
+def dequantize_kv(codes, scale, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv` (f32 expand, cast once)."""
+    return (codes.astype(jnp.float32) *
+            scale[..., None].astype(jnp.float32)).astype(dtype)
+
+
 def dequantize_k(rec: dict, dtype=jnp.bfloat16):
     """Expand a K-grouped record (fallback / non-decode path)."""
     qk, scale = rec["qk"], rec["kscale"]

@@ -440,3 +440,96 @@ def test_paged_ops_gqa_below_tp_fall_back_replicated():
             q, jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(bt), lengths)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-6, atol=1e-6)
+
+
+# -------------------------------------------------- quantized (int8) pools
+def _quantized_from_contiguous(kc, vc, nb, bs, rng):
+    """Scatter contiguous [B, HKV, S, D] caches into an int8 record pool
+    through random block tables (the write path quantizes per token)."""
+    from deepspeed_tpu.ops import paged_kv
+
+    b, hkv, s, d = kc.shape
+    nbper = s // bs
+    bt = rng.permutation(np.arange(1, nb))[:b * nbper] \
+        .reshape(b, nbper).astype(np.int32)
+    pool = paged_kv.quantize_pool(jnp.zeros((nb, hkv, bs, d), jnp.float32))
+    kp, vp = paged_kv.paged_cache_update(
+        pool, pool, jnp.asarray(kc), jnp.asarray(vc),
+        jnp.zeros(b, jnp.int32), jnp.asarray(bt))
+    return kp, vp, bt
+
+
+@pytest.mark.parametrize("h,hkv", [(4, 4), (8, 2)])
+def test_quantized_paged_pallas_kernel_matches_reference(h, hkv):
+    """int8 pool records through the decode kernel: the in-kernel
+    scale-fold (scores * k-scale, probs * v-scale) equals the gather +
+    dequant reference exactly, and both track the float cache within the
+    int8 error envelope."""
+    from deepspeed_tpu.ops import paged_kv  # noqa: F401 (fixture helper)
+
+    rng = np.random.default_rng(30)
+    b, s, d, bs = 4, 256, 64, 64
+    kc = rng.standard_normal((b, hkv, s, d)).astype(np.float32)
+    vc = rng.standard_normal((b, hkv, s, d)).astype(np.float32)
+    kp, vp, bt = _quantized_from_contiguous(kc, vc, 2 * b * (s // bs), bs,
+                                            rng)
+    q = jnp.asarray(rng.standard_normal((b, h, 1, d)), jnp.float32)
+    lengths = jnp.asarray([0, 17, 200, 255], jnp.int32)
+    ref = paged_decode_attention_reference(q, kp, vp, jnp.asarray(bt),
+                                           lengths)
+    got = paged_decode_attention_pallas(q, kp, vp, jnp.asarray(bt), lengths,
+                                        interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    dense = decode_attention_reference(q, jnp.asarray(kc), jnp.asarray(vc),
+                                       lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(dense),
+                               atol=5e-2)
+
+
+@pytest.mark.parametrize("h,hkv,t", [(4, 4, 4), (8, 2, 5)])
+def test_quantized_verify_pallas_kernel_matches_reference(h, hkv, t):
+    """The K+1 verify window over an int8 pool: per-row bases, straddled
+    block boundaries, in-kernel dequant — same contract as the float
+    kernel within kernel tolerance of the dequant reference."""
+    rng = np.random.default_rng(31)
+    b, s, d, bs = 4, 256, 64, 64
+    kc = rng.standard_normal((b, hkv, s, d)).astype(np.float32)
+    vc = rng.standard_normal((b, hkv, s, d)).astype(np.float32)
+    kp, vp, bt = _quantized_from_contiguous(kc, vc, 2 * b * (s // bs), bs,
+                                            rng)
+    q = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    bases = jnp.asarray([0, 17, 62, 256 - t], jnp.int32)
+    ref = paged_decode_attention_reference(q, kp, vp, jnp.asarray(bt),
+                                           bases)
+    got = paged_verify_attention_pallas(q, kp, vp, jnp.asarray(bt), bases,
+                                        interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("h,hkv,tp", [(8, 4, 4), (8, 2, 2)])
+def test_quantized_paged_kernel_sharded_matches_reference(h, hkv, tp):
+    """int8 records shard whole under the tp context — codes AND the
+    scale table split on the head dim — and the sharded kernel equals the
+    unsharded dequant reference (scales are head-local, so sharding
+    changes no value)."""
+    from deepspeed_tpu.ops import paged_kv
+
+    rng = np.random.default_rng(32)
+    b, s, d, bs = 4, 256, 64, 64
+    kc = rng.standard_normal((b, hkv, s, d)).astype(np.float32)
+    vc = rng.standard_normal((b, hkv, s, d)).astype(np.float32)
+    kp, vp, bt = _quantized_from_contiguous(kc, vc, 2 * b * (s // bs), bs,
+                                            rng)
+    q = jnp.asarray(rng.standard_normal((b, h, 1, d)), jnp.float32)
+    lengths = jnp.asarray([0, 17, 200, 255], jnp.int32)
+    want = paged_decode_attention_reference(q, kp, vp, jnp.asarray(bt),
+                                            lengths)
+    with paged_kv.tp_context(_tp_mesh(tp)):
+        assert paged_kv.head_shards(hkv, h) == tp
+        got = jax.jit(
+            lambda *a: paged_decode_attention_pallas(*a, interpret=True))(
+            q, kp, vp, jnp.asarray(bt), lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)

@@ -167,15 +167,17 @@ def test_w8a8_engine_decode(monkeypatch):
 
 
 def test_w8a8_rejects_non_quant_aware_model():
-    # mixtral's forwards don't dequantize at point of use (llama became
-    # quant-aware in round 4)
+    # unet's forwards don't dequantize at point of use and carry no
+    # stacked-blocks key (mixtral — the previous example here — became
+    # quant-aware in PR 7: attention records via the shared mm accessors,
+    # experts dequantizing per layer inside moe_apply)
     import deepspeed_tpu
-    from deepspeed_tpu.models import mixtral
+    from deepspeed_tpu.models import unet
 
     deepspeed_tpu.comm.reset_topology()
     with pytest.raises(ValueError, match="w8a8"):
         deepspeed_tpu.init_inference(
-            model=mixtral.build(mixtral.MixtralConfig.tiny()),
+            model=unet.build(unet.UNetConfig.tiny()),
             config={"dtype": "float32",
                     "quant": {"enabled": True, "type": "w8a8"}})
 

@@ -132,6 +132,32 @@ def test_tp4_parity_under_preemption(tp1_engine, tp4_engine, tiny_cfg):
         np.testing.assert_array_equal(r1[uid], r4[uid], err_msg=f"uid {uid}")
 
 
+def test_tp4_kv8_parity_and_sharded_scale_table(tp1_engine, tp4_engine,
+                                                tiny_cfg):
+    """int8 KV (quantize="kv8") composes with the tp head-shard with
+    EXACT token parity across degrees: per-token-vector scales are
+    head-local, so each chip quantizes its own shard to bit-identical
+    codes/scales, and the scale table (``ps`` [L, NB, HKV, bs]) shards
+    over the same head dim as the codes — the 8-device CI job's quant
+    case."""
+    r1, r4, s1, s4 = _serve_pair(tp1_engine, tp4_engine, tiny_cfg, seed=2,
+                                 quantize="kv8")
+    for uid in r1:
+        np.testing.assert_array_equal(r1[uid], r4[uid], err_msg=f"uid {uid}")
+    assert s4.kv_sharded
+    hkv = tiny_cfg.num_heads
+    for rec in (s4._cache["k"], s4._cache["v"]):
+        for name, head_dim in (("qp", 2), ("ps", 2)):
+            assert rec[name].shape[head_dim] == hkv
+            for shard in rec[name].addressable_shards:
+                assert shard.data.shape[head_dim] == hkv // 4, name
+    st1, st4 = s1.stats(), s4.stats()
+    assert st4["kv_dtype"] == "int8" and st4["kv_scale_bytes"] > 0
+    assert st4["kv_pool_bytes"] == st1["kv_pool_bytes"]
+    assert st4["kv_pool_bytes_per_chip"] == st1["kv_pool_bytes"] // 4
+    assert s4.compile_count == 2, s4.compiled_programs
+
+
 def test_shard_kv_false_forces_replicated(tp4_engine):
     srv = ServingEngine(tp4_engine, slots=2, max_seq_len=64, block_size=8,
                         shard_kv=False)
