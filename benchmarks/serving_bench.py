@@ -62,6 +62,22 @@ outputs are token-identical to sequential before reporting numbers.
 decode-bound traffic speculative decoding targets (BENCH_r05 lane:
 ``--decode-heavy --speculative 4``).
 
+``--telemetry-bench`` adds the BENCH_r08 overhead lane: the same chunked
+trace on two fresh twin engines — telemetry-off (``trace_capacity=0``:
+the event ring disabled; the metrics registry behind ``stats()`` is
+always on) vs fully-enabled (default ring) — comparing interleaved
+best-of-3 compile-warm passes.  The contract is ≤2% aggregate tok/s
+overhead, recorded as ``within_2pct`` (a breach warns without failing
+the run — wall-clock ratios on shared boxes carry ~±5% noise; the
+committed 64-request BENCH_r08.json is the pinned artifact); the
+lane also schema-validates the enabled engine's exported Chrome trace
+(``telemetry/trace.py validate_chrome_trace``: monotonic ``ts``, paired/
+complete events, pid/tid, per-request spans) and records the summary.
+``--trace-out PATH`` writes that trace for Perfetto.  ``--emit-metrics
+PATH`` dumps the headline serving engine's Prometheus text exposition to
+``PATH`` and the JSON registry snapshot to ``PATH.json`` alongside the
+bench JSON (tier-1 CI uploads these as a workflow artifact).
+
 ``--quant-suite`` runs the BENCH_r07 protocol: the mixed, prefix-heavy,
 and decode-heavy traces each with the quantized lanes, plus the tp × kv8
 combo, merged into one JSON.  Recommended at ``--dtype bf16`` (the
@@ -156,7 +172,9 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
               grid: bool = False, prefix_len: int = 0,
               block_size: int = 32, prefill_chunk: int = 128,
               speculative: int = 0, decode_heavy: bool = False,
-              tp: int = 1, quantize: tuple = ()):
+              tp: int = 1, quantize: tuple = (),
+              telemetry_bench: bool = False, trace_out: str = None,
+              emit_metrics: str = None):
     import deepspeed_tpu
     from deepspeed_tpu.inference.serving import ServingEngine
     from deepspeed_tpu.models import gpt2
@@ -398,6 +416,71 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
                 "compiled_programs": srv_tpq.compile_count,
             }
 
+    # --- telemetry overhead lane (--telemetry-bench): twin engines, same
+    # config, differing ONLY in the trace-event ring (off vs default) —
+    # interleaved best-of-3 compile-warm passes bound the wall-clock
+    # noise on a shared box.  The registry behind stats() is always on in
+    # both (it replaced the loose counter attributes 1:1), so this
+    # isolates the cost of the event stream the ≤2% contract covers.
+    telemetry_res = None
+    if telemetry_bench:
+        from deepspeed_tpu.telemetry import validate_chrome_trace
+
+        def _mk(cap):
+            return ServingEngine(engine, slots=slots, max_seq_len=max_total,
+                                 prefill_batch=prefill_batch,
+                                 block_size=block_size,
+                                 prefill_chunk=prefill_chunk,
+                                 trace_capacity=cap)
+
+        srv_off, srv_on = _mk(0), _mk(16384)
+        srv_off.serve(reqs)                 # compile + prefix-warm pass
+        srv_on.serve(reqs)
+        # interleaved best-of-3 pairs: machine drift (cache state, GC,
+        # neighbors on a shared box) hits both engines alike instead of
+        # biasing whichever ran last
+        off_warm = on_warm = float("inf")
+        on_outs = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            srv_off.serve(reqs)
+            off_warm = min(off_warm, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            on_outs = srv_on.serve(reqs)
+            on_warm = min(on_warm, time.perf_counter() - t0)
+        doc = srv_on.timeline.to_chrome()
+        trace_summary = validate_chrome_trace(doc)   # raises if malformed
+        if trace_out:
+            srv_on.dump_trace(trace_out)
+        on_stats = srv_on.stats()
+        telemetry_res = {
+            "tok_s_warm_off": gen_tokens / off_warm,
+            "tok_s_warm_on": gen_tokens / on_warm,
+            "wall_warm_off_s": off_warm,
+            "wall_warm_on_s": on_warm,
+            "overhead_pct": (on_warm / off_warm - 1.0) * 100.0,
+            "within_2pct": on_warm <= off_warm * 1.02,
+            "token_parity": all(np.array_equal(srv_outs[r.uid],
+                                               on_outs[r.uid])
+                                for r in reqs),
+            "trace_valid": True,            # validate_chrome_trace passed
+            "trace_summary": trace_summary,
+            "trace_events_recorded": on_stats["trace_events"],
+            "trace_events_dropped": on_stats["trace_events_dropped"],
+            "trace_out": trace_out,
+        }
+
+    # --- metrics artifact (--emit-metrics): the headline serving engine's
+    # Prometheus text + JSON registry snapshot, next to the bench JSON
+    metrics_files = None
+    if emit_metrics:
+        with open(emit_metrics, "w") as f:
+            f.write(srv.metrics.prometheus_text())
+        snap_path = emit_metrics + ".json"
+        with open(snap_path, "w") as f:
+            f.write(srv.metrics.snapshot_json())
+        metrics_files = {"prometheus": emit_metrics, "snapshot": snap_path}
+
     mismatches = [r.uid for r in reqs
                   if not (np.array_equal(seq_outs[r.uid], srv_outs[r.uid])
                           and np.array_equal(seq_outs[r.uid],
@@ -465,6 +548,10 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
         if spec_res else None,
         "serving_tp": tp_res,
         "serving_quant": quant_res or None,
+        # telemetry-on vs telemetry-off twin engines + trace-schema check
+        # (the BENCH_r08 ≤2% overhead contract, module docstring)
+        "serving_telemetry": telemetry_res,
+        "metrics_files": metrics_files,
         # the memory headline: per-chip KV pool bytes, replicated vs
         # head-sharded — sharding shrinks the per-chip share by ~tp
         "kv_bytes_per_chip_replicated":
@@ -474,7 +561,8 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
         "kv_per_chip_shrink": (stats_cold["kv_pool_bytes_per_chip"] /
                                tp_res["kv_pool_bytes_per_chip"])
         if tp_res else None,
-        "token_parity": not mismatches,
+        "token_parity": not mismatches and
+        (telemetry_res is None or telemetry_res["token_parity"]),
         "mismatched_uids": mismatches,
         "model": f"gpt2-{layers}l-{hidden}d-{vocab}v ({dtype})",
         "backend": __import__("jax").default_backend(),
@@ -519,6 +607,20 @@ def main():
                     help="run the BENCH_r07 protocol: mixed + prefix-heavy "
                          "+ decode-heavy traces with quantized lanes and a "
                          "tp=4 x kv8 combo point, merged into one JSON")
+    ap.add_argument("--telemetry-bench", action="store_true",
+                    help="add the telemetry overhead lane (BENCH_r08): "
+                         "trace-ring-off vs fully-enabled twin engines, "
+                         "interleaved best-of-3 warm passes, ≤2%% contract "
+                         "(recorded; breach warns) + Chrome trace schema "
+                         "validation")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="write the telemetry lane's Chrome trace_event "
+                         "JSON here (open at https://ui.perfetto.dev; "
+                         "needs --telemetry-bench)")
+    ap.add_argument("--emit-metrics", default=None, metavar="PATH",
+                    help="dump the serving engine's Prometheus text "
+                         "exposition to PATH and the JSON registry "
+                         "snapshot to PATH.json alongside the bench JSON")
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
 
@@ -586,8 +688,21 @@ def main():
         res = run_bench(grid=args.grid, prefix_len=args.prefix_len,
                         speculative=args.speculative,
                         decode_heavy=args.decode_heavy, tp=args.tp,
-                        quantize=quantize, **kw)
+                        quantize=quantize,
+                        telemetry_bench=args.telemetry_bench,
+                        trace_out=args.trace_out,
+                        emit_metrics=args.emit_metrics, **kw)
         ok = res["token_parity"]
+        tel = res.get("serving_telemetry")
+        if tel is not None and not tel["within_2pct"]:
+            # recorded in the JSON (within_2pct) but NOT an exit failure:
+            # a wall-clock ratio on a shared box carries ~±5% noise, and
+            # the pinned contract artifact is the committed BENCH_r08 run
+            # — failing CI on a GC pause would be pure flake
+            print(f"WARNING: telemetry overhead {tel['overhead_pct']:.2f}% "
+                  "exceeds the 2% contract on this run (noise-prone on "
+                  "shared boxes; see within_2pct in the JSON)",
+                  file=sys.stderr)
     print(json.dumps(res, indent=2))
     if args.json:
         with open(args.json, "w") as f:

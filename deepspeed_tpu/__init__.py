@@ -132,7 +132,8 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
                  block_size=32, num_blocks=None, chunked_prefill=None,
                  prefill_chunk=128, prefix_caching=True, spec_tokens=0,
                  quantize=None, draft=None, ngram_max=3, ngram_min=1,
-                 shard_kv=None, topology=None, debug_checks=False, **kwargs):
+                 shard_kv=None, topology=None, debug_checks=False,
+                 trace_capacity=16384, **kwargs):
     """Continuous-batching serving entry: an ``init_inference`` engine
     wrapped in the block-paged scheduler (``inference/serving.py``).
     Mixed-length request traces run at iteration-level granularity over a
@@ -175,7 +176,16 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
     trace past the engine's compile budget (with an abstract-signature
     diff of the retrace), and the paged-state invariant audit runs after
     every scheduler iteration; off, both are free and ``stats()`` still
-    reports ``retraces_observed``."""
+    reports ``retraces_observed``.
+
+    **Telemetry** (``deepspeed_tpu/telemetry/``): ``stats()`` is a view
+    over the engine's metrics registry (``srv.metrics`` — Prometheus
+    text / JSON snapshot), and a bounded ring of scheduler events
+    (``trace_capacity=``, 0 = off) records a per-request timeline
+    exportable as Chrome ``trace_event`` JSON via
+    ``srv.dump_trace(path)``; ``serve(profile_dir=...)`` brackets
+    scheduler iterations with a ``jax.profiler`` window.  See
+    ``docs/observability.md``."""
     from .inference.serving import ServingEngine
 
     if topology is not None:
@@ -229,4 +239,5 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
                          spec_tokens=spec_tokens, quantize=quantize,
                          draft=draft,
                          ngram_max=ngram_max, ngram_min=ngram_min,
-                         shard_kv=shard_kv, debug_checks=debug_checks)
+                         shard_kv=shard_kv, debug_checks=debug_checks,
+                         trace_capacity=trace_capacity)

@@ -5,7 +5,7 @@ engines document into checked contracts:
 
  - :mod:`deepspeed_tpu.analysis.lint` — ``graft-lint``, a stdlib-only AST
    pass over the package flagging recompile/host-sync hazards (rules
-   GL001..GL005, ``# graft: noqa(GLxxx)`` pragmas, ``bin/graft-lint``
+   GL001..GL006, ``# graft: noqa(GLxxx)`` pragmas, ``bin/graft-lint``
    CLI wired into CI).
  - :mod:`deepspeed_tpu.analysis.sentry` — the recompile sentry: jitted
    entry points register their Python bodies, trace counts are checked

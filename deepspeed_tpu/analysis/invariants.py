@@ -273,12 +273,28 @@ def audit_paged_state(allocator, tables, held, *,
 def audit_serving_engine(srv, active) -> None:
     """Engine-facing wrapper: pulls the :class:`ServingEngine` fields and
     derives each active slot's committed-token count (decode: host
-    ``lengths``; prefill: the chunk base already written)."""
+    ``lengths``; prefill: the chunk base already written).
+
+    When the engine carries a trace timeline (``telemetry/trace.py``),
+    the audit records itself there — a green ``invariant_audit`` instant
+    per run, or an ``invariant_violation`` naming the broken invariant
+    *before* the raise, so a fatal audit is visible in the exported trace
+    right next to the scheduler events that corrupted the state."""
     needs = {slot: max(int(srv._lengths[slot]), st.base)
              for slot, st in active.items()}
-    audit_paged_state(srv._alloc, srv._tables, srv._held,
-                      prefix=srv._prefix, active_needs=needs,
-                      block_size=srv.block_size,
-                      scale_live=(srv._kv_scale_live
-                                  if getattr(srv, "kv_quant", False)
-                                  else None))
+    timeline = getattr(srv, "timeline", None)
+    try:
+        audit_paged_state(srv._alloc, srv._tables, srv._held,
+                          prefix=srv._prefix, active_needs=needs,
+                          block_size=srv.block_size,
+                          scale_live=(srv._kv_scale_live
+                                      if getattr(srv, "kv_quant", False)
+                                      else None))
+    except PagedStateError as e:
+        if timeline is not None:
+            timeline.instant("invariant_violation", invariant=e.invariant,
+                             detail=e.detail)
+        raise
+    if timeline is not None:
+        timeline.instant("invariant_audit", slots_active=len(needs),
+                         blocks_in_use=srv._alloc.blocks_in_use)

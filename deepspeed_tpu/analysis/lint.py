@@ -35,6 +35,13 @@ GL005     traced-array comparison (or bare truthiness) as an ``if`` /
           ``while`` test inside a jit body — `TracerBoolConversionError`
           at best, silently trace-time-constant control flow at worst.
           ``is`` / ``is not`` (None checks) are static and exempt.
+GL006     host timer call (``time.time()`` / ``time.perf_counter()`` /
+          ``monotonic`` / ``*_ns`` / ``process_time`` variants) inside a
+          jit/shard_map body — the Python body runs ONCE, at trace time,
+          so the two stamps measure tracing (or nothing: both land in
+          the same trace), never device execution.  Time around the
+          compiled call after a sync instead (``utils/timer.py``,
+          ``telemetry/``).
 ========  =============================================================
 
 Suppression: append ``# graft: noqa(GLxxx)`` (one or more codes,
@@ -94,7 +101,17 @@ RULES: Dict[str, str] = {
     "GL004": "mesh-axis string literal unknown to the engine meshes",
     "GL005": "traced-array comparison or truthiness as an if/while test "
              "in a jit body",
+    "GL006": "host timer (time.time/perf_counter/...) in a jit body — "
+             "measures trace time, not device execution",
 }
+
+#: ``time`` module entry points whose call inside a traced body is GL006;
+#: the bare spellings (from-imports) are distinctive enough to flag as
+#: Names, ``time``/``clock`` themselves only as ``time.<attr>`` accesses
+_HOST_TIMER_ATTRS = frozenset({
+    "time", "time_ns", "perf_counter", "perf_counter_ns", "monotonic",
+    "monotonic_ns", "process_time", "process_time_ns"})
+_HOST_TIMER_NAMES = _HOST_TIMER_ATTRS - {"time"}
 
 _NOQA_RE = re.compile(
     r"#\s*graft:\s*noqa(?:\s*\(\s*([A-Za-z0-9_,\s]+)\s*\))?")
@@ -305,6 +322,23 @@ class _Analyzer:
             self._check_pspec_literals(node)
         if not in_jit:
             return
+        # GL006: a host timer inside a traced body stamps TRACE time —
+        # the body executes once, while XLA replays the compiled program
+        # without re-entering Python, so the reading is dispatch/tracing
+        # overhead at best and a trace-time constant at worst
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _HOST_TIMER_ATTRS and \
+                _root_name(node.func) == "time":
+            self._emit(node, "GL006",
+                       f"time.{node.func.attr}() in a jit body measures "
+                       "trace/dispatch time, not device execution — time "
+                       "around the compiled call after a sync instead")
+        elif isinstance(node.func, ast.Name) and \
+                node.func.id in _HOST_TIMER_NAMES:
+            self._emit(node, "GL006",
+                       f"{node.func.id}() in a jit body measures trace/"
+                       "dispatch time, not device execution — time around "
+                       "the compiled call after a sync instead")
         # GL001: device->host materialization in a traced body
         if isinstance(node.func, ast.Attribute) and \
                 node.func.attr in ("item", "tolist") and not node.args:

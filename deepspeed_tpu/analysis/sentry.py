@@ -133,6 +133,13 @@ class RecompileSentry:
         self.strict = bool(strict)
         self.total_budget = total_budget
         self._entries: Dict[str, _Entry] = {}
+        #: optional telemetry hook, called with the :class:`_Entry` on
+        #: EVERY trace (before any strict-mode raise, so a fatal retrace
+        #: still lands on the caller's timeline).  The serving engine
+        #: points this at its trace timeline — each compile shows up as a
+        #: ``jit_trace`` / ``retrace`` event next to the scheduler events
+        #: that provoked it (telemetry/trace.py).
+        self.on_trace: Optional[Callable[[_Entry], None]] = None
 
     # ------------------------------------------------------------- registry
     def register(self, name: str, budget: Optional[int] = 1) -> _Entry:
@@ -163,6 +170,8 @@ class RecompileSentry:
     # ------------------------------------------------------------- counting
     def _record(self, entry: _Entry, args: tuple, kwargs: dict) -> None:
         entry.record(abstract_signature(args, kwargs))
+        if self.on_trace is not None:
+            self.on_trace(entry)
         if not self.strict:
             return
         over_entry = entry.budget is not None and entry.traces > entry.budget

@@ -277,6 +277,12 @@ class InferenceEngine:
         # shape-keyed generate program below declares budget 1 — a retrace
         # of an already-built program is always contract drift
         self.sentry = RecompileSentry(name=f"inference:{model.name}")
+        # telemetry registry (telemetry/): profile_model_time observes
+        # per-forward wall clock into it, and wrappers (ServingEngine has
+        # its own) can hang engine-level metrics here
+        from ..telemetry import MetricsRegistry
+
+        self.metrics = MetricsRegistry()
         self._forward_fn = jax.jit(self.sentry.wrap(
             lambda p, batch: model.apply_fn(prepare(p), batch, None),
             "forward", budget=None))
@@ -511,11 +517,17 @@ class InferenceEngine:
     def profile_model_time(self, use_cuda_events: bool = True):
         """Enable per-forward wall-clock capture (reference
         ``inference/engine.py:163``); retrieve with :meth:`model_times`.
-        Idempotent — repeated calls do not stack timers."""
+        Idempotent — repeated calls do not stack timers.  Every sample
+        also lands in the engine registry's ``inference_forward_seconds``
+        histogram (``self.metrics``) — the bounded-memory distribution
+        survives the :meth:`model_times` drain."""
         if getattr(self, "_profiling", False):
             return
         self._profiling = True
         self._model_times = []
+        hist = self.metrics.histogram(
+            "inference_forward_seconds",
+            help="profiled forward-pass wall clock (profile_model_time)")
         orig = self._forward_fn
 
         def timed(p, batch):
@@ -524,7 +536,9 @@ class InferenceEngine:
             out = orig(p, batch)
             # fetch a value: block_until_ready no-ops on tunneled backends
             jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0])
-            self._model_times.append(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self._model_times.append(dt)
+            hist.observe(dt)
             return out
 
         self._forward_fn = timed

@@ -108,6 +108,26 @@ A host-side ledger tracks which blocks own live scale rows; the
 ``debug_checks`` audit enforces it (``scale-lockstep`` invariant,
 ``analysis/invariants.py``).
 
+**Telemetry** (``telemetry/``, always on — the registry IS the stats
+store): every scheduler counter and the TTFT/TPOT latency distributions
+live in a :class:`~deepspeed_tpu.telemetry.MetricsRegistry`
+(``engine.metrics`` — Prometheus text + JSON snapshot for scrapes and
+bench artifacts), and ``stats()`` is a backward-compatible view over it.
+Latencies are fixed-bucket streaming histograms — bounded memory for a
+serve session of any length, replacing the old unbounded raw-sample
+lists — with a small ``deque`` of recent per-request records kept for
+debugging.  A bounded ring of scheduler events (admit, prefill chunk,
+decode step, spec propose/verify/accept, prefix hit, block eviction,
+preemption, finish, plus the sentry's trace/retrace and the invariant-
+audit events from ``analysis/``) records a per-request timeline
+(``trace_capacity=``, 0 = off) exportable as Chrome ``trace_event`` JSON
+via ``dump_trace(path)`` — open it in Perfetto to see exactly where a
+slow request spent its time.  ``serve(profile_dir=...)`` additionally
+brackets the first ``profile_iters`` scheduler iterations with a
+``jax.profiler`` trace window for device-level deep dives.  Overhead
+contract: near-free when idle, ≤2% aggregate tok/s when fully enabled
+(pinned by the ``--telemetry-bench`` serving-bench lane, BENCH_r08).
+
 Greedy decoding only: per-request outputs are token-identical to
 sequential ``generate`` (pinned in ``tests/unit/test_serving.py``,
 ``tests/unit/test_paged_serving.py``, ``tests/unit/test_spec_decode.py``,
@@ -134,6 +154,7 @@ from ..analysis.sentry import (RecompileSentry, backend_compiles,
 from ..ops import paged_kv
 from ..ops.paged_kv import blocks_for
 from ..parallel.topology import TP_AXIS
+from ..telemetry import MetricsRegistry, ProfilerWindow, TraceTimeline
 from ..utils.logging import log_dist
 from ..utils.lru import LRUCache
 from .paged import BlockAllocator, PrefixCache
@@ -339,6 +360,13 @@ class ServingEngine:
                     runtime cost — the wrapped body only executes while
                     tracing) and ``stats()['retraces_observed']`` reports
                     drift; the audit is one skipped branch per iteration.
+    trace_capacity: per-request trace timeline ring size (module
+                    docstring "Telemetry"): scheduler events are recorded
+                    into a bounded host-side ring and exported as Chrome
+                    ``trace_event`` JSON by :meth:`dump_trace`.  ``0``
+                    disables event recording entirely (one predicate per
+                    would-be event); the metrics registry backing
+                    ``stats()`` is always on.
     """
 
     def __init__(self, engine, *, slots: int = 8,
@@ -356,7 +384,8 @@ class ServingEngine:
                  ngram_max: int = 3,
                  ngram_min: int = 1,
                  shard_kv: Optional[bool] = None,
-                 debug_checks: bool = False):
+                 debug_checks: bool = False,
+                 trace_capacity: int = 16384):
         self.spec_tokens = int(spec_tokens)
         if self.spec_tokens < 0:
             raise ValueError(f"spec_tokens must be >= 0, got {spec_tokens}")
@@ -510,7 +539,6 @@ class ServingEngine:
         self.sentry = RecompileSentry(name="serving",
                                       strict=self.debug_checks,
                                       total_budget=self.compile_budget)
-        self.invariant_checks_run = 0
         if self.debug_checks:
             # process-wide jax.monitoring compile counter (idempotent):
             # corroborates the sentry by also seeing programs built OUTSIDE
@@ -580,18 +608,60 @@ class ServingEngine:
                                                max_n=ngram_max,
                                                min_n=ngram_min)
 
-        # scheduler counters (stats())
-        self.iterations = 0
-        self.decode_steps = 0
-        self.prefill_calls = 0
-        self.admitted = 0
-        self.preempted = 0
-        self.prompt_tokens = 0
-        self.prefix_hit_tokens = 0
-        self.spec_rounds = 0
-        self.drafted_tokens = 0
-        self.accepted_tokens = 0
-        self._latencies: List[Dict[str, Any]] = []   # per finished request
+        # ----- telemetry (telemetry/): scheduler counters and latency
+        # distributions live in the metrics registry — stats() is a view
+        # over it (Prometheus text / JSON snapshot come for free), and the
+        # legacy counter attributes below are read-only properties.  The
+        # TTFT/TPOT histograms are fixed-bucket streaming: bounded memory
+        # for a serve session of any length (the old raw-sample lists grew
+        # forever and re-sorted on every stats() call); _latencies keeps a
+        # small deque of recent per-request records as a debug view.
+        m = self.metrics = MetricsRegistry()
+        self._c_iterations = m.counter(
+            "serving_iterations_total", "scheduler iterations run")
+        self._c_decode_steps = m.counter(
+            "serving_decode_steps_total", "single-token decode steps")
+        self._c_prefill_calls = m.counter(
+            "serving_prefill_calls_total", "prefill program invocations")
+        self._c_admitted = m.counter(
+            "serving_requests_admitted_total", "requests admitted to slots")
+        self._c_preempted = m.counter(
+            "serving_requests_preempted_total",
+            "sequences preempted under block pressure")
+        self._c_prompt_tokens = m.counter(
+            "serving_prompt_tokens_total", "prompt tokens admitted")
+        self._c_prefix_hit_tokens = m.counter(
+            "serving_prefix_hit_tokens_total",
+            "prompt tokens served from the prefix cache")
+        self._c_spec_rounds = m.counter(
+            "serving_spec_rounds_total", "speculative draft-verify rounds")
+        self._c_drafted = m.counter(
+            "serving_spec_drafted_tokens_total", "draft tokens proposed")
+        self._c_accepted = m.counter(
+            "serving_spec_accepted_tokens_total", "draft tokens accepted")
+        self._c_finished = m.counter(
+            "serving_requests_finished_total", "requests run to completion")
+        self._c_invariant_checks = m.counter(
+            "serving_invariant_checks_total",
+            "paged-state audits run (analysis/invariants.py)")
+        self._h_ttft = m.histogram(
+            "serving_ttft_seconds", help="per-request time to first token")
+        self._h_tpot = m.histogram(
+            "serving_tpot_seconds",
+            help="per-request time per output token (decode cadence)")
+        self._g_blocks_in_use = m.gauge(
+            "serving_blocks_in_use", "physical KV blocks referenced")
+        self._g_free_blocks = m.gauge(
+            "serving_free_blocks", "physical KV blocks on the free list")
+        self.timeline = TraceTimeline(capacity=trace_capacity)
+        if self.timeline.enabled:
+            # bounded lane table: one span lane per SLOT (a request's span
+            # lands on the slot that finished it) — lane count never grows
+            # with traffic, unlike per-uid lanes
+            for s in range(self.slots):
+                self.timeline.thread(f"slot {s}")
+        self.sentry.on_trace = self._emit_trace_event
+        self._latencies = deque(maxlen=256)  # recent finished requests
         self._trace_times: Dict[Any, Dict[str, Any]] = {}
         self._admit_seq = 0
         self._blocked_gate = None          # (head id, resume len, version)
@@ -621,6 +691,72 @@ class ServingEngine:
         in one process."""
         return paged_kv.tp_context(
             self.engine.mesh if self.kv_sharded else None)
+
+    # -------------------------------------------------------------- telemetry
+    # Legacy counter attributes are read-only views over the registry cells
+    # (internal code increments the cells; tests and callers keep reading
+    # srv.iterations / srv.preempted / ... unchanged).
+    @property
+    def iterations(self) -> int:
+        return int(self._c_iterations.value)
+
+    @property
+    def decode_steps(self) -> int:
+        return int(self._c_decode_steps.value)
+
+    @property
+    def prefill_calls(self) -> int:
+        return int(self._c_prefill_calls.value)
+
+    @property
+    def admitted(self) -> int:
+        return int(self._c_admitted.value)
+
+    @property
+    def preempted(self) -> int:
+        return int(self._c_preempted.value)
+
+    @property
+    def prompt_tokens(self) -> int:
+        return int(self._c_prompt_tokens.value)
+
+    @property
+    def prefix_hit_tokens(self) -> int:
+        return int(self._c_prefix_hit_tokens.value)
+
+    @property
+    def spec_rounds(self) -> int:
+        return int(self._c_spec_rounds.value)
+
+    @property
+    def drafted_tokens(self) -> int:
+        return int(self._c_drafted.value)
+
+    @property
+    def accepted_tokens(self) -> int:
+        return int(self._c_accepted.value)
+
+    @property
+    def invariant_checks_run(self) -> int:
+        return int(self._c_invariant_checks.value)
+
+    def _emit_trace_event(self, entry) -> None:
+        """Sentry trace callback (``analysis/sentry.py``): every (re)trace
+        of a registered jitted body lands on the timeline — a ``retrace``
+        event is contract drift made visible next to the scheduler events
+        that triggered it."""
+        over = entry.budget is not None and entry.traces > entry.budget
+        self.timeline.instant("retrace" if over else "jit_trace",
+                              entry=entry.name, traces=entry.traces)
+
+    def dump_trace(self, path: str) -> str:
+        """Write the per-request trace timeline as Chrome ``trace_event``
+        JSON (open at https://ui.perfetto.dev); returns ``path``.  The
+        ring holds the most recent ``trace_capacity`` events —
+        ``stats()['trace_events_dropped']`` says how much history fell
+        off."""
+        return self.timeline.dump(
+            path, process_name=f"serving:{self.engine.module.name}")
 
     # ------------------------------------------------------------ compiled fns
     @property
@@ -768,9 +904,12 @@ class ServingEngine:
         re-queue it at the FRONT with generated tokens folded into the
         prompt (greedy => recompute is token-exact)."""
         st = active.pop(slot)
+        nblocks = len(self._held[slot])
         self._release_slot(slot)
         pending.appendleft((st.req, st.prior + st.out))
-        self.preempted += 1
+        self._c_preempted.inc()
+        self.timeline.instant("preempt", uid=str(st.req.uid), slot=slot,
+                              blocks_freed=nblocks)
 
     def _alloc_block(self, active, pending, requester: int) -> Optional[int]:
         """One fresh block, reclaiming in order: free list -> LRU prefix-
@@ -786,6 +925,7 @@ class ServingEngine:
                 evicted = self._prefix.evict_one(self._alloc)
                 if evicted:
                     self._kv_scale_live.discard(evicted)
+                    self.timeline.instant("evict_block", block=int(evicted))
                     continue
             victim = max(active, key=lambda s: active[s].admit_seq)
             if victim == requester and len(active) == 1:
@@ -883,9 +1023,11 @@ class ServingEngine:
             pending.popleft()
             slot = free.pop(0)
             # latency probes: admit stamped once per request per trace (a
-            # preemption resume keeps the original admission time)
+            # preemption resume keeps the original admission time, so its
+            # TTFT/TPOT and its timeline span cover the whole wait)
             self._trace_times.setdefault(
-                req.uid, {"admit": time.perf_counter(), "first": None})
+                req.uid, {"admit": time.perf_counter(), "first": None,
+                          "admit_us": self.timeline.now_us()})
             self._tables[slot, :len(hits)] = hits
             self._held[slot] = list(hits)
             st = _SlotState(req=req, admit_seq=self._admit_seq,
@@ -895,16 +1037,23 @@ class ServingEngine:
             active[slot] = st
             joiners.append((slot, st))
             admission_log.append((req.uid, slot))
-            self.admitted += 1
-            self.prompt_tokens += plen
-            self.prefix_hit_tokens += st.base
+            self._c_admitted.inc()
+            self._c_prompt_tokens.inc(plen)
+            self._c_prefix_hit_tokens.inc(st.base)
+            # prefix_hit_tokens == 0 is the cache-miss record
+            self.timeline.instant("admit", uid=str(req.uid), slot=slot,
+                                  prompt_tokens=plen,
+                                  prefix_hit_tokens=st.base,
+                                  resumed=bool(prior))
         return joiners
 
     def serve(self, requests: Sequence[Request],
               eos_token_id: Optional[int] = None,
               admission_log: Optional[list] = None,
               step_log: Optional[list] = None,
-              debug_checks: Optional[bool] = None) -> Dict[Any, np.ndarray]:
+              debug_checks: Optional[bool] = None,
+              profile_dir: Optional[str] = None,
+              profile_iters: Optional[int] = None) -> Dict[Any, np.ndarray]:
         """Run a request trace to completion; returns ``uid -> [prompt +
         completion]`` int32 arrays, padded to ``prompt + max_new_tokens``
         with eos back-fill (HF semantics, same as ``generate``).
@@ -914,7 +1063,13 @@ class ServingEngine:
         collects one dict per iteration (admitted / evicted / blocks_in_use
         per step) for observability.  ``debug_checks`` overrides the
         engine-level flag from here on (ctor docstring): per-iteration
-        paged-state audits + strict recompile-sentry enforcement."""
+        paged-state audits + strict recompile-sentry enforcement.
+
+        ``profile_dir`` opens a ``jax.profiler`` trace window over this
+        call's first ``profile_iters`` scheduler iterations (``None`` =
+        the whole call) — the device-level deep dive behind the host-side
+        timeline (``dump_trace``).  The window start/stop are themselves
+        timeline events, so the two traces line up."""
         if debug_checks is not None:
             self.debug_checks = bool(debug_checks)
             self.sentry.strict = self.debug_checks
@@ -946,26 +1101,45 @@ class ServingEngine:
             st = active.pop(slot)
             req = st.req
             gen = np.asarray(st.prior + st.out, np.int32)
+            eos_hit = eos_token_id is not None and gen.size and \
+                gen[-1] == eos_token_id
             out = np.zeros(req.max_new_tokens, np.int32)
             out[:gen.size] = gen
-            if eos_token_id is not None and gen.size and \
-                    gen[-1] == eos_token_id:
+            if eos_hit:
                 out[gen.size:] = eos_token_id  # back-fill (HF semantics)
             results[req.uid] = np.concatenate([req.prompt, out])
             tm = self._trace_times.get(req.uid)
             if tm is not None and tm["first"] is not None:
                 done = time.perf_counter()
+                ttft = tm["first"] - tm["admit"]
+                tpot = ((done - tm["first"]) / (gen.size - 1)) \
+                    if gen.size > 1 else 0.0
+                self._c_finished.inc()
+                self._h_ttft.observe(ttft)
+                self._h_tpot.observe(tpot)
                 self._latencies.append({
                     "uid": req.uid,
                     "new_tokens": int(gen.size),
-                    "ttft_s": tm["first"] - tm["admit"],
-                    "tpot_s": ((done - tm["first"]) / (gen.size - 1))
-                    if gen.size > 1 else 0.0,
+                    "ttft_s": ttft,
+                    "tpot_s": tpot,
                 })
+                # per-request span on the finishing slot's lane: admission
+                # (original — a preemption resume keeps it) to completion
+                self.timeline.complete(
+                    f"req {req.uid}", tm["admit_us"], tid=slot + 1,
+                    uid=str(req.uid), new_tokens=int(gen.size),
+                    eos=bool(eos_hit), ttft_s=ttft)
             self._release_slot(slot)
 
+        window = None
+        if profile_dir is not None:
+            window = ProfilerWindow(profile_dir)
+            if window.start():
+                self.timeline.instant("profiler_start",
+                                      profile_dir=str(profile_dir))
+        iter0 = self.iterations
         while pending or active:
-            self.iterations += 1
+            self._c_iterations.inc()
             admitted0, preempted0 = self.admitted, self.preempted
             self._admit(pending, active, admission_log)
             self._run_prefill(active, pending, params, eos_token_id, finish)
@@ -990,9 +1164,18 @@ class ServingEngine:
             if self.debug_checks:
                 # O(blocks) host-state audit between scheduler rounds —
                 # the scheduler's state is only guaranteed consistent at
-                # iteration boundaries (analysis/invariants.py)
+                # iteration boundaries (analysis/invariants.py; the audit
+                # drops its own event on the timeline)
                 audit_serving_engine(self, active)
-                self.invariant_checks_run += 1
+                self._c_invariant_checks.inc()
+            if window is not None and window.active and \
+                    profile_iters is not None and \
+                    self.iterations - iter0 >= profile_iters:
+                window.stop()
+                self.timeline.instant("profiler_stop")
+        if window is not None and window.active:
+            window.stop()
+            self.timeline.instant("profiler_stop")
         return results
 
     # ----------------------------------------------------------------- decode
@@ -1017,12 +1200,13 @@ class ServingEngine:
             return
         bt = np.zeros_like(self._tables)
         bt[dec] = self._tables[dec]
-        with self._tp_ctx():
-            nxt, self._cache = self._get_decode_fn()(
-                params, self._cache, jnp.asarray(self._tokens),
-                jnp.asarray(self._lengths), jnp.asarray(bt))
-        nxt = np.asarray(nxt)
-        self.decode_steps += 1
+        with self.timeline.span("decode", slots=len(dec)):
+            with self._tp_ctx():
+                nxt, self._cache = self._get_decode_fn()(
+                    params, self._cache, jnp.asarray(self._tokens),
+                    jnp.asarray(self._lengths), jnp.asarray(bt))
+            nxt = np.asarray(nxt)
+        self._c_decode_steps.inc()
         for slot in dec:
             st = active[slot]
             self._lengths[slot] += 1   # the fed token is now cached
@@ -1069,42 +1253,48 @@ class ServingEngine:
             return
         bt = np.zeros_like(self._tables)
         bt[dec] = self._tables[dec]
-        if self._draft is not None:
-            with self._tp_ctx():
-                drafts, self._dcache = self._get_draft_fn()(
-                    self._draft.params, self._dcache,
-                    jnp.asarray(self._tokens), jnp.asarray(self._lengths),
-                    jnp.asarray(bt))
-            drafts = np.asarray(drafts)
-        else:
-            drafts = np.zeros((self.slots, k), np.int32)
-            for slot in dec:
-                st = active[slot]
-                drafts[slot] = self._proposer.propose(
-                    np.concatenate([st.prompt_eff,
-                                    np.asarray(st.out, np.int32)]))
+        with self.timeline.span(
+                "spec_propose", slots=len(dec),
+                mode="draft" if self._draft is not None else "ngram"):
+            if self._draft is not None:
+                with self._tp_ctx():
+                    drafts, self._dcache = self._get_draft_fn()(
+                        self._draft.params, self._dcache,
+                        jnp.asarray(self._tokens),
+                        jnp.asarray(self._lengths), jnp.asarray(bt))
+                drafts = np.asarray(drafts)
+            else:
+                drafts = np.zeros((self.slots, k), np.int32)
+                for slot in dec:
+                    st = active[slot]
+                    drafts[slot] = self._proposer.propose(
+                        np.concatenate([st.prompt_eff,
+                                        np.asarray(st.out, np.int32)]))
         ids = np.zeros((self.slots, k + 1), np.int32)
         valid = np.zeros(self.slots, np.int32)
         ids[dec, 0] = self._tokens[dec]
         ids[dec, 1:] = drafts[dec]
         valid[dec] = k + 1
-        with self._tp_ctx():
-            scored, self._cache = self._get_verify_fn()(
-                params, self._cache, jnp.asarray(ids), jnp.asarray(bt),
-                jnp.asarray(self._lengths), jnp.asarray(valid))
-        scored = np.asarray(scored)
-        self.spec_rounds += 1
+        with self.timeline.span("spec_verify", slots=len(dec), window=k + 1):
+            with self._tp_ctx():
+                scored, self._cache = self._get_verify_fn()(
+                    params, self._cache, jnp.asarray(ids), jnp.asarray(bt),
+                    jnp.asarray(self._lengths), jnp.asarray(valid))
+            scored = np.asarray(scored)
+        self._c_spec_rounds.inc()
         # a draft-model proposer caps acceptance at K-1: the K-th draft's
         # KV was never written to the draft pool, so accepting it would
         # desync the draft's next feed position (n-gram has no such state)
         max_accept = k - 1 if self._draft is not None else k
+        accept_lens = []
         for slot in dec:
             st = active[slot]
             emitted, accepted, finished = greedy_accept(
                 ids[slot].tolist(), scored[slot].tolist(), max_accept,
                 eos_token_id, st.req.max_new_tokens - st.gen_count)
-            self.drafted_tokens += k
-            self.accepted_tokens += accepted
+            self._c_drafted.inc(k)
+            self._c_accepted.inc(accepted)
+            accept_lens.append(accepted)
             st.out.extend(emitted)
             self._mark_first(st)
             if finished:
@@ -1114,6 +1304,8 @@ class ServingEngine:
                 # the correction token becomes the new pending feed
                 self._lengths[slot] += accepted + 1
                 self._tokens[slot] = emitted[-1]
+        self.timeline.instant("spec_accept", accept_lens=accept_lens,
+                              drafted=k * len(dec))
 
     # ---------------------------------------------------------------- prefill
     def _run_prefill(self, active, pending, params, eos_token_id, finish):
@@ -1186,20 +1378,23 @@ class ServingEngine:
             base[row] = st.base
             valid[row] = v
             rows.append((slot, v))
-        if self._draft is not None:
-            with self._tp_ctx():
-                first, self._cache, self._dcache = \
-                    self._get_prefill_fn(width)(
-                        params, self._draft.params, self._cache,
-                        self._dcache, jnp.asarray(ids), jnp.asarray(bt),
-                        jnp.asarray(base), jnp.asarray(valid))
-        else:
-            with self._tp_ctx():
-                first, self._cache = self._get_prefill_fn(width)(
-                    params, self._cache, jnp.asarray(ids), jnp.asarray(bt),
-                    jnp.asarray(base), jnp.asarray(valid))
-        first = np.asarray(first)
-        self.prefill_calls += 1
+        with self.timeline.span("prefill", width=width, rows=len(group),
+                                slots=list(map(int, group))):
+            if self._draft is not None:
+                with self._tp_ctx():
+                    first, self._cache, self._dcache = \
+                        self._get_prefill_fn(width)(
+                            params, self._draft.params, self._cache,
+                            self._dcache, jnp.asarray(ids), jnp.asarray(bt),
+                            jnp.asarray(base), jnp.asarray(valid))
+            else:
+                with self._tp_ctx():
+                    first, self._cache = self._get_prefill_fn(width)(
+                        params, self._cache, jnp.asarray(ids),
+                        jnp.asarray(bt), jnp.asarray(base),
+                        jnp.asarray(valid))
+            first = np.asarray(first)
+        self._c_prefill_calls.inc()
         for row, (slot, v) in enumerate(rows):
             st = active[slot]
             st.base += v
@@ -1262,13 +1457,14 @@ class ServingEngine:
 
     def _latency_stats(self) -> Dict[str, Any]:
         """TTFT/TPOT percentiles over every finished request (cumulative
-        across serve calls, like the other counters)."""
-        out: Dict[str, Any] = {"requests_finished": len(self._latencies)}
-        for key in ("ttft", "tpot"):
-            vals = [m[f"{key}_s"] for m in self._latencies]
+        across serve calls, like the other counters) — read from the
+        registry's streaming histograms: bounded memory, no re-sort, and
+        still ``None`` before the first finished request."""
+        out: Dict[str, Any] = {
+            "requests_finished": int(self._c_finished.value)}
+        for key, hist in (("ttft", self._h_ttft), ("tpot", self._h_tpot)):
             for q in (50, 95):
-                out[f"{key}_p{q}_s"] = (
-                    float(np.percentile(vals, q)) if vals else None)
+                out[f"{key}_p{q}_s"] = hist.quantile(q / 100.0)
         return out
 
     def stats(self) -> Dict[str, Any]:
@@ -1276,7 +1472,14 @@ class ServingEngine:
         rate, block occupancy, admission/eviction counters, per-request
         latency percentiles, the KV memory footprint (pool shape, total
         bytes, bytes per chip under tp sharding), and — in speculative
-        mode — draft/accept counters and the acceptance rate."""
+        mode — draft/accept counters and the acceptance rate.
+
+        Every counter/latency value is a view over ``self.metrics``
+        (``telemetry/``) — ``metrics.prometheus_text()`` and
+        ``metrics.snapshot()`` expose the same data for scrapes and
+        bench artifacts; the key set here is stable across PRs."""
+        self._g_blocks_in_use.set(self._alloc.blocks_in_use)
+        self._g_free_blocks.set(self._alloc.free_blocks)
         st = {
             "mode": "chunked" if self.chunked_prefill else "bucketed",
             "compile_count": self.compile_count,
@@ -1315,6 +1518,11 @@ class ServingEngine:
             "accepted_tokens": self.accepted_tokens,
             "acceptance_rate": (self.accepted_tokens / self.drafted_tokens
                                 if self.drafted_tokens else 0.0),
+            # timeline ring health (telemetry/trace.py): dropped > 0 means
+            # the ring wrapped — raise trace_capacity for longer history
+            "trace_capacity": self.timeline.capacity,
+            "trace_events": len(self.timeline),
+            "trace_events_dropped": self.timeline.dropped,
         }
         st.update(self._kv_footprint())
         st.update(self._latency_stats())

@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+from typing import Optional
+
 from pydantic import model_validator
 
 from ..runtime.config_utils import DeepSpeedConfigModel
@@ -15,8 +17,12 @@ class TensorBoardConfig(DeepSpeedConfigModel):
 
 class WandbConfig(DeepSpeedConfigModel):
     enabled: bool = False
-    group: str = None
-    team: str = None
+    # Optional, not bare str: pydantic v2 validates assigned values against
+    # the annotation, so `group: str = None` accepted the default but
+    # rejected an explicit group=None (and round-tripping a dumped config
+    # re-assigns every field) — reference config has the same typing bug
+    group: Optional[str] = None
+    team: Optional[str] = None
     project: str = "deepspeed"
 
 

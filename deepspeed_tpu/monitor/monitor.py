@@ -110,3 +110,14 @@ class MonitorMaster(Monitor):
     def write_events(self, event_list) -> None:
         for m in self.monitors:
             m.write_events(event_list)
+
+    def write_registry(self, registry, step: int) -> None:
+        """Fan a telemetry :class:`~deepspeed_tpu.telemetry.
+        MetricsRegistry` snapshot out to every backend: counters/gauges
+        emit one ``(name, value, step)`` event each (under their
+        ``monitor_name`` when set — the training engine keeps its
+        historical ``Train/Samples/...`` names this way), histograms
+        emit ``_p50``/``_p95``/``_count`` scalars.  This is how the
+        training engine's registry-backed loss / lr / throughput /
+        wall-clock-breakdown metrics land in the CSV files on disk."""
+        self.write_events(registry.to_events(step))

@@ -43,6 +43,7 @@ from ..analysis.sentry import RecompileSentry
 from ..ops.optimizers import get_optimizer
 from ..parallel.topology import (DATA_AXES, SP_AXIS, MeshTopology,
                                  topology_from_config)
+from ..telemetry import MetricsRegistry
 from ..utils.logging import log_dist, logger
 from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER,
                            STEP_GLOBAL_TIMER, TRAIN_BATCH_TIMER,
@@ -359,12 +360,35 @@ class DeepSpeedEngine:
             if training_data is not None else None
         self._data_iterator: Optional[Iterator] = None
 
-        # timers/monitor
-        self.timers = SynchronizedWallClockTimer()
+        # timers/monitor/telemetry: one metrics registry backs the wall-
+        # clock timer histograms, the train loss/lr/throughput gauges, and
+        # the MonitorMaster event routing (_finalize_metrics writes the
+        # registry snapshot through the CSV/TensorBoard/W&B backends on
+        # report steps — telemetry/, docs/observability.md)
+        self.metrics = MetricsRegistry()
+        self.timers = SynchronizedWallClockTimer(registry=self.metrics)
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size(),
             steps_per_output=self._config.steps_per_print or 10)
         self.wall_clock_breakdown_enabled = self._config.wall_clock_breakdown
+        self._g_train_loss = self.metrics.gauge(
+            "train_loss", "last reported global-step loss",
+            monitor_name="Train/Samples/train_loss")
+        self._g_train_lr = self.metrics.gauge(
+            "train_lr", "last reported learning rate",
+            monitor_name="Train/Samples/lr")
+        # fp16-only: an unconditional family would emit a dead-constant
+        # loss_scale series (and CSV file) for every full-precision run
+        self._g_loss_scale = self.metrics.gauge(
+            "train_loss_scale", "fp16 dynamic loss scale",
+            monitor_name="Train/Samples/loss_scale") \
+            if self.fp16_enabled else None
+        self._g_samples_per_sec = self.metrics.gauge(
+            "train_samples_per_sec",
+            "running-average training throughput (ThroughputTimer)",
+            monitor_name="Train/Samples/throughput")
+        self._g_global_steps = self.metrics.gauge(
+            "train_global_steps", "optimizer steps completed")
         from ..monitor.monitor import MonitorMaster
 
         self.monitor = MonitorMaster(self._config.monitor_config)
@@ -1300,6 +1324,7 @@ class DeepSpeedEngine:
         t0 = time.perf_counter() if profiling_now else None
 
         self.tput_timer.start()
+        self.timers(TRAIN_BATCH_TIMER).start()
         if self.offload_enabled:
             self.state, metrics = self._train_step_offload(self.state, batch)
         else:
@@ -1314,6 +1339,9 @@ class DeepSpeedEngine:
                                    max(self.steps_per_print(), 1) == 0) \
             else None
         self.tput_timer.stop(global_step=True, sync_arrays=sync)
+        # same sync decision: un-synced steps record dispatch latency, the
+        # periodic synced step bounds the true step time (timer docstring)
+        self.timers(TRAIN_BATCH_TIMER).stop(sync_arrays=sync)
         self._finalize_metrics(metrics)
 
         if profiling_now:
@@ -1523,15 +1551,26 @@ class DeepSpeedEngine:
         report = self.global_steps % max(self.steps_per_print(), 1) < steps
         if self.lr_scheduler is not None:
             self.lr_scheduler.step(self.global_steps)
-        if self.monitor.enabled and report:
-            events = [("Train/Samples/train_loss", self._cached_metrics["loss"],
-                       self.global_samples),
-                      ("Train/Samples/lr", self.get_lr()[0], self.global_samples)]
-            if self.fp16_enabled:
-                events.append(("Train/Samples/loss_scale",
-                               self._cached_metrics["loss_scale"],
-                               self.global_samples))
-            self.monitor.write_events(events)
+        if report:
+            # registry gauges are refreshed on report steps only — the same
+            # cadence the metrics sync runs at, so this forces no extra
+            # device round-trip — then the whole registry snapshot routes
+            # through the MonitorMaster backends: loss/lr/loss-scale under
+            # their historical event names (monitor_name), plus throughput
+            # and the wall-clock timer histograms (telemetry/metrics.py
+            # to_events)
+            self._g_train_loss.set(float(self._cached_metrics["loss"]))
+            self._g_train_lr.set(float(self.get_lr()[0]))
+            self._g_global_steps.set(self.global_steps)
+            if self._g_loss_scale is not None:
+                self._g_loss_scale.set(
+                    float(self._cached_metrics["loss_scale"]))
+            sps = self.tput_timer.avg_samples_per_sec()
+            if sps == sps:                 # NaN until start_step is passed
+                self._g_samples_per_sec.set(sps)
+            if self.monitor.enabled:
+                self.monitor.write_registry(self.metrics,
+                                            self.global_samples)
         if report:
             log_dist(
                 f"step={self.global_steps} loss={self._cached_metrics['loss']:.4f} "

@@ -1,0 +1,30 @@
+"""Unified telemetry layer (metrics registry + trace timeline +
+profiling hooks).
+
+Three coordinated pieces (design notes in each module):
+
+ - :mod:`~deepspeed_tpu.telemetry.metrics` — counters / gauges /
+   fixed-bucket streaming histograms with labels; Prometheus text
+   exposition, JSON snapshots, and ``(name, value, step)`` events for
+   the ``monitor/`` backends.  ``ServingEngine.stats()`` and the training
+   engine's monitor events are views over one registry each.
+ - :mod:`~deepspeed_tpu.telemetry.trace` — a bounded ring buffer of
+   per-request scheduler events exportable as Chrome ``trace_event``
+   JSON (Perfetto), plus the ``jax.profiler`` window bracket.
+ - the engines' wiring: ``ServingEngine(trace_capacity=...)`` /
+   ``.dump_trace(path)`` / ``serve(profile_dir=...)`` and
+   ``DeepSpeedEngine``'s registry-routed MonitorMaster events.
+
+See ``docs/observability.md`` for the metric name table, label
+conventions, the Perfetto walkthrough, and the overhead contract.
+"""
+
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      DEFAULT_TIME_BUCKETS_S)
+from .trace import ProfilerWindow, TraceTimeline, validate_chrome_trace
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "DEFAULT_TIME_BUCKETS_S", "ProfilerWindow", "TraceTimeline",
+    "validate_chrome_trace",
+]

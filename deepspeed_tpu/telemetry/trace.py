@@ -1,0 +1,272 @@
+"""Per-request trace timeline: a bounded host-side ring buffer of engine
+events, exportable as Chrome ``trace_event`` JSON (Perfetto-viewable).
+
+``ServingEngine.stats()`` aggregates; it cannot answer "where did THIS
+slow request spend its time".  The timeline records one small dict per
+scheduler event — admit, prefill chunk, decode step, spec propose /
+verify (with per-slot accept lengths), prefix hit/miss, block eviction,
+preemption, finish, plus the ``analysis/`` sentry's (re)trace events and
+the per-iteration invariant audits — into a ``deque(maxlen=capacity)``:
+bounded memory forever, O(1) append, and a ``dropped`` counter that says
+exactly how much history fell off the ring.  ``capacity=0`` disables
+recording entirely (one predicate per would-be event — the "near-free
+when idle" half of the telemetry overhead contract; the enabled half is
+pinned ≤2% by the ``--telemetry-bench`` serving-bench lane).
+
+Export (:meth:`TraceTimeline.to_chrome` / :meth:`dump`) follows the
+Chrome ``trace_event`` JSON-object format: ``X`` (complete) events carry
+``ts``+``dur``, ``i`` (instant) events just ``ts``, every event has
+``pid``/``tid``, timestamps are microseconds since the timeline epoch and
+sorted ascending, and ``M`` metadata events name the process and each
+registered thread lane.  Load the file at https://ui.perfetto.dev (or
+``chrome://tracing``) — requests appear as one span lane each, scheduler
+phases as a shared lane (walkthrough: ``docs/observability.md``).
+
+:class:`ProfilerWindow` is the deep-dive escalation: it brackets a region
+with ``jax.profiler.start_trace`` / ``stop_trace`` so a slow window seen
+in the host timeline can be re-run with full XLA/device traces
+(``ServingEngine.serve(profile_dir=...)`` wires it around N scheduler
+iterations).  Failures to start the profiler degrade to a logged warning
+— telemetry must never take the serving loop down.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import logger
+
+__all__ = ["TraceTimeline", "ProfilerWindow", "validate_chrome_trace"]
+
+#: tid of the shared scheduler lane (request lanes are allocated upward)
+SCHEDULER_TID = 0
+
+
+class TraceTimeline:
+    """Bounded ring buffer of trace events with Chrome export.
+
+    Parameters
+    ----------
+    capacity:  max events retained (oldest evicted first; ``dropped``
+               counts evictions).  ``0`` disables recording — every emit
+               is one ``if`` and the buffer stays empty.
+    pid:       the exported ``pid`` (multi-process launchers pass
+               ``jax.process_index()`` so merged traces stay distinct).
+    clock:     second-denominated monotonic clock (injectable for tests).
+    """
+
+    def __init__(self, capacity: int = 16384, pid: int = 0, clock=None):
+        if capacity < 0:
+            raise ValueError(f"capacity must be >= 0, got {capacity}")
+        self.capacity = int(capacity)
+        self.enabled = self.capacity > 0
+        self.pid = int(pid)
+        self._clock = clock or time.perf_counter
+        self._t0 = self._clock()
+        self._events: deque = deque(maxlen=max(self.capacity, 1))
+        self.emitted = 0
+        self.dropped = 0
+        self._thread_names: Dict[int, str] = {SCHEDULER_TID: "scheduler"}
+        self._next_tid = 1
+
+    # ------------------------------------------------------------------ time
+    def now_us(self) -> float:
+        """Microseconds since the timeline epoch (event ``ts`` domain)."""
+        return (self._clock() - self._t0) * 1e6
+
+    # --------------------------------------------------------------- threads
+    def thread(self, name: str) -> int:
+        """Allocate (or look up) a named lane; returns its ``tid``.
+        Lanes are for small, fixed sets (the serving engine allocates one
+        per SLOT at construction — request spans land on the slot that
+        finished them), never per-request values: every lane is a
+        name-table entry and a Perfetto row forever."""
+        for tid, n in self._thread_names.items():
+            if n == name:
+                return tid
+        tid = self._next_tid
+        self._next_tid += 1
+        self._thread_names[tid] = name
+        return tid
+
+    # ---------------------------------------------------------------- emits
+    def _push(self, ev: Dict[str, Any]) -> None:
+        if len(self._events) == self._events.maxlen:
+            self.dropped += 1
+        self._events.append(ev)
+        self.emitted += 1
+
+    def instant(self, name: str, tid: int = SCHEDULER_TID,
+                ts: Optional[float] = None, **args) -> None:
+        """One ``i`` (instant) event."""
+        if not self.enabled:
+            return
+        ev = {"name": name, "ph": "i", "s": "t",
+              "ts": self.now_us() if ts is None else ts,
+              "pid": self.pid, "tid": tid}
+        if args:
+            ev["args"] = args
+        self._push(ev)
+
+    def complete(self, name: str, start_us: float,
+                 tid: int = SCHEDULER_TID, end_us: Optional[float] = None,
+                 **args) -> None:
+        """One ``X`` (complete) event spanning ``[start_us, end_us]``
+        (``end_us`` defaults to now)."""
+        if not self.enabled:
+            return
+        end = self.now_us() if end_us is None else end_us
+        ev = {"name": name, "ph": "X", "ts": start_us,
+              "dur": max(end - start_us, 0.0),
+              "pid": self.pid, "tid": tid}
+        if args:
+            ev["args"] = args
+        self._push(ev)
+
+    @contextmanager
+    def span(self, name: str, tid: int = SCHEDULER_TID, **args):
+        """Context manager emitting an ``X`` event around the body; the
+        body can mutate ``args`` in place (accept-lengths are known only
+        after the verify pass returns)."""
+        if not self.enabled:
+            yield args
+            return
+        start = self.now_us()
+        try:
+            yield args
+        finally:
+            self.complete(name, start, tid=tid, **args)
+
+    # ---------------------------------------------------------------- export
+    def __len__(self) -> int:
+        return len(self._events) if self.enabled else 0
+
+    def events(self) -> List[Dict[str, Any]]:
+        """Live events, oldest first (the ring view — NOT yet sorted)."""
+        return list(self._events) if self.enabled else []
+
+    def to_chrome(self, process_name: str = "deepspeed_tpu") -> Dict[str, Any]:
+        """Chrome ``trace_event`` JSON-object document: ``M`` metadata
+        naming the process and lanes, then every ring event sorted by
+        ``ts`` ascending."""
+        meta: List[Dict[str, Any]] = [{
+            "name": "process_name", "ph": "M", "ts": 0.0,
+            "pid": self.pid, "tid": SCHEDULER_TID,
+            "args": {"name": process_name},
+        }]
+        for tid, name in sorted(self._thread_names.items()):
+            meta.append({"name": "thread_name", "ph": "M", "ts": 0.0,
+                         "pid": self.pid, "tid": tid,
+                         "args": {"name": name}})
+        body = sorted(self.events(), key=lambda e: e["ts"])
+        return {"traceEvents": meta + body,
+                "displayTimeUnit": "ms",
+                "otherData": {"dropped_events": self.dropped,
+                              "emitted_events": self.emitted}}
+
+    def dump(self, path: str, process_name: str = "deepspeed_tpu") -> str:
+        """Write the Chrome trace JSON to ``path``; returns ``path``
+        (open it at https://ui.perfetto.dev)."""
+        with open(path, "w") as f:
+            json.dump(self.to_chrome(process_name), f)
+        return path
+
+
+def validate_chrome_trace(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Schema-check an exported Chrome ``trace_event`` document; raises
+    :class:`ValueError` naming the first violation, returns a summary.
+
+    Checked (the contract the serving bench records and the telemetry
+    tests pin): ``traceEvents`` is a list; every event carries ``name`` /
+    ``ph`` / ``ts`` / ``pid`` / ``tid``; phases are ``M``/``i``/``X``/
+    ``B``/``E`` with ``X`` events carrying a non-negative ``dur`` and
+    ``B``/``E`` balanced per ``(pid, tid)``; non-metadata timestamps are
+    monotone non-decreasing (sorted export).  Summary counts let callers
+    assert content (e.g. per-request span count) without re-walking."""
+    events = doc.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        raise ValueError("traceEvents must be a non-empty list")
+    last_ts = None
+    open_spans: Dict[tuple, int] = {}
+    summary = {"events": len(events), "complete": 0, "instant": 0,
+               "metadata": 0, "request_spans": 0}
+    for i, e in enumerate(events):
+        for field in ("name", "ph", "ts", "pid", "tid"):
+            if field not in e:
+                raise ValueError(f"event {i} ({e.get('name')!r}) is "
+                                 f"missing {field!r}")
+        ph = e["ph"]
+        if ph not in ("M", "i", "X", "B", "E"):
+            raise ValueError(f"event {i} has unknown phase {ph!r}")
+        if ph == "M":
+            summary["metadata"] += 1
+            continue
+        if last_ts is not None and e["ts"] < last_ts:
+            raise ValueError(
+                f"event {i} ts {e['ts']} < previous {last_ts} — export "
+                "must be sorted")
+        last_ts = e["ts"]
+        if ph == "X":
+            if e.get("dur", -1) < 0:
+                raise ValueError(
+                    f"complete event {i} ({e['name']!r}) lacks a "
+                    "non-negative dur")
+            summary["complete"] += 1
+            if str(e["name"]).startswith("req "):
+                summary["request_spans"] += 1
+        elif ph == "i":
+            summary["instant"] += 1
+        elif ph == "B":
+            key = (e["pid"], e["tid"])
+            open_spans[key] = open_spans.get(key, 0) + 1
+        elif ph == "E":
+            key = (e["pid"], e["tid"])
+            if not open_spans.get(key):
+                raise ValueError(
+                    f"event {i}: E without a matching B on lane {key}")
+            open_spans[key] -= 1
+    dangling = {k: v for k, v in open_spans.items() if v}
+    if dangling:
+        raise ValueError(f"unclosed B spans on lanes {dangling}")
+    return summary
+
+
+class ProfilerWindow:
+    """Idempotent ``jax.profiler`` bracket around N engine iterations.
+
+    ``start()`` begins a device/XLA trace into ``profile_dir`` (TensorBoard
+    ``trace_viewer`` / Perfetto format), ``stop()`` ends it; both degrade
+    to logged warnings when the profiler is unavailable or already active
+    (e.g. nested windows) — profiling must never fail the serving loop.
+    """
+
+    def __init__(self, profile_dir: str):
+        self.profile_dir = str(profile_dir)
+        self.active = False
+
+    def start(self) -> bool:
+        if self.active:
+            return True
+        try:
+            import jax
+
+            jax.profiler.start_trace(self.profile_dir)
+            self.active = True
+        except Exception as e:  # unavailable backend / nested trace
+            logger.warning(f"jax.profiler window not started: {e}")
+        return self.active
+
+    def stop(self) -> None:
+        if not self.active:
+            return
+        self.active = False
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as e:
+            logger.warning(f"jax.profiler window not stopped cleanly: {e}")

@@ -8,6 +8,17 @@ blocking on device arrays, so ``Timer.stop(sync_arrays=...)`` optionally calls
 host-side: they time dispatched steps, which under ``jit`` includes compile time on
 the first call — callers should warm up before trusting numbers (same caveat as
 CUDA-graph capture in the reference).
+
+Timers may be backed by the telemetry layer: construct
+:class:`SynchronizedWallClockTimer` with a
+:class:`~deepspeed_tpu.telemetry.MetricsRegistry` and every ``stop()``
+also lands the elapsed milliseconds in a per-timer-labeled streaming
+histogram (``train_wall_clock_ms{timer=...}``) — the training engine
+wires its registry through here so fwd/bwd/step breakdowns reach the
+``MonitorMaster`` backends and Prometheus exposition alongside
+loss/lr/throughput (``docs/observability.md``).  Host timers NEVER
+belong inside jit/shard_map bodies — they would time dispatch, not
+device execution (lint rule GL006 enforces this).
 """
 
 from __future__ import annotations
@@ -44,24 +55,41 @@ def _sync(arrays) -> None:
 
 
 class Timer:
-    """A single named stopwatch accumulating elapsed milliseconds."""
+    """A single named stopwatch accumulating elapsed milliseconds.
 
-    def __init__(self, name: str):
+    ``histogram`` (optional): a telemetry ``Histogram`` each ``stop()``'s
+    elapsed milliseconds is also observed into — bounded-memory
+    distribution of every interval this timer ever measured, independent
+    of the reset/elapsed cycle the log path runs."""
+
+    def __init__(self, name: str, histogram: Any = None):
         self.name_ = name
         self.started_ = False
         self.start_time = 0.0
         self.elapsed_ms = 0.0
         self.count = 0
+        self._histogram = histogram
+        # segment carry for elapsed()-on-a-running-timer probes: the
+        # internal stop/restart must not split one logical interval into
+        # two histogram samples (count inflation, p50 dragged down)
+        self._hist_carry_ms = 0.0
 
     def start(self) -> None:
         assert not self.started_, f"{self.name_} timer has already been started"
         self.start_time = time.perf_counter()
         self.started_ = True
 
-    def stop(self, reset: bool = False, sync_arrays: Any = None) -> None:
+    def stop(self, reset: bool = False, sync_arrays: Any = None,
+             record: bool = True) -> None:
         assert self.started_, f"{self.name_} timer is not started"
         _sync(sync_arrays)
         elapsed = (time.perf_counter() - self.start_time) * 1000.0
+        if self._histogram is not None:
+            if record:
+                self._histogram.observe(elapsed + self._hist_carry_ms)
+                self._hist_carry_ms = 0.0
+            else:
+                self._hist_carry_ms += elapsed
         if reset:
             self.elapsed_ms = elapsed
             self.count = 1
@@ -74,12 +102,16 @@ class Timer:
         self.started_ = False
         self.elapsed_ms = 0.0
         self.count = 0
+        self._hist_carry_ms = 0.0
 
     def elapsed(self, reset: bool = True) -> float:
-        """Return accumulated elapsed time in ms (stops/restarts a running timer)."""
+        """Return accumulated elapsed time in ms (stops/restarts a running
+        timer; the probe's internal stop carries — not records — its
+        segment, so the eventual real ``stop`` observes ONE histogram
+        sample for the whole interval)."""
         started = self.started_
         if started:
-            self.stop()
+            self.stop(record=False)
         total = self.elapsed_ms
         if reset:
             self.reset()
@@ -91,15 +123,34 @@ class Timer:
         return self.elapsed_ms / max(self.count, 1)
 
 
-class SynchronizedWallClockTimer:
-    """Group of named timers. ``.log(names)`` prints a one-line breakdown."""
+#: bucket edges for millisecond-denominated timer histograms: 10us..5min
+#: (a cold-compile first step lands in the tail instead of overflowing)
+TIMER_MS_BUCKETS = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+    100.0, 250.0, 500.0, 1e3, 2.5e3, 5e3, 1e4, 3e4, 6e4, 3e5)
 
-    def __init__(self):
+
+class SynchronizedWallClockTimer:
+    """Group of named timers. ``.log(names)`` prints a one-line breakdown.
+
+    ``registry``: optional telemetry ``MetricsRegistry`` — each named
+    timer then observes every measured interval into the
+    ``train_wall_clock_ms{timer=<name>}`` histogram family (module
+    docstring)."""
+
+    def __init__(self, registry: Any = None):
         self.timers: Dict[str, Timer] = {}
+        self._registry = registry
 
     def __call__(self, name: str) -> Timer:
         if name not in self.timers:
-            self.timers[name] = Timer(name)
+            hist = None
+            if self._registry is not None:
+                hist = self._registry.histogram(
+                    "train_wall_clock_ms", buckets=TIMER_MS_BUCKETS,
+                    help="engine wall-clock breakdown (ms per interval)",
+                    timer=name)
+            self.timers[name] = Timer(name, histogram=hist)
         return self.timers[name]
 
     def has_timer(self, name: str) -> bool:

@@ -205,6 +205,43 @@ jax.jit(step)
     assert "GL005" not in _codes(static)
 
 
+def test_gl006_host_timer_fires_and_near_miss():
+    fires = """
+import jax, time
+from time import perf_counter
+
+def step(x, cache):
+    t0 = time.perf_counter()             # trace-time stamp, not device
+    t1 = perf_counter()                  # from-import spelling
+    t2 = time.time()
+    return cache
+
+jax.jit(step, donate_argnums=(1,))
+"""
+    codes = _codes(fires)
+    assert codes.count("GL006") == 3, codes
+    near_miss = """
+import jax, time
+
+def step(x, cache):
+    return cache
+
+def host(x, cache):
+    t0 = time.perf_counter()             # host code AROUND the jit call
+    out = jax.jit(step, donate_argnums=(1,))(x, cache)
+    jax.block_until_ready(out)
+    return time.time() - t0, out
+
+class Clock:
+    def time(self):
+        return 0.0
+
+def host2(c: "Clock"):
+    return c.time()                      # not the time module
+"""
+    assert "GL006" not in _codes(near_miss)
+
+
 def test_noqa_pragma_suppresses_named_rule_only():
     src = """
 import jax

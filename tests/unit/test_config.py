@@ -335,3 +335,26 @@ def test_pipeline_and_profiler_init_immune_to_on_device():
             size=(engine.train_batch_size(), 17)).astype(np.int32)})
     assert prof["params"] > 0
     assert np.isfinite(float(m["loss"]))
+
+
+def test_monitor_config_round_trips_optional_wandb_fields():
+    """WandbConfig's group/team are Optional[str] (they were annotated
+    bare ``str`` with a ``None`` default, which pydantic v2 accepts as a
+    default but rejects on explicit assignment — so a dumped config could
+    not be re-validated)."""
+    from deepspeed_tpu.monitor.config import (DeepSpeedMonitorConfig,
+                                              get_monitor_config)
+
+    cfg = get_monitor_config(
+        {"wandb": {"enabled": True, "group": None, "team": None}})
+    assert cfg.wandb.group is None and cfg.wandb.team is None
+    # round-trip: dump -> re-validate, explicit Nones included
+    again = DeepSpeedMonitorConfig(**cfg.model_dump())
+    assert again.model_dump() == cfg.model_dump()
+
+    named = get_monitor_config(
+        {"wandb": {"enabled": True, "group": "g1", "team": "t1"},
+         "csv_monitor": {"enabled": True, "output_path": "/tmp/x"}})
+    rt = DeepSpeedMonitorConfig(**named.model_dump())
+    assert rt.wandb.group == "g1" and rt.wandb.team == "t1"
+    assert rt.csv_monitor.enabled and rt.enabled

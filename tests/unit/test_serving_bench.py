@@ -120,3 +120,38 @@ def test_serving_bench_quant_lanes():
         assert q[mode]["kv_scale_bytes"] > 0
     assert q["kv8"]["weight_quant"] is None
     assert q["w8a8+kv8"]["weight_quant"] == "w8a8"
+
+
+def test_serving_bench_telemetry_lane(tmp_path):
+    """The BENCH_r08 acceptance lane (small edition): telemetry-enabled
+    vs telemetry-off twin engines on the same trace with token parity, a
+    schema-valid exported Chrome trace carrying one span per request, and
+    the --emit-metrics Prometheus/JSON artifact pair.  The 2% overhead
+    contract itself is pinned by the committed 64-request BENCH_r08 run —
+    on a small shared test box this asserts a loose 15% sanity bound."""
+    import serving_bench
+
+    trace = tmp_path / "trace.json"
+    prom = tmp_path / "metrics.prom"
+    res = serving_bench.run_bench(requests=16, slots=4, layers=1, hidden=64,
+                                  heads=4, vocab=512, seed=0,
+                                  telemetry_bench=True,
+                                  trace_out=str(trace),
+                                  emit_metrics=str(prom))
+    assert res["token_parity"], res["mismatched_uids"]
+    tel = res["serving_telemetry"]
+    assert tel["token_parity"] and tel["trace_valid"]
+    assert tel["trace_events_recorded"] > 0
+    # 4 passes (1 warm-up + 3 timed) over 16 requests all land spans
+    assert tel["trace_summary"]["request_spans"] == 4 * 16
+    assert tel["overhead_pct"] <= 15.0, tel
+    import json
+
+    from deepspeed_tpu.telemetry import validate_chrome_trace
+
+    validate_chrome_trace(json.load(open(trace)))
+    text = prom.read_text()
+    assert "# TYPE serving_iterations_total counter" in text
+    assert "serving_ttft_seconds_bucket" in text
+    snap = json.load(open(str(prom) + ".json"))
+    assert snap["serving_requests_admitted_total"]["series"][0]["value"] > 0
