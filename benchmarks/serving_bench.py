@@ -62,6 +62,21 @@ outputs are token-identical to sequential before reporting numbers.
 decode-bound traffic speculative decoding targets (BENCH_r05 lane:
 ``--decode-heavy --speculative 4``).
 
+``--pool-frac F`` adds the BENCH_r09 tiered-KV lane: the device pool is
+deliberately sized at fraction F of the trace's working set (ROADMAP's
+~25% scenario — block pressure guaranteed), and the same trace runs on
+two engines differing ONLY in the host tier: the **evict/preempt
+baseline** (cold blocks discarded, preemption recomputes whole
+prefixes) vs the **tiered engine** (``host_blocks`` sized to the
+working set: eviction/preemption demote to host DRAM, admission
+promotes back with the double-buffered prefetch).  Reports
+``speedup_tiered_vs_preemption`` (cold + warm), the swap counters,
+prefetch-wait p50/p95 from the metrics registry, and both engines'
+resume-recompute token counts; token parity vs sequential is asserted
+for BOTH engines (zero parity loss is the tiering contract).  Best on
+the prefix-heavy trace (``--prefix-len``) where the evicted prefix is
+exactly what the next request needs.
+
 ``--telemetry-bench`` adds the BENCH_r08 overhead lane: the same chunked
 trace on two fresh twin engines — telemetry-off (``trace_capacity=0``:
 the event ring disabled; the metrics registry behind ``stats()`` is
@@ -124,13 +139,34 @@ NEW_TOKEN_GRID = (16, 32, 64)
 
 
 def build_trace(n_requests: int, vocab: int, seed: int, grid: bool,
-                prefix_len: int = 0, decode_heavy: bool = False):
+                prefix_len: int = 0, decode_heavy: bool = False,
+                sessions: int = 0):
+    """``sessions > 0`` (with ``prefix_len``) draws S distinct session
+    prefixes and deals requests round-robin across them — the multi-turn
+    chat shape: request i returns to session ``i % S`` with a fresh tail,
+    AFTER the other sessions' traffic has pushed that session's blocks
+    out of a pressure-sized pool.  This is the trace the tiered-KV lane
+    runs: every return is a full re-prefill for the evict/preempt
+    baseline and a host-tier promotion for the tiered engine."""
     from deepspeed_tpu.inference.serving import Request
 
     rng = np.random.default_rng(seed)
-    prefix = rng.integers(0, vocab, prefix_len) if prefix_len else None
+    prefix = rng.integers(0, vocab, prefix_len) \
+        if prefix_len and not sessions else None
+    if sessions and prefix_len:
+        prefixes = [rng.integers(0, vocab, prefix_len)
+                    for _ in range(sessions)]
     reqs = []
     for i in range(n_requests):
+        if sessions and prefix_len:
+            tail = rng.integers(0, vocab,
+                                int(rng.integers(TAIL_RANGE[0],
+                                                 TAIL_RANGE[1] + 1)))
+            prompt = np.concatenate([prefixes[i % sessions], tail])
+            mnew = int(rng.integers(PREFIX_NEW_RANGE[0],
+                                    PREFIX_NEW_RANGE[1] + 1))
+            reqs.append(Request(uid=i, max_new_tokens=mnew, prompt=prompt))
+            continue
         if decode_heavy:
             prompt = rng.integers(
                 0, vocab, int(rng.integers(DECODE_HEAVY_PROMPT_RANGE[0],
@@ -173,6 +209,8 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
               block_size: int = 32, prefill_chunk: int = 128,
               speculative: int = 0, decode_heavy: bool = False,
               tp: int = 1, quantize: tuple = (),
+              pool_frac: float = 0.0, swap_batch: int = 8,
+              sessions: int = 0,
               telemetry_bench: bool = False, trace_out: str = None,
               emit_metrics: str = None):
     import deepspeed_tpu
@@ -191,7 +229,8 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
     engine = deepspeed_tpu.init_inference(
         gpt2.build(cfg), config={"dtype": dtype,
                                  "tensor_parallel": {"tp_size": 1}})
-    reqs = build_trace(requests, vocab, seed, grid, prefix_len, decode_heavy)
+    reqs = build_trace(requests, vocab, seed, grid, prefix_len, decode_heavy,
+                       sessions)
     gen_tokens = sum(r.max_new_tokens for r in reqs)
 
     # --- sequential pass 1: per-shape compiles included — this IS the
@@ -416,6 +455,92 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
                 "compiled_programs": srv_tpq.compile_count,
             }
 
+    # --- tiered-KV lane (--pool-frac F): a device pool sized at F of the
+    # trace working set (guaranteed block pressure), evict/preempt
+    # baseline vs the host-DRAM tier with prefetch.  Zero parity loss is
+    # the contract — both engines must match sequential exactly.
+    tiered_res = None
+    tiered_outs = {}
+    if pool_frac:
+        from deepspeed_tpu.inference.paged import chain_keys
+        from deepspeed_tpu.ops.paged_kv import blocks_for
+
+        # working set = UNIQUE cacheable content blocks (shared session
+        # prefixes count once — the same dedup the prefix trie does) plus
+        # each request's private tail/generation blocks
+        uniq = set()
+        private = 0
+        for r in reqs:
+            nfull = len(r.prompt) // block_size
+            uniq.update(chain_keys(r.prompt, nfull, block_size))
+            private += blocks_for(len(r.prompt) + r.max_new_tokens,
+                                  block_size) - nfull
+        ws_blocks = len(uniq) + private
+        nbper = blocks_for(max_total, block_size)
+        small = max(1 + nbper + 1, int(round(ws_blocks * pool_frac)) + 1)
+        small_kw = dict(slots=slots, max_seq_len=max_total,
+                        prefill_batch=prefill_batch, block_size=block_size,
+                        prefill_chunk=prefill_chunk, num_blocks=small)
+        srv_small = ServingEngine(engine, **small_kw)
+        t0 = time.perf_counter()
+        small_outs = srv_small.serve(reqs)
+        small_cold = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        small_outs2 = srv_small.serve(reqs)
+        small_warm = time.perf_counter() - t0
+        small_stats = srv_small.stats()
+
+        srv_t = ServingEngine(engine, host_blocks=ws_blocks + nbper,
+                              swap_batch=swap_batch, **small_kw)
+        t0 = time.perf_counter()
+        t_outs = srv_t.serve(reqs)
+        t_cold = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        t_outs2 = srv_t.serve(reqs)
+        t_warm = time.perf_counter() - t0
+        t_stats = srv_t.stats()
+        tiered_outs = {u: (t_outs[u], t_outs2[u], small_outs[u],
+                           small_outs2[u]) for u in t_outs}
+        tiered_res = {
+            "pool_frac": pool_frac,
+            "working_set_blocks": ws_blocks,
+            "device_pool_blocks": small,
+            "host_blocks": ws_blocks + nbper,
+            "host_pool_bytes": t_stats["host_pool_bytes"],
+            "swap_batch": swap_batch,
+            "tiered": {
+                "tok_s": gen_tokens / t_cold,
+                "wall_s": t_cold,
+                "tok_s_warm": gen_tokens / t_warm,
+                "wall_warm_s": t_warm,
+                "compiled_programs": srv_t.compile_count,
+                "swap_out": t_stats["swap_out"],
+                "swap_in": t_stats["swap_in"],
+                "swap_bytes": t_stats["swap_bytes"],
+                "prefetch_misses": t_stats["prefetch_misses"],
+                "prefetch_wait_p50_s": t_stats["prefetch_wait_p50_s"],
+                "prefetch_wait_p95_s": t_stats["prefetch_wait_p95_s"],
+                "preempted": t_stats["evicted"],
+                "resume_recompute_tokens":
+                    t_stats["resume_recompute_tokens"],
+                "prefix_cache_hit_rate": t_stats["prefix_cache_hit_rate"],
+            },
+            "preemption_baseline": {
+                "tok_s": gen_tokens / small_cold,
+                "wall_s": small_cold,
+                "tok_s_warm": gen_tokens / small_warm,
+                "wall_warm_s": small_warm,
+                "compiled_programs": srv_small.compile_count,
+                "preempted": small_stats["evicted"],
+                "resume_recompute_tokens":
+                    small_stats["resume_recompute_tokens"],
+                "prefix_cache_hit_rate":
+                    small_stats["prefix_cache_hit_rate"],
+            },
+            "speedup_tiered_vs_preemption": small_cold / t_cold,
+            "speedup_tiered_vs_preemption_warm": small_warm / t_warm,
+        }
+
     # --- telemetry overhead lane (--telemetry-bench): twin engines, same
     # config, differing ONLY in the trace-event ring (off vs default) —
     # interleaved best-of-3 compile-warm passes bound the wall-clock
@@ -491,6 +616,8 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
                                              bkt_outs2[r.uid])
                           and all(np.array_equal(seq_outs[r.uid], o)
                                   for o in tp_outs.get(r.uid, ()))
+                          and all(np.array_equal(seq_outs[r.uid], o)
+                                  for o in tiered_outs.get(r.uid, ()))
                           and (speculative == 0 or
                                (np.array_equal(seq_outs[r.uid],
                                                spec_outs[r.uid])
@@ -499,6 +626,9 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
     result = {
         "trace": (f"decode-heavy prompts {DECODE_HEAVY_PROMPT_RANGE}, "
                   f"new {DECODE_HEAVY_NEW_RANGE}") if decode_heavy else
+                 (f"{sessions} sessions x {prefix_len}-token prefixes "
+                  f"(round-robin returns), tails {TAIL_RANGE}, new "
+                  f"{PREFIX_NEW_RANGE}") if sessions and prefix_len else
                  (f"shared {prefix_len}-token prefix, tails {TAIL_RANGE}, "
                   f"new {PREFIX_NEW_RANGE}") if prefix_len else
                  ("shape-grid" if grid else
@@ -548,6 +678,9 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
         if spec_res else None,
         "serving_tp": tp_res,
         "serving_quant": quant_res or None,
+        # tiered-KV vs evict/preempt baseline on a pressure-sized pool
+        # (the BENCH_r09 lane, module docstring)
+        "serving_tiered": tiered_res,
         # telemetry-on vs telemetry-off twin engines + trace-schema check
         # (the BENCH_r08 ≤2% overhead contract, module docstring)
         "serving_telemetry": telemetry_res,
@@ -603,6 +736,18 @@ def main():
     ap.add_argument("--quantize", default=None, metavar="MODES",
                     help="comma list of quantized lanes to add: kv8, w8a8, "
                          "w8a8+kv8 (bounded divergence, not exact parity)")
+    ap.add_argument("--sessions", type=int, default=0, metavar="S",
+                    help="with --prefix-len: S distinct session prefixes "
+                         "dealt round-robin (multi-turn returning-session "
+                         "traffic — the tiered-KV scenario)")
+    ap.add_argument("--pool-frac", type=float, default=0.0, metavar="F",
+                    help="add the tiered-KV lane (BENCH_r09): size the "
+                         "device pool at fraction F of the trace working "
+                         "set and compare the host-DRAM tier against the "
+                         "evict/preempt baseline (zero parity loss "
+                         "asserted for both)")
+    ap.add_argument("--swap-batch", type=int, default=8,
+                    help="blocks per tiered-KV swap round trip")
     ap.add_argument("--quant-suite", action="store_true",
                     help="run the BENCH_r07 protocol: mixed + prefix-heavy "
                          "+ decode-heavy traces with quantized lanes and a "
@@ -688,7 +833,8 @@ def main():
         res = run_bench(grid=args.grid, prefix_len=args.prefix_len,
                         speculative=args.speculative,
                         decode_heavy=args.decode_heavy, tp=args.tp,
-                        quantize=quantize,
+                        quantize=quantize, pool_frac=args.pool_frac,
+                        swap_batch=args.swap_batch, sessions=args.sessions,
                         telemetry_bench=args.telemetry_bench,
                         trace_out=args.trace_out,
                         emit_metrics=args.emit_metrics, **kw)

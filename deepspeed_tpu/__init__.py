@@ -131,7 +131,8 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
                  max_seq_len=None, prompt_buckets=None, prefill_batch=4,
                  block_size=32, num_blocks=None, chunked_prefill=None,
                  prefill_chunk=128, prefix_caching=True, spec_tokens=0,
-                 quantize=None, draft=None, ngram_max=3, ngram_min=1,
+                 quantize=None, host_blocks=0, swap_batch=8, draft=None,
+                 ngram_max=3, ngram_min=1,
                  shard_kv=None, topology=None, debug_checks=False,
                  trace_capacity=16384, **kwargs):
     """Continuous-batching serving entry: an ``init_inference`` engine
@@ -170,6 +171,19 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
     Quantized lanes trade exact greedy parity for a bounded
     token-divergence / logit-error contract (README "Quantized serving");
     ``quantize=None`` (default) is bit-identical to prior behavior.
+
+    **Tiered KV cache**: ``host_blocks=N`` adds a host-DRAM tier of N KV
+    blocks below the device pool — under block pressure cold blocks
+    demote to host instead of being discarded (prefix-cache eviction AND
+    preemption), and admission promotes host-resident chains back with a
+    double-buffered prefetch that overlaps the H2D copy with the decode
+    step (``swap_batch`` sizes the two fixed-shape swap programs).  The
+    prefix trie becomes a session cache bounded by host DRAM rather than
+    HBM: returning conversations re-admit at full prefix-hit speed, and
+    preemption's recompute shrinks to the unfinished tail — with zero
+    parity loss (promoted bytes are bit-identical to what was demoted).
+    ``host_blocks=0`` (default) is byte-identical to prior behavior.
+    See docs/inference.md "Tiered KV".
 
     ``debug_checks=True`` turns on the correctness tooling
     (``deepspeed_tpu/analysis/``): the recompile sentry raises on any
@@ -237,6 +251,7 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
                          prefill_chunk=prefill_chunk,
                          prefix_caching=prefix_caching,
                          spec_tokens=spec_tokens, quantize=quantize,
+                         host_blocks=host_blocks, swap_batch=swap_batch,
                          draft=draft,
                          ngram_max=ngram_max, ngram_min=ngram_min,
                          shard_kv=shard_kv, debug_checks=debug_checks,

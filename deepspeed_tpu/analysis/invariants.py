@@ -56,6 +56,15 @@ the fault-injection test matrix in ``tests/unit/test_analysis.py``):
     previous owner's stale scales), contain only blocks with a nonzero
     refcount (a ledger entry surviving the free is a stale scale row
     waiting to be trusted), and never the scratch block.
+``residency-conservation``
+    tiered-KV engines only (``host_blocks > 0``): every host-arena slot
+    is exactly one of free / resident (owned by exactly one entry) /
+    in-flight (a staged promotion), and the in-flight flags stay in
+    lockstep with the engine's staged-prefetch records — an in-flight
+    entry no staged record references is a LEAKED in-flight block (its
+    arena slot can never free: ``put`` refuses to LRU-evict in-flight
+    entries), and a record referencing a resident-but-unflagged entry is
+    a staging buffer whose bytes the LRU can free mid-transfer.
 
 The audit reads pure host state (numpy + lists) — no device sync — and
 runs in O(num_blocks + trie entries).  ``ServingEngine`` calls it after
@@ -270,6 +279,71 @@ def audit_paged_state(allocator, tables, held, *,
                 f"entr(ies) / holds {len(held[slot])} block(s)")
 
 
+def _fmt_key(key) -> str:
+    """Render a chain key for an error message without dumping the whole
+    token byte string."""
+    h = key.hex() if isinstance(key, (bytes, bytearray)) else str(key)
+    return h[:16] + ("…" if len(h) > 16 else "")
+
+
+def audit_host_store(store, staged_keys) -> None:
+    """Verify the ``residency-conservation`` invariant over a tiered-KV
+    engine's :class:`~deepspeed_tpu.inference.paged.HostBlockStore`
+    (module docstring); raises :class:`PagedStateError`.
+
+    store:        the engine's host tier (``srv._host``).
+    staged_keys:  the set of chain keys referenced by the engine's live
+                  staged-prefetch records (``srv._staged``) — the other
+                  half of the in-flight lockstep.
+    """
+    free, entries = store.snapshot()
+    nb = store.num_blocks
+    staged_keys = set(staged_keys or ())
+
+    free_set = set(int(s) for s in free)
+    if len(free_set) != len(free):
+        raise PagedStateError(
+            "residency-conservation",
+            "host free list contains duplicate arena slots")
+    owned = {}
+    for key, (slot, in_flight) in entries.items():
+        if not (0 <= int(slot) < nb):
+            raise PagedStateError(
+                "residency-conservation",
+                f"host entry {_fmt_key(key)} maps out-of-range arena slot "
+                f"{slot} (arena has {nb})")
+        if slot in owned:
+            raise PagedStateError(
+                "residency-conservation",
+                f"arena slot {slot} owned by two entries "
+                f"({_fmt_key(owned[slot])} and {_fmt_key(key)})")
+        if slot in free_set:
+            raise PagedStateError(
+                "residency-conservation",
+                f"arena slot {slot} is on the free list but owned by "
+                f"entry {_fmt_key(key)}")
+        owned[int(slot)] = key
+        if in_flight and key not in staged_keys:
+            raise PagedStateError(
+                "residency-conservation",
+                f"leaked in-flight block: host entry {_fmt_key(key)} "
+                f"(arena slot {slot}) is flagged in-flight but no staged "
+                "promotion references it — its slot can never free")
+    for slot in range(nb):
+        if slot not in free_set and slot not in owned:
+            raise PagedStateError(
+                "residency-conservation",
+                f"arena slot {slot} is neither free nor owned — leaked "
+                "out of the host tier entirely")
+    for key in staged_keys:
+        if key in entries and not entries[key][1]:
+            raise PagedStateError(
+                "residency-conservation",
+                f"staged promotion references resident entry "
+                f"{_fmt_key(key)} that is NOT flagged in-flight — the "
+                "LRU could free its bytes mid-transfer")
+
+
 def audit_serving_engine(srv, active) -> None:
     """Engine-facing wrapper: pulls the :class:`ServingEngine` fields and
     derives each active slot's committed-token count (decode: host
@@ -290,6 +364,10 @@ def audit_serving_engine(srv, active) -> None:
                           scale_live=(srv._kv_scale_live
                                       if getattr(srv, "kv_quant", False)
                                       else None))
+        if getattr(srv, "_host", None) is not None:
+            audit_host_store(
+                srv._host,
+                {k for rec in srv._staged.values() for k in rec["keys"]})
     except PagedStateError as e:
         if timeline is not None:
             timeline.instant("invariant_violation", invariant=e.invariant,

@@ -42,6 +42,16 @@ GL006     host timer call (``time.time()`` / ``time.perf_counter()`` /
           the same trace), never device execution.  Time around the
           compiled call after a sync instead (``utils/timer.py``,
           ``telemetry/``).
+GL007     blocking device transfer (``jax.device_get`` /
+          ``jax.block_until_ready`` / ``.block_until_ready()``) inside a
+          host-side loop body outside a sanctioned transfer helper — a
+          scheduler/driver loop that syncs per iteration serializes the
+          device pipeline (the decode step cannot overlap the next
+          iteration's host work).  Sanctioned helpers are functions
+          whose (enclosing) name carries a transfer verb — ``demote``,
+          ``promote``, ``swap``, ``sync``, ``prefetch`` — the documented
+          commit points (e.g. the tiered-KV demotion helper's one
+          ``device_get`` per swap batch, ``inference/serving.py``).
 ========  =============================================================
 
 Suppression: append ``# graft: noqa(GLxxx)`` (one or more codes,
@@ -103,7 +113,13 @@ RULES: Dict[str, str] = {
              "in a jit body",
     "GL006": "host timer (time.time/perf_counter/...) in a jit body — "
              "measures trace time, not device execution",
+    "GL007": "blocking device transfer (device_get/block_until_ready) in "
+             "a host loop body outside a sanctioned transfer helper",
 }
+
+#: substrings marking a function as a sanctioned blocking-transfer helper
+#: for GL007 (the documented sync/swap commit points)
+_SANCTIONED_XFER = ("demote", "promote", "swap", "sync", "prefetch")
 
 #: ``time`` module entry points whose call inside a traced body is GL006;
 #: the bare spellings (from-imports) are distinctive enough to flag as
@@ -286,20 +302,37 @@ class _Analyzer:
         root = _root_name(expr)
         return root is not None and root in scope.traced_names()
 
+    @staticmethod
+    def _sanctioned_xfer(stack: List[_Scope]) -> bool:
+        """True when any enclosing function's name marks it a sanctioned
+        blocking-transfer helper (GL007)."""
+        for scope in stack:
+            name = getattr(scope.node, "name", "")
+            if any(tag in name.lower() for tag in _SANCTIONED_XFER):
+                return True
+        return False
+
     # ------------------------------------------------------------- main walk
     def analyze(self, tree: ast.Module) -> List[Finding]:
-        self._walk(tree, [])
+        self._walk(tree, [], False)
         return self.findings
 
-    def _walk(self, node: ast.AST, stack: List[_Scope]) -> None:
+    def _walk(self, node: ast.AST, stack: List[_Scope],
+              in_loop: bool) -> None:
         scope = self._scopes.get(node)
+        def_time_loop = False
         if scope is not None:
             stack = stack + [scope]
+            # a nested def's BODY is not "in" the enclosing loop until
+            # called — but its decorators, default values, and
+            # annotations evaluate AT DEF TIME, once per iteration
+            def_time_loop, in_loop = in_loop, False
         cur = self._enclosing_scope(stack)
         in_jit = cur is not None and cur.is_jit
 
         if isinstance(node, ast.Call):
-            self._check_call(node, cur, in_jit)
+            self._check_call(node, cur, in_jit,
+                             in_loop and self._sanctioned_xfer(stack) is False)
         elif isinstance(node, ast.JoinedStr) and in_jit:
             self._check_fstring(node, cur)
         elif isinstance(node, ast.Attribute) and in_jit:
@@ -307,11 +340,50 @@ class _Analyzer:
         elif isinstance(node, (ast.If, ast.While)) and in_jit:
             self._check_branch(node, cur)
 
+        if scope is not None:
+            # function node: body runs per call (loop context cleared),
+            # everything else (decorator_list, ast.arguments with its
+            # defaults/annotations) runs at def time in the caller's
+            # loop context
+            body = node.body if isinstance(node.body, list) \
+                else [node.body]               # Lambda: body is an expr,
+            body_ids = set(map(id, body))      # evaluated per call too
+            for child in ast.iter_child_nodes(node):
+                self._walk(child, stack,
+                           False if id(child) in body_ids
+                           else def_time_loop)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            # only the BODY re-executes per iteration (plus a While's
+            # test); a For's iter/target and either loop's else clause
+            # run once and stay at the caller's loop depth
+            per_iter = set(map(id, node.body))
+            if isinstance(node, ast.While):
+                per_iter.add(id(node.test))
+            for child in ast.iter_child_nodes(node):
+                self._walk(child, stack,
+                           in_loop or id(child) in per_iter)
+            return
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            # comprehensions are loops too: everything re-evaluates per
+            # element EXCEPT the first generator's iterable (evaluated
+            # once, exactly like a For's iter)
+            first_iter = node.generators[0].iter
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.comprehension):
+                    for sub in ast.iter_child_nodes(child):
+                        self._walk(sub, stack,
+                                   in_loop or sub is not first_iter)
+                else:
+                    self._walk(child, stack, True)  # elt / key / value
+            return
         for child in ast.iter_child_nodes(node):
-            self._walk(child, stack)
+            self._walk(child, stack, in_loop)
 
     # ----------------------------------------------------------------- rules
-    def _check_call(self, node: ast.Call, scope, in_jit: bool) -> None:
+    def _check_call(self, node: ast.Call, scope, in_jit: bool,
+                    in_unsanctioned_loop: bool = False) -> None:
         tail = _func_tail(node.func)
         # GL003 runs everywhere (the jit CALL lives in host code)
         if tail in ("jit", "pjit"):
@@ -321,6 +393,21 @@ class _Analyzer:
         if tail in ("PartitionSpec", "P"):
             self._check_pspec_literals(node)
         if not in_jit:
+            # GL007: a blocking transfer inside a HOST loop body — each
+            # iteration stalls on the device instead of overlapping it
+            # jax.device_get / bare from-import device_get / any
+            # *.block_until_ready() — all three spellings block
+            if in_unsanctioned_loop and (
+                    tail == "block_until_ready" or
+                    (tail == "device_get" and
+                     (isinstance(node.func, ast.Name) or
+                      _root_name(node.func) == "jax"))):
+                self._emit(node, "GL007",
+                           f"{tail}() in a host loop body serializes the "
+                           "device pipeline — batch the sync into a "
+                           "sanctioned transfer helper (demote/promote/"
+                           "swap/sync/prefetch) or hoist it out of the "
+                           "loop")
             return
         # GL006: a host timer inside a traced body stamps TRACE time —
         # the body executes once, while XLA replays the compiled program
@@ -542,7 +629,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="graft-lint",
         description="TPU/JAX recompile + host-sync hazard lint "
-                    "(rules GL001..GL005; suppress with "
+                    "(rules GL001..GL007; suppress with "
                     "'# graft: noqa(GLxxx)')")
     ap.add_argument("paths", nargs="*", default=["deepspeed_tpu"],
                     help="files/dirs to lint (default: deepspeed_tpu)")

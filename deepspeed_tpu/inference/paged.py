@@ -37,6 +37,24 @@ non-speculative path (refcounts make prefix-shared blocks survive).
 All of this is plain Python/numpy on the host; the device-side scatter /
 gather twins live in ``ops/paged_kv.py`` and ``ops/decode_attention.py``.
 
+**Tiered KV (host-DRAM block tier)**: :class:`HostBlockStore` is the tier
+below the device pool — a host numpy arena (the pinned-staging analog of
+``runtime/zero/offload.py``'s moment buffers) sized in whole KV blocks,
+with its own free list and LRU entry table.  Entries are
+content-addressed by :func:`chain_key` — the byte string of ALL tokens
+from position 0 through the end of the block — so the same key that
+names a block span in the prefix trie names its host copy, and a chain
+demoted block-by-block is re-discoverable block-by-block (each key
+stands alone; no host-side parent pointers).  Residency is exclusive by
+construction: demotion MOVES a block's bytes device→host (the device
+block frees), promotion moves them back (the host slot frees), and a
+``staged`` entry (``in_flight``) is a promotion whose ``device_put`` has
+been issued but whose pool scatter has not landed — the
+``residency-conservation`` audit in ``analysis/invariants.py`` checks
+that every arena slot is exactly one of free / resident / in-flight and
+that in-flight flags stay in lockstep with the engine's staged-prefetch
+records.
+
 **Tensor parallelism**: everything in this module is per-host and
 head-sharding-invariant.  Block ids, refcounts, and trie keys index
 PHYSICAL BLOCKS (position spans), never attention heads — when the
@@ -54,10 +72,36 @@ from __future__ import annotations
 
 import dataclasses
 from collections import OrderedDict, deque
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
 
 #: physical block 0 is never allocated; discarded writes are routed there
 SCRATCH_BLOCK = 0
+
+
+def chain_key(tokens, block_index: int, block_size: int) -> bytes:
+    """Content address of the ``block_index``-th KV block of a sequence:
+    the int32 bytes of EVERY token from position 0 through the end of that
+    block.  Cumulative on purpose — KV at a position attends over the
+    whole prefix, so two blocks hold identical KV iff their full leading
+    token chains match, and each key stands alone (a host-resident run is
+    probed block-by-block with no parent pointers)."""
+    n = (int(block_index) + 1) * int(block_size)
+    return np.ascontiguousarray(
+        np.asarray(tokens[:n], np.int32)).tobytes()
+
+
+def chain_keys(tokens, n_blocks: int, block_size: int) -> List[bytes]:
+    """:func:`chain_key` for blocks ``0..n_blocks-1`` in one pass:
+    serialize the tokens once and slice byte prefixes (4 bytes per int32
+    token), instead of re-serializing the growing chain per block —
+    O(len) total where the naive loop is O(len^2).  Byte-for-byte equal
+    to per-block :func:`chain_key` calls (pinned by a tier-1 test)."""
+    n = int(n_blocks) * int(block_size)
+    buf = np.ascontiguousarray(np.asarray(tokens[:n], np.int32)).tobytes()
+    return [buf[:4 * (i + 1) * int(block_size)]
+            for i in range(int(n_blocks))]
 
 
 class BlockAllocator:
@@ -199,14 +243,21 @@ class PrefixCache:
         return blocks
 
     def register(self, tokens: Sequence[int], blocks: Sequence[int],
-                 allocator: BlockAllocator) -> None:
-        """Insert the chain ``tokens[i*bs:(i+1)*bs] -> blocks[i]``.  Existing
-        entries win (the first prefill of a shared prompt is the canonical
-        copy; a duplicate block simply isn't cached and frees with its
-        sequence) — the chain continues through them either way."""
+                 allocator: BlockAllocator, start: int = 0) -> None:
+        """Insert the chain ``tokens[(start+i)*bs:(start+i+1)*bs] ->
+        blocks[i]``.  Existing entries win (the first prefill of a shared
+        prompt is the canonical copy; a duplicate block simply isn't cached
+        and frees with its sequence) — the chain continues through them
+        either way.  ``start > 0`` (tiered-KV promotion) grafts the chain
+        onto the entries for blocks ``0..start-1``, which must already be
+        live — the caller just claimed them via :meth:`lookup`."""
         bs = self.block_size
         parent: Optional[_PrefixEntry] = None
-        for i, b in enumerate(blocks):
+        for i in range(start):
+            parent = self._entries[
+                ((parent.uid if parent else 0),
+                 tuple(int(t) for t in tokens[i * bs:(i + 1) * bs]))]
+        for i, b in enumerate(blocks, start=start):
             key = ((parent.uid if parent else 0),
                    tuple(int(t) for t in tokens[i * bs:(i + 1) * bs]))
             e = self._entries.get(key)
@@ -236,12 +287,183 @@ class PrefixCache:
         or ``None``.  The id lets the caller retire per-block side state in
         lockstep with the free (the serving engine's int8-KV scale ledger,
         ``serving.py``)."""
-        for key, e in self._entries.items():    # oldest first
-            if e.children == 0 and allocator.refcount(e.block) == 1:
-                del self._entries[key]
-                if e.parent is not None:
-                    e.parent.children -= 1
-                allocator.decref(e.block)
-                self.evictions += 1
-                return int(e.block)
+        for e in self.evictable_leaves(allocator, 1):
+            self.evict_entry(e, allocator)
+            return int(e.block)
         return None
+
+    def evictable_leaves(self, allocator: BlockAllocator,
+                         limit: int) -> List[_PrefixEntry]:
+        """Up to ``limit`` LRU-first leaf entries whose block only the
+        cache still holds — the next eviction victims, exposed as a batch
+        so the tiered-KV engine can demote their contents to host DRAM in
+        ONE device round trip before releasing them."""
+        out: List[_PrefixEntry] = []
+        for e in self._entries.values():        # oldest first
+            if e.children == 0 and allocator.refcount(e.block) == 1:
+                out.append(e)
+                if len(out) >= limit:
+                    break
+        return out
+
+    def evict_entry(self, entry: _PrefixEntry,
+                    allocator: BlockAllocator) -> None:
+        """Release one specific (evictable-leaf) entry — the targeted twin
+        of :meth:`evict_one` for batch demotion."""
+        assert entry.children == 0 and \
+            allocator.refcount(entry.block) == 1, \
+            f"evict_entry on a non-evictable entry uid={entry.uid}"
+        del self._entries[entry.key]
+        if entry.parent is not None:
+            entry.parent.children -= 1
+        allocator.decref(entry.block)
+        self.evictions += 1
+
+    def chain_tokens(self, entry: _PrefixEntry) -> Tuple[int, ...]:
+        """The FULL leading token chain of an entry (root span through the
+        entry's own span) — exactly the tokens :func:`chain_key` hashes,
+        recovered by walking the parent links."""
+        spans = []
+        e: Optional[_PrefixEntry] = entry
+        while e is not None:
+            spans.append(e.key[1])
+            e = e.parent
+        out: List[int] = []
+        for span in reversed(spans):
+            out.extend(span)
+        return tuple(out)
+
+
+@dataclasses.dataclass
+class _HostEntry:
+    key: bytes                  # chain_key of the block's content
+    slot: int                   # arena slot holding the block's bytes
+    in_flight: bool = False     # promotion staged (device_put issued)
+
+
+class HostBlockStore:
+    """Host-DRAM tier below the device block pool (tiered KV).
+
+    A numpy arena of ``num_blocks`` whole-KV-block slots per pool leaf
+    (module docstring "Tiered KV") with a free list and an LRU entry
+    table keyed by :func:`chain_key`.  The serving engine demotes cold
+    blocks here instead of discarding their contents (prefix-cache
+    eviction, preemption) and promotes them back when an admitted
+    sequence's chain probes resident — the transfer machinery itself
+    (fixed-shape gather/scatter programs, ``device_get``/``device_put``)
+    lives in ``ops/paged_kv.py`` / ``serving.py``; this class is pure
+    host bookkeeping plus the arena bytes.
+
+    ``block_specs`` gives one ``(per_block_shape, dtype)`` per flattened
+    pool leaf — a quantized pool's codes and scale rows are separate
+    leaves, so they demote/promote together by construction.
+
+    Entry states: *resident* (bytes live in the arena, slot owned) or
+    *in-flight* (a staged promotion — the engine has issued the H2D
+    ``device_put`` but not yet scattered into the pool).  In-flight
+    entries are never LRU-evicted (the staged transfer would read freed
+    bytes) and are released either by :meth:`pop` (promotion landed) or
+    :meth:`mark_in_flight(key, False)`` (stale prefetch discarded).
+    """
+
+    def __init__(self, num_blocks: int,
+                 block_specs: Sequence[Tuple[tuple, object]]):
+        if num_blocks < 1:
+            raise ValueError(
+                f"host tier num_blocks must be >= 1, got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self.arenas: List[np.ndarray] = [
+            np.zeros((self.num_blocks,) + tuple(shape), dtype)
+            for shape, dtype in block_specs]
+        self.block_nbytes = int(sum(a[0].nbytes for a in self.arenas))
+        self._free = deque(range(self.num_blocks))
+        self._entries: "OrderedDict[bytes, _HostEntry]" = OrderedDict()
+        # counters for ServingEngine.stats()
+        self.evictions = 0
+        #: bumped whenever the resident KEY SET changes (put/pop/LRU
+        #: eviction) — probe results are stale iff this moved, which lets
+        #: the engine memoize empty prefetch probes across idle
+        #: iterations (same trick as BlockAllocator.version)
+        self.version = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    @property
+    def arena_bytes(self) -> int:
+        return int(sum(a.nbytes for a in self.arenas))
+
+    def snapshot(self):
+        """(free-list copy, ``{key: (slot, in_flight)}``) for the
+        residency-conservation audit (``analysis/invariants.py``)."""
+        return list(self._free), {
+            k: (e.slot, e.in_flight) for k, e in self._entries.items()}
+
+    def has(self, key: bytes) -> bool:
+        return key in self._entries
+
+    def put(self, key: bytes,
+            block_arrays: Sequence[np.ndarray]) -> Optional[int]:
+        """Store one demoted block's per-leaf arrays under ``key``;
+        returns the arena slot, or ``None`` when every slot is pinned by
+        in-flight entries (the caller then simply drops the demotion —
+        the block's contents are recomputable, just not for free).  A
+        duplicate key keeps the existing copy (first-writer-wins, same
+        dedup rule as the trie) and refreshes its recency."""
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return self._entries[key].slot
+        if not self._free:
+            for k, e in self._entries.items():  # oldest first
+                if not e.in_flight:
+                    del self._entries[k]
+                    self._free.append(e.slot)
+                    self.evictions += 1
+                    self.version += 1
+                    break
+            if not self._free:
+                return None
+        slot = self._free.popleft()
+        for arena, arr in zip(self.arenas, block_arrays):
+            arena[slot] = arr
+        self._entries[key] = _HostEntry(key=key, slot=slot)
+        self.version += 1
+        return slot
+
+    def read(self, key: bytes) -> List[np.ndarray]:
+        """Per-leaf views of a resident block's bytes (no copy)."""
+        e = self._entries[key]
+        return [arena[e.slot] for arena in self.arenas]
+
+    def pop(self, key: bytes) -> None:
+        """Release a block (promotion landed on device): the slot frees,
+        the entry dies — residency moves back to the device tier."""
+        e = self._entries.pop(key)
+        self._free.append(e.slot)
+        self.version += 1
+
+    def mark_in_flight(self, key: bytes, flag: bool = True) -> None:
+        self._entries[key].in_flight = bool(flag)
+
+    def probe_run(self, tokens, start_block: int, max_tokens: int,
+                  block_size: int) -> List[bytes]:
+        """Keys of the longest host-resident run of full blocks
+        ``start_block, start_block+1, ...`` of ``tokens[:max_tokens]`` —
+        the continuation probe admission uses after the device trie's own
+        hits end.  No state is touched beyond LRU recency."""
+        keys: List[bytes] = []
+        n = min(len(tokens), int(max_tokens)) // int(block_size)
+        if n <= int(start_block):
+            return keys
+        run = chain_keys(tokens, n, block_size)
+        for i in range(int(start_block), n):
+            key = run[i]
+            if key not in self._entries:
+                break
+            self._entries.move_to_end(key)
+            keys.append(key)
+        return keys

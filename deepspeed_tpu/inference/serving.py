@@ -108,6 +108,35 @@ A host-side ledger tracks which blocks own live scale rows; the
 ``debug_checks`` audit enforces it (``scale-lockstep`` invariant,
 ``analysis/invariants.py``).
 
+**Tiered KV cache** (``host_blocks=N``, default off): under block
+pressure the engine above threw computed state away — LRU prefix-cache
+eviction, then preemption with full greedy recompute.  The tier adds a
+host-DRAM arena below the device pool (:class:`~deepspeed_tpu.inference
+.paged.HostBlockStore`): eviction and preemption **demote** cold blocks —
+one fixed-shape block gather (``ops/paged_kv.paged_block_gather``) plus
+ONE ``jax.device_get`` per ``swap_batch``-sized batch — instead of
+freeing their contents, and admission of a sequence whose prefix (or
+preempted state — generated tokens fold into the resume prompt, so the
+same content-addressed chain keys cover both) is host-resident
+**promotes** the run back: an async ``jax.device_put`` issued at least
+one scheduler iteration ahead (double-buffered prefetch over the pending
+queue head, exactly the ``param_stream.py`` overlap trick) so the H2D
+transfer hides behind the decode step, then one fixed-shape scatter
+(``paged_block_scatter``) commits the staged blocks and the chain
+re-registers in the trie.  Promoted bytes are bit-identical to what was
+demoted (under ``kv8`` the int8 codes and their scale rows travel as
+separate leaves of the same swap tree; with a draft model the draft pool
+demotes/promotes alongside the target's so speculative acceptance
+survives a swap), so parity contracts are unchanged.  The two swap
+programs are fixed-shape and sentry-registered — the compile budget
+grows by exactly 2 and transfers can never introduce further programs.
+Scheduling stays host-side and sharding/quant-invariant: under tp the
+``device_get``/``device_put`` travel per addressable shard of the
+head-sharded pool.  The residency state machine (device-free /
+device-held / host-resident / in-flight) is audited by
+``analysis/invariants.py`` (``residency-conservation``) under
+``debug_checks``.
+
 **Telemetry** (``telemetry/``, always on — the registry IS the stats
 store): every scheduler counter and the TTFT/TPOT latency distributions
 live in a :class:`~deepspeed_tpu.telemetry.MetricsRegistry`
@@ -157,7 +186,8 @@ from ..parallel.topology import TP_AXIS
 from ..telemetry import MetricsRegistry, ProfilerWindow, TraceTimeline
 from ..utils.logging import log_dist
 from ..utils.lru import LRUCache
-from .paged import BlockAllocator, PrefixCache
+from .paged import (BlockAllocator, HostBlockStore, PrefixCache, chain_key,
+                    chain_keys)
 from .spec import NGramProposer, greedy_accept
 
 
@@ -343,6 +373,19 @@ class ServingEngine:
                     the config), or ``"w8a8+kv8"``.  Quantized lanes trade
                     exact greedy parity for a bounded token-divergence /
                     logit-error contract.
+    host_blocks:    host-DRAM tier size in KV blocks (module docstring
+                    "Tiered KV cache"); ``0`` (default) disables tiering
+                    — behavior, programs, and scheduling are then
+                    byte-identical to the pre-tiering engine.  Requires
+                    chunked-prefill mode with ``prefix_caching`` on (the
+                    trie is the content-address space promotions graft
+                    back into).  Size it at the session working set you
+                    want to survive eviction — e.g. the full trace
+                    footprint for a multi-turn chat tier.
+    swap_batch:     blocks per demotion/promotion device round trip (the
+                    fixed shape of the two swap programs; default 8).
+                    Larger batches amortize transfer latency, smaller
+                    ones waste less padding on short chains.
     draft:          draft proposer model — an ``init_inference`` engine or
                     a bare ModelSpec (wrapped with the target's inference
                     config) of a small same-family/same-tokenizer model.
@@ -380,6 +423,8 @@ class ServingEngine:
                  prefix_caching: bool = True,
                  spec_tokens: int = 0,
                  quantize: Optional[str] = None,
+                 host_blocks: int = 0,
+                 swap_batch: int = 8,
                  draft=None,
                  ngram_max: int = 3,
                  ngram_min: int = 1,
@@ -455,6 +500,18 @@ class ServingEngine:
         self._alloc = BlockAllocator(num_blocks)
         self._prefix = PrefixCache(self.block_size) \
             if (prefix_caching and self.chunked_prefill) else None
+        self.host_blocks = int(host_blocks)
+        if self.host_blocks < 0:
+            raise ValueError(f"host_blocks must be >= 0, got {host_blocks}")
+        self.swap_batch = int(swap_batch)
+        if self.host_blocks and self.swap_batch < 1:
+            raise ValueError(f"swap_batch must be >= 1, got {swap_batch}")
+        if self.host_blocks and self._prefix is None:
+            raise ValueError(
+                "the tiered KV cache (host_blocks > 0) needs chunked-"
+                "prefill mode with prefix_caching=True — promoted chains "
+                "re-register in the prefix trie (drop prompt_buckets / "
+                "prefix_caching=False, or host_blocks)")
 
         # ----- tensor parallelism: one pool, committed on the engine mesh so
         # the very first step sees the same placement as every later one —
@@ -536,6 +593,11 @@ class ServingEngine:
             self.compile_budget = 2
         else:
             self.compile_budget = len(self.prompt_buckets) + 2
+        if self.host_blocks:
+            # the tiered KV swap pair: kv_demote (block gather) +
+            # kv_promote (block scatter), both fixed-shape at swap_batch —
+            # H2D/D2H traffic itself never compiles anything further
+            self.compile_budget += 2
         self.sentry = RecompileSentry(name="serving",
                                       strict=self.debug_checks,
                                       total_budget=self.compile_budget)
@@ -608,6 +670,27 @@ class ServingEngine:
                                                max_n=ngram_max,
                                                min_n=ngram_min)
 
+        # ----- tiered KV: the host-DRAM arena below the device pool
+        # (module docstring).  Built from the live swap tree's per-block
+        # leaf shapes — the target pool, plus the draft pool when one
+        # exists (drafts read their own KV through the shared tables, so
+        # a promoted block must restore BOTH pools' bytes or speculative
+        # acceptance would collapse to zero after every swap).  Quantized
+        # records contribute their codes and scale rows as separate
+        # leaves, so they demote/promote in lockstep by construction.
+        self._host: Optional[HostBlockStore] = None
+        self._demote_fn = None
+        self._promote_fn = None
+        self._staged: Dict[Any, Dict[str, Any]] = {}
+        self._prefetch_gate: Dict[Any, tuple] = {}
+        self._staging_shardings = None
+        if self.host_blocks:
+            specs = [(tuple(l.shape[:1]) + tuple(l.shape[2:]), l.dtype)
+                     for l in jax.tree_util.tree_leaves(self._swap_pools())]
+            self._host = HostBlockStore(self.host_blocks, specs)
+            # per-leaf device_put specs are fixed for the engine's life
+            self._staging_shardings = self._swap_leaf_shardings()
+
         # ----- telemetry (telemetry/): scheduler counters and latency
         # distributions live in the metrics registry — stats() is a view
         # over it (Prometheus text / JSON snapshot come for free), and the
@@ -644,6 +727,34 @@ class ServingEngine:
         self._c_invariant_checks = m.counter(
             "serving_invariant_checks_total",
             "paged-state audits run (analysis/invariants.py)")
+        # tiered-KV swap traffic (zero-valued, never incremented when the
+        # tier is off — the cells exist so dashboards see a stable schema)
+        self._c_swap_out = m.counter(
+            "serving_kv_swaps_total",
+            "KV blocks swapped between the device pool and the host tier",
+            direction="out")
+        self._c_swap_in = m.counter(
+            "serving_kv_swaps_total",
+            "KV blocks swapped between the device pool and the host tier",
+            direction="in")
+        self._c_swap_bytes = m.counter(
+            "serving_swap_bytes_total",
+            "bytes moved over the device<->host KV tier (both directions)")
+        self._c_prefetch_miss = m.counter(
+            "serving_prefetch_misses_total",
+            "promotions that had to stage synchronously at admission "
+            "(no prefetch was in flight for the chain)")
+        self._c_resume_recompute = m.counter(
+            "serving_resume_recompute_tokens_total",
+            "prompt tokens re-prefilled when admitting a preemption resume "
+            "(near zero with the host tier: demoted state promotes back)")
+        self._h_prefetch_wait = m.histogram(
+            "serving_prefetch_wait_seconds",
+            help="time admission blocked on an in-flight promotion "
+                 "(0-bucket = the H2D transfer fully overlapped decode)")
+        self._g_host_blocks_in_use = m.gauge(
+            "serving_host_blocks_in_use",
+            "host-tier arena slots holding demoted KV blocks")
         self._h_ttft = m.histogram(
             "serving_ttft_seconds", help="per-request time to first token")
         self._h_tpot = m.histogram(
@@ -680,7 +791,10 @@ class ServingEngine:
                f"({hkv // self.tp_degree} heads/chip)" if self.kv_sharded
                else (f", kv replicated (tp={self.tp_degree})"
                      if self.tp_degree > 1 else ""))
-            + (f", quantize={self.quantize}" if self.quantize else ""),
+            + (f", quantize={self.quantize}" if self.quantize else "")
+            + (f", tiered KV (host_blocks={self.host_blocks}, "
+               f"{self._host.arena_bytes / 1e6:.1f}MB host arena, "
+               f"swap_batch={self.swap_batch})" if self._host else ""),
             ranks=[0])
 
     def _tp_ctx(self):
@@ -882,6 +996,361 @@ class ServingEngine:
             self.compiled_programs.append(("draft", self.slots, k))
         return self._draft_fn
 
+    # ------------------------------------------------------------- tiered KV
+    def _swap_pools(self):
+        """The tree the host tier mirrors: the target pool, plus the draft
+        pool when speculative decoding carries one (they share block
+        tables, so block residency is joint)."""
+        return (self._cache, self._dcache) if self._dcache is not None \
+            else self._cache
+
+    def _set_swap_pools(self, pools) -> None:
+        if self._dcache is not None:
+            self._cache, self._dcache = pools
+        else:
+            self._cache = pools
+
+    def _swap_leaf_shardings(self):
+        """One sharding per flattened swap-tree leaf for the staging
+        ``device_put``: target-pool leaves follow ``kv_sharded``, draft
+        leaves follow ``_dcache_sharded`` — the two can differ (a GQA
+        draft whose head count does not divide tp stays replicated while
+        the target pool shards), and staging a replicated leaf with the
+        head-sharded spec would raise at ``device_put``."""
+        rep = NamedSharding(self.engine.mesh, P())
+        hs = NamedSharding(self.engine.mesh, P(None, None, TP_AXIS))
+        tgt = jax.tree_util.tree_map(
+            lambda _: hs if self.kv_sharded else rep, self._cache)
+        if self._dcache is None:
+            return jax.tree_util.tree_leaves(tgt)
+        drf = jax.tree_util.tree_map(
+            lambda _: hs if self._dcache_sharded else rep, self._dcache)
+        return jax.tree_util.tree_leaves((tgt, drf))
+
+    def _get_demote_fn(self):
+        """The fixed-shape block-gather program (``swap_batch`` ids, pad =
+        scratch): its output is what one ``jax.device_get`` pulls per
+        demotion batch."""
+        if self._demote_fn is None:
+            def kv_demote(cache, ids):
+                return paged_kv.paged_block_gather(cache, ids)
+
+            self._demote_fn = jax.jit(
+                self.sentry.wrap(kv_demote, "kv_demote"),
+                donate_argnums=())        # the pool lives on
+            self.compiled_programs.append(("kv_demote", self.swap_batch))
+        return self._demote_fn
+
+    def _get_promote_fn(self):
+        """The fixed-shape block-scatter program committing staged
+        (device_put-ahead) host blocks into the pool."""
+        if self._promote_fn is None:
+            def kv_promote(cache, staged, ids):
+                return paged_kv.paged_block_scatter(cache, staged, ids)
+
+            self._promote_fn = jax.jit(
+                self.sentry.wrap(kv_promote, "kv_promote"),
+                donate_argnums=(0,) if self._donate() else ())
+            self.compiled_programs.append(("kv_promote", self.swap_batch))
+        return self._promote_fn
+
+    def _demote_blocks(self, blocks: List[int], keys: List[bytes]) -> int:
+        """Copy the given device blocks into the host arena under their
+        chain keys — the sanctioned blocking demotion helper (lint GL007):
+        one gather program call + ONE ``jax.device_get`` per ``swap_batch``
+        batch, host-arena writes, no per-block syncs.  Returns the blocks
+        actually stored (the arena can refuse when it is full of in-flight
+        entries — the demotion is then simply dropped; contents stay
+        recomputable)."""
+        m = self.swap_batch
+        stored = 0
+        for i in range(0, len(blocks), m):
+            chunk_b = blocks[i:i + m]
+            chunk_k = keys[i:i + m]
+            ids = np.zeros(m, np.int32)
+            ids[:len(chunk_b)] = chunk_b
+            with self._tp_ctx():
+                staged = self._get_demote_fn()(self._swap_pools(),
+                                               jnp.asarray(ids))
+            host = jax.device_get(staged)          # one D2H per batch
+            leaves = jax.tree_util.tree_leaves(host)
+            for j, key in enumerate(chunk_k):
+                if self._host.put(key, [lf[:, j] for lf in leaves]) \
+                        is not None:
+                    stored += 1
+        if stored:
+            self._c_swap_out.inc(stored)
+            self._c_swap_bytes.inc(stored * self._host.block_nbytes)
+            self.timeline.instant(
+                "demote", blocks=stored,
+                bytes=stored * self._host.block_nbytes)
+        return stored
+
+    def _demote_evict_batch(self) -> int:
+        """Tiered replacement for per-block ``evict_one`` under pool
+        pressure: demote up to ``swap_batch`` LRU evictable prefix-cache
+        leaves to the host tier in one device round trip, then release
+        them — the freed blocks land on the free list with their contents
+        preserved below.  Returns the number of blocks freed."""
+        entries = self._prefix.evictable_leaves(self._alloc, self.swap_batch)
+        if not entries:
+            return 0
+        blocks, keys, ekeys = [], [], []
+        for e in entries:
+            chain = self._prefix.chain_tokens(e)
+            # chain is exactly (depth+1)*block_size tokens, so the block
+            # index falls out of its length — no second parent walk
+            key = chain_key(chain, len(chain) // self.block_size - 1,
+                            self.block_size)
+            ekeys.append(key)
+            if not self._host.has(key):
+                blocks.append(int(e.block))
+                keys.append(key)
+        if blocks:
+            self._demote_blocks(blocks, keys)
+        for e, key in zip(entries, ekeys):
+            b = int(e.block)
+            self._prefix.evict_entry(e, self._alloc)
+            self._kv_scale_live.discard(b)
+            # demoted=True iff the tier really holds the bytes now (a
+            # saturated arena can refuse the store — then this eviction
+            # discarded contents, exactly like the untiered path)
+            self.timeline.instant("evict_block", block=b,
+                                  demoted=self._host.has(key))
+        return len(entries)
+
+    def _demote_slot_blocks(self, slot: int, st: "_SlotState") -> None:
+        """Preemption demotion: move the victim's exclusively-owned full
+        blocks (committed content only) to the host tier before its slot
+        releases — on resume, the same chain keys (generated tokens fold
+        into the resume prompt) promote them back, so the recompute that
+        used to re-run the whole prefix shrinks to the unfinished tail."""
+        committed = max(int(self._lengths[slot]), st.base)
+        seq = np.concatenate([st.prompt_eff, np.asarray(st.out, np.int32)])
+        full = min(committed, seq.size) // self.block_size
+        run = chain_keys(seq, full, self.block_size)
+        blocks, keys = [], []
+        for i in range(full):
+            b = int(self._tables[slot, i])
+            if b == 0 or self._alloc.refcount(b) != 1:
+                continue       # shared (trie / other slot): stays on device
+            if not self._host.has(run[i]):
+                blocks.append(b)
+                keys.append(run[i])
+        if blocks:
+            self._demote_blocks(blocks, keys)
+
+    def _stage_chunks(self, keys: List[bytes]):
+        """Assemble host-resident blocks into ``swap_batch``-shaped staging
+        buffers and issue their H2D ``jax.device_put`` (async — dispatch
+        returns immediately, the copy overlaps whatever the device is
+        running).  Marks every key in-flight; returns
+        ``[(keys_chunk, staged_tree), ...]``."""
+        m = self.swap_batch
+        chunks = []
+        shardings = self._staging_shardings
+        template = jax.tree_util.tree_structure(self._swap_pools())
+        for i in range(0, len(keys), m):
+            chunk = keys[i:i + m]
+            per_leaf = None
+            for j, key in enumerate(chunk):
+                arrs = self._host.read(key)
+                if per_leaf is None:
+                    per_leaf = [
+                        np.zeros((a.shape[0], m) + a.shape[1:], a.dtype)
+                        for a in arrs]
+                for buf, a in zip(per_leaf, arrs):
+                    buf[:, j] = a
+                self._host.mark_in_flight(key)
+            staged = jax.tree_util.tree_unflatten(
+                template, [jax.device_put(buf, sh)
+                           for buf, sh in zip(per_leaf, shardings)])
+            chunks.append((chunk, staged))
+        return chunks
+
+    def _issue_prefetch(self, pending) -> None:
+        """End-of-iteration prefetch: probe the pending queue's first two
+        requests for host-resident chains and stage their promotions NOW,
+        one-plus scheduler iterations before admission can consume them —
+        the double-buffered H2D overlap (``runtime/zero/param_stream.py``
+        does the same for ZeRO-3 parameters)."""
+        n = 0
+        for req, prior in pending:
+            if n >= 2 or len(self._staged) >= 2:   # double buffer
+                break
+            n += 1
+            if req.uid in self._staged:
+                continue
+            # empty-probe memo: while neither the trie's refcount state
+            # nor the host key set moved, re-probing the same request is
+            # the same O(prompt) walk for the same empty answer — skip it
+            # every idle iteration (the _blocked_gate trick, again)
+            gate = (id(req), len(prior), self._alloc.version,
+                    self._host.version)
+            if self._prefetch_gate.get(req.uid) == gate:
+                continue
+            prompt_eff = np.concatenate(
+                [req.prompt, np.asarray(prior, np.int32)]) \
+                if prior else req.prompt
+            plen = int(prompt_eff.size)
+            n_dev = self._prefix.probe(prompt_eff, plen - 1)
+            keys = self._host.probe_run(prompt_eff, n_dev, plen - 1,
+                                        self.block_size)
+            if not keys:
+                self._prefetch_gate[req.uid] = gate
+                continue
+            # cap the staged DEVICE footprint at two swap batches per
+            # request (a true chunk-level double buffer): a long session
+            # chain would otherwise pin chain-length worth of staging
+            # buffers next to a deliberately small pool for as long as
+            # the queue head stays blocked — admission consumes the
+            # staged prefix and stages the remainder there
+            keys = keys[:2 * self.swap_batch]
+            self._staged[req.uid] = {
+                "keys": keys, "chunks": self._stage_chunks(keys)}
+            self.timeline.instant("prefetch_issue", uid=str(req.uid),
+                                  blocks=len(keys))
+
+    def _unflag_keys(self, keys) -> None:
+        """Roll staged keys back to plain host residency — UNLESS another
+        live staged record still references them (two pending requests
+        sharing a session prefix both stage the same chain; the in-flight
+        pin must outlive either single record)."""
+        still = {k for rec in self._staged.values() for k in rec["keys"]}
+        for key in keys:
+            if key not in still and self._host.has(key):
+                self._host.mark_in_flight(key, False)
+
+    def _discard_all_staged(self) -> None:
+        recs = list(self._staged.values())
+        self._staged.clear()
+        for rec in recs:
+            self._unflag_keys(rec["keys"])
+
+    def _take_staged(self, uid, keys: List[bytes]):
+        """Consume the prefetched staging for ``uid`` iff it covers a
+        leading PREFIX of the chain admission resolved (prefetch caps its
+        staged footprint, so a long chain's tail stages at admission); a
+        mismatch discards it (and counts as a prefetch miss for the sync
+        path)."""
+        rec = self._staged.pop(uid, None)
+        if rec is None:
+            return None
+        rk = rec["keys"]
+        if len(rk) <= len(keys) and rk == keys[:len(rk)]:
+            return rec["chunks"]
+        self._unflag_keys(rk)
+        return None
+
+    def _promote_wait(self, staged) -> float:
+        """Join an in-flight staging buffer (sanctioned blocking helper,
+        lint GL007) and return how long admission actually stalled — 0 ≈
+        the prefetch fully hid the transfer behind decode."""
+        t0 = time.perf_counter()
+        for leaf in jax.tree_util.tree_leaves(staged):
+            leaf.block_until_ready()
+        return time.perf_counter() - t0
+
+    def _promote_chain(self, prompt_eff, plen: int, n_dev: int,
+                       req) -> List[int]:
+        """Promote the host-resident continuation of an admitted prompt's
+        chain back into the device pool: consume the prefetched staging
+        (or stage synchronously on a miss), allocate device blocks —
+        reclaiming via batch demotion, never preemption; the admission
+        gate already proved free + evictable covers the need — scatter the
+        staged bytes in, and re-register the chain in the prefix trie from
+        block ``n_dev`` on.  Returns the promoted physical blocks, claimed
+        for the caller (one reference each, like ``PrefixCache.lookup``).
+        Partial promotion (pool pressure mid-run) keeps the unpromoted
+        tail host-resident."""
+        keys = self._host.probe_run(prompt_eff, n_dev, plen - 1,
+                                    self.block_size)
+        if not keys:
+            # nothing host-resident to promote — but a prefetch staged for
+            # this request may still exist (a sharing request promoted the
+            # chain first, or the trie drifted): it dies WITH the
+            # admission, or its record would pin in-flight entries and
+            # occupy the double buffer for the rest of the trace
+            rec = self._staged.pop(req.uid, None)
+            if rec is not None:
+                self._unflag_keys(rec["keys"])
+            return []
+        chunks = self._take_staged(req.uid, keys)
+        miss = chunks is None
+        if miss:
+            self._c_prefetch_miss.inc()
+            self.timeline.instant("prefetch_miss", uid=str(req.uid),
+                                  blocks=len(keys))
+            chunks = self._stage_chunks(keys)
+        else:
+            staged_n = sum(len(ck) for ck, _ in chunks)
+            if staged_n < len(keys):
+                # the prefetch staged only the capped prefix — the tail
+                # stages now; its device_put overlaps the prefix chunks'
+                # scatter work
+                chunks = chunks + self._stage_chunks(keys[staged_n:])
+        promoted: List[int] = []
+        wait_s = 0.0
+        for ci, (chunk_keys, staged) in enumerate(chunks):
+            ids = np.zeros(self.swap_batch, np.int32)
+            got: List[int] = []
+            for key in chunk_keys:
+                b = self._alloc.alloc()
+                if b is None:
+                    if not self._demote_evict_batch():
+                        break
+                    b = self._alloc.alloc()
+                    if b is None:
+                        break
+                if self.kv_quant:
+                    self._kv_scale_live.add(b)
+                got.append(b)
+            if got:
+                ids[:len(got)] = got
+                wait_s += self._promote_wait(staged)
+                with self._tp_ctx():
+                    self._set_swap_pools(self._get_promote_fn()(
+                        self._swap_pools(), staged, jnp.asarray(ids)))
+                for key in chunk_keys[:len(got)]:
+                    self._host.pop(key)     # residency moved to device
+                promoted.extend(got)
+            if len(got) < len(chunk_keys):
+                # pool dry mid-run: everything unscattered — this chunk's
+                # tail and every later chunk — rolls back to plain host
+                # residency (NEVER left dangling in-flight; keys a sharing
+                # request still has staged keep their pin)
+                self._unflag_keys(chunk_keys[len(got):])
+                for later_keys, _ in chunks[ci + 1:]:
+                    self._unflag_keys(later_keys)
+                break
+        if promoted:
+            # a sharing pending request may have the just-popped keys
+            # staged too: drop those records NOW — their staging is stale
+            # (the sharer's own admission would probe the chain on device
+            # and discard anyway), and a dangling record would both hold
+            # the double buffer and flag a false residency violation if
+            # the chain is later re-demoted un-flagged
+            popped = set(k for ck, _ in chunks for k in ck
+                         if not self._host.has(k))
+            stale = [uid for uid, rec in self._staged.items()
+                     if popped.intersection(rec["keys"])]
+            for uid in stale:
+                rec = self._staged.pop(uid)
+                self._unflag_keys(rec["keys"])
+            # graft the chain onto the trie (the cache takes its own hold,
+            # exactly like a freshly prefilled prompt's registration)
+            self._prefix.register(prompt_eff, promoted, self._alloc,
+                                  start=n_dev)
+            self._c_swap_in.inc(len(promoted))
+            self._c_swap_bytes.inc(len(promoted) * self._host.block_nbytes)
+            self._h_prefetch_wait.observe(wait_s)
+            self.timeline.instant(
+                "promote", uid=str(req.uid), blocks=len(promoted),
+                bytes=len(promoted) * self._host.block_nbytes,
+                prefetch="miss" if miss else "hit",
+                wait_s=round(wait_s, 6))
+        return promoted
+
     # ----------------------------------------------------------- block plumbing
     def _decref(self, b: int) -> None:
         """Release one reference; when the block actually frees, retire
@@ -902,9 +1371,13 @@ class ServingEngine:
     def _preempt(self, slot: int, active, pending) -> None:
         """Evict a sequence under block pressure: free its blocks and
         re-queue it at the FRONT with generated tokens folded into the
-        prompt (greedy => recompute is token-exact)."""
+        prompt (greedy => recompute is token-exact).  With the host tier
+        the victim's committed full blocks demote first, so the resume's
+        "recompute" promotes them back instead of re-running prefill."""
         st = active.pop(slot)
         nblocks = len(self._held[slot])
+        if self._host is not None:
+            self._demote_slot_blocks(slot, st)
         self._release_slot(slot)
         pending.appendleft((st.req, st.prior + st.out))
         self._c_preempted.inc()
@@ -922,11 +1395,18 @@ class ServingEngine:
                     self._kv_scale_live.add(b)
                 return b
             if self._prefix is not None:
-                evicted = self._prefix.evict_one(self._alloc)
-                if evicted:
-                    self._kv_scale_live.discard(evicted)
-                    self.timeline.instant("evict_block", block=int(evicted))
-                    continue
+                if self._host is not None:
+                    # tiered: demote a batch of LRU leaves to host DRAM,
+                    # then free them — contents survive below
+                    if self._demote_evict_batch():
+                        continue
+                else:
+                    evicted = self._prefix.evict_one(self._alloc)
+                    if evicted:
+                        self._kv_scale_live.discard(evicted)
+                        self.timeline.instant("evict_block",
+                                              block=int(evicted))
+                        continue
             victim = max(active, key=lambda s: active[s].admit_seq)
             if victim == requester and len(active) == 1:
                 # cannot happen when num_blocks >= nbper+1 (ctor check)
@@ -1019,6 +1499,15 @@ class ServingEngine:
                 self._blocked_gate = (id(req), len(prior),
                                       self._alloc.version)
                 break
+            if self._host is not None:
+                # tiered KV: the chain's continuation may live in host
+                # DRAM (earlier eviction or this request's own preempted
+                # state) — promote it back and extend the claimed prefix;
+                # the gate above just proved the device blocks this costs
+                # are coverable, so promotion never preempts anyone
+                hits.extend(self._promote_chain(prompt_eff, plen,
+                                                len(hits), req))
+                need = total_need - len(hits)
             reserved += max(need, 0)
             pending.popleft()
             slot = free.pop(0)
@@ -1040,6 +1529,11 @@ class ServingEngine:
             self._c_admitted.inc()
             self._c_prompt_tokens.inc(plen)
             self._c_prefix_hit_tokens.inc(st.base)
+            if prior:
+                # tokens a preemption resume actually re-prefills — with
+                # the host tier this stays near zero (promoted chains
+                # cover all but the unfinished tail)
+                self._c_resume_recompute.inc(plen - st.base)
             # prefix_hit_tokens == 0 is the cache-miss record
             self.timeline.instant("admit", uid=str(req.uid), slot=slot,
                                   prompt_tokens=plen,
@@ -1093,6 +1587,9 @@ class ServingEngine:
         active: Dict[int, _SlotState] = {}
         self._blocked_gate = None          # ids are fresh for this trace
         self._trace_times = {}             # uids are unique per trace
+        if self._host is not None:
+            self._discard_all_staged()     # prior trace's prefetches died
+            self._prefetch_gate.clear()    # ids are fresh for this trace
         if admission_log is None:
             admission_log = []
         results: Dict[Any, np.ndarray] = {}
@@ -1154,6 +1651,11 @@ class ServingEngine:
             else:
                 self._run_plain_decode(active, pending, params,
                                        eos_token_id, finish)
+            if self._host is not None:
+                # stage next iteration's promotions NOW: the H2D copies
+                # run while the next decode step computes (module
+                # docstring "Tiered KV cache" — the param_stream overlap)
+                self._issue_prefetch(pending)
             if step_log is not None:
                 step_log.append({
                     "iteration": self.iterations,
@@ -1176,6 +1678,8 @@ class ServingEngine:
         if window is not None and window.active:
             window.stop()
             self.timeline.instant("profiler_stop")
+        if self._host is not None:
+            self._discard_all_staged()     # no pending queue to consume them
         return results
 
     # ----------------------------------------------------------------- decode
@@ -1480,6 +1984,8 @@ class ServingEngine:
         bench artifacts; the key set here is stable across PRs."""
         self._g_blocks_in_use.set(self._alloc.blocks_in_use)
         self._g_free_blocks.set(self._alloc.free_blocks)
+        if self._host is not None:
+            self._g_host_blocks_in_use.set(self._host.blocks_in_use)
         st = {
             "mode": "chunked" if self.chunked_prefill else "bucketed",
             "compile_count": self.compile_count,
@@ -1518,6 +2024,19 @@ class ServingEngine:
             "accepted_tokens": self.accepted_tokens,
             "acceptance_rate": (self.accepted_tokens / self.drafted_tokens
                                 if self.drafted_tokens else 0.0),
+            # tiered KV (host_blocks=0: zeros — schema stays stable)
+            "host_blocks": self.host_blocks,
+            "host_blocks_in_use": self._host.blocks_in_use
+            if self._host is not None else 0,
+            "host_pool_bytes": self._host.arena_bytes
+            if self._host is not None else 0,
+            "swap_in": int(self._c_swap_in.value),
+            "swap_out": int(self._c_swap_out.value),
+            "swap_bytes": int(self._c_swap_bytes.value),
+            "prefetch_misses": int(self._c_prefetch_miss.value),
+            "prefetch_wait_p50_s": self._h_prefetch_wait.quantile(0.50),
+            "prefetch_wait_p95_s": self._h_prefetch_wait.quantile(0.95),
+            "resume_recompute_tokens": int(self._c_resume_recompute.value),
             # timeline ring health (telemetry/trace.py): dropped > 0 means
             # the ring wrapped — raise trace_capacity for longer history
             "trace_capacity": self.timeline.capacity,

@@ -294,6 +294,71 @@ def _paged_gather(pool_leaf, block_tables, out_dtype=None):
     return g.transpose(0, 2, 1, 3, 4).reshape(b, hkv, nbper * bs, hd)
 
 
+def _block_gather_one(leaf, ids):
+    """Single-shard body of :func:`paged_block_gather` for one pool leaf:
+    ``[L, NB, ...] -> [L, M, ...]`` along the physical-block dim."""
+    return leaf[:, ids]
+
+
+def paged_block_gather(pool, ids):
+    """Gather whole physical blocks out of a (stacked, possibly multi-leaf)
+    pool for host demotion: every leaf ``[L, NB, *rest]`` yields
+    ``[L, M, *rest]`` at the ``int32 [M]`` block ids (``M`` is the engine's
+    fixed ``swap_batch`` — pad with scratch block 0, whose gathered bytes
+    the caller discards).  Quantized records travel whole: the ``qp`` codes
+    AND their ``ps`` scale rows are ordinary leaves of the tree, so a
+    demoted int8 block carries its scales with it.
+
+    This is the device half of the tiered-KV demotion path
+    (``inference/serving.py``): ONE fixed-shape compiled program per
+    engine, one ``jax.device_get`` of its output per demotion batch.
+    Under a configured tp context each chip gathers only its own head
+    shard (dims: block at 1, head at 2 on every leaf — the pool layout
+    contract), and the host-side ``device_get`` then assembles the full
+    blocks from the addressable shards; ids replicate.
+    """
+    ids = jnp.asarray(ids, jnp.int32)
+    leaves = jax.tree_util.tree_leaves(pool)
+    n = head_shards(*[l.shape[2] for l in leaves])
+    if n <= 1:
+        return jax.tree_util.tree_map(
+            lambda l: _block_gather_one(l, ids), pool)
+    hs = P(None, None, _TP_AXIS)
+    return head_shard_map(
+        lambda p, i: jax.tree_util.tree_map(
+            lambda l: _block_gather_one(l, i), p),
+        (hs, P()), hs)(pool, ids)
+
+
+def paged_block_scatter(pool, staged, ids):
+    """Scatter host-promoted blocks back into the pool: every staged leaf
+    ``[L, M, *rest]`` lands at the ``int32 [M]`` block ids of the matching
+    pool leaf — the inverse of :func:`paged_block_gather`, so a
+    demote → promote round trip is bit-identical (int8 codes and scale
+    rows included).  Pad columns target scratch block 0 (duplicate
+    scratch writes are fine — scratch is never read unmasked).
+
+    Device half of tiered-KV promotion: the engine ``jax.device_put``\\ s
+    the staged blocks ahead of admission (overlapping H2D with the decode
+    step) and this ONE fixed-shape program commits them.  Under a
+    configured tp context each chip scatters its own head shard (the
+    staged array arrives already head-sharded from the engine's
+    sharding-annotated ``device_put``); ids replicate.
+    """
+    ids = jnp.asarray(ids, jnp.int32)
+    leaves = jax.tree_util.tree_leaves(pool)
+    n = head_shards(*[l.shape[2] for l in leaves])
+
+    def scatter(p, s, i):
+        return jax.tree_util.tree_map(
+            lambda pl, sl: pl.at[:, i].set(sl.astype(pl.dtype)), p, s)
+
+    if n <= 1:
+        return scatter(pool, staged, ids)
+    hs = P(None, None, _TP_AXIS)
+    return head_shard_map(scatter, (hs, hs, P()), hs)(pool, staged, ids)
+
+
 def paged_gather(pool_leaf, block_tables, out_dtype=None):
     """Materialize each row's logical cache view from the pool:
     ``[NB, HKV, bs, hd]`` through ``int32 [B, NBPER]`` tables ->

@@ -242,6 +242,100 @@ def host2(c: "Clock"):
     assert "GL006" not in _codes(near_miss)
 
 
+def test_gl007_blocking_transfer_in_loop_fires_and_near_miss():
+    fires = """
+import jax
+
+def scheduler(reqs, pool):
+    outs = []
+    while reqs:
+        out = step(pool)
+        jax.block_until_ready(out)           # per-iteration sync
+        outs.append(jax.device_get(out))     # and a second one
+    for o in outs:
+        o.block_until_ready()                # method spelling
+    return outs
+"""
+    codes = _codes(fires)
+    assert codes.count("GL007") == 3, codes
+    near_miss = """
+import jax
+
+def scheduler(reqs, pool):
+    outs = [step(pool) for r in reqs]
+    jax.block_until_ready(outs)              # one sync, outside the loop
+    return jax.device_get(outs)
+
+def _demote_blocks(blocks, pool):
+    for b in blocks:
+        host = jax.device_get(gather(pool, b))   # sanctioned helper
+    return host
+
+def _promote_wait(staged):
+    for leaf in staged:
+        leaf.block_until_ready()             # sanctioned helper
+    return staged
+
+def driver(xs):
+    while xs:
+        y = jax.device_put(xs.pop())         # device_put is async
+    return y
+
+def once(xs):
+    for x in jax.device_get(xs):             # iter expr runs ONCE
+        use(x)
+    for x in xs:
+        pass
+    else:
+        jax.block_until_ready(xs)            # else clause runs ONCE
+"""
+    assert "GL007" not in _codes(near_miss)
+    # a While TEST re-evaluates per iteration — that one does fire
+    while_test = """
+import jax
+
+def driver(x):
+    while jax.device_get(x) > 0:
+        x = step(x)
+"""
+    assert _codes(while_test) == ["GL007"]
+    # comprehensions are loops, and the from-import spelling counts;
+    # the first generator's iterable still evaluates once (no fire)
+    comp = """
+import jax
+from jax import device_get
+
+def driver(xs, pool):
+    a = [jax.device_get(step(pool)) for x in xs]
+    b = {device_get(x) for x in xs}
+    c = [f(x) for x in jax.device_get(xs)]      # iterable: runs once
+    return a, b, c
+"""
+    assert _codes(comp).count("GL007") == 2, _codes(comp)
+    # a nested def's DEFAULTS/decorators evaluate per iteration (fire);
+    # its body only runs when called (no fire)
+    nested = """
+import jax
+
+def driver(xs):
+    for x in xs:
+        def f(y=jax.device_get(x)):          # def-time, per iteration
+            return jax.device_get(y)         # call-time: not the loop
+        h = f
+    return h
+"""
+    assert _codes(nested).count("GL007") == 1, _codes(nested)
+    # pragma support: documented per-item commit points stay expressible
+    pragma = """
+import jax
+
+def driver(xs):
+    for x in xs:
+        jax.device_get(x)  # graft: noqa(GL007) per-item commit, documented
+"""
+    assert _codes(pragma) == []
+
+
 def test_noqa_pragma_suppresses_named_rule_only():
     src = """
 import jax

@@ -99,6 +99,37 @@ def test_serving_bench_tp_lane_shrinks_per_chip_kv():
     assert res["kv_bytes_per_chip_tp"] * 2 == res["kv_bytes_per_chip_replicated"]
 
 
+def test_serving_bench_tiered_pool_frac_lane():
+    """The BENCH_r09 acceptance lane (small edition): returning-session
+    traffic on a device pool sized at 25% of the unique working set.  The
+    tiered engine must hold exact token parity (both engines are gated on
+    it by run_bench), actually swap in both directions, keep the +2
+    swap-program compile contract, land most promotions on the prefetch
+    path, and beat the evict/preempt baseline in the steady state.  The
+    compile-warm speedup floor is conservative (the committed 64-request
+    BENCH_r09.json shows 1.47x warm / 1.11x cold)."""
+    import serving_bench
+
+    res = serving_bench.run_bench(requests=32, slots=8, layers=2,
+                                  hidden=128, heads=4, vocab=2048, seed=0,
+                                  prefix_len=256, sessions=10,
+                                  pool_frac=0.25)
+    assert res["token_parity"], res["mismatched_uids"]
+    t = res["serving_tiered"]
+    assert t["device_pool_blocks"] < t["working_set_blocks"]
+    tiered, base = t["tiered"], t["preemption_baseline"]
+    assert tiered["compiled_programs"] == 4      # 2 + demote + promote
+    assert base["compiled_programs"] == 2
+    assert tiered["swap_out"] > 0 and tiered["swap_in"] > 0
+    assert tiered["prefetch_misses"] < tiered["swap_in"]
+    assert tiered["prefetch_wait_p95_s"] is not None
+    # the session cache survives below the pool: hit rate way above the
+    # evicting baseline's, and the steady state is faster
+    assert tiered["prefix_cache_hit_rate"] > \
+        base["prefix_cache_hit_rate"] + 0.3
+    assert t["speedup_tiered_vs_preemption_warm"] >= 1.1, t
+
+
 def test_serving_bench_quant_lanes():
     """--quantize lanes: kv8 reports >= 1.8x servable blocks per chip vs
     a bf16 pool (hd=32 model: 2·hd/(hd+2) ≈ 1.88x), the w8a8 engine lane

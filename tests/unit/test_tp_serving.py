@@ -158,6 +158,39 @@ def test_tp4_kv8_parity_and_sharded_scale_table(tp1_engine, tp4_engine,
     assert s4.compile_count == 2, s4.compiled_programs
 
 
+def test_tp4_tiered_kv_parity_per_shard_transfers(tp1_engine, tp4_engine,
+                                                  tiny_cfg):
+    """Tiered KV (host-DRAM offload) composes with the tp head-shard:
+    demotion's ``device_get`` assembles per-addressable-shard and
+    promotion's ``device_put`` re-shards the staged buffer, so the swap
+    round trip is byte-exact at any degree — tp=4 tokens are BIT-identical
+    to the tp=1 tiered run (and swap schedules match: the scheduler never
+    sees head counts).  kv8 composes on top with the same exactness."""
+    kw = dict(slots=3, max_seq_len=64, block_size=8, prefill_chunk=16,
+              prefill_batch=2, num_blocks=10, host_blocks=64, swap_batch=4,
+              debug_checks=True)
+    reqs = _trace(tiny_cfg, 6, seed=3, max_new=(20, 28))
+    s1 = ServingEngine(tp1_engine, **kw)
+    s4 = ServingEngine(tp4_engine, **kw)
+    r1 = s1.serve(reqs)
+    r4 = s4.serve(_trace(tiny_cfg, 6, seed=3, max_new=(20, 28)))
+    st1, st4 = s1.stats(), s4.stats()
+    assert s4.kv_sharded
+    assert st4["swap_out"] > 0 and st4["swap_in"] > 0
+    assert (st4["swap_out"], st4["swap_in"]) == \
+        (st1["swap_out"], st1["swap_in"])
+    assert s4.compile_count == 4 and s4.compile_budget == 4
+    for uid in r1:
+        np.testing.assert_array_equal(r1[uid], r4[uid], err_msg=f"uid {uid}")
+    sq1 = ServingEngine(tp1_engine, quantize="kv8", **kw)
+    sq4 = ServingEngine(tp4_engine, quantize="kv8", **kw)
+    q1 = sq1.serve(_trace(tiny_cfg, 6, seed=3, max_new=(20, 28)))
+    q4 = sq4.serve(_trace(tiny_cfg, 6, seed=3, max_new=(20, 28)))
+    assert sq4.stats()["swap_out"] > 0
+    for uid in q1:
+        np.testing.assert_array_equal(q1[uid], q4[uid], err_msg=f"uid {uid}")
+
+
 def test_shard_kv_false_forces_replicated(tp4_engine):
     srv = ServingEngine(tp4_engine, slots=2, max_seq_len=64, block_size=8,
                         shard_kv=False)
@@ -233,6 +266,31 @@ def test_draft_pool_shards_with_target(tp4_engine, tiny_cfg):
     reqs = _trace(tiny_cfg, 4, seed=2)
     res = srv.serve(reqs)
     assert srv.compile_count <= 3, srv.compiled_programs
+    for r in reqs:
+        want = tp4_engine.generate(r.prompt[None, :],
+                                   max_new_tokens=r.max_new_tokens)[0]
+        np.testing.assert_array_equal(res[r.uid], want, err_msg=f"{r.uid}")
+
+
+def test_tiered_mixed_sharding_sharded_target_replicated_draft(tp4_engine,
+                                                               tiny_cfg):
+    """Tiered KV with a SHARDED target pool and a REPLICATED draft pool
+    (GQA draft: 3 heads at tp=4): the staging device_put must apply each
+    leaf's OWN sharding — one head-sharded spec over the whole swap tree
+    crashed this supported combo.  Parity vs the tp=4 engine's own
+    generate under pressure, with swaps in both directions."""
+    dcfg = gpt2.GPT2Config(vocab_size=tiny_cfg.vocab_size, max_seq_len=128,
+                           num_layers=1, num_heads=3, hidden_size=48)
+    srv = ServingEngine(tp4_engine, slots=3, max_seq_len=64, block_size=8,
+                        prefill_chunk=16, prefill_batch=2, num_blocks=10,
+                        spec_tokens=3, draft=gpt2.build(dcfg),
+                        host_blocks=64, swap_batch=4, debug_checks=True)
+    assert srv.kv_sharded and not srv._dcache_sharded
+    reqs = _trace(tiny_cfg, 5, seed=4, max_new=(16, 24))
+    res = srv.serve(reqs)
+    st = srv.stats()
+    assert st["swap_out"] > 0 and st["swap_in"] > 0
+    assert srv.compile_count <= srv.compile_budget == 5
     for r in reqs:
         want = tp4_engine.generate(r.prompt[None, :],
                                    max_new_tokens=r.max_new_tokens)[0]
