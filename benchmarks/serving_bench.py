@@ -102,11 +102,34 @@ agreement instead of bit parity (see ``main`` — bf16 near-tie argmax
 flips between equally valid compute shapes), fp32 runs keep the exact
 gate.
 
+``--replicas N`` runs the BENCH_r10 multi-replica router protocol
+instead of the single-engine lanes: ``deepspeed_tpu/serving/``'s
+``ReplicaRouter`` over 1 → 2 → 4 engine replicas (capped at N, weights
+shared so every scale is token-identical) on the returning-session
+trace.  Scaling is WEAK — n replicas serve n× the traffic (requests×n
+over sessions×n), per-replica load constant: the DP capacity claim.
+CPU-sim methodology: one process TIME-SLICES the replicas on the host
+CPU — each replica stands in for an independent accelerator — so the
+scaling headline is **aggregate busy-time throughput** (each replica's
+generated tokens over its own ``step()`` wall time, summed over 3
+interleaved warm rounds: the DP scaling signal), reported next to raw
+wall clock (flat on a single core by construction; with >= N cores and
+``threaded`` workers the wall numbers converge toward the busy
+aggregate).  The protocol also runs affinity-vs-round-robin twin
+fleets (prefix hit rate under pool pressure) and a drained-replica
+migration: every migrated session's chain is KV-pulled from the
+drained replica's host tier and resumed on the survivor with zero
+prefix recompute, vs a ``kv_pull=False`` twin that re-prefills whole
+prompts (TTFT-shaped continuations — migration changes the prefill
+side).  Every lane is parity-gated; each replica's compile count is
+checked against its unchanged sentry budget.
+
 Usage:
   python benchmarks/serving_bench.py [--requests 64] [--slots 8]
       [--prefix-len 256] [--grid] [--decode-heavy] [--speculative K]
       [--tp N] [--quantize kv8,w8a8+kv8 | --quant-suite]
-      [--layers 2] [--hidden 128] [--seed 0] [--json out.json]
+      [--replicas N] [--layers 2] [--hidden 128] [--seed 0]
+      [--json out.json]
 """
 
 from __future__ import annotations
@@ -703,6 +726,333 @@ def run_bench(requests: int = 64, slots: int = 8, prefill_batch: int = 4,
     return result
 
 
+def run_replica_bench(replicas: int = 4, requests: int = 64,
+                      slots: int = 8, prefill_batch: int = 4,
+                      layers: int = 2, hidden: int = 128, heads: int = 4,
+                      vocab: int = 2048, seed: int = 0,
+                      dtype: str = "fp32", block_size: int = 32,
+                      prefill_chunk: int = 128, prefix_len: int = 192,
+                      sessions: int = 9, swap_batch: int = 8):
+    # sessions defaults ODD on purpose: a session count divisible by the
+    # replica count strides round-robin routing into perfect session
+    # co-location (request i of session i%S lands on replica i%R — same
+    # replica whenever R | S), which would flatter the baseline
+    """The BENCH_r10 multi-replica router protocol (module docstring
+    ``--replicas``): scaling over 1→2→4 replicas, affinity vs
+    round-robin, and the drained-replica KV-pull migration."""
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.serving import Request, ServingEngine
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.ops.paged_kv import blocks_for
+    from deepspeed_tpu.serving import ReplicaRouter
+
+    cfg = gpt2.GPT2Config(vocab_size=vocab, max_seq_len=1024,
+                          num_layers=layers, num_heads=heads,
+                          hidden_size=hidden)
+    spec = gpt2.build(cfg)
+    max_total = prefix_len + max(TAIL_RANGE) + max(PREFIX_NEW_RANGE)
+    nbper = blocks_for(max_total, block_size)
+    state = {"params": None}
+
+    def mk_engine():
+        eng = deepspeed_tpu.init_inference(
+            spec, config={"dtype": dtype,
+                          "tensor_parallel": {"tp_size": 1}},
+            params=state["params"])
+        if state["params"] is None:
+            state["params"] = eng.params     # every replica shares weights
+        return eng
+
+    def fleet(n, policy="affinity", host_blocks=0, kv_pull=True,
+              num_blocks=None):
+        extra = {"host_blocks": host_blocks, "swap_batch": swap_batch} \
+            if host_blocks else {}
+        if num_blocks is not None:
+            extra["num_blocks"] = num_blocks
+        srvs = [ServingEngine(mk_engine(), slots=slots,
+                              max_seq_len=max_total,
+                              prefill_batch=prefill_batch,
+                              block_size=block_size,
+                              prefill_chunk=prefill_chunk, **extra)
+                for _ in range(n)]
+        return ReplicaRouter(srvs, policy=policy, kv_pull=kv_pull)
+
+    reqs = build_trace(requests, vocab, seed, False, prefix_len, False,
+                       sessions)
+    gen_tokens = sum(r.max_new_tokens for r in reqs)
+    seq_engine = mk_engine()
+    seq_outs, seq_wall = run_sequential(seq_engine, reqs)
+    mismatched = []
+
+    # working set in blocks (unique shared prefixes + private tails) —
+    # sizes the scaling pools (no pressure: isolates pure DP scaling
+    # from the aggregate-HBM capacity win) and the pressure lanes below
+    from deepspeed_tpu.inference.paged import chain_keys
+    uniq = set()
+    private = 0
+    for r in reqs:
+        nfull = len(r.prompt) // block_size
+        uniq.update(chain_keys(r.prompt, nfull, block_size))
+        private += blocks_for(len(r.prompt) + r.max_new_tokens,
+                              block_size) - nfull
+    ws_blocks = len(uniq) + private
+    big = 1 + ws_blocks + slots * nbper
+    small = max(1 + nbper + 1, int(round(ws_blocks * 0.35)) + 1)
+
+    def gate(tag, outs):
+        for r in reqs:
+            if not np.array_equal(seq_outs[r.uid], outs[r.uid]):
+                mismatched.append((tag, r.uid))
+
+    # --- scaling 1 -> 2 -> 4, WEAK: n replicas serve n x the traffic
+    # (requests*n over sessions*n — the DP capacity claim: add a replica,
+    # serve another replica's worth of users) with per-replica load held
+    # constant.  Every scale gets a pool that holds its replica share of
+    # the working set, so the ratio measures replica scaling, not
+    # eviction luck.  Warm passes are INTERLEAVED 3-round across the
+    # scales (the telemetry lane's trick) and busy/token deltas sum over
+    # all rounds: wall-clock drift on a shared box hits every scale
+    # alike instead of biasing whichever lane ran last.  Parity: the
+    # base trace gates vs sequential; the bigger weak traces gate vs a
+    # fresh single-replica fleet serving the identical trace (engine vs
+    # sequential parity is the n=1 gate + every other serving test).
+    scales = [n for n in (1, 2, 4) if n <= replicas]
+    traces = {1: reqs}
+    refs = {1: seq_outs}
+    for n in scales:
+        if n == 1:
+            continue
+        tr = build_trace(requests * n, vocab, seed, False, prefix_len,
+                         False, sessions * n)
+        traces[n] = tr
+        refs[n] = fleet(1, num_blocks=n * big).serve(tr)
+    fleets = {}
+    scaling = {}
+    for n in scales:
+        router = fleet(n, num_blocks=big)
+        t0 = time.perf_counter()
+        outs = router.serve(traces[n])      # compile + prefix-warm pass
+        cold = time.perf_counter() - t0
+        for r in traces[n]:
+            if not np.array_equal(refs[n][r.uid], outs[r.uid]):
+                mismatched.append((f"scale{n}-cold", r.uid))
+        fleets[n] = router
+        gen_n = sum(r.max_new_tokens for r in traces[n])
+        scaling[str(n)] = {"replicas": n,
+                           "requests": len(traces[n]),
+                           "generated_tokens": gen_n,
+                           "wall_cold_s": cold,
+                           "tok_s_wall_cold": gen_n / cold}
+    acc = {n: [0.0, [0.0] * n, [0.0] * n] for n in scales}  # wall, busy, gen
+    for _ in range(3):
+        for n in scales:
+            router = fleets[n]
+            busy0 = router.busy_seconds
+            gen0 = [p["generated_tokens"]
+                    for p in router.stats()["per_replica"]]
+            t0 = time.perf_counter()
+            outs2 = router.serve(traces[n])
+            warm = time.perf_counter() - t0
+            for r in traces[n]:
+                if not np.array_equal(refs[n][r.uid], outs2[r.uid]):
+                    mismatched.append((f"scale{n}-warm", r.uid))
+            busy1 = router.busy_seconds
+            gen1 = [p["generated_tokens"]
+                    for p in router.stats()["per_replica"]]
+            acc[n][0] += warm
+            acc[n][1] = [a + (b1 - b0) for a, b0, b1 in
+                         zip(acc[n][1], busy0, busy1)]
+            acc[n][2] = [a + (g1 - g0) for a, g0, g1 in
+                         zip(acc[n][2], gen0, gen1)]
+    for n in scales:
+        wall3, busy, gens = acc[n]
+        st = fleets[n].stats()
+        gen_n = scaling[str(n)]["generated_tokens"]
+        scaling[str(n)].update({
+            "wall_warm_s": wall3 / 3,
+            "tok_s_wall_warm": gen_n / (wall3 / 3),
+            "busy_warm_s": busy,
+            "aggregate_tok_s_busy": sum(
+                g / max(b, 1e-9) for g, b in zip(gens, busy) if g > 0),
+            "routed_affinity": st["routed_affinity"],
+            "routed_balance": st["routed_balance"],
+            "prefix_cache_hit_rate": st["prefix_cache_hit_rate"],
+            "compile_budgets_ok": all(
+                p["compile_count"] <= p["compile_budget"]
+                for p in st["per_replica"]),
+            "per_replica_compiles": [
+                [p["compile_count"], p["compile_budget"]]
+                for p in st["per_replica"]],
+        })
+    fleets.clear()                          # free the pools
+    ratios = {}
+    for a, b in ((1, 2), (2, 4)):
+        if str(a) in scaling and str(b) in scaling:
+            ratios[f"{a}to{b}"] = (scaling[str(b)]["aggregate_tok_s_busy"]
+                                   / scaling[str(a)]["aggregate_tok_s_busy"])
+
+    # --- affinity vs round-robin twin fleets at 2 replicas on a
+    # PRESSURE-SIZED device pool (the tiered-lane working-set math):
+    # affinity halves each replica's session working set, round-robin
+    # makes every replica carry all of it — the hit-rate gap IS the
+    # routing policy's value under real block pressure
+    aff_vs_rr = None
+    if replicas >= 2:
+        r_aff = fleet(2, num_blocks=small)
+        gate("aff-cold", r_aff.serve(reqs))
+        aff_cold = r_aff.stats()["prefix_cache_hit_rate"]
+        gate("aff-warm", r_aff.serve(reqs))
+        r_rr = fleet(2, policy="round_robin", num_blocks=small)
+        gate("rr-cold", r_rr.serve(reqs))
+        rr_cold = r_rr.stats()["prefix_cache_hit_rate"]
+        gate("rr-warm", r_rr.serve(reqs))
+        sa, sr = r_aff.stats(), r_rr.stats()
+        aff_vs_rr = {
+            "device_pool_blocks": small,
+            "working_set_blocks": ws_blocks,
+            "affinity_hit_rate_cold": aff_cold,
+            "round_robin_hit_rate_cold": rr_cold,
+            "affinity_hit_rate": sa["prefix_cache_hit_rate"],
+            "round_robin_hit_rate": sr["prefix_cache_hit_rate"],
+            "affinity_routed": [sa["routed_affinity"],
+                                sa["routed_balance"]],
+            "hit_rate_advantage": (sa["prefix_cache_hit_rate"]
+                                   - sr["prefix_cache_hit_rate"]),
+        }
+
+    # --- drained-replica migration: sessions co-locate under affinity,
+    # the owning replica drains (chains demote to ITS host tier), and a
+    # continuation of its session resumes on the cold replica via the
+    # cross-replica KV pull — vs a kv_pull=False twin that re-prefills
+    # the whole prompt.  Zero prefix recompute means the cold replica
+    # prefills only the mandatory sub-block tail.
+    migration = None
+    if replicas >= 2:
+        hb = sessions * (prefix_len // block_size + 2) + 2 * nbper
+        # request i belongs to session i % sessions (build_trace), so the
+        # first `sessions` requests carry each session's shared prefix
+        prefixes = [reqs[j].prompt[:prefix_len] for j in range(sessions)]
+
+        def prep_migration(kv_pull):
+            # pressure-sized device pool: the trace itself exercises the
+            # demote/promote swap programs on BOTH replicas, so the timed
+            # migration below is compile-free on every side
+            router = fleet(2, host_blocks=hb, kv_pull=kv_pull,
+                           num_blocks=small)
+            gate(f"mig-pull{kv_pull}-trace", router.serve(reqs))
+            gate(f"mig-pull{kv_pull}-warm", router.serve(reqs))
+            # each session's home replica, then drain the busier home and
+            # continue EVERY migrated session on the survivor — the
+            # pull-vs-recompute gap scales with the migrated population
+            # instead of drowning in single-request timing noise
+            homes = []
+            for p in prefixes:
+                probe = [router.replicas[r].affinity_probe(
+                    np.concatenate([p, [0]])) for r in range(2)]
+                homes.append(int(np.argmax(
+                    [q["device_blocks"] + q["host_blocks"]
+                     for q in probe])))
+            rid0 = int(np.argmax([homes.count(r) for r in range(2)]))
+            migrated = [j for j, h in enumerate(homes) if h == rid0]
+            # short completion budgets on purpose: migration changes the
+            # PREFILL side (pull vs recompute the prefix), so the timed
+            # window is TTFT-shaped — a long decode tail would be the
+            # same on both sides and bury the difference
+            rng = np.random.default_rng(seed + 1)
+            conts = [Request(uid=f"mig{j}-{k}",
+                             prompt=np.concatenate(
+                                 [prefixes[j],
+                                  rng.integers(0, vocab, 9 + k)]),
+                             max_new_tokens=4)
+                     for j in migrated for k in range(2)]
+            seq_cont = {c.uid: seq_engine.generate(
+                c.prompt[None, :], max_new_tokens=c.max_new_tokens)[0]
+                for c in conts}
+            router.drain(rid0)
+            return router, router.replicas[1 - rid0], conts, seq_cont
+
+        def timed_migration(prep, tag):
+            router, tgt, conts, seq_cont = prep
+            # dispatch warmup outside the window: one session-free short
+            # request (sub-block prompt: no trie/host interaction) so the
+            # first timed iteration doesn't pay cold host caches for
+            # whatever ran since this fleet's prep
+            wrng = np.random.default_rng(seed + 2)
+            router.serve([Request(uid=f"warm-{tag}",
+                                  prompt=wrng.integers(0, vocab, 8),
+                                  max_new_tokens=2)])
+            pt0, ht0 = tgt.prompt_tokens, tgt.prefix_hit_tokens
+            t0 = time.perf_counter()
+            outs = router.serve(conts)
+            wall = time.perf_counter() - t0
+            for c in conts:
+                if not np.array_equal(seq_cont[c.uid], outs[c.uid]):
+                    mismatched.append((tag, c.uid))
+            recompute = (tgt.prompt_tokens - pt0) - \
+                (tgt.prefix_hit_tokens - ht0)
+            min_tail = sum(
+                len(c.prompt)
+                - ((len(c.prompt) - 1) // block_size) * block_size
+                for c in conts)
+            return wall, recompute, min_tail, conts
+
+        # prepare BOTH fleets first, then run the two timed windows
+        # back-to-back — wall drift on a shared box cannot favor one
+        prep_pull = prep_migration(True)
+        prep_re = prep_migration(False)
+        wall_pull, rec_pull, min_tail, conts = timed_migration(
+            prep_pull, "mig-pull")
+        wall_re, rec_re, _, _ = timed_migration(prep_re, "mig-recompute")
+        r_pull = prep_pull[0]
+        sp = r_pull.stats()
+        migration = {
+            "migrated_sessions": len(conts) // 2,
+            "continuations": len(conts),
+            "host_blocks": hb,
+            "kv_pulls": sp["kv_pulls"],
+            "kv_pull_blocks": sp["kv_pull_blocks"],
+            "kv_pull_bytes": sp["kv_pull_bytes"],
+            "drains": sp["drains"],
+            "wall_pull_s": wall_pull,
+            "wall_recompute_s": wall_re,
+            "speedup_pull_vs_recompute": wall_re / wall_pull,
+            "recompute_tokens_pull": int(rec_pull),
+            "recompute_tokens_baseline": int(rec_re),
+            "mandatory_tail_tokens": int(min_tail),
+            "zero_prefix_recompute": bool(rec_pull <= min_tail),
+        }
+
+    return {
+        "protocol": "multi-replica DP router (PR 11): busy-time scaling "
+                    "over 1->2->4 replicas, affinity-vs-round-robin hit "
+                    "rate, drained-replica KV-pull migration — all "
+                    "parity-gated vs sequential generate",
+        "methodology": "WEAK scaling: n replicas serve n x the traffic "
+                       "(requests*n over sessions*n) with per-replica "
+                       "load constant; a single process time-slices the "
+                       "replicas on the host CPU (each replica = one "
+                       "simulated accelerator), so aggregate_tok_s_busy "
+                       "— each replica's tokens over its own step() "
+                       "wall time, summed over 3 interleaved warm "
+                       "rounds — is the DP scaling signal; wall-clock "
+                       "tok/s is flat on a 1-core box by construction",
+        "trace": f"{sessions} sessions x {prefix_len}-token prefixes "
+                 f"(round-robin returns), tails {TAIL_RANGE}, new "
+                 f"{PREFIX_NEW_RANGE}",
+        "requests": requests,
+        "generated_tokens": gen_tokens,
+        "sequential": {"tok_s": gen_tokens / seq_wall, "wall_s": seq_wall},
+        "scaling": scaling,
+        "scaling_ratio_busy": ratios,
+        "affinity_vs_round_robin": aff_vs_rr,
+        "migration": migration,
+        "token_parity": not mismatched,
+        "mismatched": mismatched,
+        "model": f"gpt2-{layers}l-{hidden}d-{vocab}v ({dtype})",
+        "backend": __import__("jax").default_backend(),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=64)
@@ -748,6 +1098,13 @@ def main():
                          "asserted for both)")
     ap.add_argument("--swap-batch", type=int, default=8,
                     help="blocks per tiered-KV swap round trip")
+    ap.add_argument("--replicas", type=int, default=0, metavar="N",
+                    help="run the multi-replica router protocol "
+                         "(BENCH_r10) instead of the single-engine "
+                         "lanes: busy-time scaling over 1->2->4 "
+                         "replicas (capped at N), affinity vs "
+                         "round-robin, drained-replica KV-pull "
+                         "migration")
     ap.add_argument("--quant-suite", action="store_true",
                     help="run the BENCH_r07 protocol: mixed + prefix-heavy "
                          "+ decode-heavy traces with quantized lanes and a "
@@ -775,7 +1132,18 @@ def main():
               hidden=args.hidden, heads=args.heads, vocab=args.vocab,
               seed=args.seed, dtype=args.dtype, block_size=args.block_size,
               prefill_chunk=args.prefill_chunk)
-    if args.quant_suite:
+    if args.replicas > 1:
+        res = run_replica_bench(
+            replicas=args.replicas, requests=args.requests,
+            slots=args.slots, prefill_batch=args.prefill_batch,
+            layers=args.layers, hidden=args.hidden, heads=args.heads,
+            vocab=args.vocab, seed=args.seed, dtype=args.dtype,
+            block_size=args.block_size, prefill_chunk=args.prefill_chunk,
+            prefix_len=args.prefix_len or 192,
+            sessions=args.sessions or 9, swap_batch=args.swap_batch)
+        ok = res["token_parity"] and \
+            all(s["compile_budgets_ok"] for s in res["scaling"].values())
+    elif args.quant_suite:
         modes = quantize or ("kv8", "w8a8", "w8a8+kv8")
         # the protocol PROMISES a tp x kv8 combo point: default to tp=4
         # when --tp wasn't raised (needs >= 4 devices — run_bench exits

@@ -127,6 +127,51 @@ def init_inference(model=None, config=None, params=None, **kwargs):
     return InferenceEngine(model, config, params=params)
 
 
+def init_router(model=None, config=None, params=None, *, replicas=2,
+                policy="affinity", kv_pull=True, threaded=False,
+                router_trace_capacity=4096, **serving_kwargs):
+    """Multi-replica serving entry (ROADMAP item 1): ``replicas`` ×
+    ``init_serving`` engines — all sharing ONE weight pytree (the first
+    replica's initialized/loaded params are reused, so every replica is
+    token-identical by construction) — behind a
+    :class:`~deepspeed_tpu.serving.ReplicaRouter`.
+
+    The router fronts the fleet with an incremental async API:
+    ``submit(request, priority=, slo_class=)`` returns a streaming
+    :class:`~deepspeed_tpu.inference.serving.RequestHandle`
+    (``next_token`` / ``result()`` / ``cancel()``); ``serve(list)``
+    remains the batch convenience.  Routing is prefix-affinity first
+    (device trie + host tier probed by content-addressed chain key,
+    backed by a queued-prefix hint table), balanced by blocks-in-use;
+    with ``kv_pull`` (and ``host_blocks > 0`` in ``serving_kwargs``) a
+    request landing on a cold replica pulls its prefix blocks from
+    another replica's host tier instead of recomputing — and
+    ``router.drain(rid)`` / ``readmit(rid)`` migrate a whole replica's
+    sessions the same way without dropping requests
+    (``deepspeed_tpu/serving/``; docs/inference.md "Multi-replica
+    serving").
+
+    ``threaded=True`` + ``router.start()`` runs one worker thread per
+    replica; default off, the caller (or ``router.serve``) drives
+    ``step()`` deterministically.  All remaining keyword arguments go to
+    ``init_serving`` per replica — ``quantize=``, ``host_blocks=``,
+    ``spec_tokens=``, ``topology=`` (dp×tp: N replicas each tp-sharded)
+    compose unchanged, and each replica keeps its own sentry-enforced
+    compile budget (the router itself never traces a program)."""
+    from .serving import ReplicaRouter
+
+    reps = []
+    for _ in range(int(replicas)):
+        srv = init_serving(model, config, params, **serving_kwargs)
+        if params is None:
+            params = srv.engine.params
+        reps.append(srv)
+    return ReplicaRouter(
+        reps, policy=policy, kv_pull=kv_pull, threaded=threaded,
+        debug_checks=bool(serving_kwargs.get("debug_checks", False)),
+        trace_capacity=router_trace_capacity)
+
+
 def init_serving(model=None, config=None, params=None, *, slots=8,
                  max_seq_len=None, prompt_buckets=None, prefill_batch=4,
                  block_size=32, num_blocks=None, chunked_prefill=None,

@@ -56,6 +56,16 @@ the fault-injection test matrix in ``tests/unit/test_analysis.py``):
     previous owner's stale scales), contain only blocks with a nonzero
     refcount (a ledger entry surviving the free is a stale scale row
     waiting to be trusted), and never the scratch block.
+``router-request-uniqueness``
+    multi-replica router (``deepspeed_tpu/serving/``): every live
+    request is queued or active on EXACTLY ONE replica — a request on
+    two replicas would decode twice and race its own handle; a handle
+    the router maps to replica R whose request actually lives on S is a
+    lost cancel (``cancel`` would land on the wrong engine).
+``router-drain-quiesced``
+    a drained replica holds no pending or active requests — drain hands
+    everything off by contract, so anything left behind is a request no
+    worker thread will ever step again.
 ``residency-conservation``
     tiered-KV engines only (``host_blocks > 0``): every host-arena slot
     is exactly one of free / resident (owned by exactly one entry) /
@@ -342,6 +352,65 @@ def audit_host_store(store, staged_keys) -> None:
                 f"staged promotion references resident entry "
                 f"{_fmt_key(key)} that is NOT flagged in-flight — the "
                 "LRU could free its bytes mid-transfer")
+
+
+def audit_router(router) -> None:
+    """Verify the router-level invariants (module docstring:
+    ``router-request-uniqueness`` / ``router-drain-quiesced``) over a
+    :class:`~deepspeed_tpu.serving.ReplicaRouter`; raises
+    :class:`PagedStateError`.  Pure host state — runs after every
+    ``router.step()`` under ``debug_checks``; each engine's own paged
+    audit rides its engine-level flag."""
+    where = {}
+    for rid, rep in enumerate(router.replicas):
+        for item in rep._pending:
+            uid = item.req.uid
+            if uid in where:
+                raise PagedStateError(
+                    "router-request-uniqueness",
+                    f"request {uid!r} queued on replica {rid} but "
+                    f"already {where[uid][1]} on replica {where[uid][0]}")
+            where[uid] = (rid, "queued")
+        for st in rep._active.values():
+            uid = st.req.uid
+            if uid in where:
+                raise PagedStateError(
+                    "router-request-uniqueness",
+                    f"request {uid!r} active on replica {rid} but "
+                    f"already {where[uid][1]} on replica {where[uid][0]}")
+            where[uid] = (rid, "active")
+        if rid in router._drained and (rep._pending or rep._active) and \
+                rid not in getattr(router, "_worker_errors", {}):
+            # a crash-failed replica is drained WITH its (cancelled)
+            # requests left in place — its engine state is suspect, so
+            # drain's hand-off contract deliberately does not apply
+            raise PagedStateError(
+                "router-drain-quiesced",
+                f"replica {rid} is drained but still holds "
+                f"{len(rep._pending)} queued / {len(rep._active)} active "
+                "request(s) — nothing will ever step them")
+    failed = set(getattr(router, "_worker_errors", {}))
+    for uid, (handle, rid) in router._handles.items():
+        if handle.done:
+            if uid in where and where[uid][0] not in failed:
+                # crash-failed replicas keep their (cancelled) requests
+                # in place by design — same exemption as drain-quiesced
+                raise PagedStateError(
+                    "router-request-uniqueness",
+                    f"request {uid!r} handle says {handle.status} but it "
+                    f"is still {where[uid][1]} on replica {where[uid][0]}")
+        else:
+            if uid not in where:
+                raise PagedStateError(
+                    "router-request-uniqueness",
+                    f"request {uid!r} handle says {handle.status} but no "
+                    "replica holds it — the request was lost")
+            if where[uid][0] != rid:
+                raise PagedStateError(
+                    "router-request-uniqueness",
+                    f"request {uid!r} is mapped to replica {rid} but "
+                    f"lives on replica {where[uid][0]} — cancel would "
+                    "land on the wrong engine")
 
 
 def audit_serving_engine(srv, active) -> None:

@@ -55,7 +55,14 @@ class ElasticAgent:
                  master_port: int = 29500,
                  monitor_interval: float = 5.0,
                  max_restarts: int = 100,
-                 partial_grace_ticks: int = 3):
+                 partial_grace_ticks: int = 3,
+                 elect_all: bool = False):
+        #: serving-replica supervision (launcher --serve): elect every
+        #: live host, no elastic batch constraint.  An EXPLICIT flag on
+        #: purpose — keying this off a missing/disabled elasticity config
+        #: block would silently launch a mis-configured TRAINING run on
+        #: every host instead of failing fast at election.
+        self.elect_all = bool(elect_all)
         self.ds_config = ds_config
         self.probe_hosts = probe_hosts
         self.launch_cmd = launch_cmd
@@ -89,7 +96,22 @@ class ElasticAgent:
                     verbose: bool = True) -> List[str]:
         """Largest prefix of ``hosts`` whose chip count is elastic-valid.
         ``verbose=False`` for the steady-state monitor probe (the election
-        log belongs to starts/restarts, not every tick)."""
+        log belongs to starts/restarts, not every tick).
+
+        Under ``elect_all`` (serving-replica supervision,
+        ``launcher/runner.py --serve``) there is no batch constraint to
+        satisfy — every live host runs one independent engine replica,
+        so all of them are elected and the agent's value is purely its
+        restart/membership machinery.  Training runs (no flag) still
+        fail fast through ``compute_elastic_config`` on a missing or
+        disabled elasticity block."""
+        if self.elect_all:
+            if not hosts:
+                raise RuntimeError("no hosts available to elect")
+            if verbose:
+                logger.info(f"elastic: electing all {len(hosts)} hosts "
+                            "(no elastic batch constraint — replica mode)")
+            return list(hosts)
         final_batch, valid_counts = compute_elastic_config(
             self.ds_config, world_size=0)
         best: Optional[int] = None

@@ -208,17 +208,28 @@ class PrefixCache:
 
     def probe(self, tokens: Sequence[int], max_tokens: int) -> int:
         """Number of leading full blocks of ``tokens[:max_tokens]`` present
-        in the trie — no refcounts touched (admission-gate peek)."""
+        in the trie — no refcounts touched (admission-gate peek).
+        Exactly ``len(chain_blocks(...))`` so the admission gate and the
+        router/export peek can never walk the trie differently."""
+        return len(self.chain_blocks(tokens, max_tokens))
+
+    def chain_blocks(self, tokens: Sequence[int],
+                     max_tokens: int) -> List[int]:
+        """Physical block ids of the cached leading chain of
+        ``tokens[:max_tokens]`` — :meth:`probe`'s block-id twin: no
+        refcounts touched and no LRU recency (a router/export peek must
+        not perturb eviction order)."""
         bs = self.block_size
-        parent_uid, n = 0, 0
-        for i in range(min(len(tokens), max_tokens) // bs):
+        parent_uid, out = 0, []
+        for i in range(min(len(tokens), int(max_tokens)) // bs):
             e = self._entries.get(
                 (parent_uid, tuple(int(t) for t in
                                    tokens[i * bs:(i + 1) * bs])))
             if e is None:
                 break
-            parent_uid, n = e.uid, n + 1
-        return n
+            parent_uid = e.uid
+            out.append(int(e.block))
+        return out
 
     def lookup(self, tokens: Sequence[int], max_tokens: int,
                allocator: BlockAllocator) -> List[int]:
@@ -448,6 +459,29 @@ class HostBlockStore:
 
     def mark_in_flight(self, key: bytes, flag: bool = True) -> None:
         self._entries[key].in_flight = bool(flag)
+
+    def export_chain(self, keys: Sequence[bytes]) -> List[List[np.ndarray]]:
+        """Per-block, per-leaf byte COPIES of resident blocks — the
+        cross-replica KV-pull wire format: a snapshot, so later LRU
+        eviction or promotion on THIS store cannot tear the exported
+        bytes mid-transfer.  Quantized pools' int8 codes and scale rows
+        are separate leaves of the same block, so they export together
+        by construction."""
+        return [[np.array(a) for a in self.read(k)] for k in keys]
+
+    def import_chain(self, keys: Sequence[bytes],
+                     blocks: Sequence[Sequence[np.ndarray]]) -> int:
+        """Store an exported chain (same order as :meth:`export_chain`);
+        stops at the first refused ``put`` (arena saturated with
+        in-flight entries) so the imported run stays contiguous — a
+        holed chain would be unreachable past the hole anyway
+        (``probe_run`` walks contiguously).  Returns blocks stored."""
+        n = 0
+        for key, arrs in zip(keys, blocks):
+            if self.put(key, arrs) is None:
+                break
+            n += 1
+        return n
 
     def probe_run(self, tokens, start_block: int, max_tokens: int,
                   block_size: int) -> List[bytes]:

@@ -157,6 +157,28 @@ brackets the first ``profile_iters`` scheduler iterations with a
 contract: near-free when idle, ≤2% aggregate tok/s when fully enabled
 (pinned by the ``--telemetry-bench`` serving-bench lane, BENCH_r08).
 
+**Incremental serving API** (PR 11): the scheduler state (pending queue,
+active slots) lives on the engine, not inside one ``serve()`` call.
+``submit(request, priority=, slo_class=)`` enqueues a request and returns
+a :class:`RequestHandle` with per-token streaming (``next_token``,
+``tokens``), blocking ``result()``, and ``cancel()``; ``step()`` runs ONE
+scheduler iteration (admit → prefill → decode → prefetch → audit) and
+returns whether work remains; ``cancel(uid)`` drops a queued request
+immediately and releases an active slot (blocks decref'd, ``cancelled``
+timeline event) at the next iteration boundary — the only point the
+paged-state invariants are guaranteed to hold.  ``drain()`` quiesces the
+engine for a replica handoff: every active slot preempts (with the host
+tier, committed blocks demote first), the remaining prefix-cache content
+demotes, and the whole pending queue is handed back for re-submission
+elsewhere (``deepspeed_tpu/serving/`` routes it).  The batch
+``serve(list)`` entry point survives as a thin wrapper — submit all,
+loop ``step()``, gather results — with byte-identical scheduling, and
+tolerates an empty request list without tracing anything.  Admission
+stays head-of-queue-gated (no starvation) but the queue is now
+priority-ordered: higher ``priority`` (or an ``slo_class`` mapped
+through ``SLO_PRIORITY``) admits first; preemption resumes still jump
+to the very front regardless of class (they hold admission recency).
+
 Greedy decoding only: per-request outputs are token-identical to
 sequential ``generate`` (pinned in ``tests/unit/test_serving.py``,
 ``tests/unit/test_paged_serving.py``, ``tests/unit/test_spec_decode.py``,
@@ -168,6 +190,7 @@ from __future__ import annotations
 
 import dataclasses
 import inspect
+import threading
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -299,6 +322,174 @@ class Request:
                              "be >= 1")
 
 
+#: ``slo_class`` -> default admission priority (``submit``): an SLO class
+#: is a coarse priority band with a stable name — explicit ``priority=``
+#: (nonzero) always wins over the class default
+SLO_PRIORITY = {"realtime": 2, "interactive": 1, "standard": 0, "batch": -1}
+
+
+class RequestHandle:
+    """Live view of one submitted request (``ServingEngine.submit`` /
+    ``ReplicaRouter.submit``): per-token streaming, completion, and
+    cancellation.
+
+    The engine side appends committed tokens as the scheduler emits them
+    (prefill first token, decode steps, speculative accepts); the caller
+    side reads them — ``tokens()`` for everything so far, ``next_token``
+    for a streaming cursor (blocking when a worker thread drives the
+    engine, ``timeout=0`` when the caller drives ``step()`` itself), and
+    ``result()`` for the final padded ``[prompt + completion]`` array
+    (``None`` if the request was cancelled).  A preemption keeps the
+    handle: already-streamed tokens stand (greedy resume recomputes the
+    identical sequence), and fresh tokens continue on the same handle —
+    including across a replica drain handoff.  All state transitions run
+    under one condition variable, so the handle is safe to read from a
+    different thread than the scheduler's."""
+
+    def __init__(self, request: Request, *, priority: int = 0,
+                 slo_class: Optional[str] = None, canceller=None):
+        self.request = request
+        self.uid = request.uid
+        self.priority = int(priority)
+        self.slo_class = slo_class
+        self.status = "queued"        # -> "active" -> "finished"|"cancelled"
+        self._tokens: List[int] = []
+        self._result: Optional[np.ndarray] = None
+        self._cond = threading.Condition()
+        self._cursor = 0
+        self._canceller = canceller
+
+    # ---- engine-side transitions (scheduler thread)
+    def _on_active(self) -> None:
+        with self._cond:
+            if self.status == "queued":
+                self.status = "active"
+            self._cond.notify_all()
+
+    def _on_tokens(self, toks) -> None:
+        with self._cond:
+            self._tokens.extend(int(t) for t in toks)
+            self._cond.notify_all()
+
+    def _on_finish(self, result: np.ndarray) -> None:
+        with self._cond:
+            self._result = result
+            self.status = "finished"
+            self._cond.notify_all()
+
+    def _on_cancel(self) -> None:
+        with self._cond:
+            self.status = "cancelled"
+            self._cond.notify_all()
+
+    # ---- caller side
+    @property
+    def done(self) -> bool:
+        return self.status in ("finished", "cancelled")
+
+    def tokens(self) -> List[int]:
+        """Every token committed so far (a copy)."""
+        with self._cond:
+            return list(self._tokens)
+
+    def cancel(self) -> bool:
+        """Cancel via whoever owns the request now (engine or router);
+        ``False`` if it already finished."""
+        return bool(self._canceller and self._canceller(self.uid))
+
+    def next_token(self, timeout: Optional[float] = None) -> Optional[int]:
+        """Streaming cursor: the next committed token, or ``None`` once
+        the request is finished/cancelled (or ``timeout`` seconds pass
+        with nothing new — pass ``timeout=0`` when the caller itself
+        drives ``step()``, blocking would deadlock there)."""
+        with self._cond:
+            self._cond.wait_for(
+                lambda: self._cursor < len(self._tokens) or self.done,
+                timeout)
+            if self._cursor < len(self._tokens):
+                tok = self._tokens[self._cursor]
+                self._cursor += 1
+                return tok
+            return None
+
+    def result(self, timeout: Optional[float] = None) -> Optional[np.ndarray]:
+        """Block until completion; the padded ``[prompt + completion]``
+        array (``serve`` semantics), or ``None`` if cancelled.  Raises
+        ``TimeoutError`` if ``timeout`` expires first."""
+        with self._cond:
+            if not self._cond.wait_for(lambda: self.done, timeout):
+                raise TimeoutError(
+                    f"request {self.uid!r} still {self.status} after "
+                    f"{timeout}s")
+            return self._result
+
+
+@dataclasses.dataclass
+class _PendingItem:
+    """One queued request plus its resume/streaming context — what the
+    pending queue holds and what ``drain()`` hands a router."""
+    req: Request
+    prior: List[int]               # tokens generated before a preemption
+    priority: int = 0
+    slo_class: Optional[str] = None
+    eos: Optional[int] = None
+    handle: Optional[RequestHandle] = None
+    _order: tuple = (0, 0)         # (-priority, seq) — queue sort key
+
+
+class _PendingQueue:
+    """Priority-then-FIFO admission queue.
+
+    Items sort by ``(-priority, submit seq)`` — higher priority first,
+    FIFO within a class — except preemption resumes (``push_front``),
+    which jump ahead of EVERYTHING: the resumed sequence holds admission
+    recency and the scheduler's no-starvation gate reasons about the
+    literal queue head."""
+
+    def __init__(self):
+        self._items: List[_PendingItem] = []
+        self._seq = 0
+        self._front = -1
+
+    def push(self, item: _PendingItem) -> None:
+        item._order = (-int(item.priority), self._seq)
+        self._seq += 1
+        i = len(self._items)
+        while i > 0 and self._items[i - 1]._order > item._order:
+            i -= 1
+        self._items.insert(i, item)
+
+    def push_front(self, item: _PendingItem) -> None:
+        item._order = (-(1 << 30), self._front)
+        self._front -= 1
+        self._items.insert(0, item)
+
+    def popleft(self) -> _PendingItem:
+        return self._items.pop(0)
+
+    def remove(self, uid) -> Optional[_PendingItem]:
+        for i, item in enumerate(self._items):
+            if item.req.uid == uid:
+                return self._items.pop(i)
+        return None
+
+    def drain(self) -> List[_PendingItem]:
+        items, self._items = self._items, []
+        return items
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __bool__(self) -> bool:
+        return bool(self._items)
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def __getitem__(self, i) -> _PendingItem:
+        return self._items[i]
+
+
 @dataclasses.dataclass
 class _SlotState:
     req: Request
@@ -308,6 +499,10 @@ class _SlotState:
     out: List[int] = dataclasses.field(default_factory=list)
     base: int = 0                  # tokens already in the paged cache
     phase: str = "prefill"         # "prefill" -> "decode"
+    eos: Optional[int] = None      # per-request eos (submit-time)
+    priority: int = 0
+    slo_class: Optional[str] = None
+    handle: Optional[RequestHandle] = None
 
     @property
     def plen_eff(self) -> int:
@@ -724,6 +919,15 @@ class ServingEngine:
             "serving_spec_accepted_tokens_total", "draft tokens accepted")
         self._c_finished = m.counter(
             "serving_requests_finished_total", "requests run to completion")
+        self._c_cancelled = m.counter(
+            "serving_requests_cancelled_total",
+            "requests cancelled before completion (queued or active)")
+        self._c_gen_tokens = m.counter(
+            "serving_generated_tokens_total",
+            "tokens committed across all requests (prefill first tokens, "
+            "decode steps, accepted speculative drafts)")
+        self._g_queue_depth = m.gauge(
+            "serving_queue_depth", "requests waiting for a slot")
         self._c_invariant_checks = m.counter(
             "serving_invariant_checks_total",
             "paged-state audits run (analysis/invariants.py)")
@@ -776,6 +980,16 @@ class ServingEngine:
         self._trace_times: Dict[Any, Dict[str, Any]] = {}
         self._admit_seq = 0
         self._blocked_gate = None          # (head id, resume len, version)
+        # ----- incremental scheduler state (module docstring "Incremental
+        # serving API"): the pending queue and active slot map live on the
+        # engine so submit()/step()/cancel()/drain() can drive the same
+        # scheduler serve() wraps
+        self._pending = _PendingQueue()
+        self._active: Dict[int, _SlotState] = {}
+        self._live_uids: set = set()       # pending + active uids, O(1)
+        self._cancel_flags: set = set()    # active-slot cancels, applied at
+        self._admission_log = None         # the next iteration boundary
+        self._step_log = None
         log_dist(
             f"ServingEngine: slots={self.slots}, cache_len="
             f"{self._cache_len}, block_size={self.block_size}, "
@@ -886,13 +1100,14 @@ class ServingEngine:
         if self._decode_fn is None:
             fwd, prepare = self._fwd, self.engine._prepare
 
-            def step(params, cache, tokens, lengths, block_tables):
+            def decode_step(params, cache, tokens, lengths, block_tables):
                 logits, cache = fwd(prepare(params), tokens[:, None], cache,
                                     0, lengths=lengths,
                                     block_tables=block_tables)
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
-            self._decode_fn = jax.jit(self.sentry.wrap(step, "decode"),
+            self._decode_fn = jax.jit(self.sentry.wrap(decode_step,
+                                                       "decode"),
                                       donate_argnums=self._donate())
             self.compiled_programs.append(("decode", self.slots))
         return self._decode_fn
@@ -978,7 +1193,7 @@ class ServingEngine:
             def propose(dparams, dcache, tokens, lengths, block_tables):
                 dp = dprepare(dparams)
 
-                def step(carry, _):
+                def rollout_step(carry, _):
                     tok, lens, cache = carry
                     logits, cache = dfwd(dp, tok[:, None], cache, 0,
                                          lengths=lens,
@@ -987,7 +1202,7 @@ class ServingEngine:
                     return (nxt, lens + 1, cache), nxt
 
                 (_, _, dcache), drafts = jax.lax.scan(
-                    step, (tokens, lengths, dcache), None, length=k)
+                    rollout_step, (tokens, lengths, dcache), None, length=k)
                 return drafts.T, dcache            # [slots, K]
 
             self._draft_fn = jax.jit(
@@ -1053,6 +1268,25 @@ class ServingEngine:
                 donate_argnums=(0,) if self._donate() else ())
             self.compiled_programs.append(("kv_promote", self.swap_batch))
         return self._promote_fn
+
+    def warm_swap_programs(self) -> None:
+        """Compile the fixed-shape ``kv_demote``/``kv_promote`` pair ahead
+        of traffic with a no-op round trip through the scratch block
+        (gather scratch, scatter it back onto itself — byte-neutral by
+        construction).  Without this, the first real demotion or
+        promotion pays the compile inside a latency-sensitive admission —
+        the router calls it on drain targets before migrated sessions
+        land.  No-op when the tier is off; the programs are the same two
+        sentry-registered entries either way (budget unchanged)."""
+        if self._host is None:
+            return
+        if self._demote_fn is not None and self._promote_fn is not None:
+            return                          # both already compiled
+        ids = jnp.zeros(self.swap_batch, jnp.int32)
+        with self._tp_ctx():
+            staged = self._get_demote_fn()(self._swap_pools(), ids)
+            self._set_swap_pools(
+                self._get_promote_fn()(self._swap_pools(), staged, ids))
 
     def _demote_blocks(self, blocks: List[int], keys: List[bytes]) -> int:
         """Copy the given device blocks into the host arena under their
@@ -1175,7 +1409,8 @@ class ServingEngine:
         the double-buffered H2D overlap (``runtime/zero/param_stream.py``
         does the same for ZeRO-3 parameters)."""
         n = 0
-        for req, prior in pending:
+        for item in pending:
+            req, prior = item.req, item.prior
             if n >= 2 or len(self._staged) >= 2:   # double buffer
                 break
             n += 1
@@ -1368,23 +1603,25 @@ class ServingEngine:
         self._tokens[slot] = 0
         self._lengths[slot] = 0
 
-    def _preempt(self, slot: int, active, pending) -> None:
+    def _preempt(self, slot: int) -> None:
         """Evict a sequence under block pressure: free its blocks and
         re-queue it at the FRONT with generated tokens folded into the
         prompt (greedy => recompute is token-exact).  With the host tier
         the victim's committed full blocks demote first, so the resume's
         "recompute" promotes them back instead of re-running prefill."""
-        st = active.pop(slot)
+        st = self._active.pop(slot)
         nblocks = len(self._held[slot])
         if self._host is not None:
             self._demote_slot_blocks(slot, st)
         self._release_slot(slot)
-        pending.appendleft((st.req, st.prior + st.out))
+        self._pending.push_front(_PendingItem(
+            req=st.req, prior=st.prior + st.out, priority=st.priority,
+            slo_class=st.slo_class, eos=st.eos, handle=st.handle))
         self._c_preempted.inc()
         self.timeline.instant("preempt", uid=str(st.req.uid), slot=slot,
                               blocks_freed=nblocks)
 
-    def _alloc_block(self, active, pending, requester: int) -> Optional[int]:
+    def _alloc_block(self, requester: int) -> Optional[int]:
         """One fresh block, reclaiming in order: free list -> LRU prefix-
         cache eviction -> preempting the latest-admitted sequence.  Returns
         ``None`` iff the requester itself was preempted."""
@@ -1407,28 +1644,29 @@ class ServingEngine:
                         self.timeline.instant("evict_block",
                                               block=int(evicted))
                         continue
-            victim = max(active, key=lambda s: active[s].admit_seq)
-            if victim == requester and len(active) == 1:
+            victim = max(self._active,
+                         key=lambda s: self._active[s].admit_seq)
+            if victim == requester and len(self._active) == 1:
                 # cannot happen when num_blocks >= nbper+1 (ctor check)
                 raise RuntimeError(
                     "paged KV pool too small for a single sequence")
-            self._preempt(victim, active, pending)
+            self._preempt(victim)
             if victim == requester:
                 return None
 
-    def _ensure_blocks(self, slot: int, active, pending, upto: int) -> bool:
+    def _ensure_blocks(self, slot: int, upto: int) -> bool:
         """Make the slot's table cover positions ``[0, upto)``; may preempt
         other slots (or the slot itself — returns False)."""
         for li in range(blocks_for(upto, self.block_size)):
-            if slot not in active:
+            if slot not in self._active:
                 return False
             if self._tables[slot, li] == 0:
-                b = self._alloc_block(active, pending, requester=slot)
+                b = self._alloc_block(requester=slot)
                 if b is None:
                     return False
                 self._tables[slot, li] = b
                 self._held[slot].append(b)
-        return slot in active
+        return slot in self._active
 
     # --------------------------------------------------------------- schedule
     def _bucket_for(self, prompt_len: int) -> int:
@@ -1451,16 +1689,18 @@ class ServingEngine:
                 return max(2, b)
         return self._cache_len
 
-    def _admit(self, pending, active, admission_log):
-        """Strict-FIFO admission into free slots, gated on block
-        availability (free + prefix-evictable) so an admitted sequence can
-        always prefill its prompt; the queue head blocks admission when it
-        doesn't fit — no starvation."""
-        joiners = []
+    def _admit(self):
+        """Head-of-queue-gated admission into free slots (priority order,
+        module docstring), gated on block availability (free + prefix-
+        evictable) so an admitted sequence can always prefill its prompt;
+        the queue head blocks admission when it doesn't fit — no
+        starvation within a priority class."""
+        pending, active = self._pending, self._active
         free = [s for s in range(self.slots) if s not in active]
         reserved = 0                       # blocks promised to this call's
         while pending and free:            # earlier joiners, not yet alloc'd
-            req, prior = pending[0]
+            item = pending[0]
+            req, prior = item.req, item.prior
             # blocked-head memo: while nothing refcount-related moved, the
             # gate's probe/evictable answer cannot change — skip the
             # O(prompt + trie) host walk every idle iteration
@@ -1521,11 +1761,15 @@ class ServingEngine:
             self._held[slot] = list(hits)
             st = _SlotState(req=req, admit_seq=self._admit_seq,
                             prompt_eff=prompt_eff, prior=list(prior),
-                            base=len(hits) * self.block_size)
+                            base=len(hits) * self.block_size,
+                            eos=item.eos, priority=item.priority,
+                            slo_class=item.slo_class, handle=item.handle)
             self._admit_seq += 1
             active[slot] = st
-            joiners.append((slot, st))
-            admission_log.append((req.uid, slot))
+            if st.handle is not None:
+                st.handle._on_active()
+            if self._admission_log is not None:
+                self._admission_log.append((req.uid, slot))
             self._c_admitted.inc()
             self._c_prompt_tokens.inc(plen)
             self._c_prefix_hit_tokens.inc(st.base)
@@ -1539,8 +1783,288 @@ class ServingEngine:
                                   prompt_tokens=plen,
                                   prefix_hit_tokens=st.base,
                                   resumed=bool(prior))
-        return joiners
 
+    # --------------------------------------------------- incremental serving
+    def _validate_request(self, r: Request) -> None:
+        total = len(r.prompt) + r.max_new_tokens
+        if total > self.max_seq_len:
+            raise ValueError(
+                f"request {r.uid!r}: prompt ({len(r.prompt)}) + "
+                f"max_new_tokens ({r.max_new_tokens}) = {total} exceeds "
+                f"max_seq_len {self.max_seq_len}")
+        if not self.chunked_prefill:
+            self._bucket_for(len(r.prompt))  # raises if no bucket fits
+
+    def _session_boundary_reset(self) -> None:
+        """First submit into an idle engine: object ids and prefetch gates
+        from the previous trace are stale (the per-call reset ``serve``
+        used to do)."""
+        if self._pending or self._active:
+            return
+        self._blocked_gate = None
+        if self._host is not None:
+            self._discard_all_staged()
+            self._prefetch_gate.clear()
+
+    def submit(self, request: Request, *, priority: int = 0,
+               slo_class: Optional[str] = None,
+               eos_token_id: Optional[int] = None) -> RequestHandle:
+        """Enqueue one request into the live scheduler and return its
+        :class:`RequestHandle` (per-token streaming, ``result()``,
+        ``cancel()``).  Admission happens on subsequent ``step()`` calls
+        (``serve()`` and the replica router drive them).  ``priority``
+        orders the pending queue (higher admits first; FIFO within a
+        class); ``slo_class`` maps to a default priority through
+        :data:`SLO_PRIORITY` when ``priority`` is 0."""
+        self._validate_request(request)
+        if request.uid in self._live_uids:
+            raise ValueError(
+                f"request uid {request.uid!r} is already in flight")
+        self._session_boundary_reset()
+        if priority == 0 and slo_class is not None:
+            priority = SLO_PRIORITY.get(str(slo_class), 0)
+        handle = RequestHandle(request, priority=priority,
+                               slo_class=slo_class, canceller=self.cancel)
+        self._pending.push(_PendingItem(
+            req=request, prior=[], priority=priority, slo_class=slo_class,
+            eos=eos_token_id, handle=handle))
+        self._live_uids.add(request.uid)
+        self._g_queue_depth.set(len(self._pending))
+        self.timeline.instant("submit", uid=str(request.uid),
+                              priority=int(priority),
+                              slo=str(slo_class) if slo_class else "")
+        return handle
+
+    def _submit_item(self, item: _PendingItem) -> None:
+        """Router handoff entry: enqueue a fully-formed pending item (an
+        in-flight request drained off another replica), keeping its
+        handle, prior tokens, priority, and eos — token streaming
+        continues on the same handle."""
+        self._validate_request(item.req)
+        if item.req.uid in self._live_uids:
+            raise ValueError(
+                f"request uid {item.req.uid!r} is already in flight")
+        self._session_boundary_reset()
+        if item.handle is not None:
+            item.handle._canceller = self.cancel
+        self._pending.push(item)
+        self._live_uids.add(item.req.uid)
+        self._g_queue_depth.set(len(self._pending))
+        self.timeline.instant("submit", uid=str(item.req.uid),
+                              priority=int(item.priority),
+                              resumed=bool(item.prior))
+
+    def _cancel_pending(self, uid) -> bool:
+        item = self._pending.remove(uid)
+        if item is None:
+            return False
+        self._live_uids.discard(uid)
+        if self._host is not None:
+            rec = self._staged.pop(uid, None)
+            if rec is not None:
+                self._unflag_keys(rec["keys"])
+        self._prefetch_gate.pop(uid, None)
+        self._blocked_gate = None          # the head may have been this item
+        self._trace_times.pop(uid, None)
+        self._c_cancelled.inc()
+        self._g_queue_depth.set(len(self._pending))
+        self.timeline.instant("cancelled", uid=str(uid), queued=True)
+        if item.handle is not None:
+            item.handle._on_cancel()
+        return True
+
+    def cancel(self, uid) -> bool:
+        """Cancel a live request.  Queued: dropped immediately (its staged
+        prefetch, if any, rolls back).  Active: the slot and its blocks
+        release at the NEXT scheduler-iteration boundary — the only point
+        the paged-state invariants are guaranteed to hold — with a
+        ``cancelled`` timeline event; already-streamed tokens stand.
+        Returns ``False`` when the uid is unknown or already finished."""
+        if self._cancel_pending(uid):
+            return True
+        if any(st.req.uid == uid for st in self._active.values()):
+            self._cancel_flags.add(uid)
+            return True
+        return False
+
+    def _process_cancellations(self) -> None:
+        """Apply deferred active-slot cancels at the iteration boundary:
+        pop the slot, decref its blocks (prefix-shared blocks survive in
+        the trie), and emit the audited ``cancelled`` event."""
+        if not self._cancel_flags:
+            return
+        flags, self._cancel_flags = self._cancel_flags, set()
+        for uid in flags:
+            slot = next((s for s, st in self._active.items()
+                         if st.req.uid == uid), None)
+            if slot is None:
+                # finished before the boundary, or preempted back to the
+                # queue — the pending path handles the latter
+                self._cancel_pending(uid)
+                continue
+            st = self._active.pop(slot)
+            nblocks = len(self._held[slot])
+            self._release_slot(slot)
+            self._live_uids.discard(uid)
+            self._trace_times.pop(uid, None)
+            self._c_cancelled.inc()
+            self.timeline.instant("cancelled", uid=str(uid), slot=slot,
+                                  blocks_freed=nblocks)
+            if st.handle is not None:
+                st.handle._on_cancel()
+
+    def step(self) -> bool:
+        """ONE scheduler iteration over the live queue/slots: process
+        cancellations, admit, advance prefills, run the decode (or
+        draft–verify) round, stage prefetches, audit.  Returns whether
+        work remains — drive it in a loop (``serve``), from a replica
+        worker thread (``deepspeed_tpu/serving/``), or by hand."""
+        self._process_cancellations()
+        if not self._pending and not self._active:
+            if self._host is not None:
+                self._discard_all_staged()  # no queue left to consume them
+            self._g_queue_depth.set(0)
+            return False
+        params = self.engine.params
+        self._c_iterations.inc()
+        admitted0, preempted0 = self.admitted, self.preempted
+        self._admit()
+        self._run_prefill(params)
+        # one decode step over every slot (per-sequence positions);
+        # prefilling/empty slots point at the scratch block.  In
+        # speculative mode the single-token step is replaced by a
+        # draft–verify round committing up to K+1 tokens per slot.
+        if self.spec_tokens:
+            self._run_spec_decode(params)
+        else:
+            self._run_plain_decode(params)
+        if self._host is not None:
+            # stage next iteration's promotions NOW: the H2D copies
+            # run while the next decode step computes (module
+            # docstring "Tiered KV cache" — the param_stream overlap)
+            self._issue_prefetch(self._pending)
+        self._g_queue_depth.set(len(self._pending))
+        if self._step_log is not None:
+            self._step_log.append({
+                "iteration": self.iterations,
+                "admitted": self.admitted - admitted0,
+                "evicted": self.preempted - preempted0,
+                "blocks_in_use": self._alloc.blocks_in_use,
+            })
+        if self.debug_checks:
+            # O(blocks) host-state audit between scheduler rounds —
+            # the scheduler's state is only guaranteed consistent at
+            # iteration boundaries (analysis/invariants.py; the audit
+            # drops its own event on the timeline)
+            audit_serving_engine(self, self._active)
+            self._c_invariant_checks.inc()
+        return bool(self._pending or self._active)
+
+    def drain(self) -> List[_PendingItem]:
+        """Quiesce this engine for a replica handoff (router drain
+        protocol): preempt every active slot — with the host tier, each
+        victim's committed full blocks demote first and its generated
+        tokens fold into the resume prompt — then demote the remaining
+        prefix-cache content to the host tier, and hand back the whole
+        pending queue for re-submission elsewhere
+        (``ReplicaRouter._submit_item`` on another replica).  After a
+        drain the device pool is fully free; the host tier is the
+        replica's exportable session store (``host_chain_export``)."""
+        self._process_cancellations()
+        for slot in sorted(self._active,
+                           key=lambda s: -self._active[s].admit_seq):
+            self._preempt(slot)
+        if self._host is not None and self._prefix is not None:
+            while self._demote_evict_batch():
+                pass
+            self._discard_all_staged()
+            self._prefetch_gate.clear()
+        items = self._pending.drain()
+        self._blocked_gate = None
+        for item in items:
+            # the latency span can only finish on the engine that admits
+            # the resume; this engine's stamp would dangle forever
+            self._trace_times.pop(item.req.uid, None)
+            self._live_uids.discard(item.req.uid)
+        self._g_queue_depth.set(0)
+        self.timeline.instant("drain", handoff=len(items),
+                              host_blocks_in_use=(
+                                  self._host.blocks_in_use
+                                  if self._host is not None else 0))
+        return items
+
+    # ---------------------------------------------------- router probes/pull
+    def affinity_probe(self, tokens) -> Dict[str, int]:
+        """Routing probe (read-mostly, O(prompt)): leading full-block
+        depth of ``tokens`` resident on this replica — device trie plus
+        host-tier continuation — and the load signals the router balances
+        on.  Capped at ``len(tokens) - 1`` exactly like admission's own
+        lookup, so the reported depth is what an admitted request would
+        actually reuse."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        plen = int(tokens.size)
+        n_dev = self._prefix.probe(tokens, plen - 1) \
+            if self._prefix is not None else 0
+        n_host = len(self._host.probe_run(tokens, n_dev, plen - 1,
+                                          self.block_size)) \
+            if self._host is not None else 0
+        return {"device_blocks": int(n_dev), "host_blocks": int(n_host),
+                "blocks_in_use": int(self._alloc.blocks_in_use),
+                "queue_depth": len(self._pending),
+                "active": len(self._active)}
+
+    def demote_chain(self, tokens, max_tokens: Optional[int] = None,
+                     start_block: int = 0) -> int:
+        """Snapshot the device-trie-resident chain of ``tokens`` into the
+        host tier (cross-replica export): the trie keeps its entries and
+        blocks — this copies bytes DOWN so ``host_chain_export`` can read
+        them (the dedup-by-chain-key rule makes the device/host copy pair
+        safe: a later eviction sees the key resident and just frees the
+        device block).  ``start_block`` skips blocks the importer already
+        holds — a pull for the chain's suffix must not D2H-copy (and
+        LRU-churn the arena with) the prefix nobody will read.  Returns
+        blocks newly stored."""
+        if self._host is None or self._prefix is None:
+            return 0
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        mt = int(tokens.size) if max_tokens is None else int(max_tokens)
+        blocks = self._prefix.chain_blocks(tokens, mt)
+        if len(blocks) <= int(start_block):
+            return 0
+        keys = chain_keys(tokens, len(blocks), self.block_size)
+        pairs = [(b, k) for b, k in
+                 list(zip(blocks, keys))[int(start_block):]
+                 if not self._host.has(k)]
+        if not pairs:
+            return 0
+        return self._demote_blocks([b for b, _ in pairs],
+                                   [k for _, k in pairs])
+
+    def host_chain_export(self, tokens, start_block: int = 0,
+                          max_tokens: Optional[int] = None):
+        """``(keys, per-block per-leaf byte COPIES)`` of the host-resident
+        run of ``tokens`` from ``start_block`` on — the cross-replica
+        KV-pull wire format (``HostBlockStore.export_chain``): the same
+        content-addressed chain keys name the blocks on every replica,
+        and quantized ``{qp, ps}`` records travel as ordinary leaves so
+        int8 codes and scale rows move together, bit-identically."""
+        if self._host is None:
+            return [], []
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        mt = int(tokens.size) if max_tokens is None else int(max_tokens)
+        keys = self._host.probe_run(tokens, start_block, mt,
+                                    self.block_size)
+        return keys, self._host.export_chain(keys)
+
+    def host_chain_import(self, keys, blocks) -> int:
+        """Store a pulled chain into this replica's host tier (admission
+        then promotes it on-device through the ordinary fixed-shape
+        scatter path).  Returns blocks stored."""
+        if self._host is None or not keys:
+            return 0
+        return self._host.import_chain(keys, blocks)
+
+    # ----------------------------------------------------------- batch serve
     def serve(self, requests: Sequence[Request],
               eos_token_id: Optional[int] = None,
               admission_log: Optional[list] = None,
@@ -1550,7 +2074,10 @@ class ServingEngine:
               profile_iters: Optional[int] = None) -> Dict[Any, np.ndarray]:
         """Run a request trace to completion; returns ``uid -> [prompt +
         completion]`` int32 arrays, padded to ``prompt + max_new_tokens``
-        with eos back-fill (HF semantics, same as ``generate``).
+        with eos back-fill (HF semantics, same as ``generate``).  A thin
+        wrapper over the incremental API — ``submit`` everything, loop
+        ``step()``, gather handle results — with identical scheduling;
+        an empty request list returns ``{}`` without tracing anything.
 
         ``admission_log``, when given, collects ``(uid, slot)`` in admission
         order — the scheduler-determinism tests read it.  ``step_log``
@@ -1569,65 +2096,22 @@ class ServingEngine:
             self.sentry.strict = self.debug_checks
             if self.debug_checks:
                 install_compile_listener()
+        requests = list(requests)
+        if not requests:
+            return {}
+        if self._pending or self._active:
+            raise RuntimeError(
+                "serve() on a busy engine — requests are already in "
+                "flight; drive submit()/step() instead")
         for r in requests:
-            total = len(r.prompt) + r.max_new_tokens
-            if total > self.max_seq_len:
-                raise ValueError(
-                    f"request {r.uid!r}: prompt ({len(r.prompt)}) + "
-                    f"max_new_tokens ({r.max_new_tokens}) = {total} exceeds "
-                    f"max_seq_len {self.max_seq_len}")
-            if not self.chunked_prefill:
-                self._bucket_for(len(r.prompt))  # raises if no bucket fits
+            self._validate_request(r)
         uids = [r.uid for r in requests]
         if len(set(uids)) != len(uids):
             raise ValueError("duplicate request uids")
-
-        params = self.engine.params
-        pending = deque((r, []) for r in requests)
-        active: Dict[int, _SlotState] = {}
-        self._blocked_gate = None          # ids are fresh for this trace
-        self._trace_times = {}             # uids are unique per trace
-        if self._host is not None:
-            self._discard_all_staged()     # prior trace's prefetches died
-            self._prefetch_gate.clear()    # ids are fresh for this trace
-        if admission_log is None:
-            admission_log = []
-        results: Dict[Any, np.ndarray] = {}
-
-        def finish(slot):
-            st = active.pop(slot)
-            req = st.req
-            gen = np.asarray(st.prior + st.out, np.int32)
-            eos_hit = eos_token_id is not None and gen.size and \
-                gen[-1] == eos_token_id
-            out = np.zeros(req.max_new_tokens, np.int32)
-            out[:gen.size] = gen
-            if eos_hit:
-                out[gen.size:] = eos_token_id  # back-fill (HF semantics)
-            results[req.uid] = np.concatenate([req.prompt, out])
-            tm = self._trace_times.get(req.uid)
-            if tm is not None and tm["first"] is not None:
-                done = time.perf_counter()
-                ttft = tm["first"] - tm["admit"]
-                tpot = ((done - tm["first"]) / (gen.size - 1)) \
-                    if gen.size > 1 else 0.0
-                self._c_finished.inc()
-                self._h_ttft.observe(ttft)
-                self._h_tpot.observe(tpot)
-                self._latencies.append({
-                    "uid": req.uid,
-                    "new_tokens": int(gen.size),
-                    "ttft_s": ttft,
-                    "tpot_s": tpot,
-                })
-                # per-request span on the finishing slot's lane: admission
-                # (original — a preemption resume keeps it) to completion
-                self.timeline.complete(
-                    f"req {req.uid}", tm["admit_us"], tid=slot + 1,
-                    uid=str(req.uid), new_tokens=int(gen.size),
-                    eos=bool(eos_hit), ttft_s=ttft)
-            self._release_slot(slot)
-
+        handles = [self.submit(r, eos_token_id=eos_token_id)
+                   for r in requests]
+        self._admission_log = admission_log
+        self._step_log = step_log
         window = None
         if profile_dir is not None:
             window = ProfilerWindow(profile_dir)
@@ -1635,52 +2119,20 @@ class ServingEngine:
                 self.timeline.instant("profiler_start",
                                       profile_dir=str(profile_dir))
         iter0 = self.iterations
-        while pending or active:
-            self._c_iterations.inc()
-            admitted0, preempted0 = self.admitted, self.preempted
-            self._admit(pending, active, admission_log)
-            self._run_prefill(active, pending, params, eos_token_id, finish)
-
-            # one decode step over every slot (per-sequence positions);
-            # prefilling/empty slots point at the scratch block.  In
-            # speculative mode the single-token step is replaced by a
-            # draft–verify round committing up to K+1 tokens per slot.
-            if self.spec_tokens:
-                self._run_spec_decode(active, pending, params,
-                                      eos_token_id, finish)
-            else:
-                self._run_plain_decode(active, pending, params,
-                                       eos_token_id, finish)
-            if self._host is not None:
-                # stage next iteration's promotions NOW: the H2D copies
-                # run while the next decode step computes (module
-                # docstring "Tiered KV cache" — the param_stream overlap)
-                self._issue_prefetch(pending)
-            if step_log is not None:
-                step_log.append({
-                    "iteration": self.iterations,
-                    "admitted": self.admitted - admitted0,
-                    "evicted": self.preempted - preempted0,
-                    "blocks_in_use": self._alloc.blocks_in_use,
-                })
-            if self.debug_checks:
-                # O(blocks) host-state audit between scheduler rounds —
-                # the scheduler's state is only guaranteed consistent at
-                # iteration boundaries (analysis/invariants.py; the audit
-                # drops its own event on the timeline)
-                audit_serving_engine(self, active)
-                self._c_invariant_checks.inc()
-            if window is not None and window.active and \
-                    profile_iters is not None and \
-                    self.iterations - iter0 >= profile_iters:
+        try:
+            while self.step():
+                if window is not None and window.active and \
+                        profile_iters is not None and \
+                        self.iterations - iter0 >= profile_iters:
+                    window.stop()
+                    self.timeline.instant("profiler_stop")
+        finally:
+            self._admission_log = None
+            self._step_log = None
+            if window is not None and window.active:
                 window.stop()
                 self.timeline.instant("profiler_stop")
-        if window is not None and window.active:
-            window.stop()
-            self.timeline.instant("profiler_stop")
-        if self._host is not None:
-            self._discard_all_staged()     # no pending queue to consume them
-        return results
+        return {h.uid: h.result(timeout=0) for h in handles}
 
     # ----------------------------------------------------------------- decode
     def _mark_first(self, st: _SlotState) -> None:
@@ -1688,16 +2140,61 @@ class ServingEngine:
         if tm is not None and tm["first"] is None:
             tm["first"] = time.perf_counter()
 
-    def _run_plain_decode(self, active, pending, params, eos_token_id,
-                          finish):
+    def _emit_tokens(self, st: _SlotState, toks) -> None:
+        """Per-token streaming + the committed-token counter: every token
+        the scheduler commits flows through here exactly once."""
+        self._c_gen_tokens.inc(len(toks))
+        if st.handle is not None:
+            st.handle._on_tokens(toks)
+
+    def _finish_slot(self, slot: int) -> None:
+        """Complete a request: build the padded ``[prompt + completion]``
+        result (eos back-fill, HF semantics), record latencies and the
+        per-request span, release the slot, resolve the handle."""
+        st = self._active.pop(slot)
+        req = st.req
+        gen = np.asarray(st.prior + st.out, np.int32)
+        eos_hit = st.eos is not None and gen.size and gen[-1] == st.eos
+        out = np.zeros(req.max_new_tokens, np.int32)
+        out[:gen.size] = gen
+        if eos_hit:
+            out[gen.size:] = st.eos        # back-fill (HF semantics)
+        result = np.concatenate([req.prompt, out])
+        tm = self._trace_times.pop(req.uid, None)
+        if tm is not None and tm["first"] is not None:
+            done = time.perf_counter()
+            ttft = tm["first"] - tm["admit"]
+            tpot = ((done - tm["first"]) / (gen.size - 1)) \
+                if gen.size > 1 else 0.0
+            self._c_finished.inc()
+            self._h_ttft.observe(ttft)
+            self._h_tpot.observe(tpot)
+            self._latencies.append({
+                "uid": req.uid,
+                "new_tokens": int(gen.size),
+                "ttft_s": ttft,
+                "tpot_s": tpot,
+            })
+            # per-request span on the finishing slot's lane: admission
+            # (original — a preemption resume keeps it) to completion
+            self.timeline.complete(
+                f"req {req.uid}", tm["admit_us"], tid=slot + 1,
+                uid=str(req.uid), new_tokens=int(gen.size),
+                eos=bool(eos_hit), ttft_s=ttft)
+        self._release_slot(slot)
+        self._live_uids.discard(req.uid)
+        if st.handle is not None:
+            st.handle._on_finish(result)
+
+    def _run_plain_decode(self, params):
         """One single-token decode step over every decode-phase slot."""
+        active = self._active
         dec = sorted(
             (s for s, st in active.items() if st.phase == "decode"),
             key=lambda s: active[s].admit_seq)
         for slot in dec:
             if slot in active:
-                self._ensure_blocks(slot, active, pending,
-                                    int(self._lengths[slot]) + 1)
+                self._ensure_blocks(slot, int(self._lengths[slot]) + 1)
         dec = sorted(s for s, st in active.items()
                      if st.phase == "decode")
         if not dec:
@@ -1716,15 +2213,15 @@ class ServingEngine:
             self._lengths[slot] += 1   # the fed token is now cached
             tok = int(nxt[slot])
             st.out.append(tok)
+            self._emit_tokens(st, (tok,))
             self._mark_first(st)
-            if (eos_token_id is not None and tok == eos_token_id) \
+            if (st.eos is not None and tok == st.eos) \
                     or st.gen_count >= st.req.max_new_tokens:
-                finish(slot)
+                self._finish_slot(slot)
             else:
                 self._tokens[slot] = tok
 
-    def _run_spec_decode(self, active, pending, params, eos_token_id,
-                         finish):
+    def _run_spec_decode(self, params):
         """One speculative draft–verify round over every decode-phase slot.
 
         Propose K tokens per row (the draft model's one-program K-step
@@ -1741,6 +2238,7 @@ class ServingEngine:
         positions past the cap scatter to scratch instead of allocating.
         """
         k = self.spec_tokens
+        active = self._active
         dec = sorted(
             (s for s, st in active.items() if st.phase == "decode"),
             key=lambda s: active[s].admit_seq)
@@ -1749,7 +2247,7 @@ class ServingEngine:
                 st = active[slot]
                 ln = int(self._lengths[slot])
                 cap = max(st.pos_cap, ln + 1)
-                self._ensure_blocks(slot, active, pending,
+                self._ensure_blocks(slot,
                                     min(ln + k + 1, cap, self._cache_len))
         dec = sorted(s for s, st in active.items()
                      if st.phase == "decode")
@@ -1795,14 +2293,15 @@ class ServingEngine:
             st = active[slot]
             emitted, accepted, finished = greedy_accept(
                 ids[slot].tolist(), scored[slot].tolist(), max_accept,
-                eos_token_id, st.req.max_new_tokens - st.gen_count)
+                st.eos, st.req.max_new_tokens - st.gen_count)
             self._c_drafted.inc(k)
             self._c_accepted.inc(accepted)
             accept_lens.append(accepted)
             st.out.extend(emitted)
+            self._emit_tokens(st, emitted)
             self._mark_first(st)
             if finished:
-                finish(slot)
+                self._finish_slot(slot)
             else:
                 # commit = pending + accepted drafts now durable in-cache;
                 # the correction token becomes the new pending feed
@@ -1812,11 +2311,12 @@ class ServingEngine:
                               drafted=k * len(dec))
 
     # ---------------------------------------------------------------- prefill
-    def _run_prefill(self, active, pending, params, eos_token_id, finish):
+    def _run_prefill(self, params):
         """Advance prefilling slots: one fixed-width chunk per slot per
         iteration (chunked mode), or the whole prompt in its bucket's
         program (bucketed fallback).  Both modes run ``prefill_batch`` rows
         per call; pad rows write to scratch."""
+        active = self._active
         pre = [s for s, st in sorted(active.items(),
                                      key=lambda kv: kv[1].admit_seq)
                if st.phase == "prefill"]
@@ -1830,7 +2330,7 @@ class ServingEngine:
                     continue               # preempted by an earlier alloc
                 st = active[slot]
                 v = min(self.prefill_chunk, st.plen_eff - st.base)
-                if self._ensure_blocks(slot, active, pending, st.base + v):
+                if self._ensure_blocks(slot, st.base + v):
                     ready.append(slot)
             for i in range(0, len(ready), self.prefill_batch):
                 group = [s for s in ready[i:i + self.prefill_batch]
@@ -1843,7 +2343,7 @@ class ServingEngine:
                 if slot not in active:
                     continue
                 st = active[slot]
-                if self._ensure_blocks(slot, active, pending, st.plen_eff):
+                if self._ensure_blocks(slot, st.plen_eff):
                     by_bucket.setdefault(self._prefill_width(st.plen_eff),
                                          []).append(slot)
             groups = []
@@ -1859,15 +2359,14 @@ class ServingEngine:
             group = [s for s in group if s in active]
             if not group:
                 continue
-            self._run_prefill_group(width, group, active, params,
-                                    eos_token_id, finish)
+            self._run_prefill_group(width, group, params)
 
-    def _run_prefill_group(self, width, group, active, params,
-                           eos_token_id, finish):
+    def _run_prefill_group(self, width, group, params):
         """One prefill call: each row advances its slot by ``min(width,
         remaining prompt)`` tokens from its own base.  Rows whose window
         reaches the last prompt token yield that slot's first generated
         token (logits are gathered per row at ``valid - 1``)."""
+        active = self._active
         j = self.prefill_batch
         ids = np.zeros((j, width), np.int32)
         bt = np.zeros((j, self._nbper), np.int32)
@@ -1915,12 +2414,13 @@ class ServingEngine:
                                           self._alloc)
             tok = int(first[row])
             st.out.append(tok)
+            self._emit_tokens(st, (tok,))
             self._mark_first(st)
             self._tokens[slot] = tok
             self._lengths[slot] = st.plen_eff
-            if (eos_token_id is not None and tok == eos_token_id) \
+            if (st.eos is not None and tok == st.eos) \
                     or st.gen_count >= st.req.max_new_tokens:
-                finish(slot)
+                self._finish_slot(slot)
 
     # ------------------------------------------------------------------ stats
     def _kv_footprint(self) -> Dict[str, Any]:
@@ -2001,6 +2501,9 @@ class ServingEngine:
             "prefill_calls": self.prefill_calls,
             "admitted": self.admitted,
             "evicted": self.preempted,
+            "cancelled": int(self._c_cancelled.value),
+            "queue_depth": len(self._pending),
+            "generated_tokens": int(self._c_gen_tokens.value),
             "prompt_tokens": self.prompt_tokens,
             "prefix_hit_tokens": self.prefix_hit_tokens,
             "prefix_cache_hit_rate": (

@@ -59,6 +59,17 @@ def parse_args(args=None):
                         "re-elect the world and restart on failure or "
                         "hostfile membership change (reference "
                         "launcher/launch.py:257-310)")
+    parser.add_argument("--serve", action="store_true",
+                        help="serving-replica mode: supervise one engine-"
+                        "replica worker per host (or --replicas N local "
+                        "workers without a hostfile) with the elastic "
+                        "agent's restart/membership machinery but no "
+                        "elastic batch election — each worker sees "
+                        "DS_REPLICA_ID / DS_NUM_REPLICAS and hostfile "
+                        "edits resize the fleet at the next monitor tick")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="local replica count for --serve when no "
+                        "hostfile is present")
     parser.add_argument("--elastic_config", type=str, default="",
                         help="ds config json with the elasticity block; "
                         "defaults to the --deepspeed_config in the script "
@@ -312,6 +323,67 @@ def _find_ds_config(args) -> str:
         "--deepspeed_config in the training-script arguments")
 
 
+def _ssh_wrap(host: str, inner: List[str], env: Dict[str, str]) -> List[str]:
+    """Wrap a worker command for ssh launch, exporting the rendezvous /
+    replica env inline (shared by the elastic and serve modes)."""
+    rendezvous = {k: v for k, v in env.items()
+                  if k.startswith(("JAX_", "DS_ELASTIC_", "DS_REPLICA",
+                                   "DS_NUM_REPLICAS"))
+                  or k in ("WORLD_SIZE", "RANK")
+                  or any(k == e or (e.endswith("_") and k.startswith(e))
+                         for e in EXPORT_ENVS)}
+    exports = "".join(f"export {k}={shlex.quote(str(v))}; "
+                      for k, v in rendezvous.items())
+    remote = (f"cd {os.path.abspath('.')}; {exports}"
+              + " ".join(map(shlex.quote, inner)))
+    return ["ssh", host, remote]
+
+
+def _serve_main(args) -> int:
+    """``deepspeed --serve [--replicas N]``: supervise serving-replica
+    worker processes with the :class:`ElasticAgent` — its probe/restart/
+    membership machinery, with election short-circuited (no elastic batch
+    constraint: every live host runs one replica; ``elastic_agent.py
+    elect_world``).  With a hostfile, one replica per host and editing the
+    file resizes the fleet at the next monitor tick — the process-level
+    twin of ``ReplicaRouter`` drain/re-admit (``serving/supervisor.py``
+    does the same for in-process replicas).  Without one, ``--replicas N``
+    local workers.  Workers read ``DS_REPLICA_ID`` / ``DS_NUM_REPLICAS``
+    to build their slice of the fleet."""
+    import socket
+
+    from ..elasticity.elastic_agent import ElasticAgent
+
+    local_names = {"localhost", "127.0.0.1", socket.gethostname()}
+    n = max(1, int(args.replicas))
+
+    def probe_hosts():
+        pool = fetch_hostfile(args.hostfile)
+        if not pool:
+            return {f"replica-{i}": 1 for i in range(n)}
+        return {host: len(slots) for host, slots in
+                parse_resource_filter(pool, args.include,
+                                      args.exclude).items()}
+
+    def launch_cmd(host, env):
+        env["DS_REPLICA_ID"] = env.get("JAX_PROCESS_ID", "0")
+        env["DS_NUM_REPLICAS"] = env.get("JAX_NUM_PROCESSES", str(n))
+        inner = [sys.executable, "-u", args.user_script] + \
+            list(args.user_args)
+        if args.launcher == "local" or host in local_names or \
+                host.startswith("replica-"):
+            return inner  # env rides through Popen(env=...)
+        return _ssh_wrap(host, inner, env)
+
+    agent = ElasticAgent(
+        {}, probe_hosts, launch_cmd,
+        master_port=args.master_port,
+        monitor_interval=args.elastic_monitor_interval,
+        max_restarts=args.elastic_max_restarts,
+        elect_all=True)
+    return agent.run()
+
+
 def _elastic_main(args) -> int:
     """``deepspeed --enable_elastic_training``: run the training script under
     the ElasticAgent instead of a one-shot multinode launch (reference
@@ -346,16 +418,7 @@ def _elastic_main(args) -> int:
         inner = [sys.executable, "-u", args.user_script] + list(args.user_args)
         if args.launcher == "local" or host in local_names:
             return inner  # env rides through Popen(env=...)
-        rendezvous = {k: v for k, v in env.items()
-                      if k.startswith(("JAX_", "DS_ELASTIC_"))
-                      or k in ("WORLD_SIZE", "RANK")
-                      or any(k == e or (e.endswith("_") and k.startswith(e))
-                             for e in EXPORT_ENVS)}
-        exports = "".join(f"export {k}={shlex.quote(str(v))}; "
-                          for k, v in rendezvous.items())
-        remote = (f"cd {os.path.abspath('.')}; {exports}"
-                  + " ".join(map(shlex.quote, inner)))
-        return ["ssh", host, remote]
+        return _ssh_wrap(host, inner, env)
 
     agent = ElasticAgent(
         ds_config, probe_hosts, launch_cmd,
@@ -367,6 +430,8 @@ def _elastic_main(args) -> int:
 
 def main(args=None):
     args = parse_args(args)
+    if args.serve:
+        sys.exit(_serve_main(args))
     if args.enable_elastic_training:
         sys.exit(_elastic_main(args))
     resource_pool = fetch_hostfile(args.hostfile)

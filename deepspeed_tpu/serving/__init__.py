@@ -1,0 +1,14 @@
+"""deepspeed_tpu.serving — the multi-replica serving front-end: a DP
+router over ``ServingEngine`` replicas (``router.py``) plus
+elastic-agent-style fleet supervision (``supervisor.py``).  The
+single-engine scheduler itself lives in ``inference/serving.py``; this
+package is the layer ABOVE it (host-side only — no compiled programs).
+"""
+
+from ..inference.serving import (Request, RequestHandle,  # noqa: F401
+                                 SLO_PRIORITY, ServingEngine)
+from .router import ReplicaRouter  # noqa: F401
+from .supervisor import RouterSupervisor  # noqa: F401
+
+__all__ = ["ReplicaRouter", "RouterSupervisor", "Request",
+           "RequestHandle", "ServingEngine", "SLO_PRIORITY"]

@@ -1,0 +1,110 @@
+"""Replica-fleet supervision: an ``elasticity/elastic_agent.py``-style
+membership monitor wired to :class:`ReplicaRouter` drain/re-admit.
+
+The training-side :class:`~deepspeed_tpu.elasticity.elastic_agent
+.ElasticAgent` supervises a process group: probe the host set every
+tick, restart the group on membership change.  Serving cannot restart —
+a restart drops every in-flight request — so the serving analogue
+translates membership changes into the router's graceful protocol
+instead: a replica leaving the probe set is **drained** (sessions demote
+to its host tier and hand off, nothing dropped), and a replica returning
+is **re-admitted** (its host tier still holds the demoted chains, so
+affinity routing and KV pulls resume warm).
+
+``probe_replicas`` follows the agent's ``probe_hosts`` contract: a list
+of live replica ids, or a ``{rid: capacity}`` mapping where 0 capacity
+means down (the hostfile ``slots=0`` rule).  ``grace_ticks`` mirrors the
+agent's ``partial_grace_ticks`` — a transient probe miss (one slow
+health check) must not migrate a replica's whole session population, so
+a replica drains only after going unseen for ``grace_ticks + 1``
+consecutive ticks.  The supervisor only re-admits replicas it drained
+itself: an operator's manual ``router.drain()`` stays drained until the
+operator says otherwise.
+
+Tick-driven on purpose (``tick()`` — no sleeps, no threads): tests and
+embedding loops drive it explicitly; ``run()`` adds the wall-clock loop
+for standalone use.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Mapping, Optional, Union
+
+from ..utils.logging import logger
+from .router import ReplicaRouter
+
+__all__ = ["RouterSupervisor"]
+
+
+class RouterSupervisor:
+    """Membership-probe supervision over a :class:`ReplicaRouter`."""
+
+    def __init__(self, router: ReplicaRouter,
+                 probe_replicas: Callable[[], Union[List[int],
+                                                    Mapping[int, int]]],
+                 *, grace_ticks: int = 1):
+        self.router = router
+        self.probe_replicas = probe_replicas
+        self.grace_ticks = int(grace_ticks)
+        self._down_ticks: Dict[int, int] = {}
+        self._drained_by_us: set = set()
+        self.ticks = 0
+
+    def _probe(self) -> set:
+        res = self.probe_replicas()
+        if isinstance(res, Mapping):
+            return {int(r) for r, c in res.items() if c > 0}
+        return {int(r) for r in res}
+
+    def tick(self) -> Dict[str, List[int]]:
+        """One supervision round; returns ``{"drained": [...],
+        "readmitted": [...]}`` for this tick."""
+        self.ticks += 1
+        live = self._probe()
+        actions: Dict[str, List[int]] = {"drained": [], "readmitted": []}
+        for rid in range(len(self.router.replicas)):
+            if rid not in self.router._drained:
+                # not drained (any more) — whoever re-admitted it, our
+                # claim on it is over; a STALE claim here would make a
+                # later operator drain auto-readmit against the contract
+                self._drained_by_us.discard(rid)
+            if rid in live:
+                self._down_ticks.pop(rid, None)
+                if rid in self._drained_by_us and \
+                        rid in self.router._drained:
+                    self.router.readmit(rid)
+                    self._drained_by_us.discard(rid)
+                    actions["readmitted"].append(rid)
+                    logger.info(f"supervisor: replica {rid} returned — "
+                                "re-admitted")
+            else:
+                ticks = self._down_ticks.get(rid, 0) + 1
+                self._down_ticks[rid] = ticks
+                if ticks > self.grace_ticks and \
+                        rid not in self.router._drained:
+                    try:
+                        handed = self.router.drain(rid)
+                    except RuntimeError as e:
+                        # fleet-wide outage: the last live replica cannot
+                        # drain (there is nowhere to hand its sessions).
+                        # Keep it routed and keep ticking — when probes
+                        # recover, supervision resumes; crashing the
+                        # loop here would orphan the whole fleet.
+                        logger.error(
+                            f"supervisor: cannot drain replica {rid} "
+                            f"({e}); leaving it in rotation")
+                        continue
+                    self._drained_by_us.add(rid)
+                    actions["drained"].append(rid)
+                    logger.warning(
+                        f"supervisor: replica {rid} unseen for {ticks} "
+                        f"ticks — drained ({handed} requests handed off)")
+        return actions
+
+    def run(self, interval: float = 5.0,
+            max_ticks: Optional[int] = None) -> None:
+        """Standalone wall-clock loop around :meth:`tick`."""
+        while max_ticks is None or self.ticks < max_ticks:
+            self.tick()
+            time.sleep(interval)

@@ -657,10 +657,10 @@ def test_audit_runs_green_mid_trace(tiny_engine):
     audits = []
     orig = srv._run_plain_decode
 
-    def hooked(active, pending, params, eos, finish):
-        invariants.audit_serving_engine(srv, active)
-        audits.append(len(active))
-        return orig(active, pending, params, eos, finish)
+    def hooked(params):
+        invariants.audit_serving_engine(srv, srv._active)
+        audits.append(len(srv._active))
+        return orig(params)
 
     srv._run_plain_decode = hooked
     srv.serve(reqs)

@@ -119,6 +119,72 @@ def test_slot_count_change_triggers_restart(tmp_path):
     assert agent.restart_count >= 1
 
 
+def test_het_dict_probe_shrinks_mid_run(tmp_path):
+    """Heterogeneous probe dict SHRINKING mid-run: the pool loses its
+    2-chip members, chips_per_host re-derives to the new minimum (4),
+    and the group restarts at the higher per-host capacity with the
+    smaller host set — the elastic slice-resize path."""
+    log = tmp_path / "worlds.jsonl"
+
+    def probe():
+        lines = log.read_text().splitlines() if log.exists() else []
+        if len(lines) < 4:
+            # 4 hosts, min capacity 1 => WORLD_SIZE 4*1 = 4
+            return {"a": 4, "b": 1, "c": 4, "d": 1}
+        return {"a": 4, "c": 4}   # 1-chip hosts died: 2 hosts x 4 chips
+
+    prog = ("import os,time,json;"
+            f"f=open({str(log)!r},'a');"
+            "json.dump({'ws': os.environ['WORLD_SIZE']}, f);"
+            "f.write('\\n');f.close();"
+            "time.sleep(120.0) if os.environ['DS_ELASTIC_RESTART_COUNT'] "
+            "== '0' else None")
+    agent = _agent(probe, lambda host, env: [sys.executable, "-c", prog],
+                   monitor_interval=2.0)
+    assert agent.run() == 0
+    worlds = [json.loads(l)["ws"] for l in log.read_text().splitlines()]
+    assert worlds[:4] == ["4"] * 4, worlds      # gen 1: 4 hosts x 1 chip
+    assert worlds[4:] == ["8"] * 2, worlds      # gen 2: 2 hosts x 4
+    assert agent.chips_per_host == 4
+    assert agent.restart_count >= 1
+
+
+def test_partial_grace_ticks_expiry():
+    """One worker exits 0 while its peer hangs: PARTIAL persists past
+    ``partial_grace_ticks`` monitor ticks, the group restarts, and the
+    second generation (both exiting 0) SUCCEEDS.  Within-grace completion
+    skew must NOT have burned more than one restart."""
+    prog = ("import os,time,sys;"
+            "hang = (os.environ['DS_ELASTIC_RESTART_COUNT'] == '0' and "
+            "os.environ['JAX_PROCESS_ID'] == '1');"
+            "time.sleep(120.0) if hang else sys.exit(0)")
+    agent = _agent(lambda: ["a", "b"],
+                   lambda host, env: [sys.executable, "-c", prog],
+                   monitor_interval=0.2, partial_grace_ticks=2)
+    assert agent.run() == 0
+    # exactly one restart: the grace window absorbed the skew ticks, the
+    # expiry (tick 3) restarted the hung survivor's group once
+    assert agent.restart_count == 1
+
+
+def test_elect_all_flag_elects_every_host():
+    """elect_all=True (the launcher --serve replica-supervision mode):
+    every live host is elected, no batch constraint; WITHOUT the flag a
+    missing/disabled elasticity block still fails fast — a typo'd
+    training config must not silently launch on every host."""
+    agent = ElasticAgent({}, lambda: [], lambda h, e: [],
+                         monitor_interval=0.1, elect_all=True)
+    hosts = [f"r{i}" for i in range(5)]
+    assert agent.elect_world(hosts) == hosts
+    with pytest.raises(RuntimeError):
+        agent.elect_world([])
+    for cfg in ({}, {"elasticity": {"enabled": False}}):
+        strict = ElasticAgent(cfg, lambda: [], lambda h, e: [],
+                              monitor_interval=0.1)
+        with pytest.raises(Exception):
+            strict.elect_world(["x"])
+
+
 def test_zero_slot_hosts_excluded():
     """A slots=0 hostfile line behaves like an excluded host: it is not
     elected and does not drag chips_per_host to 1."""

@@ -209,3 +209,75 @@ if os.environ["DS_ELASTIC_RESTART_COUNT"] == "0":
     g1 = [g for g in gens if g["gen"] != "0"]
     assert len(g0) == 2 and len(g1) >= 2, gens
     assert {g["n"] for g in gens} == {"2"}  # both hosts elected each time
+
+
+# --------------------------------------------------------------------------
+# serving-replica mode (--serve): ElasticAgent supervision without elastic
+# batch election — one replica worker per host / --replicas N local workers
+# --------------------------------------------------------------------------
+def test_serve_flag_parses():
+    args = parse_args(["--serve", "--replicas", "3", "serve_worker.py"])
+    assert args.serve and args.replicas == 3
+    assert parse_args(["train.py"]).serve is False
+
+
+def test_serve_mode_supervises_local_replicas(tmp_path):
+    """--serve --replicas 2 without a hostfile: two local replica workers
+    run under the agent, each seeing its DS_REPLICA_ID / DS_NUM_REPLICAS,
+    and a clean fleet exit returns 0 with no restart burned."""
+    from deepspeed_tpu.launcher import runner
+
+    log = tmp_path / "replicas.jsonl"
+    script = tmp_path / "replica.py"
+    script.write_text(f"""
+import json, os
+with open({str(log)!r}, "a") as f:
+    json.dump({{"rid": os.environ["DS_REPLICA_ID"],
+               "n": os.environ["DS_NUM_REPLICAS"]}}, f)
+    f.write("\\n")
+""")
+    code = None
+    try:
+        runner.main(["--serve", "--replicas", "2",
+                     "--hostfile", str(tmp_path / "no_hostfile"),
+                     "--elastic_monitor_interval", "0.2",
+                     "--launcher", "local", str(script)])
+    except SystemExit as e:
+        code = e.code
+    assert code == 0
+    seen = [json.loads(l) for l in log.read_text().splitlines()]
+    assert {s["rid"] for s in seen} == {"0", "1"}
+    assert {s["n"] for s in seen} == {"2"}
+
+
+def test_serve_mode_restarts_dead_replica(tmp_path):
+    """A replica worker crashing is restarted by the agent (generation
+    keyed off DS_ELASTIC_RESTART_COUNT, like the elastic CLI test)."""
+    from deepspeed_tpu.launcher import runner
+
+    log = tmp_path / "gens.jsonl"
+    script = tmp_path / "replica.py"
+    script.write_text(f"""
+import json, os, sys, time
+with open({str(log)!r}, "a") as f:
+    json.dump({{"gen": os.environ["DS_ELASTIC_RESTART_COUNT"],
+               "rid": os.environ["DS_REPLICA_ID"]}}, f)
+    f.write("\\n")
+if os.environ["DS_ELASTIC_RESTART_COUNT"] == "0":
+    if os.environ["DS_REPLICA_ID"] == "1":
+        time.sleep(0.3)
+        sys.exit(1)
+    time.sleep(120)
+""")
+    code = None
+    try:
+        runner.main(["--serve", "--replicas", "2",
+                     "--hostfile", str(tmp_path / "no_hostfile"),
+                     "--elastic_monitor_interval", "0.2",
+                     "--launcher", "local", str(script)])
+    except SystemExit as e:
+        code = e.code
+    assert code == 0
+    gens = [json.loads(l) for l in log.read_text().splitlines()]
+    assert {g["rid"] for g in gens if g["gen"] == "0"} == {"0", "1"}
+    assert any(g["gen"] != "0" for g in gens)

@@ -335,7 +335,7 @@ def test_staged_prefetch_records_never_outlive_their_request(tiny_engine):
     leaks = []
 
     def hooked(pending):
-        live = {r.uid for r, _ in pending}
+        live = {item.req.uid for item in pending}
         stale = set(srv._staged) - live
         if stale:
             leaks.append(stale)

@@ -364,3 +364,59 @@ def test_tp_parity_other_families(family):
     assert s2.kv_sharded
     for uid in r1:
         np.testing.assert_array_equal(r1[uid], r2[uid], err_msg=f"uid {uid}")
+
+
+def test_router_kv_pull_tp4_kv8_composition(tp4_engine, tiny_cfg):
+    """PR 11 acceptance: the cross-replica KV pull composes with tp
+    sharding AND kv8 — two tp=4 replicas with int8 host tiers migrate a
+    session (drain -> pull -> resume) bit-identically to an unmigrated
+    tp=4 kv8 engine (per-shard gather/scatter moves codes + scale rows
+    as ordinary swap leaves)."""
+    from deepspeed_tpu.serving import ReplicaRouter
+
+    kw = dict(slots=3, max_seq_len=64, block_size=8, prefill_chunk=16,
+              prefill_batch=2, host_blocks=32, swap_batch=4,
+              quantize="kv8", debug_checks=True)
+    rng = np.random.default_rng(21)
+    prefixes = [rng.integers(0, tiny_cfg.vocab_size, 24)
+                for _ in range(2)]
+    reqs = [Request(uid=i,
+                    prompt=np.concatenate(
+                        [prefixes[i % 2],
+                         rng.integers(0, tiny_cfg.vocab_size,
+                                      int(rng.integers(3, 8)))]),
+                    max_new_tokens=8) for i in range(6)]
+    ref = ServingEngine(tp4_engine, **kw)
+    ref_outs = ref.serve(reqs)
+
+    deepspeed_tpu.comm.reset_topology()
+    peer = deepspeed_tpu.init_inference(
+        gpt2.build(tiny_cfg),
+        config={"dtype": "fp32", "tensor_parallel": {"tp_size": 4}},
+        params=tp4_engine.params)
+    reps = [ServingEngine(tp4_engine, **kw),
+            ServingEngine(peer, **kw)]
+    assert all(r.kv_sharded and r.tp_degree == 4 for r in reps)
+    router = ReplicaRouter(reps, debug_checks=True)
+    outs = router.serve(reqs)
+    for r in reqs:
+        np.testing.assert_array_equal(outs[r.uid], ref_outs[r.uid],
+                                      err_msg=f"uid {r.uid}")
+    p0 = prefixes[0]
+    depth = [rep.affinity_probe(np.concatenate([p0, [0]]))
+             for rep in reps]
+    rid0 = int(np.argmax([d["device_blocks"] + d["host_blocks"]
+                          for d in depth]))
+    router.drain(rid0)
+    cont = Request(uid="tpq",
+                   prompt=np.concatenate(
+                       [p0, rng.integers(0, tiny_cfg.vocab_size, 4)]),
+                   max_new_tokens=6)
+    ref_cont = ref.serve([Request(uid="tpq", prompt=cont.prompt,
+                                  max_new_tokens=6)])
+    out = router.serve([cont])
+    np.testing.assert_array_equal(out["tpq"], ref_cont["tpq"])
+    st = router.stats()
+    assert st["kv_pulls"] >= 1 and st["kv_pull_blocks"] >= 3
+    assert all(p["compile_count"] <= p["compile_budget"]
+               for p in st["per_replica"])
