@@ -129,7 +129,8 @@ def init_inference(model=None, config=None, params=None, **kwargs):
 
 def init_router(model=None, config=None, params=None, *, replicas=2,
                 policy="affinity", kv_pull=True, threaded=False,
-                router_trace_capacity=4096, **serving_kwargs):
+                router_trace_capacity=4096, metrics_port=None,
+                metrics_host="127.0.0.1", **serving_kwargs):
     """Multi-replica serving entry (ROADMAP item 1): ``replicas`` ×
     ``init_serving`` engines — all sharing ONE weight pytree (the first
     replica's initialized/loaded params are reused, so every replica is
@@ -155,9 +156,19 @@ def init_router(model=None, config=None, params=None, *, replicas=2,
     replica; default off, the caller (or ``router.serve``) drives
     ``step()`` deterministically.  All remaining keyword arguments go to
     ``init_serving`` per replica — ``quantize=``, ``host_blocks=``,
-    ``spec_tokens=``, ``topology=`` (dp×tp: N replicas each tp-sharded)
-    compose unchanged, and each replica keeps its own sentry-enforced
-    compile budget (the router itself never traces a program)."""
+    ``spec_tokens=``, ``topology=`` (dp×tp: N replicas each tp-sharded),
+    ``slo_targets=`` compose unchanged, and each replica keeps its own
+    sentry-enforced compile budget (the router itself never traces a
+    program).
+
+    ``metrics_port=N`` starts the fleet's live exposition server
+    (``telemetry/server.py``; 0 = ephemeral port, ``router.
+    metrics_server.port`` reports it): ``/metrics`` serves the federated
+    Prometheus text over the router + every replica registry, ``/stats``
+    the JSON fleet snapshot (router stats + per-class SLO report +
+    registry snapshot), ``/trace`` the merged multi-replica Chrome
+    trace.  ``router.stop()`` shuts it down.  See
+    ``docs/observability.md`` "Fleet observability"."""
     from .serving import ReplicaRouter
 
     reps = []
@@ -166,10 +177,13 @@ def init_router(model=None, config=None, params=None, *, replicas=2,
         if params is None:
             params = srv.engine.params
         reps.append(srv)
-    return ReplicaRouter(
+    router = ReplicaRouter(
         reps, policy=policy, kv_pull=kv_pull, threaded=threaded,
         debug_checks=bool(serving_kwargs.get("debug_checks", False)),
         trace_capacity=router_trace_capacity)
+    if metrics_port is not None:
+        router.start_metrics_server(port=metrics_port, host=metrics_host)
+    return router
 
 
 def init_serving(model=None, config=None, params=None, *, slots=8,
@@ -179,7 +193,8 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
                  quantize=None, host_blocks=0, swap_batch=8, draft=None,
                  ngram_max=3, ngram_min=1,
                  shard_kv=None, topology=None, debug_checks=False,
-                 trace_capacity=16384, **kwargs):
+                 trace_capacity=16384, slo_targets=None, peak_flops=None,
+                 **kwargs):
     """Continuous-batching serving entry: an ``init_inference`` engine
     wrapped in the block-paged scheduler (``inference/serving.py``).
     Mixed-length request traces run at iteration-level granularity over a
@@ -243,7 +258,11 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
     (``trace_capacity=``, 0 = off) records a per-request timeline
     exportable as Chrome ``trace_event`` JSON via
     ``srv.dump_trace(path)``; ``serve(profile_dir=...)`` brackets
-    scheduler iterations with a ``jax.profiler`` window.  See
+    scheduler iterations with a ``jax.profiler`` window.
+    ``slo_targets=`` overrides the per-``slo_class`` TTFT/TPOT targets
+    behind ``srv.slo_report()``; ``peak_flops=`` sets the MFU
+    denominator for ``srv.flops_report()`` (the cost_analysis-backed
+    FLOPs/MFU profiler, ``telemetry/flops.py``).  See
     ``docs/observability.md``."""
     from .inference.serving import ServingEngine
 
@@ -300,4 +319,5 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
                          draft=draft,
                          ngram_max=ngram_max, ngram_min=ngram_min,
                          shard_kv=shard_kv, debug_checks=debug_checks,
-                         trace_capacity=trace_capacity)
+                         trace_capacity=trace_capacity,
+                         slo_targets=slo_targets, peak_flops=peak_flops)

@@ -52,6 +52,17 @@ GL007     blocking device transfer (``jax.device_get`` /
           ``promote``, ``swap``, ``sync``, ``prefetch`` — the documented
           commit points (e.g. the tiered-KV demotion helper's one
           ``device_get`` per swap batch, ``inference/serving.py``).
+GL008     metric family registration outside the telemetry naming
+          convention (``registry.counter/gauge/histogram`` with a
+          literal name): counters must end in ``_total`` (the Prometheus
+          monotone-counter convention scrapers reset-detect on), every
+          family must carry a subsystem namespace prefix (``serving_`` /
+          ``train_`` / ``inference_`` — the federated fleet registry
+          stays greppable by subsystem), gauges/histograms must NOT end
+          in ``_total``, and label keys must come from the documented
+          closed set (``docs/observability.md``) — an ad-hoc label key
+          is usually a per-request value about to become unbounded
+          series cardinality.
 ========  =============================================================
 
 Suppression: append ``# graft: noqa(GLxxx)`` (one or more codes,
@@ -115,7 +126,18 @@ RULES: Dict[str, str] = {
              "measures trace time, not device execution",
     "GL007": "blocking device transfer (device_get/block_until_ready) in "
              "a host loop body outside a sanctioned transfer helper",
+    "GL008": "metric family name or label key outside the telemetry "
+             "naming convention (docs/observability.md)",
 }
+
+#: GL008 — the documented metric naming convention: registry method
+#: tails, family namespace prefixes, the closed label-key set, and the
+#: registry-method keywords that are NOT labels
+_METRIC_CTORS = frozenset({"counter", "gauge", "histogram"})
+_METRIC_NAMESPACES = ("serving_", "train_", "inference_")
+_METRIC_LABEL_KEYS = frozenset(
+    {"replica", "direction", "timer", "slo_class", "slo", "phase"})
+_METRIC_PARAM_KWARGS = frozenset({"help", "monitor_name", "buckets"})
 
 #: substrings marking a function as a sanctioned blocking-transfer helper
 #: for GL007 (the documented sync/swap commit points)
@@ -388,6 +410,9 @@ class _Analyzer:
         # GL003 runs everywhere (the jit CALL lives in host code)
         if tail in ("jit", "pjit"):
             self._check_donation(node)
+        # GL008 runs everywhere too (registries are built in host code)
+        if tail in _METRIC_CTORS and isinstance(node.func, ast.Attribute):
+            self._check_metric_convention(node, tail)
         if tail in _COLLECTIVES:
             self._check_axis_literal(node)
         if tail in ("PartitionSpec", "P"):
@@ -473,6 +498,40 @@ class _Analyzer:
                             "decision — every step copies the buffer "
                             "(pass donate_argnums=(...) or an explicit ())")
                         return
+
+    def _check_metric_convention(self, node: ast.Call, kind: str) -> None:
+        """GL008: registry ``counter``/``gauge``/``histogram`` calls with
+        a literal family name must follow the documented convention
+        (docstring rule table).  Non-literal names (the federation layer
+        copying families programmatically) are out of scope."""
+        first = node.args[0] if node.args else None
+        if not (isinstance(first, ast.Constant) and
+                isinstance(first.value, str)):
+            return
+        name = first.value
+        if not name.startswith(_METRIC_NAMESPACES):
+            self._emit(node, "GL008",
+                       f"metric family '{name}' lacks a subsystem "
+                       "namespace prefix "
+                       f"({'/'.join(_METRIC_NAMESPACES)})")
+        if kind == "counter" and not name.endswith("_total"):
+            self._emit(node, "GL008",
+                       f"counter '{name}' must end in '_total' "
+                       "(Prometheus monotone-counter convention)")
+        elif kind != "counter" and name.endswith("_total"):
+            self._emit(node, "GL008",
+                       f"{kind} '{name}' must not end in '_total' — "
+                       "the suffix promises a monotone counter")
+        for kw in node.keywords:
+            if kw.arg is None or kw.arg in _METRIC_PARAM_KWARGS:
+                continue
+            if kw.arg not in _METRIC_LABEL_KEYS:
+                self._emit(node, "GL008",
+                           f"metric label key '{kw.arg}' is outside the "
+                           "documented label set "
+                           f"({', '.join(sorted(_METRIC_LABEL_KEYS))}) — "
+                           "ad-hoc labels become unbounded series "
+                           "cardinality")
 
     def _check_axis_literal(self, node: ast.Call) -> None:
         cand: List[ast.AST] = []
@@ -629,7 +688,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="graft-lint",
         description="TPU/JAX recompile + host-sync hazard lint "
-                    "(rules GL001..GL007; suppress with "
+                    "(rules GL001..GL008; suppress with "
                     "'# graft: noqa(GLxxx)')")
     ap.add_argument("paths", nargs="*", default=["deepspeed_tpu"],
                     help="files/dirs to lint (default: deepspeed_tpu)")

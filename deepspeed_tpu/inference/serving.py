@@ -153,9 +153,15 @@ audit events from ``analysis/``) records a per-request timeline
 via ``dump_trace(path)`` — open it in Perfetto to see exactly where a
 slow request spent its time.  ``serve(profile_dir=...)`` additionally
 brackets the first ``profile_iters`` scheduler iterations with a
-``jax.profiler`` trace window for device-level deep dives.  Overhead
-contract: near-free when idle, ≤2% aggregate tok/s when fully enabled
-(pinned by the ``--telemetry-bench`` serving-bench lane, BENCH_r08).
+``jax.profiler`` trace window for device-level deep dives.  PR 12 adds
+the per-``slo_class`` attainment accounting behind ``slo_report()``
+(``telemetry/slo.py``; ``slo_targets=``), the FLOPs/MFU profiler behind
+``flops_report()`` (``telemetry/flops.py``; raw program bodies lowered
+for ``cost_analysis`` — zero new compiled programs), and the router's
+cross-ring flow linkage (``note_flow`` → admission emits the Chrome
+flow finish).  Overhead contract: near-free when idle, ≤2% aggregate
+tok/s when fully enabled (pinned by the ``--telemetry-bench``
+serving-bench lane, BENCH_r08; re-verified fleet-wide in BENCH_r12).
 
 **Incremental serving API** (PR 11): the scheduler state (pending queue,
 active slots) lives on the engine, not inside one ``serve()`` call.
@@ -207,6 +213,7 @@ from ..ops import paged_kv
 from ..ops.paged_kv import blocks_for
 from ..parallel.topology import TP_AXIS
 from ..telemetry import MetricsRegistry, ProfilerWindow, TraceTimeline
+from ..telemetry.slo import SLOTracker
 from ..utils.logging import log_dist
 from ..utils.lru import LRUCache
 from .paged import (BlockAllocator, HostBlockStore, PrefixCache, chain_key,
@@ -605,6 +612,15 @@ class ServingEngine:
                     disables event recording entirely (one predicate per
                     would-be event); the metrics registry backing
                     ``stats()`` is always on.
+    slo_targets:    per-``slo_class`` latency targets + attainment
+                    objective overrides, merged over
+                    ``telemetry/slo.py DEFAULT_SLO_TARGETS`` — every
+                    finished request lands in its class's TTFT/TPOT
+                    histograms and attainment counters; ``slo_report()``
+                    is the per-class view.
+    peak_flops:     the MFU denominator (per-chip peak FLOPs × chips)
+                    for :meth:`flops_report`; ``None`` leaves the MFU
+                    gauge unset unless the report call supplies one.
     """
 
     def __init__(self, engine, *, slots: int = 8,
@@ -625,7 +641,9 @@ class ServingEngine:
                  ngram_min: int = 1,
                  shard_kv: Optional[bool] = None,
                  debug_checks: bool = False,
-                 trace_capacity: int = 16384):
+                 trace_capacity: int = 16384,
+                 slo_targets: Optional[Dict[str, Dict[str, float]]] = None,
+                 peak_flops: Optional[float] = None):
         self.spec_tokens = int(spec_tokens)
         if self.spec_tokens < 0:
             raise ValueError(f"spec_tokens must be >= 0, got {spec_tokens}")
@@ -968,6 +986,25 @@ class ServingEngine:
             "serving_blocks_in_use", "physical KV blocks referenced")
         self._g_free_blocks = m.gauge(
             "serving_free_blocks", "physical KV blocks on the free list")
+        # SLO attainment accounting (telemetry/slo.py): every finished
+        # request lands in its class's TTFT/TPOT histograms + attainment
+        # counters on THIS registry; slo_report() is the per-class view
+        self._slo = SLOTracker(m, slo_targets)
+        self.peak_flops = peak_flops
+        self._flops_profiler = None        # built lazily by flops_report()
+        #: raw (un-sentry-wrapped) program bodies + shape meta, captured
+        #: at build time for the FLOPs profiler — lowering a RAW body for
+        #: cost_analysis never ticks the sentry and never compiles
+        #: (telemetry/flops.py).  "prefill" maps width -> body (bucketed
+        #: mode builds one program per bucket width — each must be costed
+        #: at ITS width), with per-width invocation counts alongside.
+        self._program_bodies: Dict[str, Any] = {}
+        self._program_meta: Dict[str, Any] = {}
+        self._prefill_calls_by_width: Dict[int, int] = {}
+        #: router-noted flow ids (uid -> Chrome flow id): admission emits
+        #: the matching flow-finish so the merged fleet trace draws the
+        #: route -> admit arrow (telemetry/trace.py flow events)
+        self._flow_ids: Dict[Any, int] = {}
         self.timeline = TraceTimeline(capacity=trace_capacity)
         if self.timeline.enabled:
             # bounded lane table: one span lane per SLOT (a request's span
@@ -1087,6 +1124,38 @@ class ServingEngine:
             path, process_name=f"serving:{self.engine.module.name}")
 
     # ------------------------------------------------------------ compiled fns
+    def note_flow(self, uid, flow_id: int) -> None:
+        """Register a Chrome flow id for a routed request: admission will
+        emit the matching flow-finish (``f``) event, linking the router's
+        ``route`` flow-start to this replica's admission in the merged
+        fleet trace (``telemetry/aggregate.merge_chrome_traces``).  The
+        caller (the :class:`~deepspeed_tpu.serving.ReplicaRouter`) owns
+        flow-id uniqueness across every ring that will be merged."""
+        self._flow_ids[uid] = int(flow_id)
+
+    def slo_report(self) -> Dict[str, Dict[str, Any]]:
+        """Per-``slo_class`` attainment report (``telemetry/slo.py``):
+        requests, TTFT/TPOT attainment against the configured targets,
+        merged percentiles, and error-budget burn rates."""
+        return self._slo.report()
+
+    def flops_report(self, peak_flops: Optional[float] = None,
+                     window_s: Optional[float] = None) -> Dict[str, Any]:
+        """FLOPs/MFU snapshot (``telemetry/flops.py``): per-program FLOPs
+        from XLA cost analysis (analytic fallback), cumulative
+        ``serving_model_flops_total``, the MFU gauge against
+        ``peak_flops`` (defaults to the constructor's), and the
+        prefill/decode/swap/idle busy-fraction breakdown from the
+        timeline.  Profiling lowers raw program bodies only — it never
+        compiles and never ticks the recompile sentry."""
+        if self._flops_profiler is None:
+            from ..telemetry.flops import ServingFlopsProfiler
+
+            self._flops_profiler = ServingFlopsProfiler(
+                self, peak_flops=self.peak_flops)
+        return self._flops_profiler.report(peak_flops=peak_flops,
+                                           window_s=window_s)
+
     @property
     def compile_count(self) -> int:
         return len(self.compiled_programs)
@@ -1106,6 +1175,7 @@ class ServingEngine:
                                     block_tables=block_tables)
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
+            self._program_bodies["decode"] = decode_step
             self._decode_fn = jax.jit(self.sentry.wrap(decode_step,
                                                        "decode"),
                                       donate_argnums=self._donate())
@@ -1131,6 +1201,8 @@ class ServingEngine:
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
             if draft is None:
+                self._program_bodies.setdefault("prefill", {})[width] = \
+                    prefill
                 return jax.jit(
                     self.sentry.wrap(prefill, f"prefill[w{width}]"),
                     donate_argnums=self._donate())
@@ -1145,6 +1217,9 @@ class ServingEngine:
                                  lengths=valid, block_tables=block_tables)
                 return first, cache, dcache
 
+            self._program_bodies.setdefault("prefill", {})[width] = \
+                prefill_fused
+            self._program_meta["prefill_fused"] = True
             return jax.jit(
                 self.sentry.wrap(prefill_fused, f"prefill[w{width}]"),
                 donate_argnums=(2, 3) if self._donate() else ())
@@ -1173,6 +1248,7 @@ class ServingEngine:
                                     all_positions=True)
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
+            self._program_bodies["verify"] = verify
             self._verify_fn = jax.jit(self.sentry.wrap(verify, "verify"),
                                       donate_argnums=self._donate())
             self.compiled_programs.append(
@@ -1205,6 +1281,7 @@ class ServingEngine:
                     rollout_step, (tokens, lengths, dcache), None, length=k)
                 return drafts.T, dcache            # [slots, K]
 
+            self._program_bodies["draft"] = propose
             self._draft_fn = jax.jit(
                 self.sentry.wrap(propose, "draft"),
                 donate_argnums=(1,) if self._donate() else ())
@@ -1298,6 +1375,7 @@ class ServingEngine:
         recomputable)."""
         m = self.swap_batch
         stored = 0
+        swap_t0 = self.timeline.now_us()
         for i in range(0, len(blocks), m):
             chunk_b = blocks[i:i + m]
             chunk_k = keys[i:i + m]
@@ -1312,6 +1390,11 @@ class ServingEngine:
                 if self._host.put(key, [lf[:, j] for lf in leaves]) \
                         is not None:
                     stored += 1
+        if blocks:
+            # the demotion round trip as an X span: the FLOPs profiler's
+            # busy-fraction breakdown reads "swap" span durations
+            self.timeline.complete("swap", swap_t0, direction="out",
+                                   blocks=len(blocks))
         if stored:
             self._c_swap_out.inc(stored)
             self._c_swap_bytes.inc(stored * self._host.block_nbytes)
@@ -1526,6 +1609,7 @@ class ServingEngine:
                 chunks = chunks + self._stage_chunks(keys[staged_n:])
         promoted: List[int] = []
         wait_s = 0.0
+        swap_t0 = self.timeline.now_us()
         for ci, (chunk_keys, staged) in enumerate(chunks):
             ids = np.zeros(self.swap_batch, np.int32)
             got: List[int] = []
@@ -1559,6 +1643,8 @@ class ServingEngine:
                     self._unflag_keys(later_keys)
                 break
         if promoted:
+            self.timeline.complete("swap", swap_t0, direction="in",
+                                   blocks=len(promoted))
             # a sharing pending request may have the just-popped keys
             # staged too: drop those records NOW — their staging is stale
             # (the sharer's own admission would probe the chain on device
@@ -1783,6 +1869,12 @@ class ServingEngine:
                                   prompt_tokens=plen,
                                   prefix_hit_tokens=st.base,
                                   resumed=bool(prior))
+            fid = self._flow_ids.pop(req.uid, None)
+            if fid is not None:
+                # close the router's route flow on this admission — the
+                # merged fleet trace draws the router -> replica arrow
+                self.timeline.flow_end("route", fid, uid=str(req.uid),
+                                       slot=slot)
 
     # --------------------------------------------------- incremental serving
     def _validate_request(self, r: Request) -> None:
@@ -1866,6 +1958,12 @@ class ServingEngine:
         self._prefetch_gate.pop(uid, None)
         self._blocked_gate = None          # the head may have been this item
         self._trace_times.pop(uid, None)
+        fid = self._flow_ids.pop(uid, None)
+        if fid is not None:
+            # never admitted — close the router's route flow here so the
+            # merged trace carries no dangling flow start
+            self.timeline.flow_end("route", fid, uid=str(uid),
+                                   cancelled=True)
         self._c_cancelled.inc()
         self._g_queue_depth.set(len(self._pending))
         self.timeline.instant("cancelled", uid=str(uid), queued=True)
@@ -1983,8 +2081,15 @@ class ServingEngine:
         self._blocked_gate = None
         for item in items:
             # the latency span can only finish on the engine that admits
-            # the resume; this engine's stamp would dangle forever
+            # the resume; this engine's stamp would dangle forever.  A
+            # still-noted flow id (queued, never admitted here) closes
+            # NOW — the router starts a fresh flow to the new replica
             self._trace_times.pop(item.req.uid, None)
+            fid = self._flow_ids.pop(item.req.uid, None)
+            if fid is not None:
+                self.timeline.flow_end("route", fid,
+                                       uid=str(item.req.uid),
+                                       handoff=True)
             self._live_uids.discard(item.req.uid)
         self._g_queue_depth.set(0)
         self.timeline.instant("drain", handoff=len(items),
@@ -2169,6 +2274,9 @@ class ServingEngine:
             self._c_finished.inc()
             self._h_ttft.observe(ttft)
             self._h_tpot.observe(tpot)
+            # SLO accounting: unclassified traffic lands in "standard"
+            # so fleet attainment is never flattered by omission
+            self._slo.observe(st.slo_class, ttft, tpot)
             self._latencies.append({
                 "uid": req.uid,
                 "new_tokens": int(gen.size),
@@ -2398,6 +2506,8 @@ class ServingEngine:
                         jnp.asarray(valid))
             first = np.asarray(first)
         self._c_prefill_calls.inc()
+        self._prefill_calls_by_width[width] = \
+            self._prefill_calls_by_width.get(width, 0) + 1
         for row, (slot, v) in enumerate(rows):
             st = active[slot]
             st.base += v

@@ -392,6 +392,7 @@ class DeepSpeedEngine:
         from ..monitor.monitor import MonitorMaster
 
         self.monitor = MonitorMaster(self._config.monitor_config)
+        self._metrics_server = None
 
         self.checkpoint_manager = CheckpointManager(self)
 
@@ -1576,6 +1577,24 @@ class DeepSpeedEngine:
                 f"step={self.global_steps} loss={self._cached_metrics['loss']:.4f} "
                 f"lr={self.get_lr()[0]:.3e} "
                 f"grad_norm={self._cached_metrics['grad_norm']:.3f}", ranks=[0])
+
+    def start_metrics_server(self, port: int = 0,
+                             host: str = "127.0.0.1"):
+        """Serve this engine's registry live (``telemetry/server.py``):
+        ``/metrics`` = Prometheus text, ``/stats`` = JSON snapshot — the
+        training registry joins the same exposition layer the serving
+        fleet scrapes (and federates with it:
+        ``telemetry.federate({"train": engine.metrics, ...})``).
+        ``port=0`` binds an ephemeral port; idempotent; the returned
+        server's ``stop()`` shuts it down."""
+        from ..telemetry.server import MetricsServer
+
+        if self._metrics_server is None:
+            self._metrics_server = MetricsServer(
+                metrics_text=self.metrics.prometheus_text,
+                stats=self.metrics.snapshot,
+                host=host, port=port).start()
+        return self._metrics_server
 
     # -------------------------------------------- reference micro-step shims
     def forward(self, batch) -> jnp.ndarray:

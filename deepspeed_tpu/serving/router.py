@@ -64,14 +64,22 @@ every engine touch — routing probes, pulls, submits, steps — runs under
 a per-replica lock, so the engines themselves stay single-threaded.
 
 **Telemetry**: the router carries its own ``MetricsRegistry`` —
-``routed_affinity_total`` / ``routed_balance_total`` /
-``kv_pulls_total`` (+ blocks/bytes) / ``drains_total`` /
-``readmits_total`` counters and per-replica labeled gauges
+``serving_routed_affinity_total`` / ``serving_routed_balance_total`` /
+``serving_kv_pulls_total`` (+ blocks/bytes) / ``serving_drains_total``
+/ ``serving_readmits_total`` counters and per-replica labeled gauges
 (``serving_replica_blocks_in_use{replica=}``,
 ``serving_replica_queue_depth{replica=}``) — plus a trace timeline of
-``route`` / ``kv_pull`` / ``drain`` / ``readmit`` events
-(docs/observability.md).  ``debug_checks=True`` adds the router-state
-audit (``analysis/invariants.audit_router``) after every ``step``.
+``route`` / ``kv_pull`` / ``drain`` / ``readmit`` events and the
+cross-ring flow starts whose finishes land on the replica rings
+(docs/observability.md).  The FLEET view joins it all:
+``fleet_registry()`` federates the router + replica registries with
+``replica=`` labels (``telemetry/aggregate.py``), ``merged_trace()``
+exports one multi-``pid`` Chrome document with router→replica and
+kv-pull flow arrows, ``slo_report()`` merges the per-replica SLO
+trackers, and ``start_metrics_server(port=)`` serves ``/metrics`` /
+``/stats`` / ``/trace`` live (``telemetry/server.py``).
+``debug_checks=True`` adds the router-state audit
+(``analysis/invariants.audit_router``) after every ``step``.
 """
 
 from __future__ import annotations
@@ -86,7 +94,9 @@ import numpy as np
 from ..analysis.invariants import audit_router
 from ..inference.paged import chain_keys
 from ..inference.serving import Request, RequestHandle, ServingEngine
-from ..telemetry import MetricsRegistry, TraceTimeline
+from ..telemetry import (MetricsRegistry, TraceTimeline, federate,
+                         merge_chrome_traces, merged_slo_report)
+from ..telemetry.server import MetricsServer
 from ..utils.logging import logger
 
 __all__ = ["ReplicaRouter"]
@@ -165,26 +175,29 @@ class ReplicaRouter:
         self._stop_evt = threading.Event()
         self._threads: List[threading.Thread] = []
 
+        # family names carry the serving_ namespace prefix (lint GL008:
+        # the federated fleet registry stays greppable by subsystem)
         m = self.metrics = MetricsRegistry()
         self._c_aff = m.counter(
-            "routed_affinity_total",
+            "serving_routed_affinity_total",
             "requests routed to their deepest prefix-affinity replica")
         self._c_bal = m.counter(
-            "routed_balance_total",
+            "serving_routed_balance_total",
             "requests routed by blocks-in-use balance (no affinity hit)")
         self._c_pulls = m.counter(
-            "kv_pulls_total", "cross-replica KV-pull operations")
+            "serving_kv_pulls_total", "cross-replica KV-pull operations")
         self._c_pull_blocks = m.counter(
-            "kv_pull_blocks_total", "KV blocks moved between replica "
-            "host tiers by cross-replica pulls")
+            "serving_kv_pull_blocks_total", "KV blocks moved between "
+            "replica host tiers by cross-replica pulls")
         self._c_pull_bytes = m.counter(
-            "kv_pull_bytes_total", "bytes moved between replica host "
-            "tiers by cross-replica pulls")
+            "serving_kv_pull_bytes_total", "bytes moved between replica "
+            "host tiers by cross-replica pulls")
         self._c_drains = m.counter(
-            "drains_total", "replica drains (sessions demoted + handed "
-            "off)")
+            "serving_drains_total", "replica drains (sessions demoted + "
+            "handed off)")
         self._c_readmits = m.counter(
-            "readmits_total", "drained replicas re-admitted to routing")
+            "serving_readmits_total",
+            "drained replicas re-admitted to routing")
         self._g_blocks = [
             m.gauge("serving_replica_blocks_in_use",
                     "device KV blocks referenced on the replica",
@@ -194,8 +207,34 @@ class ReplicaRouter:
                     "requests waiting for a slot on the replica",
                     replica=str(i)) for i in range(len(replicas))]
         self.timeline = TraceTimeline(capacity=trace_capacity)
+        #: fleet-wide Chrome flow-id allocator: route->admit and kv-pull
+        #: src->dst flow events must carry unique ids across EVERY ring
+        #: that merge_chrome_traces will combine (allocated under the
+        #: fleet lock only)
+        self._next_flow = 0
+        self.metrics_server: Optional[MetricsServer] = None
 
     # ------------------------------------------------------------- bookkeeping
+    def _flow_id(self) -> int:
+        self._next_flow += 1
+        return self._next_flow
+
+    def _start_route_flow(self, rid: int, uid, **args) -> None:
+        """Distributed trace linkage for one routing decision: flow START
+        on the router ring, flow id noted on the replica (its admission
+        emits the finish).  Must run before the replica's enqueue — a
+        threaded worker could admit the moment submit lands, and the
+        merged document needs ``s`` strictly before ``f``.  ``note_flow``
+        is an optional part of the replica protocol (jax-free test
+        doubles skip it)."""
+        note = getattr(self.replicas[rid], "note_flow", None)
+        if note is None or not self.timeline.enabled \
+                or not self.replicas[rid].timeline.enabled:
+            return
+        fid = self._flow_id()
+        self.timeline.flow_start("route", fid, uid=str(uid),
+                                 replica=int(rid), **args)
+        note(uid, fid)
     def _live(self) -> List[int]:
         return [i for i in range(len(self.replicas))
                 if i not in self._drained]
@@ -314,6 +353,15 @@ class ReplicaRouter:
             self._c_pull_bytes.inc(stored * tgt._host.block_nbytes)
             self.timeline.instant("kv_pull", src=int(best), dst=int(rid),
                                   blocks=int(stored))
+            # flow arrow source-replica lane -> target-replica lane in
+            # the merged fleet trace (start strictly before finish: the
+            # two now_us() stamps are taken sequentially here)
+            if src.timeline.enabled and tgt.timeline.enabled:
+                fid = self._flow_id()
+                src.timeline.flow_start("kv_pull", fid, src=int(best),
+                                        dst=int(rid), blocks=int(stored))
+                tgt.timeline.flow_end("kv_pull", fid, src=int(best),
+                                      dst=int(rid))
         return stored
 
     # ------------------------------------------------------------------ submit
@@ -338,7 +386,12 @@ class ReplicaRouter:
                 self._c_bal.inc()
             if self.kv_pull:
                 self._maybe_pull(rid, request.prompt)
+            # distributed trace linkage: the flow START must be on the
+            # ring before the replica can possibly admit (a threaded
+            # worker could admit the moment submit enqueues), so the
+            # merged document always sees s before f
             with self._locks[rid]:
+                self._start_route_flow(rid, request.uid)
                 handle = self.replicas[rid].submit(
                     request, priority=priority, slo_class=slo_class,
                     eos_token_id=eos_token_id)
@@ -452,6 +505,9 @@ class ReplicaRouter:
         for t in self._threads:
             t.join(timeout=10)
         self._threads = []
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
 
     def serve(self, requests: Sequence[Request],
               eos_token_id: Optional[int] = None) -> Dict[Any, np.ndarray]:
@@ -511,6 +567,8 @@ class ReplicaRouter:
                 if self.kv_pull:
                     self._maybe_pull(new_rid, prompt_eff)
                 with self._locks[new_rid]:
+                    self._start_route_flow(new_rid, item.req.uid,
+                                           resumed=True)
                     self.replicas[new_rid]._submit_item(item)
                 if item.handle is not None:
                     self._handles[item.req.uid] = (item.handle, new_rid)
@@ -549,6 +607,89 @@ class ReplicaRouter:
     @property
     def drained(self) -> List[int]:
         return sorted(self._drained)
+
+    # -------------------------------------------------------- fleet telemetry
+    def _all_locks(self):
+        """Fleet lock + every replica lock, ascending (the drain/cancel
+        order — workers only ever hold one replica lock, so no cycle):
+        a federation pass must not race a step() inserting new series."""
+        from contextlib import ExitStack
+
+        stack = ExitStack()
+        stack.enter_context(self._fleet_lock)
+        for lock in self._locks:
+            stack.enter_context(lock)
+        return stack
+
+    def fleet_registry(self) -> MetricsRegistry:
+        """ONE federated registry over the router registry plus every
+        replica registry (``telemetry/aggregate.federate``): every series
+        labeled ``replica=`` ("router", "0", "1", ...), histograms
+        additionally bucket-wise-summed under ``replica="fleet"``.
+        Rebuilt per call — a snapshot, not a live view."""
+        sources = OrderedDict()
+        sources["router"] = self.metrics
+        for i, rep in enumerate(self.replicas):
+            sources[str(i)] = rep.metrics
+        with self._all_locks():
+            return federate(sources)
+
+    def fleet_metrics_text(self) -> str:
+        """Prometheus text exposition of :meth:`fleet_registry` (the
+        ``/metrics`` endpoint body)."""
+        return self.fleet_registry().prometheus_text()
+
+    def fleet_snapshot(self) -> Dict[str, Any]:
+        """JSON fleet snapshot (the ``/stats`` endpoint body): router
+        stats, the per-class SLO report, and the federated registry
+        snapshot."""
+        with self._all_locks():
+            return {"stats": self.stats(),
+                    "slo": self.slo_report(),
+                    "metrics": self.fleet_registry().snapshot()}
+
+    def merged_trace(self) -> Dict[str, Any]:
+        """ONE Chrome trace document over the router ring plus every
+        replica ring — router = pid 0, replica *i* = pid *i*+1, all
+        timestamps re-based onto the earliest ring epoch — so a routed
+        request's path (route flow -> admission -> per-slot span) and a
+        kv_pull's source->target hop render as flow arrows across
+        ``pid=replica`` lanes (the ``/trace`` endpoint body)."""
+        sources = [("router", self.timeline)] + \
+            [(f"replica {i}", rep.timeline)
+             for i, rep in enumerate(self.replicas)]
+        with self._all_locks():
+            return merge_chrome_traces(sources)
+
+    def dump_merged_trace(self, path: str) -> str:
+        import json
+
+        with open(path, "w") as f:
+            json.dump(self.merged_trace(), f)
+        return path
+
+    def slo_report(self) -> Dict[str, Any]:
+        """Fleet-wide per-``slo_class`` attainment (``telemetry/slo.py``):
+        per-replica counts sum, TTFT/TPOT histograms merge bucket-wise,
+        attainment and burn rate recompute from the merged totals."""
+        return merged_slo_report([rep._slo for rep in self.replicas])
+
+    def start_metrics_server(self, port: int = 0,
+                             host: str = "127.0.0.1") -> MetricsServer:
+        """Start the live exposition server (``telemetry/server.py``)
+        over this fleet: ``/metrics`` = federated Prometheus text,
+        ``/stats`` = fleet snapshot JSON, ``/trace`` = merged Chrome
+        trace.  Scrapes run on the server thread and take the fleet +
+        replica locks briefly — the scheduler never blocks on a slow
+        scraper beyond one registry walk.  Idempotent; ``stop()`` shuts
+        it down."""
+        if self.metrics_server is None:
+            self.metrics_server = MetricsServer(
+                metrics_text=self.fleet_metrics_text,
+                stats=self.fleet_snapshot,
+                trace=self.merged_trace,
+                host=host, port=port).start()
+        return self.metrics_server
 
     # ------------------------------------------------------------------- stats
     def stats(self) -> Dict[str, Any]:
@@ -594,5 +735,7 @@ class ReplicaRouter:
             "prefix_cache_hit_rate": (hit_tokens / prompt_tokens
                                       if prompt_tokens else 0.0),
             "busy_s": self.busy_seconds,
+            "metrics_endpoint": self.metrics_server.url
+            if self.metrics_server is not None else None,
             "per_replica": per,
         }

@@ -43,13 +43,39 @@ class RouterSupervisor:
     def __init__(self, router: ReplicaRouter,
                  probe_replicas: Callable[[], Union[List[int],
                                                     Mapping[int, int]]],
-                 *, grace_ticks: int = 1):
+                 *, grace_ticks: int = 1,
+                 metrics_port: Optional[int] = None,
+                 metrics_host: str = "127.0.0.1"):
         self.router = router
         self.probe_replicas = probe_replicas
         self.grace_ticks = int(grace_ticks)
         self._down_ticks: Dict[int, int] = {}
         self._drained_by_us: set = set()
         self.ticks = 0
+        # the supervisor is the natural owner of the fleet's live
+        # exposition in standalone deployments (launcher --serve): the
+        # same process that watches membership serves /metrics, /stats,
+        # and the merged /trace (telemetry/server.py; port 0 = ephemeral)
+        self._owns_metrics_server = metrics_port is not None \
+            and router.metrics_server is None
+        if metrics_port is not None:
+            router.start_metrics_server(port=metrics_port,
+                                        host=metrics_host)
+
+    @property
+    def metrics_server(self):
+        return self.router.metrics_server
+
+    def close(self) -> None:
+        """Stop the exposition server — but only one this supervisor
+        started itself: a server the operator attached via
+        ``init_router(metrics_port=)`` outlives supervision (drained
+        state is likewise untouched — supervision can resume with a new
+        supervisor)."""
+        if self._owns_metrics_server and \
+                self.router.metrics_server is not None:
+            self.router.metrics_server.stop()
+            self.router.metrics_server = None
 
     def _probe(self) -> set:
         res = self.probe_replicas()

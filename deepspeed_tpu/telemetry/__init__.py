@@ -1,7 +1,7 @@
 """Unified telemetry layer (metrics registry + trace timeline +
-profiling hooks).
+fleet federation + SLO accounting + FLOPs/MFU profiling).
 
-Three coordinated pieces (design notes in each module):
+Coordinated pieces (design notes in each module):
 
  - :mod:`~deepspeed_tpu.telemetry.metrics` — counters / gauges /
    fixed-bucket streaming histograms with labels; Prometheus text
@@ -10,21 +10,38 @@ Three coordinated pieces (design notes in each module):
    engine's monitor events are views over one registry each.
  - :mod:`~deepspeed_tpu.telemetry.trace` — a bounded ring buffer of
    per-request scheduler events exportable as Chrome ``trace_event``
-   JSON (Perfetto), plus the ``jax.profiler`` window bracket.
- - the engines' wiring: ``ServingEngine(trace_capacity=...)`` /
-   ``.dump_trace(path)`` / ``serve(profile_dir=...)`` and
-   ``DeepSpeedEngine``'s registry-routed MonitorMaster events.
+   JSON (Perfetto) with cross-lane flow events, plus the
+   ``jax.profiler`` window bracket.
+ - :mod:`~deepspeed_tpu.telemetry.aggregate` — fleet federation: merge
+   the router + replica registries into one ``replica=``-labeled
+   registry (bucket-wise-summed histograms) and the per-replica trace
+   rings into one multi-``pid`` Chrome document.
+ - :mod:`~deepspeed_tpu.telemetry.server` — the live exposition hop: a
+   thread-owned stdlib HTTP server for ``/metrics`` (Prometheus text),
+   ``/stats`` (JSON), and ``/trace`` (merged Chrome trace).
+ - :mod:`~deepspeed_tpu.telemetry.slo` — per-``slo_class`` TTFT/TPOT
+   histograms, attainment counters against configurable targets, and
+   burn-rate gauges behind ``slo_report()``.
+ - :mod:`~deepspeed_tpu.telemetry.flops` — the serving FLOPs/MFU
+   profiler: XLA ``cost_analysis`` per compiled program family (analytic
+   fallback), ``serving_model_flops_total``, the MFU gauge, and the
+   busy-fraction breakdown.
 
 See ``docs/observability.md`` for the metric name table, label
-conventions, the Perfetto walkthrough, and the overhead contract.
+conventions, the fleet-endpoint walkthrough, and the overhead contract.
 """
 
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       DEFAULT_TIME_BUCKETS_S)
 from .trace import ProfilerWindow, TraceTimeline, validate_chrome_trace
+from .aggregate import federate, merge_chrome_traces, merge_histograms
+from .server import MetricsServer
+from .slo import DEFAULT_SLO_TARGETS, SLOTracker, merged_slo_report
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "DEFAULT_TIME_BUCKETS_S", "ProfilerWindow", "TraceTimeline",
-    "validate_chrome_trace",
+    "validate_chrome_trace", "federate", "merge_chrome_traces",
+    "merge_histograms", "MetricsServer", "DEFAULT_SLO_TARGETS",
+    "SLOTracker", "merged_slo_report",
 ]

@@ -1,0 +1,162 @@
+"""Fleet telemetry federation: merge N metric registries into one
+fleet-labeled view, and N trace rings into one multi-lane Chrome trace.
+
+PR 8 gave every engine a :class:`~deepspeed_tpu.telemetry.metrics.
+MetricsRegistry` and PR 11's router added its own — so a multi-replica
+fleet is N+1 *disconnected* registries and N+1 disconnected trace rings.
+This module is the join:
+
+ - :func:`federate` rebuilds the sources into ONE registry: every series
+   gains a ``replica=<source>`` label (sources that already carry a
+   ``replica`` label — the router's per-replica gauges — keep theirs),
+   and every histogram family additionally gets a ``replica="fleet"``
+   series whose buckets are the **bucket-wise sum** over the sources.
+   Fixed-bucket streaming histograms are mergeable by construction: two
+   rings of counts over identical edges add cell-wise, and the merged
+   quantiles are exactly what one fleet-wide histogram would have
+   recorded.  The federated registry is a *snapshot* — cheap to rebuild
+   per scrape, never mutated in place — so ``prometheus_text()`` /
+   ``snapshot()`` of one ``federate()`` call are always mutually
+   consistent.
+ - :func:`merge_histograms` is the same bucket-wise sum as a standalone
+   helper (``router.slo_report()`` merges per-replica SLO histograms
+   with it).
+ - :func:`merge_chrome_traces` merges trace rings onto distinct ``pid``
+   lanes (router = pid 0, replica *i* = pid *i*+1), re-basing every
+   ring's microsecond timestamps onto the earliest ring epoch (all rings
+   in one process share a clock — ``TraceTimeline.epoch_s``) so the
+   merged document sorts globally and Chrome flow events (``s``/``f``
+   pairs emitted by the router across rings) draw the
+   route→admit and kv-pull source→target arrows between lanes.
+
+The training engine's registry joins the same federation — pass it as a
+source (``federate({"train": engine.metrics, ...})``); nothing here is
+serving-specific.  Everything is host-side and jax-free (the same
+stdlib-only contract as ``telemetry/metrics.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from .metrics import Histogram, MetricsRegistry
+from .trace import TraceTimeline
+
+__all__ = ["federate", "merge_histograms", "merge_chrome_traces",
+           "FLEET_LABEL"]
+
+#: the ``replica=`` label value of bucket-wise-summed histogram series
+FLEET_LABEL = "fleet"
+
+
+def merge_histograms(cells: Sequence[Histogram]) -> Histogram:
+    """Bucket-wise sum of streaming histograms sharing one bucket
+    ladder; raises :class:`ValueError` on mismatched bounds (summing
+    counts across different edges would silently mis-bin everything)."""
+    if not cells:
+        raise ValueError("merge_histograms needs at least one histogram")
+    bounds = cells[0].bounds
+    for c in cells[1:]:
+        if c.bounds != bounds:
+            raise ValueError(
+                f"cannot merge histograms with different buckets: "
+                f"{bounds} vs {c.bounds}")
+    out = Histogram(bounds)
+    for c in cells:
+        for i, n in enumerate(c.counts):
+            out.counts[i] += n
+        out.count += c.count
+        out.sum += c.sum
+    return out
+
+
+def _copy_histogram(dst: Histogram, src: Histogram) -> None:
+    for i, n in enumerate(src.counts):
+        dst.counts[i] += n
+    dst.count += src.count
+    dst.sum += src.sum
+
+
+def federate(sources: Mapping[str, MetricsRegistry],
+             fleet_label: str = FLEET_LABEL) -> MetricsRegistry:
+    """Merge named source registries into one federated registry (module
+    docstring).  ``sources`` maps the ``replica=`` label value ("router",
+    "0", "1", ..., "train") to its registry; insertion order is the
+    exposition order."""
+    out = MetricsRegistry()
+    for src_name, reg in sources.items():
+        for fam in reg.families():
+            for key, cell in fam.series.items():
+                labels = dict(key)
+                # the router's per-replica gauges already say which
+                # replica they describe — re-labeling them with the
+                # SOURCE registry's name would lie
+                labels.setdefault("replica", str(src_name))
+                if fam.kind == "counter":
+                    out.counter(fam.name, fam.help, fam.monitor_name,
+                                **labels).inc(cell.value)
+                elif fam.kind == "gauge":
+                    out.gauge(fam.name, fam.help, fam.monitor_name,
+                              **labels).set(cell.value)
+                else:
+                    dst = out.histogram(fam.name, buckets=cell.bounds,
+                                        help=fam.help,
+                                        monitor_name=fam.monitor_name,
+                                        **labels)
+                    _copy_histogram(dst, cell)
+                    # the fleet aggregate: bucket-wise sum over sources
+                    agg_labels = dict(key)
+                    agg_labels["replica"] = fleet_label
+                    try:
+                        agg = out.histogram(fam.name, buckets=cell.bounds,
+                                            help=fam.help,
+                                            monitor_name=fam.monitor_name,
+                                            **agg_labels)
+                    except ValueError:
+                        # sources disagree on the bucket ladder — the
+                        # per-replica series above still expose
+                        # everything; only the sum is impossible
+                        continue
+                    _copy_histogram(agg, cell)
+    return out
+
+
+def merge_chrome_traces(
+        sources: Sequence[Tuple[str, TraceTimeline]],
+        pids: Optional[Sequence[int]] = None) -> Dict[str, Any]:
+    """Merge trace rings into one Chrome ``trace_event`` document: each
+    source gets its own ``pid`` lane group (named by its ``M`` process
+    metadata), non-metadata timestamps re-base onto the earliest source
+    epoch and re-sort globally, and ``otherData`` sums the ring health
+    counters per source.  Cross-ring flow events pair up in the merged
+    document because their ids come from one fleet-wide counter
+    (``ReplicaRouter``)."""
+    if not sources:
+        raise ValueError("merge_chrome_traces needs at least one source")
+    if pids is None:
+        pids = list(range(len(sources)))
+    base = min(tl.epoch_s for _, tl in sources)
+    meta: List[Dict[str, Any]] = []
+    body: List[Dict[str, Any]] = []
+    dropped = emitted = 0
+    lanes: Dict[str, int] = {}
+    for pid, (name, tl) in zip(pids, sources):
+        off_us = (tl.epoch_s - base) * 1e6
+        doc = tl.to_chrome(process_name=name)
+        for e in doc["traceEvents"]:
+            ne = dict(e)
+            ne["pid"] = pid
+            if ne["ph"] == "M":
+                meta.append(ne)
+            else:
+                ne["ts"] = e["ts"] + off_us
+                body.append(ne)
+        dropped += doc["otherData"]["dropped_events"]
+        emitted += doc["otherData"]["emitted_events"]
+        lanes[name] = pid
+    body.sort(key=lambda e: e["ts"])
+    return {"traceEvents": meta + body,
+            "displayTimeUnit": "ms",
+            "otherData": {"dropped_events": dropped,
+                          "emitted_events": emitted,
+                          "sources": lanes}}

@@ -1,0 +1,147 @@
+"""Live telemetry exposition: a thread-owned stdlib HTTP server serving
+``/metrics`` (Prometheus text), ``/stats`` (JSON snapshot), and
+``/trace`` (Chrome ``trace_event`` JSON).
+
+Until now every telemetry surface was pull-by-code — ``stats()``,
+``metrics.prometheus_text()``, ``dump_trace()`` — which a Prometheus
+scraper or a human with ``curl`` cannot reach while the fleet is live.
+This server is the missing exposition hop, built deliberately on
+``http.server`` only (zero dependencies — the same stdlib-only contract
+as the rest of ``telemetry/``): one daemon thread owns a
+``ThreadingHTTPServer``; each endpoint calls a host-side callback the
+owner wires in (the :class:`~deepspeed_tpu.serving.ReplicaRouter` wires
+its federated fleet registry, fleet snapshot, and merged multi-replica
+trace; a :class:`~deepspeed_tpu.runtime.engine.DeepSpeedEngine` wires
+its training registry).  Callbacks run on scrape, on the server thread —
+the serving scheduler never blocks on a scraper, and a scrape is one
+registry walk, never a device touch.
+
+Endpoints::
+
+    GET /metrics   -> text/plain; version=0.0.4   (Prometheus exposition)
+    GET /stats     -> application/json            (snapshot dict)
+    GET /trace     -> application/json            (Chrome trace document)
+    GET /healthz   -> "ok"
+
+Unwired endpoints return 404; a callback that raises returns 500 with
+the error text (telemetry must never take the serving loop down, and a
+scrape-side bug must be visible to the scraper, not swallowed).
+``port=0`` binds an ephemeral port (tests; ``server.port`` reports it).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+
+from ..utils.logging import logger
+
+__all__ = ["MetricsServer", "PROMETHEUS_CONTENT_TYPE"]
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsServer:
+    """Thread-owned exposition server over host-side telemetry callbacks.
+
+    Parameters
+    ----------
+    metrics_text:  ``() -> str`` Prometheus text for ``/metrics``.
+    stats:         ``() -> dict`` JSON-able snapshot for ``/stats``.
+    trace:         ``() -> dict`` Chrome trace document for ``/trace``.
+    host / port:   bind address; ``port=0`` picks an ephemeral port.
+    """
+
+    def __init__(self, *,
+                 metrics_text: Optional[Callable[[], str]] = None,
+                 stats: Optional[Callable[[], Dict[str, Any]]] = None,
+                 trace: Optional[Callable[[], Dict[str, Any]]] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self._callbacks = {"metrics_text": metrics_text, "stats": stats,
+                           "trace": trace}
+        self.host = host
+        self._requested_port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------------------- control
+    def start(self) -> "MetricsServer":
+        """Bind and serve on a daemon thread; idempotent."""
+        if self._httpd is not None:
+            return self
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):   # noqa: N802 — stdlib API
+                pass                             # scrapes are not log news
+
+            def do_GET(self):                    # noqa: N802 — stdlib API
+                server._handle(self)
+
+        httpd = ThreadingHTTPServer((self.host, self._requested_port),
+                                    _Handler)
+        httpd.daemon_threads = True
+        self._httpd = httpd
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, name="telemetry-metrics-server",
+            daemon=True)
+        self._thread.start()
+        logger.info(f"telemetry: metrics server listening on {self.url}")
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._httpd = None
+        self._thread = None
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    @property
+    def url(self) -> Optional[str]:
+        return f"http://{self.host}:{self.port}" if self._httpd else None
+
+    # --------------------------------------------------------------- handling
+    def _handle(self, req: BaseHTTPRequestHandler) -> None:
+        path = req.path.split("?", 1)[0]
+        if path == "/healthz":
+            self._respond(req, 200, "ok", "text/plain; charset=utf-8")
+            return
+        route = {"/metrics": ("metrics_text", PROMETHEUS_CONTENT_TYPE),
+                 "/stats": ("stats", "application/json"),
+                 "/trace": ("trace", "application/json")}.get(path)
+        if route is None or self._callbacks.get(route[0]) is None:
+            self._respond(req, 404, f"no handler for {path}\n",
+                          "text/plain; charset=utf-8")
+            return
+        name, ctype = route
+        try:
+            body = self._callbacks[name]()
+            if not isinstance(body, str):
+                body = json.dumps(body)
+        except Exception as e:               # noqa: BLE001 — scrape-side
+            # a failing callback must be VISIBLE to the scraper (a 500
+            # trips Prometheus "up" alerts) and must not kill the thread
+            logger.warning(f"telemetry: {path} callback failed: {e!r}")
+            self._respond(req, 500, f"{type(e).__name__}: {e}\n",
+                          "text/plain; charset=utf-8")
+            return
+        self._respond(req, 200, body, ctype)
+
+    @staticmethod
+    def _respond(req: BaseHTTPRequestHandler, code: int, body: str,
+                 ctype: str) -> None:
+        data = body.encode("utf-8")
+        req.send_response(code)
+        req.send_header("Content-Type", ctype)
+        req.send_header("Content-Length", str(len(data)))
+        req.end_headers()
+        req.wfile.write(data)

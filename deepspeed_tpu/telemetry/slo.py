@@ -1,0 +1,199 @@
+"""SLO attainment accounting: per-class TTFT/TPOT distributions,
+attainment counters against configurable latency targets, and
+error-budget burn-rate gauges.
+
+The scheduler has ordered admission by ``slo_class`` since PR 11
+(``SLO_PRIORITY``), but nothing *measured* whether a class actually got
+the latency its priority was supposed to buy.  This module closes the
+loop: every finished request lands in its class's streaming TTFT/TPOT
+histograms and attainment counters, so ``slo_report()`` can answer "is
+the realtime class meeting its 500ms TTFT target, and how fast is it
+burning its error budget?" — the signal the ROADMAP's closed-loop
+autotuner and any capacity decision (add a replica / shed batch
+traffic) keys off.
+
+Definitions (per class, per latency dimension):
+
+ - **attainment** = attained / total — the fraction of finished requests
+   at or under the class target.
+ - **objective** — the attainment fraction the class promises (e.g.
+   "99% of realtime requests see TTFT ≤ 0.5s").
+ - **burn rate** = (1 - attainment) / (1 - objective) — how fast the
+   error budget burns: 1.0 = exactly on budget, >1 = violating faster
+   than the objective allows (the standard SRE multi-window burn-rate
+   alert input), 0 = no violations.
+
+Requests submitted without an ``slo_class`` are accounted under
+``"standard"`` — every request is SLO-accounted, so fleet attainment
+can never be flattered by unclassified traffic.
+
+Metric families (on the owning engine's registry; label ``slo_class``,
+plus ``slo ∈ {ttft, tpot}`` on the attainment/burn families):
+
+ - ``serving_slo_requests_total{slo_class=}``
+ - ``serving_slo_attained_total{slo_class=, slo=}``
+ - ``serving_slo_ttft_seconds{slo_class=}`` /
+   ``serving_slo_tpot_seconds{slo_class=}`` (histograms — bucket-wise
+   mergeable across replicas, ``telemetry/aggregate.py``)
+ - ``serving_slo_burn_rate{slo_class=, slo=}`` (gauge)
+
+Everything is host-side, jax-free, and O(1) per finished request.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional, Sequence
+
+from .aggregate import merge_histograms
+from .metrics import DEFAULT_TIME_BUCKETS_S, MetricsRegistry
+
+__all__ = ["DEFAULT_SLO_TARGETS", "SLOTracker", "merged_slo_report"]
+
+#: per-class latency targets + attainment objective.  The classes mirror
+#: ``inference/serving.py SLO_PRIORITY``; targets are deliberately
+#: generous defaults — production overrides them per deployment
+#: (``init_serving(slo_targets=...)`` / ``init_router(...)``).
+DEFAULT_SLO_TARGETS: Dict[str, Dict[str, float]] = {
+    "realtime":    {"ttft_s": 0.5,  "tpot_s": 0.05, "objective": 0.99},
+    "interactive": {"ttft_s": 1.0,  "tpot_s": 0.10, "objective": 0.95},
+    "standard":    {"ttft_s": 2.5,  "tpot_s": 0.25, "objective": 0.90},
+    "batch":       {"ttft_s": 30.0, "tpot_s": 1.00, "objective": 0.50},
+}
+
+_DIMS = ("ttft", "tpot")
+
+
+class SLOTracker:
+    """Per-class SLO accounting over one engine's finished requests.
+
+    Parameters
+    ----------
+    registry:  the engine's :class:`MetricsRegistry` — all cells live
+               there, so scrapes/snapshots/federation see them for free.
+    targets:   ``{class: {"ttft_s", "tpot_s", "objective"}}`` overrides,
+               merged OVER :data:`DEFAULT_SLO_TARGETS` per class (a
+               partial override keeps the other fields' defaults); new
+               class names are allowed.
+    """
+
+    def __init__(self, registry: MetricsRegistry,
+                 targets: Optional[Mapping[str, Mapping[str, float]]]
+                 = None):
+        self.registry = registry
+        self.targets: Dict[str, Dict[str, float]] = {
+            cls: dict(t) for cls, t in DEFAULT_SLO_TARGETS.items()}
+        for cls, t in (targets or {}).items():
+            base = self.targets.setdefault(
+                cls, dict(DEFAULT_SLO_TARGETS["standard"]))
+            base.update(t)
+        self._cells: Dict[str, Dict[str, Any]] = {}
+        # create every configured class's cells up front: the metric
+        # schema (and the report key set) is stable regardless of which
+        # classes this trace's traffic happened to exercise
+        for cls in self.targets:
+            self._class_cells(cls)
+
+    def _class_cells(self, cls: str) -> Dict[str, Any]:
+        cells = self._cells.get(cls)
+        if cells is None:
+            m = self.registry
+            cells = self._cells[cls] = {
+                "requests": m.counter(
+                    "serving_slo_requests_total",
+                    "finished requests accounted per SLO class",
+                    slo_class=cls),
+                "ttft_hist": m.histogram(
+                    "serving_slo_ttft_seconds",
+                    help="per-class time to first token", slo_class=cls),
+                "tpot_hist": m.histogram(
+                    "serving_slo_tpot_seconds",
+                    help="per-class time per output token", slo_class=cls),
+                "ttft_attained": m.counter(
+                    "serving_slo_attained_total",
+                    "finished requests at or under the class target",
+                    slo_class=cls, slo="ttft"),
+                "tpot_attained": m.counter(
+                    "serving_slo_attained_total",
+                    "finished requests at or under the class target",
+                    slo_class=cls, slo="tpot"),
+                "ttft_burn": m.gauge(
+                    "serving_slo_burn_rate",
+                    "error-budget burn rate: (1 - attainment) / "
+                    "(1 - objective); > 1 violates faster than the "
+                    "objective allows", slo_class=cls, slo="ttft"),
+                "tpot_burn": m.gauge(
+                    "serving_slo_burn_rate",
+                    "error-budget burn rate: (1 - attainment) / "
+                    "(1 - objective); > 1 violates faster than the "
+                    "objective allows", slo_class=cls, slo="tpot"),
+            }
+        return cells
+
+    def observe(self, slo_class: Optional[str], ttft_s: float,
+                tpot_s: float) -> None:
+        """Account one finished request (``None`` class → "standard")."""
+        cls = str(slo_class) if slo_class is not None else "standard"
+        cells = self._class_cells(cls)
+        tgt = self.targets.setdefault(
+            cls, dict(DEFAULT_SLO_TARGETS["standard"]))
+        cells["requests"].inc()
+        cells["ttft_hist"].observe(ttft_s)
+        cells["tpot_hist"].observe(tpot_s)
+        total = cells["requests"].value
+        for dim, v in (("ttft", ttft_s), ("tpot", tpot_s)):
+            if v <= tgt[f"{dim}_s"]:
+                cells[f"{dim}_attained"].inc()
+            attainment = cells[f"{dim}_attained"].value / total
+            allowed = max(1.0 - tgt["objective"], 1e-9)
+            cells[f"{dim}_burn"].set((1.0 - attainment) / allowed)
+
+    # ------------------------------------------------------------ reporting
+    def report(self) -> Dict[str, Dict[str, Any]]:
+        """Per-class attainment report — a stable-schema dict view over
+        the cells (class key set = configured targets plus any class
+        traffic introduced)."""
+        return merged_slo_report([self])
+
+
+def merged_slo_report(trackers: Sequence["SLOTracker"]
+                      ) -> Dict[str, Dict[str, Any]]:
+    """One fleet-wide SLO report over per-replica trackers: counts sum,
+    histograms merge bucket-wise (``telemetry/aggregate.py``), and
+    attainment/burn recompute from the merged totals.  Targets come from
+    the first tracker that knows the class (``init_router`` gives every
+    replica the same targets)."""
+    if not trackers:
+        return {}
+    classes: Dict[str, Dict[str, float]] = {}
+    for t in trackers:
+        for cls, tgt in t.targets.items():
+            classes.setdefault(cls, tgt)
+    out: Dict[str, Dict[str, Any]] = {}
+    for cls in sorted(classes):
+        tgt = classes[cls]
+        have = [t._cells[cls] for t in trackers if cls in t._cells]
+        requests = int(sum(c["requests"].value for c in have))
+        entry: Dict[str, Any] = {
+            "requests": requests,
+            "objective": tgt["objective"],
+        }
+        for dim in _DIMS:
+            entry[f"{dim}_target_s"] = tgt[f"{dim}_s"]
+            attained = int(sum(c[f"{dim}_attained"].value for c in have))
+            entry[f"{dim}_attained"] = attained
+            if requests:
+                attainment = attained / requests
+                entry[f"{dim}_attainment"] = attainment
+                entry[f"{dim}_burn_rate"] = (1.0 - attainment) / \
+                    max(1.0 - tgt["objective"], 1e-9)
+            else:
+                entry[f"{dim}_attainment"] = None
+                entry[f"{dim}_burn_rate"] = 0.0
+            hists = [c[f"{dim}_hist"] for c in have]
+            merged = merge_histograms(hists) if hists else None
+            entry[f"{dim}_p50_s"] = merged.quantile(0.50) if merged \
+                else None
+            entry[f"{dim}_p95_s"] = merged.quantile(0.95) if merged \
+                else None
+        out[cls] = entry
+    return out

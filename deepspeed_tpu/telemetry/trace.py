@@ -15,7 +15,10 @@ pinned ≤2% by the ``--telemetry-bench`` serving-bench lane).
 
 Export (:meth:`TraceTimeline.to_chrome` / :meth:`dump`) follows the
 Chrome ``trace_event`` JSON-object format: ``X`` (complete) events carry
-``ts``+``dur``, ``i`` (instant) events just ``ts``, every event has
+``ts``+``dur``, ``i`` (instant) events just ``ts``, ``s``/``f`` flow
+events carry a shared ``id`` and render as arrows between lanes (the
+cross-replica request/KV-pull linkage — ``telemetry/aggregate.py``
+merges rings onto distinct ``pid`` lanes), every event has
 ``pid``/``tid``, timestamps are microseconds since the timeline epoch and
 sorted ascending, and ``M`` metadata events name the process and each
 registered thread lane.  Load the file at https://ui.perfetto.dev (or
@@ -78,6 +81,13 @@ class TraceTimeline:
         """Microseconds since the timeline epoch (event ``ts`` domain)."""
         return (self._clock() - self._t0) * 1e6
 
+    @property
+    def epoch_s(self) -> float:
+        """The timeline's epoch on its own clock — rings recorded in one
+        process share a clock, so ``telemetry/aggregate.py`` re-bases
+        every ring's ``ts`` onto the earliest epoch when merging."""
+        return self._t0
+
     # --------------------------------------------------------------- threads
     def thread(self, name: str) -> int:
         """Allocate (or look up) a named lane; returns its ``tid``.
@@ -122,6 +132,41 @@ class TraceTimeline:
         end = self.now_us() if end_us is None else end_us
         ev = {"name": name, "ph": "X", "ts": start_us,
               "dur": max(end - start_us, 0.0),
+              "pid": self.pid, "tid": tid}
+        if args:
+            ev["args"] = args
+        self._push(ev)
+
+    def flow_start(self, name: str, flow_id: int,
+                   tid: int = SCHEDULER_TID, ts: Optional[float] = None,
+                   **args) -> None:
+        """One ``s`` (flow start) event.  Chrome flow events with the same
+        ``id`` render as an arrow between lanes — even across ``pid``s in
+        a merged multi-replica document — which is how a routed request's
+        router span links to its replica admission, and a cross-replica
+        KV pull links its source lane to its target lane.  Callers must
+        allocate ``flow_id`` uniquely across every ring that will be
+        merged (the ``ReplicaRouter`` owns one counter for the fleet)."""
+        if not self.enabled:
+            return
+        ev = {"name": name, "ph": "s", "cat": "flow", "id": int(flow_id),
+              "ts": self.now_us() if ts is None else ts,
+              "pid": self.pid, "tid": tid}
+        if args:
+            ev["args"] = args
+        self._push(ev)
+
+    def flow_end(self, name: str, flow_id: int,
+                 tid: int = SCHEDULER_TID, ts: Optional[float] = None,
+                 **args) -> None:
+        """One ``f`` (flow finish) event — the arrowhead of the matching
+        :meth:`flow_start`.  ``bp: "e"`` binds it to the enclosing slice
+        (Chrome's "bind to enclosing" convention)."""
+        if not self.enabled:
+            return
+        ev = {"name": name, "ph": "f", "cat": "flow", "bp": "e",
+              "id": int(flow_id),
+              "ts": self.now_us() if ts is None else ts,
               "pid": self.pid, "tid": tid}
         if args:
             ev["args"] = args
@@ -176,31 +221,51 @@ class TraceTimeline:
         return path
 
 
-def validate_chrome_trace(doc: Dict[str, Any]) -> Dict[str, Any]:
+def validate_chrome_trace(doc: Dict[str, Any],
+                          strict_flows: Optional[bool] = None
+                          ) -> Dict[str, Any]:
     """Schema-check an exported Chrome ``trace_event`` document; raises
     :class:`ValueError` naming the first violation, returns a summary.
 
     Checked (the contract the serving bench records and the telemetry
     tests pin): ``traceEvents`` is a list; every event carries ``name`` /
     ``ph`` / ``ts`` / ``pid`` / ``tid``; phases are ``M``/``i``/``X``/
-    ``B``/``E`` with ``X`` events carrying a non-negative ``dur`` and
-    ``B``/``E`` balanced per ``(pid, tid)``; non-metadata timestamps are
-    monotone non-decreasing (sorted export).  Summary counts let callers
-    assert content (e.g. per-request span count) without re-walking."""
+    ``B``/``E``/``s``/``f`` with ``X`` events carrying a non-negative
+    ``dur``, ``B``/``E`` balanced per ``(pid, tid)``, ``s``/``f`` flow
+    events carrying an ``id``; non-metadata timestamps are monotone
+    non-decreasing (sorted export).
+
+    ``strict_flows`` additionally requires every flow to PAIR — each
+    finish follows a start with the same id, no start dangles.  Default
+    ``None`` auto-enables it for merged multi-source documents
+    (``otherData.sources``, the ``merge_chrome_traces`` marker) and
+    leaves single rings lenient: one replica's ring legitimately holds
+    only its half of a cross-ring flow (the router holds the other), so
+    strict pairing is a whole-fleet property.  Unpaired flows are
+    counted in ``flow_unmatched`` either way (in a merged document a
+    nonzero count means the other end was never emitted or fell off a
+    ring — check ``dropped_events``).  Summary counts let callers assert
+    content (e.g. per-request span count, cross-replica flow count)
+    without re-walking."""
+    if strict_flows is None:
+        strict_flows = bool(doc.get("otherData", {}).get("sources"))
     events = doc.get("traceEvents")
     if not isinstance(events, list) or not events:
         raise ValueError("traceEvents must be a non-empty list")
     last_ts = None
     open_spans: Dict[tuple, int] = {}
+    flow_started: Dict[Any, int] = {}      # flow id -> finish count
     summary = {"events": len(events), "complete": 0, "instant": 0,
-               "metadata": 0, "request_spans": 0}
+               "metadata": 0, "request_spans": 0, "flow_starts": 0,
+               "flow_ends": 0, "flow_unmatched": 0}
+    orphan_ends = 0
     for i, e in enumerate(events):
         for field in ("name", "ph", "ts", "pid", "tid"):
             if field not in e:
                 raise ValueError(f"event {i} ({e.get('name')!r}) is "
                                  f"missing {field!r}")
         ph = e["ph"]
-        if ph not in ("M", "i", "X", "B", "E"):
+        if ph not in ("M", "i", "X", "B", "E", "s", "f"):
             raise ValueError(f"event {i} has unknown phase {ph!r}")
         if ph == "M":
             summary["metadata"] += 1
@@ -229,9 +294,30 @@ def validate_chrome_trace(doc: Dict[str, Any]) -> Dict[str, Any]:
                 raise ValueError(
                     f"event {i}: E without a matching B on lane {key}")
             open_spans[key] -= 1
+        elif ph in ("s", "f"):
+            if "id" not in e:
+                raise ValueError(
+                    f"flow event {i} ({e['name']!r}) is missing 'id'")
+            if ph == "s":
+                flow_started.setdefault(e["id"], 0)
+                summary["flow_starts"] += 1
+            else:
+                if e["id"] not in flow_started:
+                    if strict_flows:
+                        raise ValueError(
+                            f"event {i}: flow finish 'f' (id {e['id']!r}) "
+                            "without a preceding flow start 's'")
+                    orphan_ends += 1
+                else:
+                    flow_started[e["id"]] += 1
+                summary["flow_ends"] += 1
     dangling = {k: v for k, v in open_spans.items() if v}
     if dangling:
         raise ValueError(f"unclosed B spans on lanes {dangling}")
+    unfinished = [fid for fid, ends in flow_started.items() if not ends]
+    if unfinished and strict_flows:
+        raise ValueError(f"flow start(s) without a finish: {unfinished}")
+    summary["flow_unmatched"] = orphan_ends + len(unfinished)
     return summary
 
 

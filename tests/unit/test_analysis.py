@@ -336,6 +336,43 @@ def driver(xs):
     assert _codes(pragma) == []
 
 
+def test_gl008_metric_convention_fires_and_near_miss():
+    fires = """
+def build(m):
+    a = m.counter("serving_requests")            # counter missing _total
+    b = m.counter("things_total", "help")        # missing namespace
+    c = m.gauge("serving_queue_total")           # gauge claiming _total
+    d = m.histogram("serving_lat_seconds", uid="x")  # ad-hoc label key
+"""
+    codes = _codes(fires)
+    assert codes.count("GL008") == 4, codes
+    near_miss = """
+import collections
+
+def build(m, name):
+    a = m.counter("serving_requests_admitted_total", "help")
+    b = m.gauge("train_loss", "help", replica="0")
+    c = m.histogram("inference_forward_seconds", buckets=(1.0, 2.0),
+                    timer="fwd", monitor_name="X/y")
+    d = m.counter("serving_kv_swaps_total", direction="out")
+    e = m.counter(name)                          # non-literal: out of scope
+    f = collections.Counter("abc")               # not a registry call
+    g = m.gauge("serving_slo_burn_rate", slo_class="batch", slo="ttft")
+"""
+    assert "GL008" not in _codes(near_miss)
+    # one bad call can violate two conventions at once — both fire
+    double = """
+def build(m):
+    m.counter("queue_depth")   # no namespace AND not _total
+"""
+    assert _codes(double).count("GL008") == 2
+    pragma = """
+def build(m):
+    m.counter("legacy_hits")  # graft: noqa(GL008) pre-registry name, migrating
+"""
+    assert _codes(pragma) == []
+
+
 def test_noqa_pragma_suppresses_named_rule_only():
     src = """
 import jax

@@ -1,0 +1,124 @@
+"""Telemetry schema stability: the observable dict surfaces —
+``ServingEngine.stats()``, ``ReplicaRouter.stats()`` (+ per-replica
+rows), and ``slo_report()`` — are PINNED key-for-key.
+
+Dashboards, the bench JSON artifacts, and every PR 2–11 test read these
+dicts by key; a silently dropped or renamed key is a breaking API change
+nothing else would catch until a dashboard 404s.  The frozen sets below
+are the contract: every pre-existing key must stay byte-identical
+(the PR 12 acceptance criterion), and a NEW key is added here
+deliberately, in the same PR that introduces it.
+"""
+
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.serving import Request, ServingEngine
+from deepspeed_tpu.models import gpt2
+from deepspeed_tpu.serving import ReplicaRouter
+import pytest
+
+
+@pytest.fixture(scope="module")
+def served():
+    cfg = gpt2.GPT2Config.tiny(max_seq_len=128)
+    deepspeed_tpu.comm.reset_topology()
+    engine = deepspeed_tpu.init_inference(
+        gpt2.build(cfg),
+        config={"dtype": "fp32", "tensor_parallel": {"tp_size": 1}})
+    srv = ServingEngine(engine, slots=2, max_seq_len=64, block_size=8,
+                        prefill_chunk=16)
+    router = ReplicaRouter([srv])
+    rng = np.random.default_rng(0)
+    reqs = [Request(uid=i, prompt=rng.integers(0, cfg.vocab_size, 9 + i),
+                    max_new_tokens=3) for i in range(3)]
+    router.serve(reqs)
+    return srv, router
+
+
+#: ServingEngine.stats() — the PR 2–11 key set, frozen byte-identical
+#: (PR 12 added NO engine stats keys: SLO and FLOPs ride slo_report()
+#: and flops_report(), the registry carries their metric families)
+ENGINE_STATS_KEYS = frozenset({
+    "acceptance_rate", "accepted_tokens", "admitted", "backend_compiles",
+    "block_size", "blocks_in_use", "cancelled", "compile_budget",
+    "compile_count", "debug_checks", "decode_steps", "drafted_tokens",
+    "evicted", "free_blocks", "generated_tokens", "host_blocks",
+    "host_blocks_in_use", "host_pool_bytes", "invariant_checks_run",
+    "iterations", "kv_dtype", "kv_pool_bytes", "kv_pool_bytes_per_chip",
+    "kv_pool_shape", "kv_scale_bytes", "kv_sharded", "mode",
+    "num_blocks", "prefetch_misses", "prefetch_wait_p50_s",
+    "prefetch_wait_p95_s", "prefill_calls", "prefix_cache_entries",
+    "prefix_cache_evictions", "prefix_cache_hit_rate",
+    "prefix_hit_tokens", "prompt_tokens", "quantize", "queue_depth",
+    "requests_finished", "resume_recompute_tokens", "retraces_observed",
+    "spec_rounds", "spec_tokens", "speculative", "swap_bytes", "swap_in",
+    "swap_out", "tp_degree", "tpot_p50_s", "tpot_p95_s",
+    "trace_capacity", "trace_events", "trace_events_dropped",
+    "ttft_p50_s", "ttft_p95_s", "weight_quant",
+})
+
+#: ReplicaRouter.stats() — PR 11 keys + PR 12's "metrics_endpoint"
+ROUTER_STATS_KEYS = frozenset({
+    "busy_s", "drained", "drains", "generated_tokens", "kv_pull",
+    "kv_pull_blocks", "kv_pull_bytes", "kv_pulls", "metrics_endpoint",
+    "per_replica", "policy", "prefix_cache_hit_rate", "prompt_tokens",
+    "readmits", "replicas", "routed_affinity", "routed_balance",
+})
+
+PER_REPLICA_KEYS = frozenset({
+    "active", "admitted", "blocks_in_use", "busy_s", "compile_budget",
+    "compile_count", "drained", "generated_tokens",
+    "prefix_cache_hit_rate", "queue_depth", "replica",
+})
+
+#: slo_report() — one entry per class, each with this exact shape
+SLO_CLASSES = frozenset({"realtime", "interactive", "standard", "batch"})
+SLO_CLASS_KEYS = frozenset({
+    "objective", "requests",
+    "ttft_attained", "ttft_attainment", "ttft_burn_rate",
+    "ttft_p50_s", "ttft_p95_s", "ttft_target_s",
+    "tpot_attained", "tpot_attainment", "tpot_burn_rate",
+    "tpot_p50_s", "tpot_p95_s", "tpot_target_s",
+})
+
+
+def test_engine_stats_keys_pinned(served):
+    srv, _ = served
+    assert set(srv.stats().keys()) == ENGINE_STATS_KEYS
+
+
+def test_engine_stats_keys_pinned_with_draft_pool_extras(served):
+    """The only engine stats() extension point: a draft pool adds its
+    two byte-accounting keys (PR 5 behavior, unchanged)."""
+    srv, _ = served
+    st = set(srv.stats().keys())
+    assert "draft_pool_bytes" not in st       # no draft on this engine
+
+
+def test_router_stats_keys_pinned(served):
+    _, router = served
+    st = router.stats()
+    assert set(st.keys()) == ROUTER_STATS_KEYS
+    assert set(st["per_replica"][0].keys()) == PER_REPLICA_KEYS
+
+
+def test_slo_report_schema_pinned(served):
+    srv, router = served
+    for rep in (srv.slo_report(), router.slo_report()):
+        assert set(rep.keys()) == SLO_CLASSES
+        for cls, entry in rep.items():
+            assert set(entry.keys()) == SLO_CLASS_KEYS, cls
+
+
+def test_flops_report_schema_pinned(served):
+    srv, _ = served
+    rep = srv.flops_report()
+    assert set(rep.keys()) == {
+        "programs", "program_calls", "model_flops_total",
+        "flops_per_generated_token", "generated_tokens", "window_s",
+        "peak_flops", "mfu", "busy_fractions"}
+    for prog in rep["programs"].values():
+        assert set(prog.keys()) == {
+            "rows", "width", "flops_analytic", "flops_cost_analysis",
+            "flops_per_call", "tokens_per_call", "source"}
