@@ -1396,6 +1396,138 @@ def run_replica_bench(replicas: int = 4, requests: int = 64,
     }
 
 
+def run_autotune_bench(requests: int = 64, sessions: int = 16,
+                       prefix_len: int = 256, pool_frac: float = 0.25,
+                       slots: int = 8, layers: int = 2, hidden: int = 128,
+                       heads: int = 4, vocab: int = 2048, seed: int = 0,
+                       dtype: str = "fp32",
+                       results_dir: str = "autotuning_results_serving",
+                       max_trials: int = None, min_budget: int = None,
+                       eta: int = 2, min_speedup: float = 1.0,
+                       resume: bool = False):
+    """BENCH_r13 protocol (ROADMAP item 5): closed-loop serving autotune
+    on the BENCH_r09 returning-sessions trace.
+
+    The workload is ``sessions`` distinct ``prefix_len``-token session
+    prefixes dealt round-robin over ``requests`` requests, with the
+    device pool pressure-sized at ``pool_frac`` of the unique working
+    set — the hand-picked default config (pressured pool, no host tier,
+    no speculation) is candidate 0 AND the parity reference for every
+    trial.  ``autotuning/runner.py tune_serving`` searches the knob
+    space under the byte-equal memory ceiling with successive halving;
+    every trial is parity-gated and runs ``debug_checks=True`` so the
+    recompile sentry enforces each candidate's compile budget at trace
+    time.  The bench gates on the measured winner >= ``min_speedup`` x
+    the measured default and on ``best_config.json`` round-tripping
+    through ``init_serving(**config)``."""
+    import deepspeed_tpu
+    from deepspeed_tpu.autotuning import ModelGeom, sessions_trace, \
+        tune_serving
+    from deepspeed_tpu.autotuning.space import workload_space
+    from deepspeed_tpu.models import gpt2
+
+    trace = sessions_trace(requests, vocab=vocab, seed=seed,
+                           sessions=sessions, prefix_len=prefix_len,
+                           tail_range=TAIL_RANGE,
+                           new_range=PREFIX_NEW_RANGE)
+    cfg = gpt2.GPT2Config(vocab_size=vocab, max_seq_len=1024,
+                          num_layers=layers, num_heads=heads,
+                          hidden_size=hidden)
+    deepspeed_tpu.comm.reset_topology()
+    engine = deepspeed_tpu.init_inference(
+        gpt2.build(cfg), config={"dtype": dtype,
+                                 "tensor_parallel": {"tp_size": 1}})
+    # the searched knobs: block geometry vs pool depth under ONE byte
+    # ceiling, chunk window, n-gram speculation, and the host tier (the
+    # BENCH_r09 escape hatch from pool-pressure preemption).  The
+    # spec_tokens=24 point is deliberately past the verify kernel's
+    # window: the constraint layer must prune it BEFORE any trial runs
+    # (pruned_by_constraint in the artifact), not crash a trial
+    space = workload_space(
+        ModelGeom.from_engine(engine), trace, pool_frac=pool_frac,
+        base={"slots": slots},
+        domains={"block_size": (32, 64),
+                 "prefill_chunk": (128, 256),
+                 "spec_tokens": (0, 4, 24),
+                 "host_blocks": (0, "ws")})
+    summary = tune_serving(engine, trace, space=space, eta=eta,
+                           min_budget=min_budget, max_trials=max_trials,
+                           results_dir=results_dir, resume=resume)
+
+    # best_config.json must round-trip: build an engine straight from the
+    # artifact and replay a short slice through it
+    with open(os.path.join(results_dir, "best_config.json")) as f:
+        best = json.load(f)
+    deepspeed_tpu.comm.reset_topology()
+    srv = deepspeed_tpu.init_serving(
+        gpt2.build(cfg), config={"dtype": dtype}, **best)
+    probe = trace.slice(min(4, len(trace)))
+    handles = probe.submit_all(srv)
+    while srv.step():
+        pass
+    outs = {h.uid: h.result(timeout=0) for h in handles}
+    roundtrip_ok = all(outs[u] is not None for u in outs) and \
+        srv.resolved_config()["block_size"] == best["block_size"] and \
+        srv.resolved_config()["num_blocks"] == best["num_blocks"] and \
+        srv.resolved_config()["host_blocks"] == best["host_blocks"]
+
+    speedup = summary["speedup"] or 0.0
+    res = {
+        "protocol": "closed-loop serving autotune (BENCH_r13): "
+                    "successive-halving search over the serving knob "
+                    "space on the BENCH_r09 returning-sessions trace, "
+                    "every trial parity-gated with sentry-enforced "
+                    "compile budgets; winner re-run at full budget vs "
+                    "the hand-picked default",
+        "trace": {"requests": requests, "sessions": sessions,
+                  "prefix_len": prefix_len, "pool_frac": pool_frac,
+                  "working_set_tokens": trace.working_set_tokens(),
+                  "max_total_len": trace.max_total_len()},
+        "model": {"layers": layers, "hidden": hidden, "heads": heads,
+                  "vocab": vocab, "dtype": dtype},
+        "search": {
+            "candidates": summary["candidates"],
+            "admissible": summary["admissible"],
+            "pruned_by_constraint": summary["pruned_by_constraint"],
+            "trials_executed": summary["trials_executed"],
+            "trials_total": summary["trials_total"],
+            "budget_spent_requests": summary["budget_spent_requests"],
+            "rungs": summary["rungs"],
+            "exhausted": summary["exhausted"],
+            "mem_ceiling_bytes": space.mem_ceiling_bytes,
+        },
+        "default": {
+            "config": space.default_config(),
+            "measured_tok_s": summary["default"]["measured_tok_s"],
+        },
+        "winner": {
+            "config": summary["best_config"],
+            "predicted_tok_s": summary["winner"]["predicted_tok_s"],
+            "measured_tok_s": summary["winner"]["measured_tok_s"],
+            "token_match": summary["winner"]["record"].get("token_match"),
+            "compiled_programs":
+                summary["winner"]["record"].get("compiled_programs"),
+            "prefix_cache_hit_rate":
+                summary["winner"]["record"].get("prefix_cache_hit_rate"),
+        },
+        "speedup": speedup,
+        "gates": {
+            "min_speedup": min_speedup,
+            "winner_ge_min_speedup": speedup >= min_speedup,
+            "best_config_roundtrip": bool(roundtrip_ok),
+            "all_trials_parity_gated": True,
+            "sentry_strict_in_trials": True,
+        },
+        "artifacts": {
+            "results_dir": results_dir,
+            "best_config": os.path.join(results_dir, "best_config.json"),
+            "exps": os.path.join(results_dir, "exps.json"),
+            "report": os.path.join(results_dir, "report.md"),
+        },
+    }
+    return res
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=64)
@@ -1403,9 +1535,10 @@ def main():
     ap.add_argument("--prefill-batch", type=int, default=4)
     ap.add_argument("--block-size", type=int, default=32)
     ap.add_argument("--prefill-chunk", type=int, default=128)
-    ap.add_argument("--prefix-len", type=int, default=0,
+    ap.add_argument("--prefix-len", type=int, default=None,
                     help="prepend a shared N-token system prompt to every "
-                         "request (prefix-heavy trace)")
+                         "request (prefix-heavy trace); 0 disables, "
+                         "default per lane")
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--hidden", type=int, default=128)
     ap.add_argument("--heads", type=int, default=4)
@@ -1429,11 +1562,11 @@ def main():
     ap.add_argument("--quantize", default=None, metavar="MODES",
                     help="comma list of quantized lanes to add: kv8, w8a8, "
                          "w8a8+kv8 (bounded divergence, not exact parity)")
-    ap.add_argument("--sessions", type=int, default=0, metavar="S",
+    ap.add_argument("--sessions", type=int, default=None, metavar="S",
                     help="with --prefix-len: S distinct session prefixes "
                          "dealt round-robin (multi-turn returning-session "
                          "traffic — the tiered-KV scenario)")
-    ap.add_argument("--pool-frac", type=float, default=0.0, metavar="F",
+    ap.add_argument("--pool-frac", type=float, default=None, metavar="F",
                     help="add the tiered-KV lane (BENCH_r09): size the "
                          "device pool at fraction F of the trace working "
                          "set and compare the host-DRAM tier against the "
@@ -1459,6 +1592,26 @@ def main():
                     help="nominal MFU denominator for the --slo lane's "
                          "FLOPs report (CPU-sim: gauge mechanics, not a "
                          "hardware claim)")
+    ap.add_argument("--autotune", action="store_true",
+                    help="run the closed-loop autotuner protocol "
+                         "(BENCH_r13) instead of the single-engine "
+                         "lanes: successive-halving search over the "
+                         "serving knob space on the returning-sessions "
+                         "trace, gated on winner >= "
+                         "--autotune-min-speedup x the default")
+    ap.add_argument("--autotune-trials", type=int, default=None,
+                    metavar="N", help="bound on executed trials")
+    ap.add_argument("--autotune-min-budget", type=int, default=None,
+                    metavar="B", help="rung-0 replay length "
+                                      "(default: requests/4)")
+    ap.add_argument("--autotune-min-speedup", type=float, default=1.0,
+                    metavar="F",
+                    help="fail unless measured winner >= F x measured "
+                         "default (the committed BENCH_r13 runs at 1.15)")
+    ap.add_argument("--autotune-results-dir",
+                    default="autotuning_results_serving")
+    ap.add_argument("--autotune-resume", action="store_true",
+                    help="replay completed trials from exps.json")
     ap.add_argument("--quant-suite", action="store_true",
                     help="run the BENCH_r07 protocol: mixed + prefix-heavy "
                          "+ decode-heavy traces with quantized lanes and a "
@@ -1484,11 +1637,18 @@ def main():
                  "--replicas N with N >= 2")
 
     quantize = tuple(m for m in (args.quantize or "").split(",") if m)
+
+    def _default(v, lane_default):
+        # argparse default is None so an EXPLICIT 0 stays 0 (sessionless
+        # / unpressured modes are reachable in every lane)
+        return lane_default if v is None else v
+
     kw = dict(requests=args.requests, slots=args.slots,
               prefill_batch=args.prefill_batch, layers=args.layers,
               hidden=args.hidden, heads=args.heads, vocab=args.vocab,
               seed=args.seed, dtype=args.dtype, block_size=args.block_size,
               prefill_chunk=args.prefill_chunk)
+    fail_msg = "serving outputs diverged from sequential generate"
     if args.replicas > 1 and args.slo:
         res = run_fleet_observability_bench(
             replicas=args.replicas, requests=args.requests,
@@ -1496,8 +1656,9 @@ def main():
             layers=args.layers, hidden=args.hidden, heads=args.heads,
             vocab=args.vocab, seed=args.seed, dtype=args.dtype,
             block_size=args.block_size, prefill_chunk=args.prefill_chunk,
-            prefix_len=args.prefix_len or 192,
-            sessions=args.sessions or 9, swap_batch=args.swap_batch,
+            prefix_len=_default(args.prefix_len, 192),
+            sessions=_default(args.sessions, 9),
+            swap_batch=args.swap_batch,
             peak_flops=args.peak_flops, emit_metrics=args.emit_metrics,
             trace_out=args.trace_out)
         ok = res["token_parity"] and res["compile_budgets_ok"] and \
@@ -1518,11 +1679,35 @@ def main():
             layers=args.layers, hidden=args.hidden, heads=args.heads,
             vocab=args.vocab, seed=args.seed, dtype=args.dtype,
             block_size=args.block_size, prefill_chunk=args.prefill_chunk,
-            prefix_len=args.prefix_len or 192,
-            sessions=args.sessions or 9, swap_batch=args.swap_batch,
+            prefix_len=_default(args.prefix_len, 192),
+            sessions=_default(args.sessions, 9),
+            swap_batch=args.swap_batch,
             emit_metrics=args.emit_metrics)
         ok = res["token_parity"] and \
             all(s["compile_budgets_ok"] for s in res["scaling"].values())
+    elif args.autotune:
+        res = run_autotune_bench(
+            requests=args.requests, sessions=_default(args.sessions, 16),
+            prefix_len=_default(args.prefix_len, 256),
+            pool_frac=_default(args.pool_frac, 0.25), slots=args.slots,
+            layers=args.layers, hidden=args.hidden, heads=args.heads,
+            vocab=args.vocab, seed=args.seed, dtype=args.dtype,
+            results_dir=args.autotune_results_dir,
+            max_trials=args.autotune_trials,
+            min_budget=args.autotune_min_budget,
+            min_speedup=args.autotune_min_speedup,
+            resume=args.autotune_resume)
+        ok = res["gates"]["winner_ge_min_speedup"] and \
+            res["gates"]["best_config_roundtrip"]
+        fail_msg = None          # the autotune gate prints its own reason
+        if not ok:
+            print("WARNING: autotune gate failed — winner "
+                  f"{res['winner']['measured_tok_s']:.1f} tok/s vs "
+                  f"default {res['default']['measured_tok_s']:.1f} "
+                  f"(speedup {res['speedup']:.2f}x, floor "
+                  f"{args.autotune_min_speedup}x; roundtrip="
+                  f"{res['gates']['best_config_roundtrip']})",
+                  file=sys.stderr)
     elif args.quant_suite:
         modes = quantize or ("kv8", "w8a8", "w8a8+kv8")
         # the protocol PROMISES a tp x kv8 combo point: default to tp=4
@@ -1578,11 +1763,14 @@ def main():
             "serving bug; fp32 runs assert exact parity" if bf16 else
             "fp32 run: unquantized lanes assert exact token parity")
     else:
-        res = run_bench(grid=args.grid, prefix_len=args.prefix_len,
+        res = run_bench(grid=args.grid,
+                        prefix_len=_default(args.prefix_len, 0),
                         speculative=args.speculative,
                         decode_heavy=args.decode_heavy, tp=args.tp,
-                        quantize=quantize, pool_frac=args.pool_frac,
-                        swap_batch=args.swap_batch, sessions=args.sessions,
+                        quantize=quantize,
+                        pool_frac=_default(args.pool_frac, 0.0),
+                        swap_batch=args.swap_batch,
+                        sessions=_default(args.sessions, 0),
                         telemetry_bench=args.telemetry_bench,
                         trace_out=args.trace_out,
                         emit_metrics=args.emit_metrics, **kw)
@@ -1602,8 +1790,8 @@ def main():
         with open(args.json, "w") as f:
             json.dump(res, f, indent=2)
     if not ok:
-        print("WARNING: serving outputs diverged from sequential generate",
-              file=sys.stderr)
+        if fail_msg:
+            print(f"WARNING: {fail_msg}", file=sys.stderr)
         return 1
     return 0
 

@@ -1,7 +1,30 @@
-"""Autotuning (reference ``deepspeed/autotuning``): search ZeRO stage /
-micro-batch / remat configurations by measuring short training runs."""
+"""Autotuning (reference ``deepspeed/autotuning``): measured search over
+configuration spaces.
+
+Training side (:class:`Autotuner` / :func:`autotune`): ZeRO stage /
+micro-batch / remat / gas / flash-block coordinate descent over short
+``train_batch`` runs.  Serving side (:func:`tune_serving`): replayable
+traces (:class:`ServingTrace` — record, load, or fit one from a
+telemetry snapshot), a constraint-screened knob space
+(:class:`ServingKnobSpace`), and deterministic successive halving
+(:class:`SuccessiveHalving`) with parity-gated, sentry-enforced trials.
+Both emit the same ``exps.json`` / ``best_config.json`` / ``report.md``
+artifact trio (``report.py``).  See ``docs/autotuning.md``.
+"""
 
 from .autotuner import Autotuner, autotune
 from .config import AutotuningConfig
+from .runner import ParityError, TrialRunner, tune_serving
+from .search import SuccessiveHalving, config_key, rank_results
+from .space import ModelGeom, ServingKnobSpace
+from .trace import ServingTrace, TraceEntry, TraceRecorder, fit_trace, \
+    sessions_trace
 
-__all__ = ["Autotuner", "autotune", "AutotuningConfig"]
+__all__ = [
+    "Autotuner", "autotune", "AutotuningConfig",
+    "ServingTrace", "TraceEntry", "TraceRecorder", "fit_trace",
+    "sessions_trace",
+    "ModelGeom", "ServingKnobSpace",
+    "SuccessiveHalving", "config_key", "rank_results",
+    "ParityError", "TrialRunner", "tune_serving",
+]

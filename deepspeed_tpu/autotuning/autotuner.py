@@ -14,15 +14,13 @@ memory-based pruning).
 from __future__ import annotations
 
 import gc
-import json
-import os
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-import numpy as np
-
-from ..utils.logging import log_dist, logger
+from ..utils.logging import log_dist
+from . import report
 from .config import AutotuningConfig
+from .search import run_candidates
 
 OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Allocation", "exceed", "out of memory")
 
@@ -198,6 +196,23 @@ class Autotuner:
             float(m.get("flash_block_k", 0)),
         ]
 
+    def _measure(self, overrides: dict, stage_name: Optional[str],
+                 measured: Optional[List[tuple]] = None) -> Dict[str, Any]:
+        """Run + record + log ONE experiment (the shared per-candidate
+        body both search modes hand to ``search.run_candidates``)."""
+        rec = self._run_experiment(overrides)
+        if stage_name is not None:
+            rec["stage"] = stage_name
+        self.results.append(rec)
+        log_dist(
+            f"autotuning{'[' + stage_name + ']' if stage_name else ''} "
+            f"{overrides}: "
+            f"{'%.1f tok/s' % rec['throughput'] if rec.get('feasible') else 'infeasible'}",
+            ranks=[0])
+        if measured is not None and rec.get("feasible"):
+            measured.append((self._features(overrides), rec["throughput"]))
+        return rec
+
     def _tune_staged(self) -> Dict[str, Any]:
         """Greedy coordinate descent over knob groups: tune batch geometry
         first (memory-dominant), then remat policy, then gas, then flash
@@ -220,28 +235,12 @@ class Autotuner:
                 cands.sort(key=lambda c: -(self._predict(
                     self._features(_merge_overrides(best_over, c)), measured)
                     or 0.0))
-            stage_best: Optional[Dict[str, Any]] = None
-            stale = 0
-            for cand in cands:
-                overrides = _merge_overrides(best_over, cand)
-                rec = self._run_experiment(overrides)
-                rec["stage"] = stage_name
-                self.results.append(rec)
-                log_dist(
-                    f"autotuning[{stage_name}] {cand}: "
-                    f"{'%.1f tok/s' % rec['throughput'] if rec.get('feasible') else 'infeasible'}",
-                    ranks=[0])
-                if not rec.get("feasible"):
-                    continue
-                measured.append((self._features(overrides),
-                                 rec["throughput"]))
-                if stage_best is None or \
-                        rec["throughput"] > stage_best["throughput"]:
-                    stage_best, stale = rec, 0
-                else:
-                    stale += 1
-                    if stale >= self.cfg.tuner_early_stopping:
-                        break
+            stage_best = run_candidates(
+                cands,
+                lambda cand: self._measure(
+                    _merge_overrides(best_over, cand), stage_name,
+                    measured),
+                early_stopping=self.cfg.tuner_early_stopping)
             if stage_best is not None and (
                     best_rec is None or
                     stage_best["throughput"] >= best_rec["throughput"]):
@@ -266,30 +265,25 @@ class Autotuner:
         search."""
         if self.cfg.tuner_type in ("staged", "model_based"):
             return self._tune_staged()
-        best: Optional[Dict[str, Any]] = None
-        stale = 0
         pruned_stage_micro: Dict[int, int] = {}
-        for overrides in self.experiment_space():
+
+        def _skip(overrides):
             stage = overrides["zero_optimization"]["stage"]
             micro = overrides["train_micro_batch_size_per_gpu"]
-            if stage in pruned_stage_micro and \
-                    micro >= pruned_stage_micro[stage]:
-                continue
-            rec = self._run_experiment(overrides)
-            self.results.append(rec)
-            log_dist(f"autotuning exp {overrides}: "
-                     f"{'%.1f tok/s' % rec['throughput'] if rec.get('feasible') else 'infeasible'}",
-                     ranks=[0])
-            if not rec.get("feasible"):
-                if rec.get("oom"):
-                    pruned_stage_micro[stage] = micro
-                continue
-            if best is None or rec["throughput"] > best["throughput"]:
-                best, stale = rec, 0
-            else:
-                stale += 1
-                if stale >= self.cfg.tuner_early_stopping:
-                    break
+            return stage in pruned_stage_micro and \
+                micro >= pruned_stage_micro[stage]
+
+        def _run(overrides):
+            rec = self._measure(overrides, None)
+            if not rec.get("feasible") and rec.get("oom"):
+                pruned_stage_micro[
+                    overrides["zero_optimization"]["stage"]] = \
+                    overrides["train_micro_batch_size_per_gpu"]
+            return rec
+
+        best = run_candidates(
+            self.experiment_space(), _run,
+            early_stopping=self.cfg.tuner_early_stopping, skip=_skip)
         if best is None:
             raise RuntimeError(
                 "autotuning found no feasible configuration; "
@@ -298,35 +292,19 @@ class Autotuner:
         return best
 
     def _write_results(self, best) -> None:
-        os.makedirs(self.cfg.results_dir, exist_ok=True)
-        with open(os.path.join(self.cfg.results_dir, "exps.json"), "w") as f:
-            json.dump(self.results, f, indent=2, default=str)
-        with open(os.path.join(self.cfg.results_dir,
-                               "best_config.json"), "w") as f:
-            cfg = dict(self.base_config)
-            cfg.pop("autotuning", None)
-            model_over = best["config"].get("_model")
-            cfg = _merge_overrides(
-                cfg, {k: v for k, v in best["config"].items()
-                      if k != "_model"})
-            if model_over:
-                cfg["_model"] = model_over  # builder knobs (GPT2Config etc.)
-            json.dump(cfg, f, indent=2)
-        # ranked report (reference emits a summary table per experiment set)
-        ranked = sorted((r for r in self.results if r.get("feasible")),
-                        key=lambda r: -r["throughput"])
-        with open(os.path.join(self.cfg.results_dir, "report.md"), "w") as f:
-            f.write("# Autotuning report\n\n"
-                    "| rank | stage | overrides | tok/s | step ms |\n"
-                    "|---|---|---|---|---|\n")
-            for i, r in enumerate(ranked, 1):
-                f.write(f"| {i} | {r.get('stage', '-')} | "
-                        f"`{json.dumps(r['config'], default=str)}` | "
-                        f"{r['throughput']:.0f} | {1e3*r['step_s']:.1f} |\n")
-            infeasible = [r for r in self.results if not r.get("feasible")]
-            if infeasible:
-                f.write(f"\n{len(infeasible)} infeasible experiment(s) "
-                        "(OOM/invalid) — see exps.json.\n")
+        """Emit the shared artifact trio (``autotuning/report.py`` — the
+        ranked table and exps schema are identical to the serving
+        tuner's).  ``best_config.json`` stays a full merged DeepSpeed
+        config, ``_model`` builder knobs alongside."""
+        cfg = dict(self.base_config)
+        cfg.pop("autotuning", None)
+        model_over = best["config"].get("_model")
+        cfg = _merge_overrides(
+            cfg, {k: v for k, v in best["config"].items()
+                  if k != "_model"})
+        if model_over:
+            cfg["_model"] = model_over  # builder knobs (GPT2Config etc.)
+        report.write_results(self.cfg.results_dir, self.results, cfg)
         log_dist(f"autotuning: best {best['config']} at "
                  f"{best['throughput']:.1f} tok/s -> "
                  f"{self.cfg.results_dir}/best_config.json", ranks=[0])

@@ -1,0 +1,103 @@
+"""Shared autotuning artifact writers (training AND serving).
+
+Both tuners emit the same three artifacts into their ``results_dir`` —
+the reference's ``autotuning/`` layout, kept schema-identical across the
+two subsystems so dashboards and CI read one format:
+
+ - ``exps.json``: every trial record, arrival order.  Common keys:
+   ``config`` (the overrides/kwargs measured), ``feasible``,
+   ``throughput`` (tok/s — the ranking metric), ``stage`` (coordinate-
+   descent stage name or ``rungN``), plus per-subsystem extras
+   (``step_s``/``loss`` for training; ``budget``/``rung``/``wall_s``/
+   ``slo_attainment``/``parity`` for serving; ``error`` when
+   infeasible).
+ - ``best_config.json``: a ready-to-use config dict — merged DeepSpeed
+   JSON config for training, ``init_serving`` kwargs for serving
+   (``init_serving(model, **json.load(...))`` must just work).
+ - ``report.md``: the ranked markdown table (:func:`render_table`) plus
+   any subsystem sections (infeasible summary, constraint-pruning
+   counts, the serving tuner's predicted-vs-measured block).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+from .search import rank_results
+
+__all__ = ["write_json", "write_exps", "write_best_config",
+           "render_table", "write_report_md", "write_results"]
+
+
+def write_json(path: str, obj: Any) -> str:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(obj, f, indent=2, default=str)
+    return path
+
+
+def write_exps(results_dir: str, results: Sequence[Dict[str, Any]]) -> str:
+    return write_json(os.path.join(results_dir, "exps.json"), list(results))
+
+
+def write_best_config(results_dir: str, config: Dict[str, Any]) -> str:
+    return write_json(os.path.join(results_dir, "best_config.json"), config)
+
+
+def _detail(rec: Dict[str, Any]) -> str:
+    """The per-record detail cell: step latency for training trials,
+    rung/budget for serving trials."""
+    if rec.get("step_s") is not None:
+        return f"{1e3 * rec['step_s']:.1f} ms/step"
+    if rec.get("budget") is not None:
+        return f"budget {rec['budget']}"
+    return "-"
+
+
+def render_table(results: Sequence[Dict[str, Any]],
+                 metric: str = "throughput") -> str:
+    """Ranked feasible-trial table (shared columns, module docstring)."""
+    lines = ["| rank | stage | config | tok/s | detail |",
+             "|---|---|---|---|---|"]
+    for i, r in enumerate(rank_results(results, metric), 1):
+        lines.append(
+            f"| {i} | {r.get('stage', '-')} | "
+            f"`{json.dumps(r['config'], default=str)}` | "
+            f"{float(r[metric]):.0f} | {_detail(r)} |")
+    return "\n".join(lines) + "\n"
+
+
+def write_report_md(results_dir: str, results: Sequence[Dict[str, Any]], *,
+                    metric: str = "throughput",
+                    title: str = "Autotuning report",
+                    extra_sections: Optional[Sequence[str]] = None) -> str:
+    body = [f"# {title}", "", render_table(results, metric)]
+    infeasible = [r for r in results if not r.get("feasible")]
+    if infeasible:
+        body.append(f"\n{len(infeasible)} infeasible experiment(s) "
+                    "(OOM/invalid/constraint) — see exps.json.\n")
+    for section in extra_sections or ():
+        body.append("\n" + section.rstrip() + "\n")
+    path = os.path.join(results_dir, "report.md")
+    os.makedirs(results_dir, exist_ok=True)
+    with open(path, "w") as f:
+        f.write("\n".join(body))
+    return path
+
+
+def write_results(results_dir: str, results: Sequence[Dict[str, Any]],
+                  best_config: Dict[str, Any], *,
+                  metric: str = "throughput",
+                  title: str = "Autotuning report",
+                  extra_sections: Optional[Sequence[str]] = None
+                  ) -> Dict[str, str]:
+    """Write the full artifact trio; returns their paths."""
+    return {
+        "exps": write_exps(results_dir, results),
+        "best_config": write_best_config(results_dir, best_config),
+        "report": write_report_md(results_dir, results, metric=metric,
+                                  title=title,
+                                  extra_sections=extra_sections),
+    }

@@ -1,0 +1,210 @@
+"""Search cores shared by the training and serving autotuners.
+
+Two searchers live here:
+
+ - :func:`run_candidates` — the measured sequential loop (best-feasible
+   tracking + early stopping) both the training ``Autotuner``'s
+   gridsearch mode and its staged coordinate descent drive.  It used to
+   exist twice inside ``autotuner.py``; this is the shared copy.
+ - :class:`SuccessiveHalving` — the serving tuner's search: run EVERY
+   admissible candidate at a short replay budget, keep the top ``1/eta``
+   by score, multiply the budget by ``eta``, repeat until the full
+   budget (or one survivor).  Serving trials are expensive (an engine
+   build + a trace replay each) and the knob space is wide but shallow —
+   halving spends the trial budget where cheap short replays already
+   rank candidates, and only the finalists earn full-length runs.
+
+The halving searcher is **deterministic**: given the same candidate
+list and the same per-trial records it visits the same (config, budget)
+pairs in the same order, survivors tie-break by candidate order, and the
+whole run is resumable — every completed trial is appended to
+``<results_dir>/exps.json`` *as it finishes*, and a re-run with
+``resume=True`` replays completed trials from that file instead of
+re-measuring them (mid-rung interruptions included).  ``max_trials``
+bounds *executed* (non-resumed) objective calls; budget accounting in
+the returned summary counts replayed requests per rung.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["config_key", "rank_results", "run_candidates",
+           "SuccessiveHalving"]
+
+
+def config_key(config: Dict[str, Any]) -> str:
+    """Canonical identity of a candidate (dict-order independent)."""
+    return json.dumps(config, sort_keys=True, default=str)
+
+
+def rank_results(results: Sequence[Dict[str, Any]],
+                 metric: str = "throughput") -> List[Dict[str, Any]]:
+    """Feasible records, best metric first (the report table order).
+    Ties keep input (arrival) order — ranking stays deterministic."""
+    return sorted((r for r in results if r.get("feasible")),
+                  key=lambda r: -float(r[metric]))
+
+
+def run_candidates(cands: Sequence[Any], run_fn: Callable[[Any], dict], *,
+                   metric: str = "throughput", early_stopping: int = 0,
+                   skip: Optional[Callable[[Any], bool]] = None
+                   ) -> Optional[Dict[str, Any]]:
+    """Measure candidates in order, tracking the best feasible record.
+
+    ``run_fn(cand)`` returns a record with ``feasible`` and ``metric``;
+    recording/logging side effects belong inside it.  ``skip(cand)``
+    prunes before measuring.  After ``early_stopping`` consecutive
+    measured-feasible records that fail to improve the incumbent, the
+    loop ends (0 = never).  Returns the best feasible record or None.
+    """
+    best: Optional[Dict[str, Any]] = None
+    stale = 0
+    for cand in cands:
+        if skip is not None and skip(cand):
+            continue
+        rec = run_fn(cand)
+        if not rec.get("feasible"):
+            continue
+        if best is None or rec[metric] > best[metric]:
+            best, stale = rec, 0
+        else:
+            stale += 1
+            if early_stopping and stale >= early_stopping:
+                break
+    return best
+
+
+class SuccessiveHalving:
+    """Constraint-aware successive halving over explicit candidates.
+
+    Parameters
+    ----------
+    eta:         keep ``ceil(n/eta)`` survivors per rung; budgets also
+                 multiply by ``eta``.
+    min_budget:  rung-0 per-trial budget (trace entries replayed).
+    max_budget:  final-rung budget (the full trace length).
+    max_trials:  bound on *executed* objective calls (resumed trials are
+                 free); exhausting it ends the search with the best
+                 record measured so far and ``exhausted=True``.
+    results_dir: where ``exps.json`` persists (None = no persistence,
+                 no resume).
+    metric:      record key to rank on (higher is better).
+    """
+
+    def __init__(self, *, eta: int = 2, min_budget: int, max_budget: int,
+                 max_trials: Optional[int] = None,
+                 results_dir: Optional[str] = None,
+                 metric: str = "throughput"):
+        if eta < 2:
+            raise ValueError(f"eta must be >= 2, got {eta}")
+        if not 1 <= min_budget <= max_budget:
+            raise ValueError(
+                f"need 1 <= min_budget ({min_budget}) <= max_budget "
+                f"({max_budget})")
+        self.eta = int(eta)
+        self.min_budget = int(min_budget)
+        self.max_budget = int(max_budget)
+        self.max_trials = max_trials if max_trials is None \
+            else int(max_trials)
+        self.results_dir = results_dir
+        self.metric = metric
+        self.results: List[Dict[str, Any]] = []
+
+    # ------------------------------------------------------ persistence
+    def _exps_path(self) -> Optional[str]:
+        if self.results_dir is None:
+            return None
+        return os.path.join(self.results_dir, "exps.json")
+
+    def _load_cache(self) -> Dict[Tuple[str, int], Dict[str, Any]]:
+        path = self._exps_path()
+        if path is None or not os.path.exists(path):
+            return {}
+        with open(path) as f:
+            prior = json.load(f)
+        return {(config_key(r["config"]), int(r["budget"])): r
+                for r in prior if "budget" in r}
+
+    def _persist(self) -> None:
+        path = self._exps_path()
+        if path is None:
+            return
+        os.makedirs(self.results_dir, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.results, f, indent=2, default=str)
+
+    # ------------------------------------------------------------- run
+    def run(self, candidates: Sequence[Dict[str, Any]],
+            objective: Callable[[Dict[str, Any], int], Dict[str, Any]],
+            *, resume: bool = False) -> Dict[str, Any]:
+        """Search.  ``objective(config, budget)`` returns a record with
+        at least ``feasible`` (bool) and, when feasible, ``metric``;
+        the searcher stamps ``rung``/``budget`` onto it.  Returns::
+
+            {"best": record | None, "results": [records...],
+             "rungs": [{rung, budget, candidates, feasible, resumed}...],
+             "trials_executed": int, "trials_total": int,
+             "budget_spent": int, "exhausted": bool}
+        """
+        pool = []
+        seen = set()
+        for cfg in candidates:
+            k = config_key(cfg)
+            if k not in seen:
+                seen.add(k)
+                pool.append(dict(cfg))
+        if not pool:
+            raise ValueError("successive halving needs >= 1 candidate")
+        cache = self._load_cache() if resume else {}
+        self.results = []
+        rungs: List[Dict[str, Any]] = []
+        executed = spent = 0
+        rung, budget = 0, self.min_budget
+        exhausted = False
+        best: Optional[Dict[str, Any]] = None
+        while True:
+            ranked: List[Tuple[float, int, Dict[str, Any]]] = []
+            resumed_here = 0
+            for idx, cfg in enumerate(pool):
+                key = (config_key(cfg), budget)
+                rec = cache.get(key)
+                if rec is not None:
+                    resumed_here += 1
+                    rec = dict(rec)
+                else:
+                    if self.max_trials is not None and \
+                            executed >= self.max_trials:
+                        exhausted = True
+                        break
+                    rec = dict(objective(cfg, budget))
+                    executed += 1
+                    spent += budget
+                rec.update(config=cfg, rung=rung, budget=budget,
+                           stage=f"rung{rung}")
+                self.results.append(rec)
+                self._persist()
+                if rec.get("feasible"):
+                    ranked.append((float(rec[self.metric]), idx, rec))
+            ranked.sort(key=lambda t: (-t[0], t[1]))
+            if ranked:
+                # the deepest rung with any feasible record names the
+                # winner — scores at different budgets are not comparable
+                best = ranked[0][2]
+            rungs.append({"rung": rung, "budget": budget,
+                          "candidates": len(pool),
+                          "feasible": len(ranked),
+                          "resumed": resumed_here})
+            if exhausted or budget >= self.max_budget or len(ranked) <= 1:
+                break
+            keep = max(1, math.ceil(len(ranked) / self.eta))
+            pool = [rec["config"] for _, _, rec in ranked[:keep]]
+            budget = min(budget * self.eta, self.max_budget)
+            rung += 1
+        return {"best": best, "results": self.results, "rungs": rungs,
+                "trials_executed": executed,
+                "trials_total": len(self.results),
+                "budget_spent": spent, "exhausted": exhausted}

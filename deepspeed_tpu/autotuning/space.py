@@ -1,0 +1,403 @@
+"""The serving knob space: per-knob domains + constraint predicates.
+
+``init_serving`` has grown ~10 interacting knobs (ROADMAP item 5's "knob
+explosion"); most combinations are either invalid (speculative decoding
+in bucketed-prefill mode), physically impossible (a KV pool past the HBM
+ceiling), or violate a checked contract (compile budget, HKV
+divisibility).  Searching them naively wastes most of the trial budget
+discovering what static reasoning already knows, so this module encodes
+the space *with* its constraints:
+
+ - :class:`ModelGeom`: the model geometry the KV block formulae need
+   (layers, KV heads, head dim, KV dtype bytes) — from a live engine or
+   a model config.
+ - :func:`kv_pool_bytes` / :func:`compile_budget`: closed-form mirrors
+   of the engine's own accounting (``ServingEngine._kv_footprint`` /
+   the ctor's budget arithmetic) over a *candidate dict*, evaluated
+   before anything is built.
+ - :class:`ServingKnobSpace`: base config + per-knob domains →
+   cartesian candidates, each screened by the ``CONSTRAINTS`` predicates;
+   ``prune()`` reports how many candidates each constraint removed (the
+   searcher's "pruned before any trial ran" accounting).  The special
+   ``num_blocks`` value ``"mem"`` resolves to the largest pool that fits
+   ``mem_ceiling_bytes`` at the candidate's own ``block_size``/
+   ``quantize`` — candidates trade block granularity against pool depth
+   under one fixed memory envelope, the way a real chip does.
+
+Every constraint here has a matching *loud* ctor validation in
+``ServingEngine``/``init_serving`` (audited by
+``tests/unit/test_serving_autotune.py``): pruning is an optimization,
+not the safety net — a config that somehow slips through still fails
+with a diagnosis naming the knob, never a mid-trial crash.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["ModelGeom", "ServingKnobSpace", "kv_pool_bytes",
+           "compile_budget", "workload_space", "DEFAULT_DOMAINS",
+           "CONSTRAINTS", "BASE_SERVING_CONFIG"]
+
+#: the verify kernel's widest speculative window (K+1 <= this);
+#: mirrored from ops/decode_attention.py without importing jax
+VERIFY_T_MAX = 16
+
+#: ``init_serving`` serving-level defaults — the hand-picked config every
+#: search starts from (and the yardstick the winner must beat)
+BASE_SERVING_CONFIG: Dict[str, Any] = {
+    "slots": 8,
+    "max_seq_len": None,
+    "block_size": 32,
+    "num_blocks": None,
+    "chunked_prefill": True,
+    "prefill_chunk": 128,
+    "prompt_buckets": None,
+    "prefill_batch": 4,
+    "prefix_caching": True,
+    "spec_tokens": 0,
+    "quantize": None,
+    "host_blocks": 0,
+    "swap_batch": 8,
+    "shard_kv": None,
+    "topology": 1,
+    "trace_capacity": 16384,
+}
+
+#: conservative default domains — callers override per workload (the
+#: bench lane, for one, adds trace-sized ``host_blocks`` choices)
+DEFAULT_DOMAINS: Dict[str, Tuple[Any, ...]] = {
+    "block_size": (16, 32, 64),
+    "prefill_chunk": (64, 128, 256),
+    "prefill_batch": (2, 4, 8),
+    "spec_tokens": (0, 4),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelGeom:
+    """KV-geometry inputs to the block formulae."""
+    layers: int
+    kv_heads: int
+    head_dim: int
+    dtype_bytes: int = 4          # KV pool element size (fp32=4, bf16=2)
+
+    @classmethod
+    def from_model_config(cls, mc, dtype_bytes: int = 4) -> "ModelGeom":
+        heads = int(getattr(mc, "num_heads"))
+        kv_heads = int(getattr(mc, "num_kv_heads", heads))
+        head_dim = int(getattr(mc, "head_dim",
+                               getattr(mc, "hidden_size") // heads))
+        return cls(layers=int(mc.num_layers), kv_heads=kv_heads,
+                   head_dim=head_dim, dtype_bytes=int(dtype_bytes))
+
+    @classmethod
+    def from_engine(cls, engine) -> "ModelGeom":
+        """From a live ``init_inference`` engine (reads the engine's KV
+        dtype — the serving pool is built in it)."""
+        import jax.numpy as jnp
+
+        return cls.from_model_config(
+            engine.module.model_config,
+            dtype_bytes=jnp.dtype(engine._config.jnp_dtype).itemsize)
+
+
+def _blocks_per_seq(config: Dict[str, Any]) -> int:
+    return int(math.ceil(int(config["max_seq_len"]) /
+                         int(config["block_size"])))
+
+
+def resolved_num_blocks(config: Dict[str, Any]) -> int:
+    """``num_blocks`` with the engine's own default applied (``None`` =
+    scratch + full residency for every slot)."""
+    nb = config.get("num_blocks")
+    if nb is None:
+        return 1 + int(config["slots"]) * _blocks_per_seq(config)
+    return int(nb)
+
+
+def block_bytes(config: Dict[str, Any], geom: ModelGeom) -> int:
+    """Bytes of ONE physical KV block under the candidate's knobs —
+    the k + v payload leaves (``[L, NB, HKV, bs, hd]`` per leaf), plus
+    the per-block bf16 scale rows (``[L, NB, HKV, bs]``) when the pool
+    quantizes to int8 codes (``quantize`` includes ``kv8``)."""
+    bs = int(config["block_size"])
+    elems = geom.layers * geom.kv_heads * bs * geom.head_dim
+    quant = str(config.get("quantize") or "")
+    if "kv8" in quant:
+        return 2 * (elems * 1 + geom.layers * geom.kv_heads * bs * 2)
+    return 2 * elems * geom.dtype_bytes
+
+
+def kv_pool_bytes(config: Dict[str, Any], geom: ModelGeom) -> int:
+    """Device KV pool footprint of a candidate (block formula x pool
+    depth) — the quantity ``mem_ceiling_bytes`` caps.  Host-tier blocks
+    live in host DRAM and do not count against the device ceiling."""
+    return resolved_num_blocks(config) * block_bytes(config, geom)
+
+
+def compile_budget(config: Dict[str, Any]) -> int:
+    """Mirror of the ctor's compiled-program budget: 2 chunked (prefill +
+    decode / n-gram verify), buckets + 2 bucketed, + 2 swap programs with
+    a host tier.  (A draft model would add 1; the space searches the
+    zero-extra-programs n-gram proposer.)"""
+    if config.get("spec_tokens"):
+        budget = 2
+    elif config.get("chunked_prefill", True):
+        budget = 2
+    else:
+        budget = len(config.get("prompt_buckets") or ()) + 2
+    if config.get("host_blocks"):
+        budget += 2
+    return budget
+
+
+# ---------------------------------------------------------- constraints
+def _c_memory(config, space) -> Optional[str]:
+    if space.mem_ceiling_bytes is None:
+        return None
+    got = kv_pool_bytes(config, space.geom)
+    if got > space.mem_ceiling_bytes:
+        return (f"kv pool {got} bytes exceeds the ceiling "
+                f"{space.mem_ceiling_bytes} (num_blocks="
+                f"{resolved_num_blocks(config)}, block_size="
+                f"{config['block_size']}, quantize="
+                f"{config.get('quantize')})")
+    return None
+
+
+def _c_compile(config, space) -> Optional[str]:
+    got = compile_budget(config)
+    if got > space.max_programs:
+        return (f"compile budget {got} exceeds max_programs="
+                f"{space.max_programs}")
+    return None
+
+
+def _c_shard_kv(config, space) -> Optional[str]:
+    tp = int(config.get("topology") or 1)
+    if config.get("shard_kv") and tp > 1 and space.geom.kv_heads % tp:
+        return (f"shard_kv=True but kv_heads={space.geom.kv_heads} does "
+                f"not divide tp={tp}")
+    return None
+
+
+def _c_spec_bucketed(config, space) -> Optional[str]:
+    if config.get("spec_tokens") and not config.get("chunked_prefill",
+                                                    True):
+        return "spec_tokens > 0 requires chunked-prefill mode"
+    return None
+
+
+def _c_spec_window(config, space) -> Optional[str]:
+    k = int(config.get("spec_tokens") or 0)
+    if k and k + 1 > VERIFY_T_MAX:
+        return (f"spec_tokens={k} verify window exceeds the kernel max "
+                f"{VERIFY_T_MAX}")
+    return None
+
+
+def _c_tiered_prefix(config, space) -> Optional[str]:
+    if config.get("host_blocks") and not (
+            config.get("chunked_prefill", True)
+            and config.get("prefix_caching", True)):
+        return ("host_blocks > 0 requires chunked prefill with "
+                "prefix_caching=True")
+    return None
+
+
+def _c_swap_batch(config, space) -> Optional[str]:
+    hb = int(config.get("host_blocks") or 0)
+    sb = int(config.get("swap_batch") or 0)
+    if hb and (sb < 1 or sb > hb):
+        return f"swap_batch={sb} outside [1, host_blocks={hb}]"
+    return None
+
+
+def _c_pool_min(config, space) -> Optional[str]:
+    if int(config.get("block_size") or 0) < 1:
+        return None                    # positive_knobs owns this failure
+    need = 1 + _blocks_per_seq(config)
+    if resolved_num_blocks(config) < need:
+        return (f"num_blocks={resolved_num_blocks(config)} cannot hold "
+                f"one full sequence ({need} blocks incl. scratch)")
+    return None
+
+
+def _c_positive(config, space) -> Optional[str]:
+    for k in ("slots", "prefill_batch", "block_size"):
+        if int(config.get(k) or 0) < 1:
+            return f"{k} must be >= 1, got {config.get(k)}"
+    return None
+
+
+#: ``(name, predicate)`` — predicate returns a violation message or None.
+#: Each has a loud ctor-validation twin (module docstring).
+CONSTRAINTS: Tuple[Tuple[str, Callable], ...] = (
+    ("positive_knobs", _c_positive),
+    ("kv_pool_memory", _c_memory),
+    ("compile_budget", _c_compile),
+    ("shard_kv_divisibility", _c_shard_kv),
+    ("spec_bucketed_exclusive", _c_spec_bucketed),
+    ("spec_window", _c_spec_window),
+    ("tiered_needs_prefix_cache", _c_tiered_prefix),
+    ("swap_batch_bounds", _c_swap_batch),
+    ("pool_min_blocks", _c_pool_min),
+)
+
+
+class ServingKnobSpace:
+    """Base config + domains -> constraint-screened candidates.
+
+    Parameters
+    ----------
+    geom:             :class:`ModelGeom` for the block formulae.
+    max_seq_len:      the trace's required sequence budget (every
+                      candidate carries it; a knob only via ``domains``).
+    base:             overrides onto :data:`BASE_SERVING_CONFIG` — the
+                      "hand-picked default" candidate 0 of every search.
+    domains:          ``knob -> tuple of values`` (unlisted knobs stay at
+                      the base value).  ``num_blocks`` accepts the
+                      special value ``"mem"`` (module docstring);
+                      ``host_blocks`` accepts ``"ws"`` — the trace's
+                      unique working set in the candidate's OWN block
+                      size, plus one sequence of slack (needs
+                      ``ws_tokens``).
+    mem_ceiling_bytes: device KV-pool byte ceiling (None = uncapped).
+    max_programs:     compile-budget ceiling (sentry-aligned).
+    """
+
+    def __init__(self, geom: ModelGeom, *, max_seq_len: int,
+                 base: Optional[Dict[str, Any]] = None,
+                 domains: Optional[Dict[str, Sequence[Any]]] = None,
+                 mem_ceiling_bytes: Optional[int] = None,
+                 max_programs: int = 8,
+                 ws_tokens: Optional[int] = None):
+        self.geom = geom
+        self.base = dict(BASE_SERVING_CONFIG)
+        self.base["max_seq_len"] = int(max_seq_len)
+        self.base.update(base or {})
+        self.domains: Dict[str, Tuple[Any, ...]] = {
+            k: tuple(v) for k, v in (domains if domains is not None
+                                     else DEFAULT_DOMAINS).items()}
+        unknown = set(self.domains) - set(self.base)
+        if unknown:
+            raise ValueError(
+                f"domain(s) over unknown knob(s) {sorted(unknown)} — "
+                f"knobs: {sorted(self.base)}")
+        self.mem_ceiling_bytes = mem_ceiling_bytes
+        self.max_programs = int(max_programs)
+        self.ws_tokens = None if ws_tokens is None else int(ws_tokens)
+
+    # ------------------------------------------------------- candidates
+    def size(self) -> int:
+        out = 1
+        for v in self.domains.values():
+            out *= len(v)
+        return out
+
+    def _resolve(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        if config.get("num_blocks") == "mem":
+            if self.mem_ceiling_bytes is None:
+                raise ValueError(
+                    'num_blocks="mem" needs mem_ceiling_bytes')
+            config["num_blocks"] = max(
+                1, self.mem_ceiling_bytes // block_bytes(config, self.geom))
+        if config.get("host_blocks") == "ws":
+            if self.ws_tokens is None:
+                raise ValueError('host_blocks="ws" needs ws_tokens')
+            config["host_blocks"] = int(
+                math.ceil(self.ws_tokens / int(config["block_size"]))
+                + _blocks_per_seq(config))
+        return config
+
+    def default_config(self) -> Dict[str, Any]:
+        return self._resolve(dict(self.base))
+
+    def candidates(self) -> List[Dict[str, Any]]:
+        """Cartesian product of the domains overlaid on the base,
+        deduplicated, default config first — UNSCREENED (``prune()``
+        applies the constraints and reports per-constraint counts)."""
+        keys = sorted(self.domains)
+        seen = set()
+        out: List[Dict[str, Any]] = []
+        default = self.default_config()
+        for cfg in [default] + [
+                self._resolve({**self.base,
+                               **dict(zip(keys, combo))})
+                for combo in itertools.product(
+                    *(self.domains[k] for k in keys))]:
+            key = tuple(sorted((k, repr(v)) for k, v in cfg.items()))
+            if key not in seen:
+                seen.add(key)
+                out.append(cfg)
+        return out
+
+    # ------------------------------------------------------ constraints
+    def check(self, config: Dict[str, Any]) -> List[Tuple[str, str]]:
+        """``(constraint name, violation message)`` for every violated
+        predicate (empty = admissible)."""
+        out = []
+        for name, pred in CONSTRAINTS:
+            msg = pred(config, self)
+            if msg:
+                out.append((name, msg))
+        return out
+
+    def prune(self, candidates: Optional[List[Dict[str, Any]]] = None
+              ) -> Tuple[List[Dict[str, Any]], Dict[str, int]]:
+        """Screen candidates; returns ``(kept, pruned_by_constraint)``
+        where the counts attribute each rejection to the FIRST violated
+        constraint (a candidate is pruned once)."""
+        if candidates is None:
+            candidates = self.candidates()
+        kept: List[Dict[str, Any]] = []
+        pruned: Dict[str, int] = {name: 0 for name, _ in CONSTRAINTS}
+        for cfg in candidates:
+            bad = self.check(cfg)
+            if bad:
+                pruned[bad[0][0]] += 1
+            else:
+                kept.append(cfg)
+        return kept, {k: v for k, v in pruned.items() if v}
+
+
+def workload_space(geom: ModelGeom, trace, *, pool_frac: float = 0.0,
+                   mem_ceiling_bytes: Optional[int] = None,
+                   base: Optional[Dict[str, Any]] = None,
+                   domains: Optional[Dict[str, Sequence[Any]]] = None
+                   ) -> ServingKnobSpace:
+    """A :class:`ServingKnobSpace` sized to a :class:`~deepspeed_tpu
+    .autotuning.trace.ServingTrace` workload.
+
+    ``pool_frac > 0`` applies the BENCH_r09 pool-pressure protocol: the
+    device memory ceiling is set to the bytes of a default-``block_size``
+    pool holding ``pool_frac`` of the trace's unique working set, the
+    base ``num_blocks`` becomes ``"mem"`` (every candidate fills its own
+    block geometry to the SAME byte envelope), and the ``host_blocks``
+    domain offers the tiered escape hatch (0, or the full working set
+    plus one sequence of slack — host DRAM is not under the device
+    ceiling).  An explicit ``mem_ceiling_bytes`` overrides the
+    ``pool_frac`` sizing.  With neither, the space is unpressured: the
+    engine's default full-residency pool and no memory constraint."""
+    base = dict(base or {})
+    base.setdefault("max_seq_len", trace.max_total_len())
+    bs = int(base.get("block_size", BASE_SERVING_CONFIG["block_size"]))
+    ws_blocks = max(1, math.ceil(trace.working_set_tokens() / bs))
+    probe = {**BASE_SERVING_CONFIG, **base}
+    if mem_ceiling_bytes is None and pool_frac > 0:
+        bps = _blocks_per_seq(probe)
+        pressured = max(1 + bps, int(pool_frac * ws_blocks) + 1)
+        mem_ceiling_bytes = pressured * block_bytes(probe, geom)
+    if mem_ceiling_bytes is not None:
+        base.setdefault("num_blocks", "mem")
+        if domains is None:
+            domains = dict(DEFAULT_DOMAINS)
+            domains["host_blocks"] = (0, "ws")
+    return ServingKnobSpace(geom, max_seq_len=base.pop("max_seq_len"),
+                            base=base, domains=domains,
+                            mem_ceiling_bytes=mem_ceiling_bytes,
+                            ws_tokens=trace.working_set_tokens())

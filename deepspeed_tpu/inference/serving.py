@@ -210,6 +210,7 @@ from ..analysis.invariants import audit_serving_engine
 from ..analysis.sentry import (RecompileSentry, backend_compiles,
                                install_compile_listener)
 from ..ops import paged_kv
+from ..ops.decode_attention import VERIFY_T_MAX
 from ..ops.paged_kv import blocks_for
 from ..parallel.topology import TP_AXIS
 from ..telemetry import MetricsRegistry, ProfilerWindow, TraceTimeline
@@ -647,6 +648,12 @@ class ServingEngine:
         self.spec_tokens = int(spec_tokens)
         if self.spec_tokens < 0:
             raise ValueError(f"spec_tokens must be >= 0, got {spec_tokens}")
+        if self.spec_tokens and self.spec_tokens + 1 > VERIFY_T_MAX:
+            raise ValueError(
+                f"spec_tokens={spec_tokens} needs a {spec_tokens + 1}-token "
+                f"verify window but the paged verify kernel takes at most "
+                f"{VERIFY_T_MAX} — lower spec_tokens to "
+                f"{VERIFY_T_MAX - 1} or less")
         if draft is not None and not self.spec_tokens:
             raise ValueError(
                 "a draft model was given but spec_tokens is 0 — pass "
@@ -675,6 +682,8 @@ class ServingEngine:
                 f"length {max_ctx}")
         self.max_seq_len = int(max_seq_len)
         self.slots = int(slots)
+        if self.slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         self.block_size = int(block_size)
@@ -703,6 +712,9 @@ class ServingEngine:
             self.prompt_buckets = buckets
             self.prefill_chunk = 0
         self.prefill_batch = int(prefill_batch)
+        if self.prefill_batch < 1:
+            raise ValueError(
+                f"prefill_batch must be >= 1, got {prefill_batch}")
 
         if num_blocks is None:
             num_blocks = 1 + self.slots * self._nbper
@@ -719,6 +731,11 @@ class ServingEngine:
         self.swap_batch = int(swap_batch)
         if self.host_blocks and self.swap_batch < 1:
             raise ValueError(f"swap_batch must be >= 1, got {swap_batch}")
+        if self.host_blocks and self.swap_batch > self.host_blocks:
+            raise ValueError(
+                f"swap_batch={swap_batch} exceeds host_blocks="
+                f"{host_blocks} — one demotion batch could never fit the "
+                "host arena; lower swap_batch or grow host_blocks")
         if self.host_blocks and self._prefix is None:
             raise ValueError(
                 "the tiered KV cache (host_blocks > 0) needs chunked-"
@@ -825,6 +842,8 @@ class ServingEngine:
         self._dcache = None                # draft paged pool (shares tables)
         self._dcache_sharded = False
         self._proposer = None              # host-side n-gram fallback
+        self.ngram_max = int(ngram_max)    # kept for resolved_config()
+        self.ngram_min = int(ngram_min)
         if self.spec_tokens:
             if not self.chunked_prefill:
                 raise ValueError(
@@ -1027,6 +1046,10 @@ class ServingEngine:
         self._cancel_flags: set = set()    # active-slot cancels, applied at
         self._admission_log = None         # the next iteration boundary
         self._step_log = None
+        #: trace-capture hook (autotuning/trace.py TraceRecorder): called
+        #: once per successful submit() with the request and its
+        #: submit-time knobs, BEFORE any slo_class -> priority mapping
+        self._submit_observer = None
         log_dist(
             f"ServingEngine: slots={self.slots}, cache_len="
             f"{self._cache_len}, block_size={self.block_size}, "
@@ -1913,6 +1936,12 @@ class ServingEngine:
             raise ValueError(
                 f"request uid {request.uid!r} is already in flight")
         self._session_boundary_reset()
+        if self._submit_observer is not None:
+            # record the CALLER's knobs (pre-SLO-mapping priority) so a
+            # replay resubmits through the same mapping
+            self._submit_observer(request, priority=priority,
+                                  slo_class=slo_class,
+                                  eos_token_id=eos_token_id)
         if priority == 0 and slo_class is not None:
             priority = SLO_PRIORITY.get(str(slo_class), 0)
         handle = RequestHandle(request, priority=priority,
@@ -2533,6 +2562,47 @@ class ServingEngine:
                 self._finish_slot(slot)
 
     # ------------------------------------------------------------------ stats
+    def resolved_config(self) -> Dict[str, Any]:
+        """The engine's resolved serving knobs as a **round-trippable**,
+        JSON-able ``init_serving`` kwargs dict: ``init_serving(model,
+        **srv.resolved_config())`` rebuilds a behaviorally identical
+        engine (auto knobs — ``chunked_prefill``, ``shard_kv``,
+        ``num_blocks``, SLO targets — come back resolved, so the rebuilt
+        engine does not depend on the defaults in force when this one was
+        built).  This is what autotuner trials, ``best_config.json``, and
+        the bench JSONs persist so a winning config reproduces from
+        artifacts alone.
+
+        Not captured: the wrapped ``init_inference`` engine itself (model,
+        params, dtype, quant group sizes) and a ``draft`` model object —
+        a draft-model speculative engine round-trips to the n-gram
+        proposer at the same ``spec_tokens``.
+        """
+        return {
+            "slots": self.slots,
+            "max_seq_len": self.max_seq_len,
+            "block_size": self.block_size,
+            "num_blocks": int(self._alloc.num_blocks),
+            "chunked_prefill": bool(self.chunked_prefill),
+            "prefill_chunk": int(self.prefill_chunk),
+            "prompt_buckets": list(self.prompt_buckets) or None,
+            "prefill_batch": self.prefill_batch,
+            "prefix_caching": self._prefix is not None,
+            "spec_tokens": self.spec_tokens,
+            "ngram_max": self.ngram_max,
+            "ngram_min": self.ngram_min,
+            "quantize": self.quantize,
+            "host_blocks": self.host_blocks,
+            "swap_batch": self.swap_batch,
+            "shard_kv": bool(self.kv_sharded),
+            "topology": self.tp_degree,
+            "debug_checks": self.debug_checks,
+            "trace_capacity": int(self.timeline.capacity),
+            "slo_targets": {cls: dict(t)
+                            for cls, t in self._slo.targets.items()},
+            "peak_flops": self.peak_flops,
+        }
+
     def _kv_footprint(self) -> Dict[str, Any]:
         """KV memory accounting: pool shape, total logical bytes (quant-
         adjusted — int8 codes + the scale table when ``kv8``), and each
@@ -2655,6 +2725,9 @@ class ServingEngine:
             "trace_capacity": self.timeline.capacity,
             "trace_events": len(self.timeline),
             "trace_events_dropped": self.timeline.dropped,
+            # round-trippable init_serving kwargs (autotuner trials and
+            # bench JSONs reproduce the engine from artifacts alone)
+            "config": self.resolved_config(),
         }
         st.update(self._kv_footprint())
         st.update(self._latency_stats())

@@ -174,6 +174,10 @@ class ReplicaRouter:
         self._busy_s = [0.0] * len(replicas)
         self._stop_evt = threading.Event()
         self._threads: List[threading.Thread] = []
+        #: trace-capture hook (autotuning/trace.py TraceRecorder): called
+        #: per submit() with the caller's knobs, before routing — the
+        #: recorded arrival order is the fleet-wide one
+        self._submit_observer = None
 
         # family names carry the serving_ namespace prefix (lint GL008:
         # the federated fleet registry stays greppable by subsystem)
@@ -378,6 +382,10 @@ class ReplicaRouter:
         ``result()`` / ``cancel()`` — cancel routes back through the
         router so it lands on whichever replica owns the request after
         any drain handoffs)."""
+        if self._submit_observer is not None:
+            self._submit_observer(request, priority=priority,
+                                  slo_class=slo_class,
+                                  eos_token_id=eos_token_id)
         with self._fleet_lock:
             rid, why, depth = self._route(request.prompt)
             if why == "affinity":
@@ -717,6 +725,9 @@ class ReplicaRouter:
                 "compile_count": rep.compile_count,
                 "compile_budget": rep.compile_budget,
                 "busy_s": self._busy_s[rid],
+                # optional protocol member (jax-free fakes skip it)
+                "config": rep.resolved_config()
+                if hasattr(rep, "resolved_config") else {},
             })
         return {
             "replicas": len(self.replicas),

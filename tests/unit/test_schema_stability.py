@@ -36,13 +36,14 @@ def served():
     return srv, router
 
 
-#: ServingEngine.stats() — the PR 2–11 key set, frozen byte-identical
-#: (PR 12 added NO engine stats keys: SLO and FLOPs ride slo_report()
-#: and flops_report(), the registry carries their metric families)
+#: ServingEngine.stats() — the PR 2–11 key set, frozen byte-identical,
+#: + PR 13's "config" (the round-trippable init_serving kwargs sub-dict
+#: autotuner trials and bench JSONs reproduce engines from)
 ENGINE_STATS_KEYS = frozenset({
     "acceptance_rate", "accepted_tokens", "admitted", "backend_compiles",
     "block_size", "blocks_in_use", "cancelled", "compile_budget",
-    "compile_count", "debug_checks", "decode_steps", "drafted_tokens",
+    "compile_count", "config", "debug_checks", "decode_steps",
+    "drafted_tokens",
     "evicted", "free_blocks", "generated_tokens", "host_blocks",
     "host_blocks_in_use", "host_pool_bytes", "invariant_checks_run",
     "iterations", "kv_dtype", "kv_pool_bytes", "kv_pool_bytes_per_chip",
@@ -58,6 +59,17 @@ ENGINE_STATS_KEYS = frozenset({
     "ttft_p50_s", "ttft_p95_s", "weight_quant",
 })
 
+#: stats()["config"] / resolved_config() — the ``init_serving`` kwargs
+#: dict pinned key-for-key: bench JSONs, ``best_config.json``, and the
+#: autotuner's trial records must stay mutually loadable across PRs
+CONFIG_KEYS = frozenset({
+    "block_size", "chunked_prefill", "debug_checks", "host_blocks",
+    "max_seq_len", "ngram_max", "ngram_min", "num_blocks", "peak_flops",
+    "prefill_batch", "prefill_chunk", "prefix_caching", "prompt_buckets",
+    "quantize", "shard_kv", "slo_targets", "slots", "spec_tokens",
+    "swap_batch", "topology", "trace_capacity",
+})
+
 #: ReplicaRouter.stats() — PR 11 keys + PR 12's "metrics_endpoint"
 ROUTER_STATS_KEYS = frozenset({
     "busy_s", "drained", "drains", "generated_tokens", "kv_pull",
@@ -68,7 +80,7 @@ ROUTER_STATS_KEYS = frozenset({
 
 PER_REPLICA_KEYS = frozenset({
     "active", "admitted", "blocks_in_use", "busy_s", "compile_budget",
-    "compile_count", "drained", "generated_tokens",
+    "compile_count", "config", "drained", "generated_tokens",
     "prefix_cache_hit_rate", "queue_depth", "replica",
 })
 
@@ -94,6 +106,26 @@ def test_engine_stats_keys_pinned_with_draft_pool_extras(served):
     srv, _ = served
     st = set(srv.stats().keys())
     assert "draft_pool_bytes" not in st       # no draft on this engine
+
+
+def test_stats_config_keys_pinned_and_roundtrippable(served):
+    """The config sub-dict is pinned key-for-key, JSON-able, and a
+    fixpoint of ``init_serving``: rebuilding from it resolves to the
+    identical dict (trials/benches reproduce engines from artifacts
+    alone)."""
+    import json
+
+    srv, router = served
+    cfg = srv.stats()["config"]
+    assert set(cfg.keys()) == CONFIG_KEYS
+    assert cfg == srv.resolved_config()
+    json.dumps(cfg)
+    assert router.stats()["per_replica"][0]["config"] == cfg
+    deepspeed_tpu.comm.reset_topology()
+    rebuilt = deepspeed_tpu.init_serving(
+        gpt2.build(gpt2.GPT2Config.tiny(max_seq_len=128)),
+        config={"dtype": "fp32"}, **cfg)
+    assert rebuilt.resolved_config() == cfg
 
 
 def test_router_stats_keys_pinned(served):
