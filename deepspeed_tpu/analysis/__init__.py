@@ -1,6 +1,6 @@
 """Static + runtime correctness tooling for the TPU hot paths.
 
-Three coordinated passes turn the conventions the serving/training
+Four coordinated passes turn the conventions the serving/training
 engines document into checked contracts:
 
  - :mod:`deepspeed_tpu.analysis.lint` — ``graft-lint``, a stdlib-only AST
@@ -15,10 +15,18 @@ engines document into checked contracts:
    audit (refcount conservation, free-list disjointness, scratch
    aliasing, trie structure, table/length consistency) run after every
    scheduler round under ``debug_checks``.
+ - :mod:`deepspeed_tpu.analysis.concurrency` — ``graft-race``, the
+   lock-discipline layer: a stdlib-only static pass (rules
+   GL009..GL011 — lock-order inversion, unguarded shared state,
+   blocking under a lock; ``bin/graft-race`` CLI wired into CI) plus
+   the runtime ``OrderedLock`` sanitizer the threaded serving fleet
+   wires in under ``debug_checks`` (lock-order cycles and
+   blocking-wait-under-lock raise at acquire time, naming both
+   acquisition sites).
 
-``lint`` stays importable without jax (the CI lint job runs bare);
-import the runtime pieces from their submodules or via the lazy
-attributes here.
+``lint`` and ``concurrency`` stay importable without jax (the CI lint
+job runs bare); import the runtime pieces from their submodules or via
+the lazy attributes here.
 """
 
 from __future__ import annotations
@@ -32,17 +40,28 @@ _RUNTIME_EXPORTS = {
     "PagedStateError": "invariants",
     "audit_paged_state": "invariants",
     "audit_serving_engine": "invariants",
+    "LockSanitizer": "concurrency",
+    "OrderedLock": "concurrency",
+    "ordered_condition": "concurrency",
+    "held_locks": "concurrency",
+    "LockOrderError": "concurrency",
+    "BlockingUnderLockError": "concurrency",
 }
 
-__all__ = sorted(_RUNTIME_EXPORTS) + ["lint"]
+__all__ = sorted(_RUNTIME_EXPORTS) + ["lint", "concurrency"]
 
 
 def __getattr__(name):
     # lazy: importing deepspeed_tpu.analysis.lint alone must not pull jax
-    if name in _RUNTIME_EXPORTS:
-        import importlib
+    import importlib
 
+    if name in _RUNTIME_EXPORTS:
         mod = importlib.import_module(
             f".{_RUNTIME_EXPORTS[name]}", __name__)
         return getattr(mod, name)
+    if name in ("lint", "concurrency", "sentry", "invariants"):
+        # submodules advertised in __all__ resolve lazily too —
+        # ``deepspeed_tpu.analysis.lint`` must work without a prior
+        # ``from ... import lint`` having bound the attribute
+        return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

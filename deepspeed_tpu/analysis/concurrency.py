@@ -1,0 +1,1185 @@
+"""graft-race: static lock-discipline analysis + runtime lock-order/race
+sanitizer for the threaded serving fleet (``bin/graft-race``).
+
+PRs 11-13 made the host side genuinely concurrent — router worker
+threads holding per-replica locks under a fleet lock
+(``serving/router.py``), Condition-based streaming ``RequestHandle``\\ s
+(``inference/serving.py``), and a live ``/metrics`` scrape thread that
+interleaves with the step loop (``telemetry/server.py``) — but the
+locking discipline lived only in comments ("same order as drain — no
+cycle").  This module turns that discipline into a checked contract,
+with the same two-pronged architecture as the recompile sentry: a
+stdlib-only static AST pass (rules GL009..GL011, ``analysis/lint.py``
+architecture, ``# graft: noqa(GLxxx)`` pragmas, CI-wired CLI) plus a
+zero-overhead-off runtime sanitizer (``OrderedLock`` /
+``ordered_condition``) that detects lock-order inversions and
+blocking-under-lock hazards at acquire time, *before* they deadlock.
+
+Static rules
+============
+
+========  =============================================================
+GL009     lock-order inversion: two code paths acquire the same pair of
+          locks in opposite order (a cross-thread deadlock window), an
+          acquisition edge contradicts the declared fleet partial order
+          (``DEFAULT_LOCK_ORDER``), or two locks from one collection
+          (``self._locks[i]``) are nested without a sorted-index /
+          loop-order idiom making the order deterministic.
+GL010     unguarded shared state: an instance field of a *concurrent*
+          class (one that spawns threads or owns locks/Conditions) is
+          mutated both inside and outside lock regions — guarded-by
+          inference resolves lock regions through the intra-file call
+          graph, so a private helper only ever called under the fleet
+          lock counts as guarded.  Also: a store to another object's
+          private field when that field is lock-guarded in its owning
+          class (bypassing the owner's discipline).
+GL011     blocking call under a lock: ``device_get`` /
+          ``block_until_ready`` / zero-arg ``join()`` / unbounded
+          ``wait()``/``wait_for()`` on a foreign object / ``sleep`` /
+          HTTP handling (``serve_forever``/``handle_request``/
+          ``urlopen``) while a lock region is held — every contending
+          thread stalls behind the device/network.  Waiting on the
+          region's *own* Condition is exempt (wait releases it), as are
+          timeout-bounded joins/waits and the sanctioned transfer
+          helpers (``demote``/``promote``/``swap``/``sync``/
+          ``prefetch`` — the documented device commit points, same set
+          as lint GL007).
+========  =============================================================
+
+The declared fleet lock order (checked statically here by attribute
+name, enforced dynamically by rank) is::
+
+    _sup_lock -> _fleet_lock -> _locks[ascending index] -> _cond -> _reg_lock
+    (supervisor)   (fleet)        (per-replica)           (handle)  (registry)
+
+Suppression: ``# graft: noqa(GL009)`` (comma-separated codes, or bare)
+on the offending line, with a written justification — identical
+semantics to graft-lint.  ``bin/graft-race deepspeed_tpu/`` exits
+nonzero on any unsuppressed finding or on a path matching no files.
+
+Runtime sanitizer
+=================
+
+:class:`OrderedLock` wraps a ``threading.RLock`` with a per-thread
+held-set and a process-wide name-level order graph: every cross-lock
+acquisition records a ``held -> acquired`` edge and is checked — at
+acquire time, before blocking — against (a) the declared rank order,
+(b) ascending-key order for same-name locks (the per-replica
+collection), and (c) cycles in the observed edge graph, so the
+*potential* deadlock is reported from a single run even when the racy
+interleaving never actually deadlocks.  Violations raise
+:class:`LockOrderError` naming **both** acquisition sites.
+:func:`ordered_condition` builds a ``threading.Condition`` over an
+``OrderedLock`` (the ``_release_save``/``_acquire_restore`` protocol
+keeps the held-set exact across ``wait()``), and
+:meth:`LockSanitizer.check_wait` raises :class:`BlockingUnderLockError`
+when a blocking wait is entered while any sanitized lock is held (the
+``RequestHandle.result()``-under-fleet-lock deadlock).  The router,
+supervisor, metrics server scrape path, and ``RequestHandle`` wire
+these in under ``debug_checks=True``; off, every primitive is a plain
+``threading`` object — zero overhead, the concurrency analogue of the
+recompile sentry.
+
+Everything here is stdlib-only on purpose: the CI job and
+``bin/graft-race`` run without jax installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import re
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "RULES", "DEFAULT_LOCK_ORDER", "DEFAULT_LOCK_RANKS", "Finding",
+    "check_source", "analyze_sources", "race_paths", "main",
+    "LockSanitizer", "OrderedLock", "ordered_condition", "held_locks",
+    "LockOrderError", "BlockingUnderLockError",
+]
+
+RULES: Dict[str, str] = {
+    "GL009": "lock-order inversion (opposite-order pair, declared-order "
+             "violation, or unordered same-collection nesting)",
+    "GL010": "shared instance field mutated both inside and outside lock "
+             "regions in a thread-spawning/lock-owning class",
+    "GL011": "blocking call (device_get/block_until_ready/join/unbounded "
+             "wait/sleep/HTTP) while holding a lock",
+}
+
+#: the declared fleet lock partial order, by attribute name — supervisor
+#: tick -> fleet decisions -> per-replica engine locks (ascending index)
+#: -> handle condition -> metrics-registry creation lock.  Attribute
+#: names in this tuple are treated as ONE lock vocabulary across classes
+#: (they are the documented fleet-wide roles); undeclared lock attrs stay
+#: class-local.
+DEFAULT_LOCK_ORDER: Tuple[str, ...] = (
+    "_sup_lock", "_fleet_lock", "_locks", "_cond", "_reg_lock")
+
+_DECLARED_RANK = {name: i for i, name in enumerate(DEFAULT_LOCK_ORDER)}
+
+#: constructor tails that make ``self.X = <ctor>()`` a lock attribute
+_LOCK_CTORS = frozenset({"Lock", "RLock", "OrderedLock"})
+_COND_CTORS = frozenset({"Condition", "ordered_condition"})
+
+#: callables that spawn a thread of control (marks a class "concurrent")
+_THREAD_CTORS = frozenset(
+    {"Thread", "ThreadingHTTPServer", "ThreadPoolExecutor"})
+
+#: mutating container/method calls on ``self.f.<m>(...)`` that count as
+#: field mutations for GL010 (``set``/``clear``/``inc`` deliberately
+#: excluded: Event flips and metric-cell pokes are single GIL-atomic
+#: stores by the telemetry contract)
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "remove", "pop",
+    "popleft", "popitem", "add", "discard", "update", "setdefault",
+    "move_to_end", "sort", "reverse"})
+
+#: blocking-call tails for GL011; "wait"/"wait_for"/"join" get bounded /
+#: own-lock refinement in ``_blocking_kind``
+_BLOCKING_TAILS = frozenset({
+    "device_get", "block_until_ready", "join", "wait", "wait_for",
+    "sleep", "serve_forever", "handle_request", "urlopen"})
+
+#: enclosing-function name substrings exempting GL011 (the documented
+#: device transfer commit points — same set as lint GL007)
+_SANCTIONED_XFER = ("demote", "promote", "swap", "sync", "prefetch")
+
+_NOQA_RE = re.compile(
+    r"#\s*graft:\s*noqa(?:\s*\(\s*([A-Za-z0-9_,\s]+)\s*\))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}: " \
+               f"{self.code} {self.message}"
+
+
+def _func_tail(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` -> "X"; None otherwise."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _lock_ctor_kind(expr: ast.AST) -> Optional[str]:
+    """Classify a value expression as a lock-attribute initializer:
+    "lock" / "condition" / "collection" / None.  Handles direct ctor
+    calls, list/comprehension collections of ctor calls, and
+    conditional expressions over either."""
+    if isinstance(expr, ast.Call):
+        tail = _func_tail(expr.func)
+        if tail in _LOCK_CTORS:
+            return "lock"
+        if tail in _COND_CTORS:
+            return "condition"
+        return None
+    if isinstance(expr, ast.ListComp):
+        return "collection" if _lock_ctor_kind(expr.elt) else None
+    if isinstance(expr, (ast.List, ast.Tuple)):
+        if expr.elts and all(_lock_ctor_kind(e) for e in expr.elts):
+            return "collection"
+        return None
+    if isinstance(expr, ast.IfExp):
+        return _lock_ctor_kind(expr.body) or _lock_ctor_kind(expr.orelse)
+    return None
+
+
+# ===================================================================== #
+#  static half                                                          #
+# ===================================================================== #
+
+@dataclasses.dataclass
+class _Acq:
+    """One lock-acquisition event inside a method."""
+    token: str
+    node: ast.AST
+    held: Tuple[str, ...]           # lexically-held tokens at the event
+    collection: bool = False
+    index_names: Tuple[str, ...] = ()   # subscript index Name ids
+    index_consts: Tuple[Any, ...] = ()  # subscript constant indices
+    ordered_ok: bool = False        # loop-order / known-ascending idiom
+
+
+@dataclasses.dataclass
+class _Mut:
+    field: str
+    node: ast.AST
+    held: Tuple[str, ...]
+
+
+@dataclasses.dataclass
+class _Blk:
+    kind: str
+    node: ast.AST
+    held: Tuple[str, ...]
+    target_token: Optional[str]     # lock token being waited on, if any
+    sanctioned: bool
+
+
+@dataclasses.dataclass
+class _CallSite:
+    callee: str                     # method or module-function name
+    is_method: bool
+    held: Tuple[str, ...]
+
+
+@dataclasses.dataclass
+class _FnInfo:
+    name: str
+    qual: str                       # "Class.meth" or module-level name
+    cls: Optional[str]
+    node: ast.AST
+    acqs: List[_Acq] = dataclasses.field(default_factory=list)
+    muts: List[_Mut] = dataclasses.field(default_factory=list)
+    blocks: List[_Blk] = dataclasses.field(default_factory=list)
+    calls: List[_CallSite] = dataclasses.field(default_factory=list)
+    external_stores: List[Tuple[str, ast.AST]] = \
+        dataclasses.field(default_factory=list)
+    entry_held: Optional[frozenset] = None   # None == top (optimistic)
+
+    @property
+    def is_private(self) -> bool:
+        return self.name.startswith("_") and not self.name.startswith("__")
+
+
+@dataclasses.dataclass
+class _ClassInfo:
+    name: str
+    path: str
+    lock_attrs: Dict[str, str] = dataclasses.field(default_factory=dict)
+    spawns_thread: bool = False
+    methods: Dict[str, _FnInfo] = dataclasses.field(default_factory=dict)
+
+    @property
+    def concurrent(self) -> bool:
+        return self.spawns_thread or bool(self.lock_attrs)
+
+    def token(self, attr: str) -> str:
+        """Lock tokens in the declared order share one fleet-wide
+        vocabulary; everything else stays class-local."""
+        return attr if attr in _DECLARED_RANK else f"{self.name}.{attr}"
+
+
+class _MethodWalker:
+    """One method's lock-region walk: tracks the lexically-held token
+    stack through ``with`` regions, explicit ``acquire()``/
+    ``enter_context()`` calls, and the sorted-index / loop-order
+    acquisition idioms."""
+
+    def __init__(self, fn: _FnInfo, cls: Optional[_ClassInfo],
+                 module_funcs: Set[str]):
+        self.fn = fn
+        self.cls = cls
+        self.module_funcs = module_funcs
+        #: name -> position in its ``a, b = sorted(...)`` target tuple
+        self.sorted_pos: Dict[str, int] = {}
+        #: loop variable iterating a lock collection -> collection attr
+        self.loop_locks: Dict[str, str] = {}
+        self._collect_sorted_idiom(fn.node)
+
+    # -------------------------------------------------------------- idioms
+    def _collect_sorted_idiom(self, fn_node: ast.AST) -> None:
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Tuple) and \
+                    isinstance(node.value, ast.Call) and \
+                    _func_tail(node.value.func) == "sorted":
+                for i, elt in enumerate(node.targets[0].elts):
+                    if isinstance(elt, ast.Name):
+                        self.sorted_pos[elt.id] = i
+
+    # --------------------------------------------------------- lock lookup
+    def _lock_expr(self, expr: ast.AST) -> Optional[_Acq]:
+        """Resolve an expression to a lock-acquisition description, or
+        None when it is not a recognizable lock."""
+        if self.cls is not None:
+            attr = _is_self_attr(expr)
+            if attr is not None and attr in self.cls.lock_attrs:
+                kind = self.cls.lock_attrs[attr]
+                return _Acq(self.cls.token(attr), expr, (),
+                            collection=(kind == "collection"))
+            if isinstance(expr, ast.Subscript):
+                attr = _is_self_attr(expr.value)
+                if attr is not None and \
+                        self.cls.lock_attrs.get(attr) == "collection":
+                    idx = expr.slice
+                    names, consts = (), ()
+                    if isinstance(idx, ast.Name):
+                        names = (idx.id,)
+                    elif isinstance(idx, ast.Constant):
+                        consts = (idx.value,)
+                    return _Acq(self.cls.token(attr), expr, (),
+                                collection=True, index_names=names,
+                                index_consts=consts)
+        if isinstance(expr, ast.Name) and expr.id in self.loop_locks:
+            attr = self.loop_locks[expr.id]
+            tok = self.cls.token(attr) if self.cls else attr
+            return _Acq(tok, expr, (), collection=True, ordered_ok=True)
+        return None
+
+    # ------------------------------------------------------------- walking
+    def walk(self) -> None:
+        node = self.fn.node
+        self._stmts(list(node.body), (), ())
+
+    def _stmts(self, stmts: List[ast.stmt], held: Tuple[str, ...],
+               lex: Tuple["_Acq", ...] = ()) -> None:
+        extra: List[str] = []
+        for stmt in stmts:
+            cur = held + tuple(extra)
+            acquired = self._stmt(stmt, cur, lex)
+            for tok, releasing in acquired:
+                if releasing:
+                    if tok in extra:
+                        extra.remove(tok)
+                else:
+                    extra.append(tok)
+
+    def _record_acq(self, acq: _Acq, held: Tuple[str, ...],
+                    lex: Tuple["_Acq", ...] = ()) -> None:
+        acq.held = held
+        if acq.collection and acq.index_names and not acq.ordered_ok:
+            # sorted-unpack idiom: indices bound from one sorted() call,
+            # acquired in target order, are ascending by construction
+            poss = [self.sorted_pos.get(n) for n in acq.index_names]
+            if all(p is not None for p in poss):
+                acq.ordered_ok = True
+        if acq.collection and not acq.ordered_ok and \
+                len(acq.index_consts) == 1 and \
+                isinstance(acq.index_consts[0], int):
+            # literal ascending indices (locks[0] then locks[1]) are as
+            # deterministic as the sorted idiom — require every
+            # lexically-enclosing same-collection acquisition to carry a
+            # strictly smaller literal
+            outers = [a for a in lex if a.token == acq.token]
+            if outers and all(
+                    len(a.index_consts) == 1 and
+                    isinstance(a.index_consts[0], int) and
+                    a.index_consts[0] < acq.index_consts[0]
+                    for a in outers):
+                acq.ordered_ok = True
+        self.fn.acqs.append(acq)
+
+    def _stmt(self, stmt: ast.stmt, held: Tuple[str, ...],
+              lex: Tuple["_Acq", ...] = ()) -> List[Tuple[str, bool]]:
+        """Process one statement; returns ``(token, is_release)`` events
+        that persist for the remainder of the enclosing block
+        (``acquire()``/``release()``/``enter_context`` calls)."""
+        persisted: List[Tuple[str, bool]] = []
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner, lex_inner = held, lex
+            for item in stmt.items:
+                acq = self._lock_expr(item.context_expr)
+                self._scan_expr(item.context_expr, inner, skip_lock=True)
+                if acq is not None:
+                    acq.node = item.context_expr
+                    self._record_acq(acq, inner, lex_inner)
+                    inner = inner + (acq.token,)
+                    lex_inner = lex_inner + (acq,)
+            self._stmts(list(stmt.body), inner, lex_inner)
+            return persisted
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            bound = None
+            if isinstance(stmt.target, ast.Name):
+                attr = _is_self_attr(stmt.iter)
+                if attr is not None and self.cls is not None and \
+                        self.cls.lock_attrs.get(attr) == "collection":
+                    bound = stmt.target.id
+                    self.loop_locks[bound] = attr
+            persisted.extend(self._scan_expr(stmt.iter, held))
+            self._stmts(list(stmt.body), held, lex)
+            self._stmts(list(stmt.orelse), held, lex)
+            if bound is not None:
+                self.loop_locks.pop(bound, None)
+            return persisted
+        if isinstance(stmt, ast.While):
+            persisted.extend(self._scan_expr(stmt.test, held))
+            self._stmts(list(stmt.body), held, lex)
+            self._stmts(list(stmt.orelse), held, lex)
+            return persisted
+        if isinstance(stmt, ast.If):
+            persisted.extend(self._scan_expr(stmt.test, held))
+            self._stmts(list(stmt.body), held, lex)
+            self._stmts(list(stmt.orelse), held, lex)
+            return persisted
+        if isinstance(stmt, ast.Try):
+            self._stmts(list(stmt.body), held, lex)
+            for h in stmt.handlers:
+                self._stmts(list(h.body), held, lex)
+            self._stmts(list(stmt.orelse), held, lex)
+            self._stmts(list(stmt.finalbody), held, lex)
+            return persisted
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def's body runs later, in an unknown lock context
+            self._stmts(list(stmt.body), (), ())
+            return persisted
+        # ---- leaf statements: mutations + expression scan
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for tgt in targets:
+                self._mutation_target(tgt, held)
+            value = stmt.value
+            if value is not None:
+                # 'ok = self._lk.acquire(...)' must persist the
+                # acquisition into the remaining block exactly like the
+                # bare-expression form
+                persisted.extend(self._scan_expr(value, held))
+            if isinstance(stmt, ast.AugAssign):
+                self._scan_expr(stmt.target, held)
+            return persisted
+        if isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                self._mutation_target(tgt, held)
+            return persisted
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                persisted.extend(self._scan_expr(child, held))
+        return persisted
+
+    def _mutation_target(self, tgt: ast.AST, held: Tuple[str, ...]) -> None:
+        base = tgt
+        while isinstance(base, (ast.Subscript, ast.Starred)):
+            base = base.value
+        if isinstance(base, ast.Attribute):
+            attr = _is_self_attr(base)
+            if attr is not None:
+                if self.cls is None or attr in self.cls.lock_attrs:
+                    return
+                self.fn.muts.append(_Mut(attr, tgt, held))
+            elif base.attr.startswith("_") and \
+                    not isinstance(base.value, ast.Constant):
+                self.fn.external_stores.append((base.attr, tgt))
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._mutation_target(elt, held)
+
+    # ---------------------------------------------------------- expressions
+    def _scan_expr(self, expr: ast.AST, held: Tuple[str, ...],
+                   skip_lock: bool = False) -> List[Tuple[str, bool]]:
+        persisted: List[Tuple[str, bool]] = []
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _func_tail(node.func)
+            # explicit acquire()/release()/enter_context(lock)
+            if tail in ("acquire", "release") and \
+                    isinstance(node.func, ast.Attribute):
+                acq = self._lock_expr(node.func.value)
+                if acq is not None and not skip_lock:
+                    if tail == "acquire":
+                        acq.node = node
+                        self._record_acq(acq, held)
+                        persisted.append((acq.token, False))
+                    else:
+                        persisted.append((acq.token, True))
+                    continue
+            if tail == "enter_context" and node.args:
+                acq = self._lock_expr(node.args[0])
+                if acq is not None:
+                    acq.node = node
+                    self._record_acq(acq, held)
+                    persisted.append((acq.token, False))
+                    continue
+            if tail in _THREAD_CTORS and self.cls is not None:
+                self.cls.spawns_thread = True
+            # intra-file call graph
+            if isinstance(node.func, ast.Attribute):
+                if isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "self" and \
+                        self.cls is not None:
+                    self.fn.calls.append(
+                        _CallSite(node.func.attr, True, held))
+            elif isinstance(node.func, ast.Name) and \
+                    node.func.id in self.module_funcs:
+                self.fn.calls.append(
+                    _CallSite(node.func.id, False, held))
+            # mutator-method field mutations: self.f.<mutator>(...)
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATORS:
+                attr = _is_self_attr(node.func.value)
+                if attr is None and isinstance(node.func.value,
+                                               ast.Subscript):
+                    attr = _is_self_attr(node.func.value.value)
+                if attr is not None and self.cls is not None and \
+                        attr not in self.cls.lock_attrs:
+                    self.fn.muts.append(_Mut(attr, node, held))
+            # GL011 candidates
+            kind = self._blocking_kind(node, tail)
+            if kind is not None:
+                target_tok = None
+                if tail in ("wait", "wait_for") and \
+                        isinstance(node.func, ast.Attribute):
+                    acq = self._lock_expr(node.func.value)
+                    if acq is not None:
+                        target_tok = acq.token
+                self.fn.blocks.append(_Blk(
+                    kind, node, held, target_tok,
+                    sanctioned=any(t in self.fn.name.lower()
+                                   for t in _SANCTIONED_XFER)))
+        return persisted
+
+    @staticmethod
+    def _blocking_kind(node: ast.Call, tail: Optional[str]) -> Optional[str]:
+        if tail not in _BLOCKING_TAILS:
+            return None
+        has_timeout = any(kw.arg == "timeout" for kw in node.keywords)
+        if tail == "join":
+            # zero-arg join is a thread/process join with no bound;
+            # str.join always carries its iterable argument
+            if node.args or has_timeout:
+                return None
+            return "join()"
+        if tail == "wait":
+            if node.args or has_timeout:
+                return None
+            return "wait()"
+        if tail == "wait_for":
+            if len(node.args) > 1 or has_timeout:
+                return None
+            return "wait_for()"
+        if tail == "sleep":
+            root = node.func
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id in ("time", "sleep") \
+                    or isinstance(node.func, ast.Name):
+                return "sleep()"
+            return None
+        return f"{tail}()"
+
+
+class _ModuleCollector:
+    """Phase A: collect per-class / per-function lock facts for one
+    module."""
+
+    def __init__(self, tree: ast.Module, path: str):
+        self.path = path
+        self.classes: List[_ClassInfo] = []
+        self.functions: Dict[str, _FnInfo] = {}   # module-level
+        module_funcs = {n.name for n in tree.body
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._collect_class(node, module_funcs)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = _FnInfo(node.name, node.name, None, node)
+                self.functions[node.name] = fn
+        for fn in self.functions.values():
+            _MethodWalker(fn, None, module_funcs).walk()
+
+    def _collect_class(self, node: ast.ClassDef,
+                       module_funcs: Set[str]) -> None:
+        cls = _ClassInfo(node.name, self.path)
+        methods = [n for n in node.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        # lock attributes: any `self.X = <lock ctor>` in any method
+        for meth in methods:
+            for sub in ast.walk(meth):
+                if isinstance(sub, ast.Assign):
+                    kind = _lock_ctor_kind(sub.value)
+                    if kind is None:
+                        continue
+                    for tgt in sub.targets:
+                        attr = _is_self_attr(tgt)
+                        if attr is not None:
+                            prev = cls.lock_attrs.get(attr)
+                            if prev == "collection" or kind == "collection":
+                                cls.lock_attrs[attr] = "collection"
+                            else:
+                                cls.lock_attrs[attr] = \
+                                    "condition" if "condition" in (
+                                        prev, kind) else kind
+        for meth in methods:
+            fn = _FnInfo(meth.name, f"{cls.name}.{meth.name}", cls.name,
+                         meth)
+            cls.methods[meth.name] = fn
+            _MethodWalker(fn, cls, module_funcs).walk()
+        self.classes.append(cls)
+
+
+def _fix_entry_held(collector: _ModuleCollector) -> None:
+    """Greatest-fixpoint guarded-by inference: a private method's entry
+    held-set is the intersection, over every intra-file call site, of
+    the caller's entry set union the lexically-held set at the site.
+    Public (and never-called) functions enter with nothing held."""
+    fns: Dict[Tuple[Optional[str], str], _FnInfo] = {}
+    for cls in collector.classes:
+        for fn in cls.methods.values():
+            fns[(cls.name, fn.name)] = fn
+    for fn in collector.functions.values():
+        fns[(None, fn.name)] = fn
+    # seed: public entry points pin to {}; private stay optimistic (None)
+    for fn in fns.values():
+        fn.entry_held = None if fn.is_private else frozenset()
+    for _ in range(len(fns) + 2):          # bounded fixpoint iteration
+        changed = False
+        incoming: Dict[Tuple[Optional[str], str],
+                       Optional[frozenset]] = {k: None for k in fns}
+        seen: Set[Tuple[Optional[str], str]] = set()
+        for (cls_name, _), fn in fns.items():
+            base = fn.entry_held if fn.entry_held is not None \
+                else frozenset()
+            for site in fn.calls:
+                key = (cls_name if site.is_method else None, site.callee)
+                if key not in fns:
+                    continue
+                seen.add(key)
+                at_site = base | frozenset(site.held)
+                cur = incoming[key]
+                incoming[key] = at_site if cur is None \
+                    else (cur & at_site)
+        for key, fn in fns.items():
+            if not fn.is_private:
+                continue
+            new = incoming[key] if key in seen else frozenset()
+            if new is None:
+                new = frozenset()
+            if fn.entry_held != new:
+                fn.entry_held = new
+                changed = True
+        if not changed:
+            break
+    for fn in fns.values():
+        if fn.entry_held is None:
+            fn.entry_held = frozenset()
+
+
+def _line_site(path: str, node: ast.AST) -> str:
+    return f"{path}:{node.lineno}"
+
+
+def analyze_sources(sources: Sequence[Tuple[str, str]],
+                    keep_suppressed: bool = False) -> List[Finding]:
+    """Analyze ``(source_text, path)`` pairs as one unit (cross-file
+    lock-order edges and guarded-field indexes merge across them);
+    returns unsuppressed findings sorted by path/line."""
+    collectors: List[_ModuleCollector] = []
+    findings: List[Finding] = []
+    lines_by_path: Dict[str, List[str]] = {}
+    for source, path in sources:
+        lines_by_path[path] = source.splitlines()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            findings.append(Finding(path, e.lineno or 0, 0, "GL000",
+                                    f"syntax error: {e.msg}"))
+            continue
+        collector = _ModuleCollector(tree, path)
+        _fix_entry_held(collector)
+        collectors.append(collector)
+
+    def emit(path, node, code, msg):
+        findings.append(Finding(path, node.lineno, node.col_offset,
+                                code, msg))
+
+    # ---- global guarded-field index (for cross-object stores)
+    guarded_fields: Dict[str, str] = {}    # field -> owning class
+    for col in collectors:
+        for cls in col.classes:
+            if not cls.concurrent:
+                continue
+            for fn in cls.methods.values():
+                if fn.name in ("__init__", "__post_init__"):
+                    continue
+                for mut in fn.muts:
+                    if tuple(mut.held) or fn.entry_held:
+                        guarded_fields.setdefault(mut.field, cls.name)
+
+    # ---- GL009: edges + declared order + collection nesting
+    # first-seen site per directed edge, fleet-wide token vocabulary
+    edge_site: Dict[Tuple[str, str], Tuple[str, ast.AST]] = {}
+    for col in collectors:
+        all_fns = list(col.functions.values()) + \
+            [fn for cls in col.classes for fn in cls.methods.values()]
+        for fn in all_fns:
+            entry = fn.entry_held or frozenset()
+            for acq in fn.acqs:
+                held_total = list(dict.fromkeys(
+                    tuple(entry) + tuple(acq.held)))
+                for held_tok in held_total:
+                    if held_tok == acq.token:
+                        if acq.collection and not acq.ordered_ok:
+                            emit(col.path, acq.node, "GL009",
+                                 f"two locks from collection "
+                                 f"'{acq.token}' nested without a "
+                                 "deterministic order — sort the "
+                                 "indices (`lo, hi = sorted(...)`) or "
+                                 "acquire in iteration order")
+                        continue
+                    edge_site.setdefault((held_tok, acq.token),
+                                         (col.path, acq.node))
+                    r_held = _DECLARED_RANK.get(held_tok)
+                    r_acq = _DECLARED_RANK.get(acq.token)
+                    if r_held is not None and r_acq is not None and \
+                            r_acq < r_held:
+                        emit(col.path, acq.node, "GL009",
+                             f"'{acq.token}' acquired while holding "
+                             f"'{held_tok}' inverts the declared lock "
+                             "order (" +
+                             " -> ".join(DEFAULT_LOCK_ORDER) + ")")
+    for (a, b), (path, node) in edge_site.items():
+        rev = edge_site.get((b, a))
+        if rev is not None and (a, b) < (b, a):
+            rpath, rnode = rev
+            findings.append(Finding(
+                path, node.lineno, node.col_offset, "GL009",
+                f"lock-order inversion: '{b}' acquired while holding "
+                f"'{a}' here, but the opposite order at "
+                f"{_line_site(rpath, rnode)} — a cross-thread deadlock "
+                "window"))
+            findings.append(Finding(
+                rpath, rnode.lineno, rnode.col_offset, "GL009",
+                f"lock-order inversion: '{a}' acquired while holding "
+                f"'{b}' here, but the opposite order at "
+                f"{_line_site(path, node)} — a cross-thread deadlock "
+                "window"))
+
+    # ---- GL010: mixed guarded/unguarded field mutation
+    for col in collectors:
+        for cls in col.classes:
+            if not cls.concurrent:
+                continue
+            sites: Dict[str, Dict[str, List[Tuple[_FnInfo, _Mut]]]] = {}
+            for fn in cls.methods.values():
+                if fn.name in ("__init__", "__post_init__"):
+                    continue
+                entry = fn.entry_held or frozenset()
+                for mut in fn.muts:
+                    guarded = bool(entry or mut.held)
+                    sites.setdefault(mut.field, {"g": [], "u": []})[
+                        "g" if guarded else "u"].append((fn, mut))
+            for field, d in sites.items():
+                if not (d["g"] and d["u"]):
+                    continue
+                g_fn, g_mut = d["g"][0]
+                for fn, mut in d["u"]:
+                    emit(col.path, mut.node, "GL010",
+                         f"field '{field}' of {cls.name} is mutated "
+                         f"here with no lock held, but lock-guarded at "
+                         f"{_line_site(col.path, g_mut.node)} "
+                         f"(in {g_fn.name}) — guard every mutation or "
+                         "document single-threaded ownership")
+        # cross-object stores bypassing the owner's lock discipline
+        all_fns = list(col.functions.values()) + \
+            [fn for cls in col.classes for fn in cls.methods.values()]
+        for fn in all_fns:
+            for attr, node in fn.external_stores:
+                owner = guarded_fields.get(attr)
+                if owner is not None and fn.cls != owner:
+                    emit(col.path, node, "GL010",
+                         f"store to '{attr}' of a foreign {owner} "
+                         "instance — the field is lock-guarded in its "
+                         "owning class; use the owner's locked mutator "
+                         "instead")
+
+    # ---- GL011: blocking calls under a lock
+    for col in collectors:
+        all_fns = list(col.functions.values()) + \
+            [fn for cls in col.classes for fn in cls.methods.values()]
+        for fn in all_fns:
+            entry = fn.entry_held or frozenset()
+            for blk in fn.blocks:
+                held_total = list(dict.fromkeys(
+                    tuple(entry) + tuple(blk.held)))
+                if not held_total or blk.sanctioned:
+                    continue
+                if blk.target_token is not None and \
+                        blk.target_token in held_total:
+                    continue        # waiting on the region's own lock
+                                    # releases it (Condition protocol)
+                emit(col.path, blk.node, "GL011",
+                     f"blocking {blk.kind} while holding lock "
+                     f"'{held_total[-1]}' stalls every contending "
+                     "thread — hoist it out of the lock region (or "
+                     "bound it with a timeout)")
+
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    if keep_suppressed:
+        return findings
+    out = []
+    for f in findings:
+        lines = lines_by_path.get(f.path, [])
+        if not _suppressed(f, lines):
+            out.append(f)
+    return out
+
+
+def _suppressed(finding: Finding, lines: Sequence[str]) -> bool:
+    if not (1 <= finding.line <= len(lines)):
+        return False
+    m = _NOQA_RE.search(lines[finding.line - 1])
+    if not m:
+        return False
+    if m.group(1) is None:
+        return True
+    codes = {c.strip().upper() for c in m.group(1).split(",")}
+    return finding.code in codes
+
+
+def check_source(source: str, path: str = "<string>",
+                 keep_suppressed: bool = False) -> List[Finding]:
+    """Analyze one module's source text (single-file convenience over
+    :func:`analyze_sources`)."""
+    return analyze_sources([(source, path)],
+                           keep_suppressed=keep_suppressed)
+
+
+def iter_py_files(paths: Sequence[str]) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            out.extend(sorted(path.rglob("*.py")))
+        elif path.suffix == ".py":
+            out.append(path)
+    return out
+
+
+def race_paths(paths: Sequence[str]) -> Tuple[List[Finding], int]:
+    """Analyze every ``*.py`` under ``paths`` as ONE cross-file unit;
+    returns ``(findings, file_count)``."""
+    files = iter_py_files(paths)
+    sources = []
+    findings: List[Finding] = []
+    for f in files:
+        try:
+            sources.append((f.read_text(encoding="utf-8"), str(f)))
+        except OSError as e:
+            # an unreadable (or nonexistent) explicit argument must fail
+            # the gate loudly, not count as a clean file
+            findings.append(Finding(str(f), 0, 0, "GL000",
+                                    f"cannot read file: {e}"))
+    return findings + analyze_sources(sources), len(files)
+
+
+# ===================================================================== #
+#  dynamic half                                                         #
+# ===================================================================== #
+
+class LockOrderError(RuntimeError):
+    """A lock acquisition violates the declared rank order, the
+    ascending-key order for same-name locks, or closes a cycle in the
+    observed cross-thread acquisition graph.  Raised *before* the lock
+    is taken, naming both acquisition sites."""
+
+
+class BlockingUnderLockError(RuntimeError):
+    """A blocking wait was entered while the thread holds a sanitized
+    lock — the classic ``handle.result()``-under-the-fleet-lock
+    deadlock.  Names the wait site and every held lock's acquire
+    site."""
+
+
+#: rank declaration for the fleet's named locks — the runtime mirror of
+#: :data:`DEFAULT_LOCK_ORDER` (``telemetry.registry`` participates in
+#: the declared order but is a plain ``threading.Lock`` at runtime: its
+#: regions are leaves that never take another lock)
+DEFAULT_LOCK_RANKS: Dict[str, int] = {
+    "serving.supervisor": 0,
+    "serving.fleet": 1,
+    "serving.replica": 2,
+    "serving.handle": 3,
+    "telemetry.registry": 4,
+}
+
+_HELD = threading.local()
+
+
+def _held_stack() -> List["_HeldEntry"]:
+    stack = getattr(_HELD, "stack", None)
+    if stack is None:
+        stack = _HELD.stack = []
+    return stack
+
+
+def held_locks() -> List["_HeldEntry"]:
+    """The current thread's held sanitized locks, outermost first (a
+    snapshot — debugging / assertion surface)."""
+    return list(_held_stack())
+
+
+@dataclasses.dataclass
+class _HeldEntry:
+    lock: "OrderedLock"
+    name: str
+    rank: Optional[int]
+    key: int
+    site: str
+
+
+def caller_site(depth: int = 1) -> str:
+    """``file:line`` of the nearest caller frame outside this module and
+    ``threading.py`` (Condition internals route acquires through
+    ``threading``; the useful site is the ``with handle._cond:``).
+    ``depth=1`` is the immediate caller; wired sites pass the depth of
+    the frame their error should blame (``RequestHandle.result`` blames
+    *its* caller — the thread that would deadlock)."""
+    try:
+        frame = sys._getframe(depth)
+    except ValueError:                      # pragma: no cover
+        return "<unknown>"
+    skip = (__file__, threading.__file__)
+    while frame is not None and frame.f_code.co_filename in skip:
+        frame = frame.f_back
+    if frame is None:                       # pragma: no cover
+        return "<unknown>"
+    return f"{frame.f_code.co_filename}:{frame.f_lineno}"
+
+
+class LockSanitizer:
+    """Shared order-checking state for a set of :class:`OrderedLock`\\ s:
+    declared ranks, the observed name-level acquisition-edge graph, and
+    the check/violation counters ``ReplicaRouter.stats()`` surfaces.
+
+    The per-thread held-set is module-global (all sanitizers see one
+    stack), so an edge between locks owned by different components —
+    a replica lock and a handle condition, say — is still checked."""
+
+    def __init__(self, ranks: Optional[Dict[str, int]] = None):
+        self.ranks = dict(DEFAULT_LOCK_RANKS if ranks is None else ranks)
+        self._mu = threading.Lock()
+        #: name -> successor name -> "heldsite -> acqsite" of first edge
+        self._edges: Dict[str, Dict[str, str]] = {}
+        self.checks = 0
+        self.violations = 0
+        #: optional per-check callback (the router wires its
+        #: ``serving_lock_order_checks_total`` counter here)
+        self.on_check = None
+
+    # ------------------------------------------------------------- checking
+    def _violate(self, msg: str, kind=LockOrderError) -> None:
+        with self._mu:
+            self.violations += 1
+        raise kind(msg)
+
+    def _path_exists(self, src: str, dst: str) -> Optional[str]:
+        """First-hop site of a path ``src -> ... -> dst`` in the edge
+        graph, or None.  Caller holds ``_mu``."""
+        seen = {src}
+        stack = [(src, None)]
+        while stack:
+            node, first = stack.pop()
+            for succ, site in self._edges.get(node, {}).items():
+                hop = first if first is not None else site
+                if succ == dst:
+                    return hop
+                if succ not in seen:
+                    seen.add(succ)
+                    stack.append((succ, hop))
+        return None
+
+    def check_acquire(self, lock: "OrderedLock", site: str) -> _HeldEntry:
+        """Order checks for acquiring ``lock`` at ``site`` given the
+        thread's held stack; returns the held-entry to push on success,
+        raises :class:`LockOrderError` (before any blocking) on a
+        violation."""
+        entry = _HeldEntry(lock, lock.name, lock.rank, lock.key, site)
+        stack = _held_stack()
+        if any(h.lock is lock for h in stack):
+            return entry                    # re-entrant RLock acquire
+        if not stack:
+            return entry
+        with self._mu:
+            # on_check runs UNDER _mu so a wired metrics counter (a
+            # plain lock-free cell) stays exactly in lockstep with
+            # ``checks`` — the threaded stress asserts equality
+            self.checks += 1
+            if self.on_check is not None:
+                self.on_check()
+        for h in stack:
+            if h.name == lock.name:
+                if lock.key <= h.key:
+                    self._violate(
+                        f"same-order violation: {lock.name!r}"
+                        f"[key={lock.key}] acquired at {site} while "
+                        f"holding {h.name!r}[key={h.key}] acquired at "
+                        f"{h.site} — same-name locks must be taken in "
+                        "ascending key order")
+            elif lock.rank is not None and h.rank is not None and \
+                    lock.rank < h.rank:
+                self._violate(
+                    f"declared-order inversion: {lock.name!r} "
+                    f"(rank {lock.rank}) acquired at {site} while "
+                    f"holding {h.name!r} (rank {h.rank}) acquired at "
+                    f"{h.site} — declared order: " +
+                    " -> ".join(sorted(self.ranks, key=self.ranks.get)))
+        top = stack[-1]
+        if top.name != lock.name:
+            with self._mu:
+                reverse = self._path_exists(lock.name, top.name)
+                self._edges.setdefault(top.name, {}).setdefault(
+                    lock.name, f"{top.site} -> {site}")
+            if reverse is not None:
+                self._violate(
+                    f"lock-order cycle: {lock.name!r} acquired at "
+                    f"{site} while holding {top.name!r} acquired at "
+                    f"{top.site}, but the opposite order was observed "
+                    f"({reverse})")
+        return entry
+
+    def check_wait(self, what: str, site: Optional[str] = None) -> None:
+        """Raise :class:`BlockingUnderLockError` if the current thread
+        enters a blocking wait (``what``) while holding any sanitized
+        lock — naming the wait site and every held acquisition site."""
+        stack = _held_stack()
+        if not stack:
+            return
+        site = site or caller_site(2)
+        held = "; ".join(f"{h.name!r} acquired at {h.site}"
+                         for h in stack)
+        self._violate(
+            f"{what} would block at {site} while holding {held} — "
+            "release every lock before a blocking wait",
+            kind=BlockingUnderLockError)
+
+    # ------------------------------------------------------------ debugging
+    def edges(self) -> Dict[str, Dict[str, str]]:
+        with self._mu:
+            return {k: dict(v) for k, v in self._edges.items()}
+
+
+class OrderedLock:
+    """A ``threading.RLock`` instrumented with the sanitizer's held-set
+    / order checks and an optional wait-time observer (the
+    ``serving_lock_wait_seconds{lock=}`` histogram).  Drop-in for
+    ``with``-statement use and as the lock under ``threading.Condition``
+    (the ``_release_save`` protocol keeps the held-set exact across
+    ``wait()``)."""
+
+    def __init__(self, name: str, *, sanitizer: LockSanitizer,
+                 key: int = 0, rank: Optional[int] = None,
+                 wait_observer=None):
+        self._inner = threading.RLock()
+        self.name = name
+        self.key = int(key)
+        self.sanitizer = sanitizer
+        self.rank = sanitizer.ranks.get(name) if rank is None else rank
+        self._wait_observer = wait_observer
+
+    def __repr__(self) -> str:
+        return f"OrderedLock({self.name!r}, key={self.key}, " \
+               f"rank={self.rank})"
+
+    # ------------------------------------------------------------ lock API
+    def acquire(self, blocking: bool = True, timeout: float = -1,
+                _site: Optional[str] = None) -> bool:
+        site = _site if _site is not None else caller_site(2)
+        entry = self.sanitizer.check_acquire(self, site)
+        t0 = time.perf_counter()
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            if self._wait_observer is not None:
+                # several locks can share one histogram cell (the
+                # per-replica set shares lock="replica"), and observe()
+                # is a multi-step update — serialize under the
+                # sanitizer mutex so concurrent workers cannot tear it
+                with self.sanitizer._mu:
+                    self._wait_observer(time.perf_counter() - t0)
+            _held_stack().append(entry)
+        return ok
+
+    def release(self) -> None:
+        stack = _held_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i].lock is self:
+                del stack[i]
+                break
+        self._inner.release()
+
+    def __enter__(self) -> "OrderedLock":
+        self.acquire(_site=caller_site(2))
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # ------------------------- threading.Condition integration protocol
+    def _release_save(self):
+        stack = _held_stack()
+        # a blocking Condition.wait while OTHER sanitized locks stay
+        # held is the deadlock the blocking guard exists to catch
+        rest = [h for h in stack if h.lock is not self]
+        if rest:
+            self.sanitizer.check_wait(
+                f"Condition.wait on {self.name!r}", caller_site(2))
+        mine = [h for h in stack if h.lock is self]
+        for h in mine:
+            stack.remove(h)
+        return self._inner._release_save(), mine
+
+    def _acquire_restore(self, state) -> None:
+        inner_state, mine = state
+        self._inner._acquire_restore(inner_state)
+        _held_stack().extend(mine)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+def ordered_condition(name: str, sanitizer: LockSanitizer, *,
+                      key: int = 0,
+                      wait_observer=None) -> threading.Condition:
+    """A ``threading.Condition`` over an :class:`OrderedLock` — the
+    sanitized replacement for ``threading.Condition()`` in
+    ``RequestHandle`` under ``debug_checks``."""
+    return threading.Condition(OrderedLock(
+        name, sanitizer=sanitizer, key=key, wait_observer=wait_observer))
+
+
+# ------------------------------------------------------------------ driver
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graft-race",
+        description="lock-discipline static analysis for the threaded "
+                    "serving fleet (rules GL009..GL011; suppress with "
+                    "'# graft: noqa(GLxxx)')")
+    ap.add_argument("paths", nargs="*", default=["deepspeed_tpu"],
+                    help="files/dirs to analyze as one cross-file unit "
+                         "(default: deepspeed_tpu)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for code in sorted(RULES):
+            print(f"{code}  {RULES[code]}")
+        return 0
+    paths = args.paths or ["deepspeed_tpu"]
+    findings, nfiles = race_paths(paths)
+    if nfiles == 0:
+        # a typo'd path must fail loudly, not turn the CI gate into a no-op
+        print(f"graft-race: no Python files under {paths}",
+              file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f.render())
+    print(f"graft-race: {nfiles} files, {len(findings)} finding(s)",
+          file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

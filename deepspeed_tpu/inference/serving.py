@@ -206,6 +206,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..analysis.concurrency import (LockSanitizer, caller_site,
+                                    ordered_condition)
 from ..analysis.invariants import audit_serving_engine
 from ..analysis.sentry import (RecompileSentry, backend_compiles,
                                install_compile_listener)
@@ -352,10 +354,21 @@ class RequestHandle:
     identical sequence), and fresh tokens continue on the same handle —
     including across a replica drain handoff.  All state transitions run
     under one condition variable, so the handle is safe to read from a
-    different thread than the scheduler's."""
+    different thread than the scheduler's.
+
+    Under ``debug_checks`` the engine passes its lock sanitizer
+    (``analysis/concurrency.py``) and the condition becomes an
+    instrumented ``ordered_condition``: the handle participates in the
+    declared fleet lock order (fleet -> replica -> handle), and the
+    blocking accessors (``result`` / blocking ``next_token``) raise
+    :class:`~deepspeed_tpu.analysis.concurrency.BlockingUnderLockError`
+    when entered while the calling thread holds any sanitized lock —
+    waiting on a handle under the fleet or a replica lock is a deadlock
+    (the scheduler that would finish the request can never run)."""
 
     def __init__(self, request: Request, *, priority: int = 0,
-                 slo_class: Optional[str] = None, canceller=None):
+                 slo_class: Optional[str] = None, canceller=None,
+                 lock_sanitizer: Optional[LockSanitizer] = None):
         self.request = request
         self.uid = request.uid
         self.priority = int(priority)
@@ -363,7 +376,9 @@ class RequestHandle:
         self.status = "queued"        # -> "active" -> "finished"|"cancelled"
         self._tokens: List[int] = []
         self._result: Optional[np.ndarray] = None
-        self._cond = threading.Condition()
+        self._sanitizer = lock_sanitizer
+        self._cond = ordered_condition("serving.handle", lock_sanitizer) \
+            if lock_sanitizer is not None else threading.Condition()
         self._cursor = 0
         self._canceller = canceller
 
@@ -390,6 +405,15 @@ class RequestHandle:
             self.status = "cancelled"
             self._cond.notify_all()
 
+    def set_canceller(self, canceller) -> None:
+        """Rebind the cancel route (router submit / drain handoff) —
+        under the handle condition, because a worker may already be
+        streaming transitions into this handle when the router rebinds
+        it (a bare attribute store is exactly the unguarded-shared-state
+        hazard graft-race GL010 flags)."""
+        with self._cond:
+            self._canceller = canceller
+
     # ---- caller side
     @property
     def done(self) -> bool:
@@ -410,6 +434,10 @@ class RequestHandle:
         the request is finished/cancelled (or ``timeout`` seconds pass
         with nothing new — pass ``timeout=0`` when the caller itself
         drives ``step()``, blocking would deadlock there)."""
+        if self._sanitizer is not None and timeout != 0:
+            self._sanitizer.check_wait(
+                f"RequestHandle.next_token(uid={self.uid!r})",
+                site=caller_site(2))
         with self._cond:
             self._cond.wait_for(
                 lambda: self._cursor < len(self._tokens) or self.done,
@@ -424,6 +452,10 @@ class RequestHandle:
         """Block until completion; the padded ``[prompt + completion]``
         array (``serve`` semantics), or ``None`` if cancelled.  Raises
         ``TimeoutError`` if ``timeout`` expires first."""
+        if self._sanitizer is not None and timeout != 0:
+            self._sanitizer.check_wait(
+                f"RequestHandle.result(uid={self.uid!r})",
+                site=caller_site(2))
         with self._cond:
             if not self._cond.wait_for(lambda: self.done, timeout):
                 raise TimeoutError(
@@ -831,6 +863,14 @@ class ServingEngine:
         self.sentry = RecompileSentry(name="serving",
                                       strict=self.debug_checks,
                                       total_budget=self.compile_budget)
+        # lock sanitizer for the handle Conditions this engine mints
+        # (analysis/concurrency.py): a router embedding this replica
+        # overrides it with the fleet-shared one so replica-lock ->
+        # handle-cond acquisition edges are order-checked; None when
+        # debug_checks is off (handles fall back to plain Conditions —
+        # zero overhead, same contract as the sentry)
+        self._lock_sanitizer = LockSanitizer() if self.debug_checks \
+            else None
         if self.debug_checks:
             # process-wide jax.monitoring compile counter (idempotent):
             # corroborates the sentry by also seeing programs built OUTSIDE
@@ -1945,7 +1985,8 @@ class ServingEngine:
         if priority == 0 and slo_class is not None:
             priority = SLO_PRIORITY.get(str(slo_class), 0)
         handle = RequestHandle(request, priority=priority,
-                               slo_class=slo_class, canceller=self.cancel)
+                               slo_class=slo_class, canceller=self.cancel,
+                               lock_sanitizer=self._lock_sanitizer)
         self._pending.push(_PendingItem(
             req=request, prior=[], priority=priority, slo_class=slo_class,
             eos=eos_token_id, handle=handle))
@@ -1956,18 +1997,24 @@ class ServingEngine:
                               slo=str(slo_class) if slo_class else "")
         return handle
 
-    def _submit_item(self, item: _PendingItem) -> None:
+    def _submit_item(self, item: _PendingItem,
+                     canceller=None) -> None:
         """Router handoff entry: enqueue a fully-formed pending item (an
         in-flight request drained off another replica), keeping its
         handle, prior tokens, priority, and eos — token streaming
-        continues on the same handle."""
+        continues on the same handle.  ``canceller`` is the cancel
+        route to rebind (the router passes its own ``cancel`` so the
+        handle never — even transiently — routes around the fleet
+        locks straight into this engine); defaults to this engine's."""
         self._validate_request(item.req)
         if item.req.uid in self._live_uids:
             raise ValueError(
                 f"request uid {item.req.uid!r} is already in flight")
         self._session_boundary_reset()
         if item.handle is not None:
-            item.handle._canceller = self.cancel
+            # under the handle condition (set_canceller) — the stream
+            # may still be read concurrently during a drain handoff
+            item.handle.set_canceller(canceller or self.cancel)
         self._pending.push(item)
         self._live_uids.add(item.req.uid)
         self._g_queue_depth.set(len(self._pending))

@@ -79,7 +79,14 @@ kv-pull flow arrows, ``slo_report()`` merges the per-replica SLO
 trackers, and ``start_metrics_server(port=)`` serves ``/metrics`` /
 ``/stats`` / ``/trace`` live (``telemetry/server.py``).
 ``debug_checks=True`` adds the router-state audit
-(``analysis/invariants.audit_router``) after every ``step``.
+(``analysis/invariants.audit_router``) after every ``step`` AND swaps
+every fleet/replica lock for an instrumented
+:class:`~deepspeed_tpu.analysis.concurrency.OrderedLock`: lock-order
+violations raise at acquire time, contended-wait time lands in
+``serving_lock_wait_seconds{lock=}``, order checks tick
+``serving_lock_order_checks_total``, and ``stats()`` reports
+``lock_order_checks`` / ``lock_violations`` (docs/static_analysis.md
+"graft-race").
 """
 
 from __future__ import annotations
@@ -91,6 +98,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..analysis.concurrency import LockSanitizer, OrderedLock
 from ..analysis.invariants import audit_router
 from ..inference.paged import chain_keys
 from ..inference.serving import Request, RequestHandle, ServingEngine
@@ -154,14 +162,6 @@ class ReplicaRouter:
         self.kv_pull = bool(kv_pull)
         self.threaded = bool(threaded)
         self.debug_checks = bool(debug_checks)
-        self._locks = [threading.RLock() for _ in replicas]
-        #: serializes fleet-level decisions (routing, hints, the
-        #: handle->replica map, drain/readmit) against each other —
-        #: without it a submit could pick a replica that drains between
-        #: the routing decision and the enqueue, stranding the request
-        #: on an engine nothing steps.  Lock order: fleet -> replica
-        #: (workers take only replica locks, so no cycle).
-        self._fleet_lock = threading.RLock()
         self._drained: set = set()
         self._worker_errors: Dict[int, BaseException] = {}
         self._handles: Dict[Any, Tuple[RequestHandle, int]] = {}
@@ -210,6 +210,60 @@ class ReplicaRouter:
             m.gauge("serving_replica_queue_depth",
                     "requests waiting for a slot on the replica",
                     replica=str(i)) for i in range(len(replicas))]
+
+        # ----- locking: one fleet lock serializing fleet-level decisions
+        # (routing, hints, the handle->replica map, drain/readmit)
+        # against each other — without it a submit could pick a replica
+        # that drains between the routing decision and the enqueue,
+        # stranding the request on an engine nothing steps — plus one
+        # lock per replica so engines stay effectively single-threaded.
+        # The declared partial order (checked statically by bin/graft-
+        # race, dynamically by the sanitizer below) is fleet -> replica
+        # [ascending index] -> handle condition; workers take only their
+        # replica lock, so no cycle.  Under debug_checks every lock is
+        # an instrumented OrderedLock: acquisition-order violations
+        # raise LockOrderError at acquire time, contended-wait time
+        # lands in serving_lock_wait_seconds{lock=}, and each cross-lock
+        # order check ticks serving_lock_order_checks_total — the
+        # concurrency analogue of the recompile sentry, zero overhead
+        # off (analysis/concurrency.py; docs/static_analysis.md).
+        if self.debug_checks:
+            self._sanitizer = LockSanitizer()
+            self._c_lock_checks = m.counter(
+                "serving_lock_order_checks_total",
+                "cross-lock acquisition-order checks run by the lock "
+                "sanitizer")
+            self._sanitizer.on_check = self._c_lock_checks.inc
+            h_fleet = m.histogram(
+                "serving_lock_wait_seconds",
+                help="time spent waiting to acquire an instrumented "
+                     "serving lock", lock="fleet")
+            h_rep = m.histogram(
+                "serving_lock_wait_seconds",
+                help="time spent waiting to acquire an instrumented "
+                     "serving lock", lock="replica")
+            self._fleet_lock = OrderedLock(
+                "serving.fleet", sanitizer=self._sanitizer,
+                wait_observer=h_fleet.observe)
+            self._locks = [
+                OrderedLock("serving.replica", key=i,
+                            sanitizer=self._sanitizer,
+                            wait_observer=h_rep.observe)
+                for i in range(len(replicas))]
+            for rep in replicas:
+                # handle Conditions the replicas mint from here on share
+                # the fleet sanitizer, so replica-lock -> handle-cond
+                # edges are checked too (jax-free fakes tolerate the
+                # attribute fine)
+                try:
+                    rep._lock_sanitizer = self._sanitizer
+                except AttributeError:
+                    pass
+        else:
+            self._sanitizer = None
+            self._fleet_lock = threading.RLock()
+            self._locks = [threading.RLock() for _ in replicas]
+
         self.timeline = TraceTimeline(capacity=trace_capacity)
         #: fleet-wide Chrome flow-id allocator: route->admit and kv-pull
         #: src->dst flow events must carry unique ids across EVERY ring
@@ -403,7 +457,9 @@ class ReplicaRouter:
                 handle = self.replicas[rid].submit(
                     request, priority=priority, slo_class=slo_class,
                     eos_token_id=eos_token_id)
-            handle._canceller = self.cancel
+            # under the handle's own condition — a bare attribute store
+            # would race a worker already streaming into the handle
+            handle.set_canceller(self.cancel)
             self._prune_handles()
             self._handles[request.uid] = (handle, rid)
         self.timeline.instant("route", uid=str(request.uid),
@@ -443,7 +499,10 @@ class ReplicaRouter:
                     self._busy_s[rid] += time.perf_counter() - t0
             more = m or more
             self._refresh_gauges(rid)
-        self._prune_handles()
+        # the handle map is fleet state: pruning it unlocked would race
+        # a concurrent submit's insert (graft-race GL010)
+        with self._fleet_lock:
+            self._prune_handles()
         if self.debug_checks:
             audit_router(self)
         return more
@@ -577,7 +636,13 @@ class ReplicaRouter:
                 with self._locks[new_rid]:
                     self._start_route_flow(new_rid, item.req.uid,
                                            resumed=True)
-                    self.replicas[new_rid]._submit_item(item)
+                    # the handle keeps routing cancels through the
+                    # router (fleet + replica locks) — handed straight
+                    # to _submit_item so there is no window where a
+                    # cancel could land on the bare engine a worker is
+                    # stepping
+                    self.replicas[new_rid]._submit_item(
+                        item, canceller=self.cancel)
                 if item.handle is not None:
                     self._handles[item.req.uid] = (item.handle, new_rid)
                 self.timeline.instant("route", uid=str(item.req.uid),
@@ -741,6 +806,10 @@ class ReplicaRouter:
             "kv_pull_bytes": int(self._c_pull_bytes.value),
             "drains": int(self._c_drains.value),
             "readmits": int(self._c_readmits.value),
+            "lock_order_checks": int(self._sanitizer.checks)
+            if self._sanitizer is not None else 0,
+            "lock_violations": int(self._sanitizer.violations)
+            if self._sanitizer is not None else 0,
             "generated_tokens": gen_tokens,
             "prompt_tokens": prompt_tokens,
             "prefix_cache_hit_rate": (hit_tokens / prompt_tokens

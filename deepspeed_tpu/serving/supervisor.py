@@ -28,9 +28,11 @@ for standalone use.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Dict, List, Mapping, Optional, Union
 
+from ..analysis.concurrency import OrderedLock
 from ..utils.logging import logger
 from .router import ReplicaRouter
 
@@ -52,6 +54,17 @@ class RouterSupervisor:
         self._down_ticks: Dict[int, int] = {}
         self._drained_by_us: set = set()
         self.ticks = 0
+        # serializes tick() against itself (run() on a thread while an
+        # operator/test drives tick() directly) — the grace-tick
+        # counters and the drained-by-us claim set are check-then-act
+        # state.  First in the declared fleet lock order: a tick holds
+        # it across router.drain()/readmit() (supervisor -> fleet ->
+        # replica); instrumented under the router's lock sanitizer when
+        # debug_checks is on (analysis/concurrency.py)
+        san = getattr(router, "_sanitizer", None)
+        self._sup_lock = OrderedLock("serving.supervisor",
+                                     sanitizer=san) \
+            if san is not None else threading.RLock()
         # the supervisor is the natural owner of the fleet's live
         # exposition in standalone deployments (launcher --serve): the
         # same process that watches membership serves /metrics, /stats,
@@ -85,7 +98,13 @@ class RouterSupervisor:
 
     def tick(self) -> Dict[str, List[int]]:
         """One supervision round; returns ``{"drained": [...],
-        "readmitted": [...]}`` for this tick."""
+        "readmitted": [...]}`` for this tick.  Serialized under the
+        supervisor lock (``run()`` on a thread and a directly-driven
+        ``tick()`` must not interleave their grace-tick accounting)."""
+        with self._sup_lock:
+            return self._tick_locked()
+
+    def _tick_locked(self) -> Dict[str, List[int]]:
         self.ticks += 1
         live = self._probe()
         actions: Dict[str, List[int]] = {"drained": [], "readmitted": []}

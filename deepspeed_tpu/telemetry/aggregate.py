@@ -86,7 +86,11 @@ def federate(sources: Mapping[str, MetricsRegistry],
     out = MetricsRegistry()
     for src_name, reg in sources.items():
         for fam in reg.families():
-            for key, cell in fam.series.items():
+            # list(): a federation pass may run on a scrape thread
+            # while the source engine registers a new labeled series
+            # (families() already snapshots under the registry lock;
+            # the per-family series dict needs the same courtesy)
+            for key, cell in list(fam.series.items()):
                 labels = dict(key)
                 # the router's per-replica gauges already say which
                 # replica they describe — re-labeling them with the

@@ -37,6 +37,7 @@ telemetry belong *around* compiled calls, never inside them).
 from __future__ import annotations
 
 import json
+import threading
 from bisect import bisect_left
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -215,11 +216,30 @@ class MetricsRegistry:
             raise ValueError(f"invalid metric namespace {namespace!r}")
         self.namespace = namespace
         self._families: Dict[str, _Family] = {}
+        # registry lock: family/series CREATION and the reader walks
+        # (snapshot / prometheus_text / to_events / families) — a live
+        # /metrics scrape must not iterate the family dict while a
+        # worker thread registers a new labeled series.  Cell UPDATES
+        # (inc/set/observe) stay lock-free by contract: each is a
+        # GIL-atomic store on the hot path, and readers tolerate a
+        # torn-by-one-observation histogram (monotone, Prometheus-
+        # style).  Last in the declared fleet lock order (supervisor ->
+        # fleet -> replica -> handle -> registry): registry regions are
+        # leaves that never take another lock (docs/static_analysis.md
+        # "graft-race").
+        self._reg_lock = threading.Lock()
 
     # ------------------------------------------------------------- creation
     def _get(self, name: str, kind: str, help: str,
              monitor_name: Optional[str], labels: Dict[str, str],
              **ctor_kwargs):
+        with self._reg_lock:
+            return self._get_locked(name, kind, help, monitor_name,
+                                    labels, **ctor_kwargs)
+
+    def _get_locked(self, name: str, kind: str, help: str,
+                    monitor_name: Optional[str], labels: Dict[str, str],
+                    **ctor_kwargs):
         if self.namespace and not name.startswith(self.namespace + "_"):
             name = f"{self.namespace}_{name}"
         if any(ch not in _NAME_OK for ch in name) or name[:1].isdigit():
@@ -266,15 +286,25 @@ class MetricsRegistry:
 
     # -------------------------------------------------------------- reading
     def families(self) -> Iterable[_Family]:
-        return self._families.values()
+        with self._reg_lock:
+            return list(self._families.values())
+
+    def _walk(self) -> List[Tuple["_Family", List[Tuple[Any, Any]]]]:
+        """Structure snapshot for the reader walks: families and their
+        series lists copied under the registry lock (a scrape must not
+        iterate dicts a worker thread is inserting into); cell reads
+        then happen lock-free outside it."""
+        with self._reg_lock:
+            return [(fam, list(fam.series.items()))
+                    for fam in self._families.values()]
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON-able view of every series (the ``--emit-metrics`` bench
         artifact and the engine debug surface)."""
         out: Dict[str, Any] = {}
-        for fam in self._families.values():
+        for fam, fam_series in self._walk():
             series = []
-            for key, cell in fam.series.items():
+            for key, cell in fam_series:
                 entry: Dict[str, Any] = {"labels": dict(key)}
                 if fam.kind == "histogram":
                     entry.update({
@@ -302,11 +332,11 @@ class MetricsRegistry:
         ``_bucket``/``_sum``/``_count`` expansion with cumulative
         ``le`` edges."""
         lines: List[str] = []
-        for fam in self._families.values():
+        for fam, fam_series in self._walk():
             if fam.help:
                 lines.append(f"# HELP {fam.name} {fam.help}")
             lines.append(f"# TYPE {fam.name} {fam.kind}")
-            for key, cell in fam.series.items():
+            for key, cell in fam_series:
                 if fam.kind == "histogram":
                     for edge, cum in cell.bucket_counts():
                         le = "+Inf" if edge == float("inf") else repr(edge)
@@ -328,9 +358,9 @@ class MetricsRegistry:
         suffix their label values onto the name (CSV filenames must stay
         1:1 with series)."""
         events: List[Tuple[str, float, int]] = []
-        for fam in self._families.values():
+        for fam, fam_series in self._walk():
             base = fam.monitor_name or fam.name
-            for key, cell in fam.series.items():
+            for key, cell in fam_series:
                 name = base + "".join(f"/{v}" for _, v in key)
                 if fam.kind == "histogram":
                     if not cell.count:

@@ -65,41 +65,57 @@ class MetricsServer:
         self._requested_port = int(port)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # guards the start/stop check-then-act on _httpd/_thread — a
+        # supervisor closing the server while an operator restarts it
+        # must not double-bind or leak the serve_forever thread
+        # (graft-race GL010: server state is mutated from more than one
+        # thread, so every mutation runs under the same lock)
+        self._state_lock = threading.Lock()
 
     # ---------------------------------------------------------------- control
     def start(self) -> "MetricsServer":
         """Bind and serve on a daemon thread; idempotent."""
-        if self._httpd is not None:
-            return self
-        server = self
+        with self._state_lock:
+            if self._httpd is not None:
+                return self
+            server = self
 
-        class _Handler(BaseHTTPRequestHandler):
-            def log_message(self, fmt, *args):   # noqa: N802 — stdlib API
-                pass                             # scrapes are not log news
+            class _Handler(BaseHTTPRequestHandler):
+                def log_message(self, fmt, *args):  # noqa: N802 stdlib API
+                    pass                            # scrapes aren't log news
 
-            def do_GET(self):                    # noqa: N802 — stdlib API
-                server._handle(self)
+                def do_GET(self):                   # noqa: N802 stdlib API
+                    server._handle(self)
 
-        httpd = ThreadingHTTPServer((self.host, self._requested_port),
-                                    _Handler)
-        httpd.daemon_threads = True
-        self._httpd = httpd
-        self._thread = threading.Thread(
-            target=httpd.serve_forever, name="telemetry-metrics-server",
-            daemon=True)
-        self._thread.start()
+            httpd = ThreadingHTTPServer((self.host, self._requested_port),
+                                        _Handler)
+            httpd.daemon_threads = True
+            self._httpd = httpd
+            self._thread = threading.Thread(
+                target=httpd.serve_forever,
+                name="telemetry-metrics-server", daemon=True)
+            self._thread.start()
         logger.info(f"telemetry: metrics server listening on {self.url}")
         return self
 
     def stop(self) -> None:
-        if self._httpd is None:
-            return
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-        self._httpd = None
-        self._thread = None
+        with self._state_lock:
+            httpd, thread = self._httpd, self._thread
+            self._httpd = None
+            self._thread = None
+            if httpd is None:
+                return
+            # the listening socket must CLOSE before the lock releases,
+            # or a concurrent start() on a fixed port would see
+            # _httpd=None and bind over a still-open listener
+            # (EADDRINUSE).  shutdown() only waits for the accept loop
+            # to notice the flag (handler threads are daemons), so it
+            # is bounded; the unbounded part — joining the loop
+            # thread — stays outside the lock (graft-race GL011).
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=5)
 
     @property
     def port(self) -> Optional[int]:

@@ -36,6 +36,7 @@ iterations).  Failures to start the profiler degrade to a logged warning
 from __future__ import annotations
 
 import json
+import threading
 import time
 from collections import deque
 from contextlib import contextmanager
@@ -75,6 +76,12 @@ class TraceTimeline:
         self.dropped = 0
         self._thread_names: Dict[int, str] = {SCHEDULER_TID: "scheduler"}
         self._next_tid = 1
+        # lane allocation is check-then-act (look up name, else mint a
+        # tid) and runs off the hot path, so it takes a lock; the emit
+        # path stays lock-free on purpose — deque appends are GIL-atomic
+        # and the ring tolerates interleaved emitters (the router
+        # timeline is written by worker threads AND the caller thread)
+        self._names_lock = threading.Lock()
 
     # ------------------------------------------------------------------ time
     def now_us(self) -> float:
@@ -95,13 +102,14 @@ class TraceTimeline:
         per SLOT at construction — request spans land on the slot that
         finished them), never per-request values: every lane is a
         name-table entry and a Perfetto row forever."""
-        for tid, n in self._thread_names.items():
-            if n == name:
-                return tid
-        tid = self._next_tid
-        self._next_tid += 1
-        self._thread_names[tid] = name
-        return tid
+        with self._names_lock:
+            for tid, n in self._thread_names.items():
+                if n == name:
+                    return tid
+            tid = self._next_tid
+            self._next_tid += 1
+            self._thread_names[tid] = name
+            return tid
 
     # ---------------------------------------------------------------- emits
     def _push(self, ev: Dict[str, Any]) -> None:
@@ -203,7 +211,9 @@ class TraceTimeline:
             "pid": self.pid, "tid": SCHEDULER_TID,
             "args": {"name": process_name},
         }]
-        for tid, name in sorted(self._thread_names.items()):
+        with self._names_lock:
+            lanes = sorted(self._thread_names.items())
+        for tid, name in lanes:
             meta.append({"name": "thread_name", "ph": "M", "ts": 0.0,
                          "pid": self.pid, "tid": tid,
                          "args": {"name": name}})

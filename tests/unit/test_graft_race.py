@@ -1,0 +1,435 @@
+"""graft-race static half (``analysis/concurrency.py``): per-rule fire /
+near-miss fixtures, pragma suppression, cross-file edge merging, and the
+zero-findings gate over the real package (the same check CI's lint job
+runs via ``bin/graft-race``).
+
+The dynamic sanitizer's fault-injection coverage lives in
+``tests/unit/test_lock_sanitizer.py``; the threaded end-to-end smoke in
+``tests/unit/test_threaded_serving.py``.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+from deepspeed_tpu.analysis import concurrency
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def _codes(src, path="fixture.py"):
+    return [f.code for f in concurrency.check_source(src, path)]
+
+
+def _findings(src, path="fixture.py"):
+    return concurrency.check_source(src, path)
+
+
+# ------------------------------------------------------------------ GL009
+def test_gl009_opposite_order_pair_fires_both_sites():
+    src = """
+import threading
+
+class Fleet:
+    def __init__(self):
+        self._a = threading.RLock()
+        self._b = threading.RLock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def two(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+    fs = [f for f in _findings(src) if f.code == "GL009"]
+    assert len(fs) == 2, fs
+    # each finding names the opposite site
+    lines = sorted(f.line for f in fs)
+    msgs = " ".join(f.message for f in fs)
+    assert "opposite order" in msgs
+    assert f"fixture.py:{lines[0]}" in msgs or \
+        f"fixture.py:{lines[1]}" in msgs
+
+
+def test_gl009_declared_order_inversion_fires():
+    src = """
+import threading
+
+class Router:
+    def __init__(self):
+        self._fleet_lock = threading.RLock()
+        self._locks = [threading.RLock() for _ in range(2)]
+
+    def bad(self, rid):
+        with self._locks[rid]:
+            with self._fleet_lock:      # replica -> fleet: inverted
+                pass
+"""
+    codes = _codes(src)
+    assert "GL009" in codes, codes
+    msg = next(f.message for f in _findings(src) if f.code == "GL009")
+    assert "declared lock order" in msg
+
+
+def test_gl009_collection_nesting_fires_and_sorted_loop_near_miss():
+    fires = """
+import threading
+
+class Router:
+    def __init__(self):
+        self._locks = [threading.RLock() for _ in range(4)]
+
+    def pull(self, src, dst):
+        with self._locks[src], self._locks[dst]:    # unordered pair
+            pass
+"""
+    assert "GL009" in _codes(fires)
+    near_miss = """
+import threading
+from contextlib import ExitStack
+
+class Router:
+    def __init__(self):
+        self._locks = [threading.RLock() for _ in range(4)]
+
+    def pull(self, src, dst):
+        lo, hi = sorted((src, dst))
+        with self._locks[lo], self._locks[hi]:      # index-sorted
+            pass
+
+    def all_locks(self):
+        stack = ExitStack()
+        for lock in self._locks:                    # iteration order
+            stack.enter_context(lock)
+        return stack
+"""
+    assert "GL009" not in _codes(near_miss)
+
+
+def test_gl009_literal_ascending_indices_are_clean():
+    """Constant-index nesting in ascending order is as deterministic as
+    the sorted idiom; descending literals still fire."""
+    ok = """
+import threading
+
+class Router:
+    def __init__(self):
+        self._locks = [threading.RLock() for _ in range(2)]
+
+    def fast_path(self):
+        with self._locks[0], self._locks[1]:
+            pass
+"""
+    assert "GL009" not in _codes(ok)
+    descending = ok.replace("self._locks[0], self._locks[1]",
+                            "self._locks[1], self._locks[0]")
+    assert "GL009" in _codes(descending)
+
+
+def test_gl009_consistent_order_is_clean():
+    src = """
+import threading
+
+class Router:
+    def __init__(self):
+        self._fleet_lock = threading.RLock()
+        self._locks = [threading.RLock() for _ in range(2)]
+
+    def submit(self, rid):
+        with self._fleet_lock:
+            with self._locks[rid]:
+                pass
+
+    def drain(self, rid):
+        with self._fleet_lock:
+            with self._locks[rid]:
+                pass
+"""
+    assert _codes(src) == []
+
+
+# ------------------------------------------------------------------ GL010
+_GL010_FIRE = """
+import threading
+
+class Handle:
+    def __init__(self):
+        self._lk = threading.Lock()
+        self._tokens = []
+
+    def on_tokens(self, toks):
+        with self._lk:
+            self._tokens.extend(toks)       # guarded mutation
+
+    def reset(self):
+        self._tokens = []                   # unguarded mutation
+"""
+
+
+def test_gl010_mixed_guarding_fires_and_names_guarded_site():
+    fs = [f for f in _findings(_GL010_FIRE) if f.code == "GL010"]
+    assert len(fs) == 1, fs
+    assert "_tokens" in fs[0].message
+    assert "fixture.py:11" in fs[0].message    # the guarded extend site
+
+
+def test_gl010_guarded_by_inference_through_private_callee():
+    """A private helper only ever called under the lock counts as
+    guarded — the call-graph half of the inference."""
+    src = """
+import threading
+
+class Router:
+    def __init__(self):
+        self._lk = threading.Lock()
+        self._hints = {}
+
+    def submit(self, k, v):
+        with self._lk:
+            self._note(k, v)
+
+    def drain(self, k, v):
+        with self._lk:
+            self._note(k, v)
+
+    def _note(self, k, v):
+        self._hints[k] = v                  # guarded via every caller
+"""
+    assert _codes(src) == []
+
+
+def test_gl010_skips_non_concurrent_classes_and_init():
+    src = """
+class Plain:                        # no locks, no threads: single-owner
+    def __init__(self):
+        self.n = 0
+
+    def bump(self):
+        self.n += 1
+"""
+    assert _codes(src) == []
+
+
+def test_gl010_external_store_to_guarded_field_fires():
+    src = _GL010_FIRE + """
+
+class Router:
+    def __init__(self):
+        self._fleet_lock = threading.Lock()
+
+    def rebind(self, handle):
+        handle._tokens = []                 # bypasses Handle's lock
+"""
+    fs = [f for f in _findings(src) if f.code == "GL010"]
+    assert any("foreign" in f.message and "Handle" in f.message
+               for f in fs), fs
+
+
+# ------------------------------------------------------------------ GL011
+def test_gl011_blocking_calls_under_lock_fire():
+    src = """
+import threading, time, jax
+
+class Engine:
+    def __init__(self):
+        self._lk = threading.Lock()
+
+    def bad(self, x, worker):
+        with self._lk:
+            v = jax.device_get(x)
+            worker.join()
+            time.sleep(0.1)
+        return v
+"""
+    codes = _codes(src)
+    assert codes.count("GL011") == 3, codes
+
+
+def test_gl011_near_misses_are_clean():
+    src = """
+import threading, time, jax
+
+class Engine:
+    def __init__(self):
+        self._lk = threading.Lock()
+        self._cond = threading.Condition()
+
+    def bounded(self, worker):
+        with self._lk:
+            worker.join(timeout=5)          # bounded: fine
+
+    def own_cond(self):
+        with self._cond:
+            self._cond.wait_for(lambda: True, 1.0)   # releases it
+
+    def unlocked(self, x):
+        return jax.device_get(x)            # no lock held
+
+    def demote_batch(self, x):
+        with self._lk:
+            return jax.device_get(x)        # sanctioned transfer helper
+"""
+    assert "GL011" not in _codes(src)
+
+
+def test_gl011_interprocedural_entry_held():
+    """A blocking call in a private helper reached only from inside a
+    lock region is flagged through the call graph."""
+    src = """
+import threading, jax
+
+class Engine:
+    def __init__(self):
+        self._lk = threading.Lock()
+
+    def step(self, x):
+        with self._lk:
+            return self._pull(x)
+
+    def _pull(self, x):
+        return jax.device_get(x)
+"""
+    assert "GL011" in _codes(src)
+
+
+def test_gl011_assignment_form_acquire_persists():
+    """'ok = lock.acquire(...)' enters the held-set for the remaining
+    block exactly like the bare-expression form."""
+    src = """
+import threading, jax
+
+class A:
+    def __init__(self):
+        self._lk = threading.Lock()
+
+    def bad(self, x):
+        ok = self._lk.acquire(timeout=5)
+        v = jax.device_get(x)
+        self._lk.release()
+        return v
+
+    def guarded_then_not(self, v):
+        got = self._lk.acquire()
+        self._n = v
+        self._lk.release()
+
+    def unguarded(self, v):
+        self._n = v
+"""
+    codes = _codes(src)
+    assert "GL011" in codes, codes
+    assert "GL010" in codes, codes
+
+
+def test_gl011_unbounded_foreign_wait_fires():
+    src = """
+import threading
+
+class A:
+    def __init__(self):
+        self._lk = threading.Lock()
+
+    def bad(self, event):
+        with self._lk:
+            event.wait()                    # unbounded, foreign object
+"""
+    assert "GL011" in _codes(src)
+
+
+# ------------------------------------------------------- pragmas / driver
+def test_noqa_pragma_suppresses_named_rule_only():
+    src = """
+import threading, jax
+
+class Engine:
+    def __init__(self):
+        self._lk = threading.Lock()
+
+    def commit(self, x):
+        with self._lk:
+            return jax.device_get(x)  # graft: noqa(GL011) documented commit point
+"""
+    assert _codes(src) == []
+    wrong_code = src.replace("noqa(GL011)", "noqa(GL009)")
+    assert "GL011" in _codes(wrong_code)
+    bare = src.replace("noqa(GL011)", "noqa")
+    assert _codes(bare) == []
+
+
+def test_cross_file_inversion_detected():
+    """Opposite-order acquisitions of the DECLARED lock vocabulary merge
+    across files — the fleet order is one contract, not per-module."""
+    a = """
+import threading
+
+class A:
+    def __init__(self):
+        self._fleet_lock = threading.Lock()
+        self._cond = threading.Condition()
+
+    def fwd(self):
+        with self._fleet_lock:
+            with self._cond:
+                pass
+"""
+    b = """
+import threading
+
+class B:
+    def __init__(self):
+        self._fleet_lock = threading.Lock()
+        self._cond = threading.Condition()
+
+    def rev(self):
+        with self._cond:
+            with self._fleet_lock:
+                pass
+"""
+    findings = concurrency.analyze_sources([(a, "a.py"), (b, "b.py")])
+    gl9 = [f for f in findings if f.code == "GL009"]
+    assert any(f.path == "b.py" for f in gl9), findings
+
+
+def test_package_is_clean_and_cli_exit_codes(tmp_path):
+    """The real package gates clean (the CI check), a typo'd path exits
+    2, and a finding exits 1 — mirroring graft-lint's driver."""
+    findings, nfiles = concurrency.race_paths(
+        [str(REPO / "deepspeed_tpu")])
+    assert nfiles > 50
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+    cli = str(REPO / "bin" / "graft-race")
+    ok = subprocess.run([sys.executable, cli,
+                         str(REPO / "deepspeed_tpu")],
+                        capture_output=True, text=True)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+
+    missing = subprocess.run([sys.executable, cli,
+                              str(tmp_path / "nope")],
+                             capture_output=True, text=True)
+    assert missing.returncode == 2
+
+    # an explicit .py argument that cannot be read fails loudly too —
+    # a since-renamed file in a CI step must not pass forever
+    ghost = subprocess.run([sys.executable, cli,
+                            str(tmp_path / "renamed_away.py")],
+                           capture_output=True, text=True)
+    assert ghost.returncode == 1
+    assert "GL000" in ghost.stdout
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(_GL010_FIRE)
+    fires = subprocess.run([sys.executable, cli, str(bad)],
+                           capture_output=True, text=True)
+    assert fires.returncode == 1
+    assert "GL010" in fires.stdout
+
+    rules = subprocess.run([sys.executable, cli, "--list-rules"],
+                           capture_output=True, text=True)
+    assert rules.returncode == 0
+    for code in ("GL009", "GL010", "GL011"):
+        assert code in rules.stdout

@@ -1,0 +1,293 @@
+"""graft-race dynamic half (``analysis/concurrency.py``): fault
+injection for the runtime lock-order / blocking sanitizer.
+
+Covers the two acceptance scenarios — a deliberate two-thread
+lock-order inversion and a blocking-call-under-lock, each raising with
+BOTH acquisition sites named under ``debug_checks=True`` and passing
+untouched with ``debug_checks=False`` — plus the primitive-level
+contracts: declared-rank and ascending-key checks, Condition
+integration (``wait`` releases the held-set entry), re-entrancy, and
+the check/violation counters the router surfaces.
+
+Everything here is jax-free: the router scenarios run on the same fake
+replicas ``test_replica_router.py`` uses for routing units.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.analysis.concurrency import (
+    BlockingUnderLockError, LockOrderError, LockSanitizer, OrderedLock,
+    held_locks, ordered_condition)
+from deepspeed_tpu.inference.serving import Request, RequestHandle
+from deepspeed_tpu.serving import ReplicaRouter
+
+
+# ----------------------------------------------------------- fake replica
+class _FakeReplica:
+    """Minimal ServingEngine protocol for jax-free router construction
+    (mirrors test_replica_router.py's double)."""
+
+    block_size = 8
+    _host = None
+    _prefix = None
+
+    def __init__(self):
+        from deepspeed_tpu.telemetry import MetricsRegistry, TraceTimeline
+
+        self.metrics = MetricsRegistry()
+        self.timeline = TraceTimeline(capacity=0)
+        self._pending = []
+        self._active = {}
+        self._cancel_flags = set()
+        self._slo = None
+        self.prompt_tokens = 0
+        self.prefix_hit_tokens = 0
+        self.admitted = 0
+        self.compile_count = 0
+        self.compile_budget = 2
+        self._c_gen_tokens = type("C", (), {"value": 0.0})()
+
+        class _Alloc:
+            blocks_in_use = 0
+        self._alloc = _Alloc()
+
+    def affinity_probe(self, prompt):
+        return {"device_blocks": 0, "host_blocks": 0, "blocks_in_use": 0,
+                "queue_depth": 0, "active": 0}
+
+    def submit(self, request, **kw):
+        return RequestHandle(request)
+
+    def step(self):
+        return False
+
+
+def _mk_router(debug_checks):
+    return ReplicaRouter([_FakeReplica(), _FakeReplica()],
+                         kv_pull=False, debug_checks=debug_checks,
+                         trace_capacity=0)
+
+
+def _run_in_thread(fn):
+    err = {}
+
+    def runner():
+        try:
+            fn()
+        except BaseException as e:        # noqa: BLE001 — reraised below
+            err["e"] = e
+    t = threading.Thread(target=runner)
+    t.start()
+    t.join(timeout=30)
+    assert not t.is_alive(), "injected scenario thread hung"
+    return err.get("e")
+
+
+# ----------------------------------------- injected lock-order inversion
+def _inversion_scenario(router):
+    """Two threads acquiring (fleet -> replica0) then (replica0 ->
+    fleet), sequenced so no real deadlock can occur — the sanitizer must
+    still catch the POTENTIAL deadlock from the order graph."""
+    fleet, rep0 = router._fleet_lock, router._locks[0]
+
+    def forward():
+        with fleet:
+            with rep0:
+                pass
+
+    def inverted():
+        with rep0:
+            with fleet:               # replica -> fleet: inverted
+                pass
+
+    e1 = _run_in_thread(forward)
+    if e1 is not None:
+        raise e1
+    e2 = _run_in_thread(inverted)
+    if e2 is not None:
+        raise e2
+
+
+def test_injected_inversion_raises_with_both_sites_under_debug():
+    router = _mk_router(debug_checks=True)
+    with pytest.raises(LockOrderError) as ei:
+        _inversion_scenario(router)
+    msg = str(ei.value)
+    # both acquisition sites (this file) are named
+    assert msg.count(__file__) >= 2, msg
+    assert "serving.fleet" in msg and "serving.replica" in msg
+    assert router.stats()["lock_violations"] >= 1
+    assert router.stats()["lock_order_checks"] >= 1
+
+
+def test_injected_inversion_passes_with_debug_off():
+    router = _mk_router(debug_checks=False)
+    assert isinstance(router._fleet_lock, type(threading.RLock()))
+    _inversion_scenario(router)           # plain RLocks: no sanitizer
+    st = router.stats()
+    assert st["lock_order_checks"] == 0 and st["lock_violations"] == 0
+
+
+def test_two_thread_cycle_detected_across_threads():
+    """The order graph is cross-thread: thread 1 records a->b, thread 2
+    trips on b->a."""
+    san = LockSanitizer()
+    a = OrderedLock("test.a", sanitizer=san)
+    b = OrderedLock("test.b", sanitizer=san)
+
+    def t1():
+        with a:
+            with b:
+                pass
+    assert _run_in_thread(t1) is None
+
+    def t2():
+        with b:
+            with a:
+                pass
+    err = _run_in_thread(t2)
+    assert isinstance(err, LockOrderError)
+    assert "opposite order" in str(err)
+    assert san.violations == 1
+
+
+# -------------------------------------------- injected blocking-under-lock
+def _blocking_scenario(router):
+    """``handle.result()`` (a blocking wait) entered while the calling
+    thread holds the fleet lock — the scheduler that would finish the
+    request could never run: a guaranteed deadlock without the
+    timeout."""
+    rep = router.replicas[0]
+    handle = RequestHandle(Request(uid=7, prompt=np.array([1, 2, 3])),
+                           lock_sanitizer=getattr(rep, "_lock_sanitizer",
+                                                  None))
+    handle._on_finish(np.array([1, 2, 3, 4]))
+    with router._fleet_lock:
+        return handle.result(timeout=1.0)
+
+
+def test_injected_blocking_under_lock_raises_with_both_sites():
+    router = _mk_router(debug_checks=True)
+    # the router shares its sanitizer with every replica (handles the
+    # replicas mint from now on participate in the checks)
+    assert router.replicas[0]._lock_sanitizer is router._sanitizer
+    with pytest.raises(BlockingUnderLockError) as ei:
+        _blocking_scenario(router)
+    msg = str(ei.value)
+    assert "RequestHandle.result" in msg
+    assert "serving.fleet" in msg
+    assert msg.count(__file__) >= 2, msg   # wait site + acquire site
+    assert router.stats()["lock_violations"] >= 1
+
+
+def test_injected_blocking_passes_with_debug_off():
+    router = _mk_router(debug_checks=False)
+    out = _blocking_scenario(router)
+    np.testing.assert_array_equal(out, np.array([1, 2, 3, 4]))
+
+
+def test_condition_wait_under_foreign_lock_raises():
+    san = LockSanitizer()
+    cond = ordered_condition("serving.handle", san)
+    other = OrderedLock("serving.fleet", sanitizer=san)
+    with pytest.raises(BlockingUnderLockError):
+        with other:
+            with cond:
+                cond.wait(0.01)
+    assert held_locks() == []             # unwound cleanly
+
+
+# --------------------------------------------------- primitive contracts
+def test_declared_rank_and_key_order():
+    san = LockSanitizer()
+    fleet = OrderedLock("serving.fleet", sanitizer=san)
+    r0 = OrderedLock("serving.replica", key=0, sanitizer=san)
+    r1 = OrderedLock("serving.replica", key=1, sanitizer=san)
+    with fleet:
+        with r0:
+            with r1:                      # ascending keys: fine
+                pass
+    with pytest.raises(LockOrderError, match="ascending key"):
+        with r1:
+            with r0:
+                pass
+    with pytest.raises(LockOrderError, match="declared-order"):
+        with r0:
+            with fleet:
+                pass
+    assert held_locks() == []
+
+
+def test_reentrant_acquire_is_not_a_violation():
+    san = LockSanitizer()
+    lk = OrderedLock("serving.fleet", sanitizer=san)
+    with lk:
+        with lk:
+            assert len(held_locks()) == 2
+    assert held_locks() == []
+    assert san.violations == 0
+
+
+def test_condition_wait_notify_roundtrip_keeps_held_set_exact():
+    san = LockSanitizer()
+    cond = ordered_condition("serving.handle", san)
+    state = {"ready": False}
+
+    def setter():
+        with cond:
+            state["ready"] = True
+            cond.notify_all()
+
+    with cond:
+        threading.Thread(target=setter, daemon=True).start()
+        assert cond.wait_for(lambda: state["ready"], timeout=10)
+        assert len(held_locks()) == 1     # re-acquired after the wait
+    assert held_locks() == []
+
+
+def test_check_counter_callback_fires():
+    san = LockSanitizer()
+    ticks = []
+    san.on_check = lambda: ticks.append(1)
+    a = OrderedLock("serving.fleet", sanitizer=san)
+    b = OrderedLock("serving.replica", sanitizer=san)
+    with a:
+        with b:
+            pass
+    assert san.checks == 1 and ticks == [1]
+
+
+def test_wait_observer_records_contended_wait():
+    waits = []
+    san = LockSanitizer()
+    lk = OrderedLock("serving.fleet", sanitizer=san,
+                     wait_observer=waits.append)
+    hold = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            hold.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    assert hold.wait(5)
+    start_len = len(waits)
+
+    def contender():
+        with lk:
+            pass
+
+    t2 = threading.Thread(target=contender, daemon=True)
+    t2.start()
+    import time as _time
+    _time.sleep(0.05)
+    release.set()
+    t2.join(5)
+    t.join(5)
+    assert len(waits) >= start_len + 1
+    assert max(waits) >= 0.02             # the contender really waited

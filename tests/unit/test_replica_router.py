@@ -248,7 +248,9 @@ class _FakeReplica:
                                         handle=handle))
         return handle
 
-    def _submit_item(self, item):
+    def _submit_item(self, item, canceller=None):
+        if item.handle is not None and canceller is not None:
+            item.handle.set_canceller(canceller)
         self._pending.push(item)
 
     def step(self):

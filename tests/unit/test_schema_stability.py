@@ -70,10 +70,12 @@ CONFIG_KEYS = frozenset({
     "swap_batch", "topology", "trace_capacity",
 })
 
-#: ReplicaRouter.stats() — PR 11 keys + PR 12's "metrics_endpoint"
+#: ReplicaRouter.stats() — PR 11 keys + PR 12's "metrics_endpoint" +
+#: PR 14's lock-sanitizer counters (0 when debug_checks is off)
 ROUTER_STATS_KEYS = frozenset({
     "busy_s", "drained", "drains", "generated_tokens", "kv_pull",
-    "kv_pull_blocks", "kv_pull_bytes", "kv_pulls", "metrics_endpoint",
+    "kv_pull_blocks", "kv_pull_bytes", "kv_pulls", "lock_order_checks",
+    "lock_violations", "metrics_endpoint",
     "per_replica", "policy", "prefix_cache_hit_rate", "prompt_tokens",
     "readmits", "replicas", "routed_affinity", "routed_balance",
 })
@@ -133,6 +135,34 @@ def test_router_stats_keys_pinned(served):
     st = router.stats()
     assert set(st.keys()) == ROUTER_STATS_KEYS
     assert set(st["per_replica"][0].keys()) == PER_REPLICA_KEYS
+
+
+def test_lock_metric_schema_pinned(served):
+    """PR 14: the instrumented-lock telemetry surface — a debug_checks
+    router registers ``serving_lock_wait_seconds{lock=fleet|replica}``
+    and ``serving_lock_order_checks_total`` (GL008-compliant names),
+    and ``stats()`` carries integer ``lock_order_checks`` /
+    ``lock_violations``; with debug off the families are absent and the
+    stats keys read 0."""
+    srv, router = served
+    st = router.stats()
+    assert st["lock_order_checks"] == 0 and st["lock_violations"] == 0
+    snap = router.metrics.snapshot()
+    assert "serving_lock_wait_seconds" not in snap      # off: no family
+
+    dbg = ReplicaRouter([ServingEngine(
+        srv.engine, slots=2, max_seq_len=64, block_size=8,
+        prefill_chunk=16, debug_checks=True)], debug_checks=True)
+    snap = dbg.metrics.snapshot()
+    fam = snap["serving_lock_wait_seconds"]
+    assert fam["type"] == "histogram"
+    assert sorted(s["labels"]["lock"] for s in fam["series"]) == \
+        ["fleet", "replica"]
+    assert snap["serving_lock_order_checks_total"]["type"] == "counter"
+    st = dbg.stats()
+    assert isinstance(st["lock_order_checks"], int)
+    assert isinstance(st["lock_violations"], int)
+    assert set(st.keys()) == ROUTER_STATS_KEYS
 
 
 def test_slo_report_schema_pinned(served):
