@@ -140,12 +140,30 @@ protocol), ``--emit-metrics`` writes the **federated fleet** Prometheus
 text + JSON snapshot — router + every replica registry with ``replica=``
 labels — not one engine's registry.
 
+``--chaos`` runs the BENCH_r14 **fault-tolerance** protocol (PR 15,
+docs/reliability.md): seeded ``FaultPlan``s (``serving/faults.py``)
+against the returning-sessions trace — (1) a crash lane killing one of
+two tiered replicas mid-decode, gated on token-EXACT parity vs the
+fault-free twin fleet, zero hung handles, and unchanged compile
+budgets, with recovery latency read off the ``replica_fail`` →
+``rehome`` timeline gap (add ``--quantize kv8`` for the kv8 crash
+twin: bit-exact vs unfaulted kv8, bounded match vs fp32 sequential);
+(2) a flaky-transport lane where a drain-forced migration must land
+its pulls through the transient-fault retry/backoff machinery; (3) a
+corruption lane flipping bits in EVERY host-tier arena entry after a
+full drain — 100% must be caught by checksum (promote exit gates +
+the final patrol scrub) and recovered via recompute, corrupt KV never
+served; (4) an ``--overload``x batch burst against bounded admission —
+``realtime``/``interactive`` submit-to-first-token p95 must hold
+within 1.5x of the unloaded baseline while batch absorbs every
+``RequestRejected``.
+
 Usage:
   python benchmarks/serving_bench.py [--requests 64] [--slots 8]
       [--prefix-len 256] [--grid] [--decode-heavy] [--speculative K]
       [--tp N] [--quantize kv8,w8a8+kv8 | --quant-suite]
-      [--replicas N] [--slo] [--layers 2] [--hidden 128] [--seed 0]
-      [--json out.json]
+      [--replicas N] [--slo] [--chaos] [--layers 2] [--hidden 128]
+      [--seed 0] [--json out.json]
 """
 
 from __future__ import annotations
@@ -1396,6 +1414,374 @@ def run_replica_bench(replicas: int = 4, requests: int = 64,
     }
 
 
+def run_chaos_bench(requests: int = 64, slots: int = 8,
+                    prefill_batch: int = 4, layers: int = 2,
+                    hidden: int = 128, heads: int = 4, vocab: int = 2048,
+                    seed: int = 0, dtype: str = "fp32",
+                    block_size: int = 32, prefill_chunk: int = 128,
+                    prefix_len: int = 192, sessions: int = 16,
+                    swap_batch: int = 8, overload: int = 4,
+                    quantize: tuple = ()):
+    """The BENCH_r14 chaos protocol (PR 15, module docstring
+    ``--chaos``): seeded fault plans against the 16-session returning
+    trace, every recovery gate measured.
+
+     - **crash lane**: a seeded FaultPlan kills one of two tiered
+       replicas mid-decode; every in-flight + pending request must
+       complete on the survivor with tokens EXACTLY matching the
+       fault-free twin fleet (fp32), zero hung handles, budgets intact.
+       Recovery latency = the timeline gap from ``replica_fail`` to the
+       last ``rehome``.  A ``kv8`` lane repeats the kill vs an
+       unfaulted kv8 twin (bit-exact) and records the bounded token
+       match vs full-precision sequential.
+     - **flaky-transport lane**: transient TransportErrors on the pull
+       path; a drain-forced migration must still land its pulls through
+       the retry/backoff machinery with exact parity.
+     - **corruption lane**: bit flips in every host-tier arena entry
+       after a full drain; 100% must be detected by checksum at the
+       promote gate and recovered via recompute — corrupt KV is never
+       served (exact parity).
+     - **overload/shed lane**: an ``overload``x burst of batch traffic
+       in front of the protected classes with bounded admission;
+       ``realtime``/``interactive`` submit-to-first-token p95 must stay
+       within 1.5x of the unloaded baseline while batch absorbs every
+       rejection (bench-side stamps — engine TTFT excludes queue wait,
+       and queue wait is exactly what shedding bounds).
+    """
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.serving import Request, ServingEngine
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.ops.paged_kv import blocks_for
+    from deepspeed_tpu.serving import (FaultInjector, FaultPlan,
+                                       ReplicaRouter, RequestRejected)
+
+    cfg = gpt2.GPT2Config(vocab_size=vocab, max_seq_len=1024,
+                          num_layers=layers, num_heads=heads,
+                          hidden_size=hidden)
+    spec = gpt2.build(cfg)
+    max_total = prefix_len + max(TAIL_RANGE) + max(PREFIX_NEW_RANGE)
+    nbper = blocks_for(max_total, block_size)
+    state = {"params": None}
+
+    def mk_engine():
+        eng = deepspeed_tpu.init_inference(
+            spec, config={"dtype": dtype,
+                          "tensor_parallel": {"tp_size": 1}},
+            params=state["params"])
+        if state["params"] is None:
+            state["params"] = eng.params
+        return eng
+
+    def mk_srv(**extra):
+        kw = dict(slots=slots, max_seq_len=max_total,
+                  prefill_batch=prefill_batch, block_size=block_size,
+                  prefill_chunk=prefill_chunk, host_blocks=max(
+                      32, sessions * (prefix_len // block_size + 2)),
+                  swap_batch=swap_batch, debug_checks=True)
+        kw.update(extra)
+        return ServingEngine(mk_engine(), **kw)
+
+    def fleet(n=2, **router_kw):
+        return ReplicaRouter([mk_srv() for _ in range(n)],
+                             debug_checks=True, **router_kw)
+
+    reqs = build_trace(requests, vocab, seed, False, prefix_len, False,
+                       sessions)
+    gen_tokens = sum(r.max_new_tokens for r in reqs)
+    seq_engine = mk_engine()
+    seq_outs, seq_wall = run_sequential(seq_engine, reqs)
+    mismatched = []
+
+    def gate(tag, ref, outs, uids=None):
+        for uid in (uids if uids is not None else [r.uid for r in reqs]):
+            if not np.array_equal(ref[uid], outs[uid]):
+                mismatched.append((tag, uid))
+
+    def drive_handles(router, handles):
+        while router.step():
+            pass
+        return {h.uid: (h.result(timeout=0) if h.status == "finished"
+                        else None) for h in handles}
+
+    def recovery_window_s(router):
+        """Timeline gap replica_fail -> last rehome (microsecond stamps
+        on the router ring) — the crash-to-recovered latency."""
+        evs = router.timeline.events()
+        t_fail = [e["ts"] for e in evs if e["name"] == "replica_fail"]
+        t_home = [e["ts"] for e in evs if e["name"] == "rehome"]
+        if not t_fail or not t_home:
+            return None
+        return (max(t_home) - min(t_fail)) / 1e6
+
+    # ---------------------------------------------------------- crash lane
+    crash_step = 6                 # mid-decode for this trace shape
+    crash_plan = FaultPlan(seed=seed,
+                           crashes=[{"replica": 1,
+                                     "at_step": crash_step}])
+    free = fleet()
+    outs_free = free.serve(reqs)
+    gate("crash-faultfree", seq_outs, outs_free)
+    chaos = fleet()
+    inj = chaos.arm_faults(crash_plan)
+    handles = [chaos.submit(r) for r in reqs]
+    t0 = time.perf_counter()
+    outs_chaos = drive_handles(chaos, handles)
+    chaos_wall = time.perf_counter() - t0
+    gate("crash-chaos", outs_free, outs_chaos)
+    st = chaos.stats()
+    crash = {
+        "plan": crash_plan.to_json(),
+        "crashes_fired": inj.report()["crashes_fired"],
+        "hung_handles": sum(1 for h in handles if not h.done),
+        "unfinished": sum(1 for h in handles
+                          if h.status != "finished"),
+        "requests_rehomed": st["requests_rehomed"],
+        "requests_failed": st["requests_failed"],
+        "replica_failures": st["replica_failures"],
+        "kv_pulls": st["kv_pulls"],
+        "recovery_latency_s": recovery_window_s(chaos),
+        "wall_s": chaos_wall,
+        "tok_s_wall": gen_tokens / chaos_wall,
+        "compile_budgets_ok": all(
+            p["compile_count"] <= p["compile_budget"]
+            for p in st["per_replica"]),
+        "survivor_prefix_hit_rate":
+            st["per_replica"][0]["prefix_cache_hit_rate"],
+        "parity_exact_vs_faultfree": not any(
+            t == "crash-chaos" for t, _ in mismatched),
+    }
+
+    # kv8 crash twin (bounded divergence vs fp32 sequential, bit-exact
+    # vs the unfaulted kv8 fleet)
+    crash_kv8 = None
+    if quantize and "kv8" in quantize:
+        tu = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tests", "unit")
+        if tu not in sys.path:
+            sys.path.insert(0, tu)
+        from quant_divergence import token_match_rate
+
+        def kv8_fleet():
+            return ReplicaRouter([mk_srv(quantize="kv8")
+                                  for _ in range(2)], debug_checks=True)
+
+        ref_q = kv8_fleet().serve(reqs)
+        chaos_q = kv8_fleet()
+        chaos_q.arm_faults(FaultPlan(
+            seed=seed, crashes=[{"replica": 1, "at_step": crash_step}]))
+        hq = [chaos_q.submit(r) for r in reqs]
+        outs_q = drive_handles(chaos_q, hq)
+        gate("crash-kv8-vs-twin", ref_q, outs_q)
+        crash_kv8 = {
+            "bit_exact_vs_unfaulted_kv8": not any(
+                t == "crash-kv8-vs-twin" for t, _ in mismatched),
+            "token_match_rate_vs_sequential":
+                token_match_rate(seq_outs, outs_q),
+            "requests_rehomed":
+                chaos_q.stats()["requests_rehomed"],
+        }
+
+    # ------------------------------------------------- flaky transport lane
+    flaky_plan = FaultPlan(
+        seed=seed + 1,
+        transport={"ops": ["export", "import"], "transient_rate": 1.0,
+                   "max_faults": 2},
+        stalls=[{"replica": 0, "at_step": 3, "stall_s": 0.002}])
+    flk = fleet(pull_retries=5)
+    inj_f = flk.arm_faults(flaky_plan)
+    gate("flaky-trace", seq_outs, flk.serve(reqs))
+    # drain the busiest session home => forced cross-replica pulls
+    # through the flaky transport
+    prefixes = [reqs[j].prompt[:prefix_len] for j in range(sessions)]
+
+    def _home(p):
+        probes = [flk.replicas[r].affinity_probe(
+            np.concatenate([p, [0]])) for r in range(2)]
+        return int(np.argmax([q["device_blocks"] + q["host_blocks"]
+                              for q in probes]))
+
+    homes = [_home(p) for p in prefixes]
+    rid0 = int(np.argmax([homes.count(r) for r in range(2)]))
+    migrated = [j for j, h in enumerate(homes) if h == rid0]
+    flk.drain(rid0)
+    rng = np.random.default_rng(seed + 2)
+    conts = [Request(uid=f"mig{j}", prompt=np.concatenate(
+        [prefixes[j], rng.integers(0, vocab, 9)]), max_new_tokens=4)
+        for j in migrated]
+    seq_cont = {c.uid: seq_engine.generate(
+        c.prompt[None, :], max_new_tokens=4)[0] for c in conts}
+    outs_mig = flk.serve(conts)
+    gate("flaky-migration", seq_cont, outs_mig,
+         uids=[c.uid for c in conts])
+    stf = flk.stats()
+    flaky = {
+        "plan": flaky_plan.to_json(),
+        "transport_faults_injected": inj_f.report()["transport_faults"],
+        "stalls_fired": inj_f.report()["stalls_fired"],
+        "kv_pull_retries": stf["kv_pull_retries"],
+        "kv_pulls": stf["kv_pulls"],
+        "kv_pull_blocks": stf["kv_pull_blocks"],
+        "migrated_sessions": len(migrated),
+        "pulls_landed_through_retries": stf["kv_pulls"] >= 1
+        and stf["kv_pull_retries"] >= 1,
+    }
+
+    # ------------------------------------------------------ corruption lane
+    # arena sized with 3x headroom: during the post-corruption re-serve
+    # nothing is LRU-evicted, so EVERY injected corruption is still
+    # accountable at the end — caught at a promote exit gate during
+    # traffic, or by the final patrol scrub (entries shadowed behind an
+    # earlier corrupt block in their chain are never probed by traffic;
+    # the scrub is the background-scrubber primitive that finds them)
+    srv_c = mk_srv(host_blocks=3 * max(
+        64, sessions * (prefix_len // block_size + 4)))
+    outs_c = srv_c.serve(reqs)
+    gate("corrupt-pre", seq_outs, outs_c)
+    srv_c.drain()                  # host tier becomes the only copy
+    n_host = len(srv_c._host)
+    corrupt_plan = FaultPlan(
+        seed=seed + 3,
+        corruption=[{"replica": 0, "at_step": 1, "entries": n_host,
+                     "bits": 3}])
+    inj_c = FaultInjector(corrupt_plan)
+    srv_c.arm_faults(inj_c.bind(0))
+    re_reqs = [Request(uid=f"re{r.uid}", prompt=r.prompt,
+                       max_new_tokens=r.max_new_tokens) for r in reqs]
+    outs_c2 = srv_c.serve(re_reqs)
+    srv_c.arm_faults(None)
+    gate("corrupt-post", {f"re{r.uid}": seq_outs[r.uid] for r in reqs},
+         outs_c2, uids=[r.uid for r in re_reqs])
+    detected_gate = int(srv_c._c_checksum_fail.value)
+    scrubbed = srv_c.scrub_host_tier()
+    detected = int(srv_c._c_checksum_fail.value)
+    corruption = {
+        "plan": corrupt_plan.to_json(),
+        "host_entries_corrupted": inj_c.corrupted_entries,
+        "detected_at_exit_gates": detected_gate,
+        "detected_by_patrol_scrub": scrubbed,
+        "checksum_failures_detected": detected,
+        "detected_100pct": detected == inj_c.corrupted_entries
+        and inj_c.corrupted_entries > 0,
+        "recovered_via_recompute_parity": not any(
+            t == "corrupt-post" for t, _ in mismatched),
+        "swap_in_after_corruption": srv_c.stats()["swap_in"],
+    }
+
+    # -------------------------------------------------- overload/shed lane
+    classes = ("realtime", "interactive")
+
+    def measure_ttft(router, entries, warm_reqs=None):
+        """Submit everything up front (batch first — the adversarial
+        order), then step-poll: per-uid submit->first-token wall time,
+        bench-side (INCLUDES queue wait, unlike the engine's
+        slot-admission TTFT)."""
+        if warm_reqs:                       # compile outside the window
+            router.serve(warm_reqs)
+        handles, t_submit, t_first, shed = {}, {}, {}, []
+        for req, cls in entries:
+            t_submit[req.uid] = time.perf_counter()
+            try:
+                handles[req.uid] = router.submit(req, slo_class=cls)
+            except RequestRejected as e:
+                shed.append((e.uid, e.slo_class))
+        live = True
+        while live:
+            live = router.step()
+            now = time.perf_counter()
+            for uid, h in handles.items():
+                if uid not in t_first and h.tokens():
+                    t_first[uid] = now
+        per_class = {}
+        for (req, cls) in entries:
+            if req.uid in t_first:
+                per_class.setdefault(cls, []).append(
+                    t_first[req.uid] - t_submit[req.uid])
+        return handles, per_class, shed
+
+    def p95(xs):
+        return float(np.percentile(xs, 95)) if xs else None
+
+    n_prot = max(4, requests // 4)
+    rng = np.random.default_rng(seed + 4)
+    prot_entries = [
+        (Request(uid=f"p{i}", prompt=np.concatenate(
+            [prefixes[i % sessions],
+             rng.integers(0, vocab, 12)]), max_new_tokens=6),
+         classes[i % 2]) for i in range(n_prot)]
+    batch_entries = [
+        (Request(uid=f"b{i}", prompt=np.concatenate(
+            [prefixes[i % sessions],
+             rng.integers(0, vocab, 12)]), max_new_tokens=6), "batch")
+        for i in range(n_prot * (overload - 1))]
+    warm = [Request(uid=f"w{i}", prompt=np.concatenate(
+        [prefixes[i % sessions], rng.integers(0, vocab, 10)]),
+        max_new_tokens=3) for i in range(4)]
+
+    base_fleet = fleet()               # unloaded, shedding off
+    _, base_cls, base_shed = measure_ttft(
+        base_fleet, [(r, c) for r, c in prot_entries], warm_reqs=warm)
+    shed_fleet = fleet(max_queue_depth=max(2, slots))
+    over_entries = batch_entries + \
+        [(Request(uid=r.uid + "o", prompt=r.prompt,
+                  max_new_tokens=r.max_new_tokens), c)
+         for r, c in prot_entries]
+    over_handles, over_cls, over_shed = measure_ttft(
+        shed_fleet, over_entries, warm_reqs=warm)
+    base_p95 = p95(base_cls.get("realtime", [])
+                   + base_cls.get("interactive", []))
+    over_p95 = p95(over_cls.get("realtime", [])
+                   + over_cls.get("interactive", []))
+    shed_by_class = {}
+    for _, cls in over_shed:
+        key = cls if cls is not None else "standard"
+        shed_by_class[key] = shed_by_class.get(key, 0) + 1
+    overload_shed = {
+        "overload_factor": overload,
+        "protected_requests": n_prot,
+        "batch_requests_offered": len(batch_entries),
+        "max_queue_depth": max(2, slots),
+        "unloaded_protected_ttft_p95_s": base_p95,
+        "overloaded_protected_ttft_p95_s": over_p95,
+        "protected_p95_ratio": (over_p95 / base_p95
+                                if base_p95 and over_p95 else None),
+        "protected_within_1p5x": bool(
+            base_p95 and over_p95 and over_p95 <= 1.5 * base_p95),
+        "shed_by_class": shed_by_class,
+        "protected_shed": sum(v for k, v in shed_by_class.items()
+                              if k != "batch"),
+        "batch_absorbed_all_rejections": bool(shed_by_class) and all(
+            k == "batch" for k in shed_by_class),
+        "unloaded_sheds": len(base_shed),
+        "protected_finished": sum(
+            1 for uid, h in over_handles.items()
+            if not uid.startswith("b") and h.status == "finished"),
+    }
+
+    return {
+        "protocol": "fault-tolerant serving fleet (PR 15, BENCH_r14): "
+                    "seeded crash-at-iteration / flaky-transport / "
+                    "host-corruption / overload-shedding lanes on the "
+                    "returning-sessions trace, every lane parity- or "
+                    "counter-gated (docs/reliability.md)",
+        "trace": f"{sessions} sessions x {prefix_len}-token prefixes, "
+                 f"tails {TAIL_RANGE}, new {PREFIX_NEW_RANGE}",
+        "requests": requests,
+        "generated_tokens": gen_tokens,
+        "sequential": {"tok_s": gen_tokens / seq_wall,
+                       "wall_s": seq_wall},
+        "crash": crash,
+        "crash_kv8": crash_kv8,
+        "flaky_transport": flaky,
+        "corruption": corruption,
+        "overload_shed": overload_shed,
+        "token_parity": not mismatched,
+        "mismatched": mismatched,
+        "model": f"gpt2-{layers}l-{hidden}d-{vocab}v ({dtype})",
+        "backend": __import__("jax").default_backend(),
+    }
+
+
 def run_autotune_bench(requests: int = 64, sessions: int = 16,
                        prefix_len: int = 256, pool_frac: float = 0.25,
                        slots: int = 8, layers: int = 2, hidden: int = 128,
@@ -1592,6 +1978,18 @@ def main():
                     help="nominal MFU denominator for the --slo lane's "
                          "FLOPs report (CPU-sim: gauge mechanics, not a "
                          "hardware claim)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run the BENCH_r14 fault-tolerance protocol "
+                         "(PR 15): seeded crash-at-iteration, flaky "
+                         "transport, host-tier corruption, and overload-"
+                         "shedding lanes on the returning-sessions "
+                         "trace — recovery latency, rehomed/shed "
+                         "counts, 100%% checksum detection, and parity "
+                         "vs the fault-free twin (add --quantize kv8 "
+                         "for the kv8 crash lane)")
+    ap.add_argument("--overload", type=int, default=4,
+                    help="overload factor for the --chaos shed lane "
+                         "(batch traffic = (N-1) x protected)")
     ap.add_argument("--autotune", action="store_true",
                     help="run the closed-loop autotuner protocol "
                          "(BENCH_r13) instead of the single-engine "
@@ -1685,6 +2083,36 @@ def main():
             emit_metrics=args.emit_metrics)
         ok = res["token_parity"] and \
             all(s["compile_budgets_ok"] for s in res["scaling"].values())
+    elif args.chaos:
+        res = run_chaos_bench(
+            requests=args.requests, slots=args.slots,
+            prefill_batch=args.prefill_batch, layers=args.layers,
+            hidden=args.hidden, heads=args.heads, vocab=args.vocab,
+            seed=args.seed, dtype=args.dtype, block_size=args.block_size,
+            prefill_chunk=args.prefill_chunk,
+            prefix_len=_default(args.prefix_len, 192),
+            sessions=_default(args.sessions, 16),
+            swap_batch=args.swap_batch, overload=args.overload,
+            quantize=quantize)
+        ok = res["token_parity"] and \
+            res["crash"]["hung_handles"] == 0 and \
+            res["crash"]["unfinished"] == 0 and \
+            res["crash"]["requests_rehomed"] >= 1 and \
+            res["crash"]["compile_budgets_ok"] and \
+            res["flaky_transport"]["pulls_landed_through_retries"] and \
+            res["corruption"]["detected_100pct"] and \
+            res["corruption"]["recovered_via_recompute_parity"] and \
+            res["overload_shed"]["batch_absorbed_all_rejections"] and \
+            res["overload_shed"]["protected_shed"] == 0
+        fail_msg = "chaos recovery gate failed (see JSON lanes)"
+        if not res["overload_shed"]["protected_within_1p5x"]:
+            # wall-clock contract: recorded and warned, not exit-fatal —
+            # CPU-sim TTFT on a shared box is noise-prone (the committed
+            # BENCH_r14.json pins a passing measurement)
+            print("WARNING: protected TTFT p95 ratio "
+                  f"{res['overload_shed']['protected_p95_ratio']} "
+                  "exceeds the 1.5x shed contract on this run "
+                  "(see overload_shed in the JSON)", file=sys.stderr)
     elif args.autotune:
         res = run_autotune_bench(
             requests=args.requests, sessions=_default(args.sessions, 16),

@@ -130,7 +130,10 @@ def init_inference(model=None, config=None, params=None, **kwargs):
 def init_router(model=None, config=None, params=None, *, replicas=2,
                 policy="affinity", kv_pull=True, threaded=False,
                 router_trace_capacity=4096, metrics_port=None,
-                metrics_host="127.0.0.1", **serving_kwargs):
+                metrics_host="127.0.0.1", max_queue_depth=None,
+                shed_classes=("batch",), burn_threshold=None,
+                pull_retries=2, pull_backoff_s=0.0, pull_timeout_s=None,
+                max_rehomes=3, **serving_kwargs):
     """Multi-replica serving entry (ROADMAP item 1): ``replicas`` ×
     ``init_serving`` engines — all sharing ONE weight pytree (the first
     replica's initialized/loaded params are reused, so every replica is
@@ -168,7 +171,18 @@ def init_router(model=None, config=None, params=None, *, replicas=2,
     the JSON fleet snapshot (router stats + per-class SLO report +
     registry snapshot), ``/trace`` the merged multi-replica Chrome
     trace.  ``router.stop()`` shuts it down.  See
-    ``docs/observability.md`` "Fleet observability"."""
+    ``docs/observability.md`` "Fleet observability".
+
+    Fault tolerance (docs/reliability.md): a crashed replica is failed
+    out of rotation (``router.fail(rid)`` — supervisor hard-probe
+    detection, worker-death handling, or the ``serving/faults.py``
+    chaos harness) and its live requests re-home onto survivors with
+    token-exact greedy resume, streaming on the same handles;
+    cross-replica KV pulls retry transient faults (``pull_retries`` /
+    ``pull_backoff_s`` / ``pull_timeout_s``) with checksum-verified
+    bytes, and ``max_queue_depth`` / ``burn_threshold`` bound admission
+    by shedding ``shed_classes`` work with typed ``RequestRejected``
+    results under overload."""
     from .serving import ReplicaRouter
 
     reps = []
@@ -180,7 +194,11 @@ def init_router(model=None, config=None, params=None, *, replicas=2,
     router = ReplicaRouter(
         reps, policy=policy, kv_pull=kv_pull, threaded=threaded,
         debug_checks=bool(serving_kwargs.get("debug_checks", False)),
-        trace_capacity=router_trace_capacity)
+        trace_capacity=router_trace_capacity,
+        max_queue_depth=max_queue_depth, shed_classes=shed_classes,
+        burn_threshold=burn_threshold, pull_retries=pull_retries,
+        pull_backoff_s=pull_backoff_s, pull_timeout_s=pull_timeout_s,
+        max_rehomes=max_rehomes)
     if metrics_port is not None:
         router.start_metrics_server(port=metrics_port, host=metrics_host)
     return router

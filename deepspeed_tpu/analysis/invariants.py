@@ -66,6 +66,14 @@ the fault-injection test matrix in ``tests/unit/test_analysis.py``):
     a drained replica holds no pending or active requests — drain hands
     everything off by contract, so anything left behind is a request no
     worker thread will ever step again.
+``router-failure-state``
+    crash recovery (``ReplicaRouter.fail``): a crash-failed replica
+    owns ZERO uids — ``fail`` must salvage and scrub the dead replica's
+    host-side bookkeeping, so anything left behind was never re-homed
+    and will never be stepped — and no live (not-done) handle maps to a
+    failed replica: every live handle's owner is a live survivor, or
+    the handle was resolved loudly (``RequestFailedError``) when the
+    re-home budget ran out.
 ``residency-conservation``
     tiered-KV engines only (``host_blocks > 0``): every host-arena slot
     is exactly one of free / resident (owned by exactly one entry) /
@@ -356,11 +364,13 @@ def audit_host_store(store, staged_keys) -> None:
 
 def audit_router(router) -> None:
     """Verify the router-level invariants (module docstring:
-    ``router-request-uniqueness`` / ``router-drain-quiesced``) over a
+    ``router-request-uniqueness`` / ``router-drain-quiesced`` /
+    ``router-failure-state``) over a
     :class:`~deepspeed_tpu.serving.ReplicaRouter`; raises
     :class:`PagedStateError`.  Pure host state — runs after every
     ``router.step()`` under ``debug_checks``; each engine's own paged
     audit rides its engine-level flag."""
+    failed = set(getattr(router, "_failed", ()))
     where = {}
     for rid, rep in enumerate(router.replicas):
         for item in rep._pending:
@@ -379,22 +389,26 @@ def audit_router(router) -> None:
                     f"request {uid!r} active on replica {rid} but "
                     f"already {where[uid][1]} on replica {where[uid][0]}")
             where[uid] = (rid, "active")
-        if rid in router._drained and (rep._pending or rep._active) and \
-                rid not in getattr(router, "_worker_errors", {}):
-            # a crash-failed replica is drained WITH its (cancelled)
-            # requests left in place — its engine state is suspect, so
-            # drain's hand-off contract deliberately does not apply
+        if (rep._pending or rep._active) and rid in failed:
+            # fail(rid) salvages + scrubs the dead engine's host-side
+            # bookkeeping — anything still here was never re-homed and
+            # nothing will ever step it
+            raise PagedStateError(
+                "router-failure-state",
+                f"crash-failed replica {rid} still owns "
+                f"{len(rep._pending)} queued / {len(rep._active)} active "
+                "request(s) — salvage must leave a dead replica with "
+                "zero uids")
+        if rid in router._drained and rid not in failed and \
+                (rep._pending or rep._active):
             raise PagedStateError(
                 "router-drain-quiesced",
                 f"replica {rid} is drained but still holds "
                 f"{len(rep._pending)} queued / {len(rep._active)} active "
                 "request(s) — nothing will ever step them")
-    failed = set(getattr(router, "_worker_errors", {}))
     for uid, (handle, rid) in router._handles.items():
         if handle.done:
-            if uid in where and where[uid][0] not in failed:
-                # crash-failed replicas keep their (cancelled) requests
-                # in place by design — same exemption as drain-quiesced
+            if uid in where:
                 raise PagedStateError(
                     "router-request-uniqueness",
                     f"request {uid!r} handle says {handle.status} but it "
@@ -405,6 +419,13 @@ def audit_router(router) -> None:
                     "router-request-uniqueness",
                     f"request {uid!r} handle says {handle.status} but no "
                     "replica holds it — the request was lost")
+            if rid in failed:
+                raise PagedStateError(
+                    "router-failure-state",
+                    f"live request {uid!r} is mapped to crash-failed "
+                    f"replica {rid} — it must re-home to a survivor or "
+                    "fail loudly (RequestFailedError), never wait on a "
+                    "dead engine")
             if where[uid][0] != rid:
                 raise PagedStateError(
                     "router-request-uniqueness",

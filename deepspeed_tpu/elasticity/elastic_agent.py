@@ -182,6 +182,36 @@ class ElasticAgent:
                 proc.kill()
         self._procs = {}
 
+    def _restart_dead_workers(self) -> bool:
+        """Replica-mode (``elect_all``) worker recovery: a crashed
+        replica worker restarts ALONE — the survivors keep serving.  A
+        whole-group restart here would drop every healthy replica's
+        in-flight sessions to recover one dead process; serving workers
+        are independent (no collective waits), so individual restart is
+        safe in a way it never is for a training group.  This is the
+        process-level half of the crash protocol: the in-process router
+        fails the dead replica and re-homes its sessions
+        (``serving/router.py fail`` via the supervisor's hard-probe
+        detection), this restart brings the worker back, and the
+        recovered probe re-admits it.  Each restart burns one unit of
+        the shared restart budget; returns ``False`` when spent."""
+        for rank, host in enumerate(self._hosts):
+            proc = self._procs.get(host)
+            if proc is None or proc.poll() in (None, 0):
+                continue                    # running or finished clean
+            self.restart_count += 1
+            if self.restart_count > self.max_restarts:
+                logger.error("elastic: restart budget exhausted")
+                return False
+            logger.warning(
+                f"elastic: replica worker on {host} exited "
+                f"{proc.poll()} — restarting it alone "
+                f"(restart #{self.restart_count})")
+            env = self._env_for(host, rank, self._hosts)
+            self._procs[host] = subprocess.Popen(
+                self.launch_cmd(host, env), env=env)
+        return True
+
     # ----------------------------------------------------------------- monitor
     def _group_state(self) -> str:
         """SUCCEEDED (all 0), FAILED (any non-zero), PARTIAL (some exited 0
@@ -207,6 +237,18 @@ class ElasticAgent:
             if state == "SUCCEEDED":
                 logger.info("elastic: worker group finished")
                 return 0
+            if state == "FAILED" and self.elect_all:
+                # serving replicas are independent processes: restart
+                # the dead one(s) alone, never the whole fleet
+                if not self._restart_dead_workers():
+                    # budget spent: stop the SURVIVORS too before
+                    # exiting (the whole-group path below does the
+                    # same) — an exiting agent must not orphan worker
+                    # processes holding ports/devices
+                    self._stop_group()
+                    return 1
+                partial_ticks = 0
+                continue
             if state == "PARTIAL":
                 partial_ticks += 1
                 if partial_ticks <= self.partial_grace_ticks:

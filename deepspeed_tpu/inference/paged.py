@@ -53,7 +53,12 @@ been issued but whose pool scatter has not landed — the
 ``residency-conservation`` audit in ``analysis/invariants.py`` checks
 that every arena slot is exactly one of free / resident / in-flight and
 that in-flight flags stay in lockstep with the engine's staged-prefetch
-records.
+records.  Every entry additionally carries a :func:`block_checksum`
+integrity record computed when the bytes enter the tier and re-verified
+whenever they leave it (promotion staging, cross-replica export/import)
+— a host-DRAM bit flip is detected at the exit point, the corrupt entry
+is dropped, and the chain recomputes from tokens instead of serving
+corrupt KV (docs/reliability.md).
 
 **Tensor parallelism**: everything in this module is per-host and
 head-sharding-invariant.  Block ids, refcounts, and trie keys index
@@ -71,6 +76,7 @@ topologies.
 from __future__ import annotations
 
 import dataclasses
+import zlib
 from collections import OrderedDict, deque
 from typing import List, Optional, Sequence, Tuple
 
@@ -78,6 +84,39 @@ import numpy as np
 
 #: physical block 0 is never allocated; discarded writes are routed there
 SCRATCH_BLOCK = 0
+
+
+class TransportError(RuntimeError):
+    """A KV transport operation (demote / promote / cross-replica
+    export / import) failed.  ``transient=True`` means the caller may
+    retry with backoff; ``transient=False`` is a permanent fault — the
+    caller must fall back to local recompute (the contents are always
+    recomputable from tokens, just not for free).  Raised by the
+    fault-injection harness (``serving/faults.py``) in CPU-sim and by a
+    real RPC/RDMA fabric in a multi-host deployment."""
+
+    def __init__(self, op: str, transient: bool = True,
+                 detail: str = ""):
+        super().__init__(
+            f"KV transport '{op}' failed "
+            f"({'transient' if transient else 'permanent'})"
+            + (f": {detail}" if detail else ""))
+        self.op = op
+        self.transient = bool(transient)
+
+
+def block_checksum(block_arrays: Sequence[np.ndarray]) -> int:
+    """Integrity checksum of one KV block's per-leaf byte content
+    (crc32 chained across leaves — xxhash-style speed from zlib's C
+    loop, no new dependency).  Computed when bytes enter the host tier
+    and re-verified whenever they leave it (promotion staging,
+    cross-replica export/import), so a bit flip in host DRAM is caught
+    BEFORE the corrupt KV can reach a device pool — corrupt chains are
+    dropped and recomputed, never served (docs/reliability.md)."""
+    c = 0
+    for a in block_arrays:
+        c = zlib.crc32(np.ascontiguousarray(a).tobytes(), c)
+    return c & 0xFFFFFFFF
 
 
 def chain_key(tokens, block_index: int, block_size: int) -> bytes:
@@ -350,6 +389,7 @@ class _HostEntry:
     key: bytes                  # chain_key of the block's content
     slot: int                   # arena slot holding the block's bytes
     in_flight: bool = False     # promotion staged (device_put issued)
+    checksum: int = 0           # block_checksum of the stored bytes
 
 
 class HostBlockStore:
@@ -391,6 +431,9 @@ class HostBlockStore:
         self._entries: "OrderedDict[bytes, _HostEntry]" = OrderedDict()
         # counters for ServingEngine.stats()
         self.evictions = 0
+        #: blocks refused by :meth:`import_chain`'s checksum gate (the
+        #: engine folds deltas into serving_checksum_failures_total)
+        self.checksum_rejects = 0
         #: bumped whenever the resident KEY SET changes (put/pop/LRU
         #: eviction) — probe results are stale iff this moved, which lets
         #: the engine memoize empty prefetch probes across idle
@@ -418,13 +461,16 @@ class HostBlockStore:
         return key in self._entries
 
     def put(self, key: bytes,
-            block_arrays: Sequence[np.ndarray]) -> Optional[int]:
+            block_arrays: Sequence[np.ndarray],
+            checksum: Optional[int] = None) -> Optional[int]:
         """Store one demoted block's per-leaf arrays under ``key``;
         returns the arena slot, or ``None`` when every slot is pinned by
         in-flight entries (the caller then simply drops the demotion —
         the block's contents are recomputable, just not for free).  A
         duplicate key keeps the existing copy (first-writer-wins, same
-        dedup rule as the trie) and refreshes its recency."""
+        dedup rule as the trie) and refreshes its recency.  ``checksum``
+        pins the entry's integrity record (cross-replica import reuses
+        the exporter's sum); ``None`` computes it from the bytes."""
         if key in self._entries:
             self._entries.move_to_end(key)
             return self._entries[key].slot
@@ -441,7 +487,10 @@ class HostBlockStore:
         slot = self._free.popleft()
         for arena, arr in zip(self.arenas, block_arrays):
             arena[slot] = arr
-        self._entries[key] = _HostEntry(key=key, slot=slot)
+        self._entries[key] = _HostEntry(
+            key=key, slot=slot,
+            checksum=(block_checksum(block_arrays)
+                      if checksum is None else int(checksum)))
         self.version += 1
         return slot
 
@@ -460,6 +509,28 @@ class HostBlockStore:
     def mark_in_flight(self, key: bytes, flag: bool = True) -> None:
         self._entries[key].in_flight = bool(flag)
 
+    def checksum_of(self, key: bytes) -> int:
+        """The integrity record stored when the block entered the tier."""
+        return self._entries[key].checksum
+
+    def verify(self, key: bytes) -> bool:
+        """Recompute the resident bytes' checksum against the stored
+        record — ``False`` means the arena bytes were corrupted after the
+        store (host-DRAM bit flip).  O(block bytes); called at the points
+        bytes LEAVE the arena (promotion staging, export), never on the
+        per-iteration probe path."""
+        e = self._entries[key]
+        return block_checksum([arena[e.slot] for arena in self.arenas]) \
+            == e.checksum
+
+    def drop_corrupt(self, key: bytes) -> None:
+        """Discard an entry whose bytes failed :meth:`verify`: the slot
+        frees and the chain truncates here — the contents recompute from
+        tokens on the next admission (corrupt KV is never served)."""
+        e = self._entries.pop(key)
+        self._free.append(e.slot)
+        self.version += 1
+
     def export_chain(self, keys: Sequence[bytes]) -> List[List[np.ndarray]]:
         """Per-block, per-leaf byte COPIES of resident blocks — the
         cross-replica KV-pull wire format: a snapshot, so later LRU
@@ -469,16 +540,34 @@ class HostBlockStore:
         by construction."""
         return [[np.array(a) for a in self.read(k)] for k in keys]
 
+    def export_checksums(self, keys: Sequence[bytes]) -> List[int]:
+        """The stored integrity records for an exported chain — travels
+        beside :meth:`export_chain`'s bytes so the importer can verify
+        the transfer end-to-end (``import_chain``)."""
+        return [self.checksum_of(k) for k in keys]
+
     def import_chain(self, keys: Sequence[bytes],
-                     blocks: Sequence[Sequence[np.ndarray]]) -> int:
+                     blocks: Sequence[Sequence[np.ndarray]],
+                     checksums: Optional[Sequence[int]] = None) -> int:
         """Store an exported chain (same order as :meth:`export_chain`);
         stops at the first refused ``put`` (arena saturated with
         in-flight entries) so the imported run stays contiguous — a
         holed chain would be unreachable past the hole anyway
-        (``probe_run`` walks contiguously).  Returns blocks stored."""
+        (``probe_run`` walks contiguously).  With ``checksums`` (the
+        exporter's :meth:`export_checksums`), every block's bytes are
+        re-hashed on arrival and a mismatch STOPS the import there —
+        bytes corrupted in the exporter's arena or in transit never
+        enter this tier (``checksum_rejects`` counts them; the engine
+        surfaces the total as ``serving_checksum_failures_total``).
+        Returns blocks stored."""
         n = 0
-        for key, arrs in zip(keys, blocks):
-            if self.put(key, arrs) is None:
+        sums = list(checksums) if checksums is not None else None
+        for i, (key, arrs) in enumerate(zip(keys, blocks)):
+            want = sums[i] if sums is not None else None
+            if want is not None and block_checksum(arrs) != int(want):
+                self.checksum_rejects += 1
+                break
+            if self.put(key, arrs, checksum=want) is None:
                 break
             n += 1
         return n

@@ -219,9 +219,22 @@ from ..telemetry import MetricsRegistry, ProfilerWindow, TraceTimeline
 from ..telemetry.slo import SLOTracker
 from ..utils.logging import log_dist
 from ..utils.lru import LRUCache
-from .paged import (BlockAllocator, HostBlockStore, PrefixCache, chain_key,
-                    chain_keys)
+from .paged import (BlockAllocator, HostBlockStore, PrefixCache,
+                    TransportError, chain_key, chain_keys)
 from .spec import NGramProposer, greedy_accept
+
+
+class RequestFailedError(RuntimeError):
+    """A request was permanently failed by the serving fleet: its
+    replica crashed and the re-home retry budget was exhausted, or no
+    live replica remained to take it (``ReplicaRouter.fail``).  Raised
+    by :meth:`RequestHandle.result`; tokens streamed before the failure
+    stay readable via :meth:`RequestHandle.tokens`."""
+
+    def __init__(self, uid, reason: str):
+        super().__init__(f"request {uid!r} failed: {reason}")
+        self.uid = uid
+        self.reason = reason
 
 
 #: legal ``quantize=`` values (order-normalized; ``None`` = full precision)
@@ -373,9 +386,13 @@ class RequestHandle:
         self.uid = request.uid
         self.priority = int(priority)
         self.slo_class = slo_class
-        self.status = "queued"        # -> "active" -> "finished"|"cancelled"
+        # "queued" -> "active" -> "finished" | "cancelled" | "failed"
+        # (failed = crash re-homing exhausted; result() raises the
+        # recorded RequestFailedError instead of returning)
+        self.status = "queued"
         self._tokens: List[int] = []
         self._result: Optional[np.ndarray] = None
+        self._error: Optional[BaseException] = None
         self._sanitizer = lock_sanitizer
         self._cond = ordered_condition("serving.handle", lock_sanitizer) \
             if lock_sanitizer is not None else threading.Condition()
@@ -405,6 +422,15 @@ class RequestHandle:
             self.status = "cancelled"
             self._cond.notify_all()
 
+    def _on_fail(self, exc: BaseException) -> None:
+        """Resolve the handle as permanently failed (crash re-homing
+        exhausted) — never downgrades an already-finished request."""
+        with self._cond:
+            if self.status not in ("finished", "cancelled"):
+                self._error = exc
+                self.status = "failed"
+            self._cond.notify_all()
+
     def set_canceller(self, canceller) -> None:
         """Rebind the cancel route (router submit / drain handoff) —
         under the handle condition, because a worker may already be
@@ -417,7 +443,7 @@ class RequestHandle:
     # ---- caller side
     @property
     def done(self) -> bool:
-        return self.status in ("finished", "cancelled")
+        return self.status in ("finished", "cancelled", "failed")
 
     def tokens(self) -> List[int]:
         """Every token committed so far (a copy)."""
@@ -431,9 +457,14 @@ class RequestHandle:
 
     def next_token(self, timeout: Optional[float] = None) -> Optional[int]:
         """Streaming cursor: the next committed token, or ``None`` once
-        the request is finished/cancelled (or ``timeout`` seconds pass
-        with nothing new — pass ``timeout=0`` when the caller itself
-        drives ``step()``, blocking would deadlock there)."""
+        the request is finished/cancelled/failed.  ``timeout=0`` is the
+        non-blocking poll for callers driving ``step()`` themselves
+        (``None`` then also means "nothing new yet"); a positive
+        ``timeout`` that expires with the request still live raises
+        ``TimeoutError`` — a lost replica surfaces as a loud, typed
+        error at the caller instead of an indefinite hang
+        (docs/reliability.md).  ``timeout=None`` blocks until a token
+        arrives or the request resolves."""
         if self._sanitizer is not None and timeout != 0:
             self._sanitizer.check_wait(
                 f"RequestHandle.next_token(uid={self.uid!r})",
@@ -446,12 +477,19 @@ class RequestHandle:
                 tok = self._tokens[self._cursor]
                 self._cursor += 1
                 return tok
-            return None
+            if self.done or not timeout:   # resolved, poll, or blocking
+                return None
+            raise TimeoutError(
+                f"request {self.uid!r} streamed nothing new within "
+                f"{timeout}s (status {self.status})")
 
     def result(self, timeout: Optional[float] = None) -> Optional[np.ndarray]:
         """Block until completion; the padded ``[prompt + completion]``
         array (``serve`` semantics), or ``None`` if cancelled.  Raises
-        ``TimeoutError`` if ``timeout`` expires first."""
+        ``TimeoutError`` if ``timeout`` expires first, and
+        :class:`RequestFailedError` when the fleet permanently failed
+        the request (crash re-homing exhausted — the tokens streamed
+        before the failure stay readable via :meth:`tokens`)."""
         if self._sanitizer is not None and timeout != 0:
             self._sanitizer.check_wait(
                 f"RequestHandle.result(uid={self.uid!r})",
@@ -461,6 +499,8 @@ class RequestHandle:
                 raise TimeoutError(
                     f"request {self.uid!r} still {self.status} after "
                     f"{timeout}s")
+            if self.status == "failed":
+                raise self._error
             return self._result
 
 
@@ -1029,6 +1069,10 @@ class ServingEngine:
             "serving_resume_recompute_tokens_total",
             "prompt tokens re-prefilled when admitting a preemption resume "
             "(near zero with the host tier: demoted state promotes back)")
+        self._c_checksum_fail = m.counter(
+            "serving_checksum_failures_total",
+            "host-tier KV blocks rejected by the integrity checksum "
+            "(corrupt bytes dropped and recomputed, never served)")
         self._h_prefetch_wait = m.histogram(
             "serving_prefetch_wait_seconds",
             help="time admission blocked on an in-flight promotion "
@@ -1090,6 +1134,15 @@ class ServingEngine:
         #: once per successful submit() with the request and its
         #: submit-time knobs, BEFORE any slo_class -> priority mapping
         self._submit_observer = None
+        #: fault-injection hook (serving/faults.py): a bound replica view
+        #: armed by arm_faults(); None = zero cost (one predicate at each
+        #: injection point, nothing else changes)
+        self._fault_injector = None
+        #: bounded deterministic retry/backoff for the engine-internal
+        #: swap transport (demote/promote) under an armed fault plan —
+        #: attributes, not ctor knobs, so resolved_config() stays stable
+        self._transport_retries = 2
+        self._transport_backoff_s = 0.0
         log_dist(
             f"ServingEngine: slots={self.slots}, cache_len="
             f"{self._cache_len}, block_size={self.block_size}, "
@@ -1428,6 +1481,99 @@ class ServingEngine:
             self._set_swap_pools(
                 self._get_promote_fn()(self._swap_pools(), staged, ids))
 
+    # ------------------------------------------------------- fault injection
+    def arm_faults(self, injector) -> None:
+        """Arm (or, with ``None``, disarm) a fault-injection view on this
+        engine (``serving/faults.py`` — a :class:`FaultInjector` bound to
+        this replica's id).  The armed view is consulted at every
+        scheduler iteration (crash/stall/corruption events) and at every
+        swap-transport operation (transient/permanent transport faults);
+        unarmed, each injection point is a single ``is None`` predicate."""
+        self._fault_injector = injector
+
+    def _swap_transport_ok(self, op: str) -> bool:
+        """Gate one engine-internal swap-transport operation (demote /
+        promote) through the armed fault plan with bounded deterministic
+        retry: transient faults back off exponentially
+        (``_transport_backoff_s * 2^attempt``) and retry up to
+        ``_transport_retries`` times; a permanent fault (or an exhausted
+        budget) returns ``False`` — the caller falls back to dropping
+        the demotion or recomputing the chain (docs/reliability.md).
+        Always ``True`` when no plan is armed."""
+        inj = self._fault_injector
+        if inj is None:
+            return True
+        for attempt in range(self._transport_retries + 1):
+            try:
+                inj.on_transport(op)
+                return True
+            except TransportError as e:
+                # TransportError ONLY: anything else out of the
+                # injector is a bug and must propagate, not masquerade
+                # as a quiet permanent transport fault
+                self.timeline.instant("transport_fault", op=op,
+                                      attempt=attempt,
+                                      transient=e.transient)
+                if not e.transient:
+                    break
+                if self._transport_backoff_s:
+                    time.sleep(self._transport_backoff_s * (2 ** attempt))
+        return False
+
+    def _verified_keys(self, keys: List[bytes]) -> List[bytes]:
+        """Integrity gate at the points host-tier bytes LEAVE the arena
+        (promotion staging, prefetch staging, cross-replica export):
+        verify EVERY entry in the probed run against its stored
+        checksum, drop every corrupt one, and truncate the usable run
+        at the first failure (the chain is only walkable contiguously)
+        — so each corrupt block in a probed run is detected exactly
+        once, counted, and recomputed from tokens; corrupt KV is never
+        staged, exported, or served.  An entry a live staged record
+        still pins keeps its slot (the staged device copy predates the
+        corruption and is clean); it drops on the next unpinned pass."""
+        cut = len(keys)
+        for i, key in enumerate(keys):
+            if self._host.verify(key):
+                continue
+            cut = min(cut, i)
+            if any(key in rec["keys"] for rec in self._staged.values()):
+                # pinned by a live staged record: truncate (never serve
+                # it) but COUNT only on the pass that actually drops it
+                # — otherwise every re-probe of the same pinned entry
+                # would re-count one corruption
+                continue
+            self._c_checksum_fail.inc()
+            self.timeline.instant("checksum_fail", key=key.hex()[:16],
+                                  block_index=i)
+            self._host.drop_corrupt(key)
+        return keys[:cut]
+
+    def scrub_host_tier(self) -> int:
+        """Patrol scrub (the background-scrubber primitive real storage
+        tiers run): verify EVERY resident host-tier entry against its
+        stored checksum and drop the corrupt ones — entries shadowed
+        behind an earlier corrupt block in their chain would otherwise
+        sit undetected until (if ever) probed.  In-flight entries are
+        skipped (their staged device copies predate the corruption and
+        are clean; they drop on the next pass).  Counted into
+        ``serving_checksum_failures_total``; returns entries dropped.
+        O(arena bytes) — run it between traffic, not per iteration."""
+        if self._host is None:
+            return 0
+        dropped = 0
+        for key, e in list(self._host._entries.items()):
+            if e.in_flight or self._host.verify(key):
+                continue
+            self._c_checksum_fail.inc()
+            self.timeline.instant("checksum_fail", key=key.hex()[:16],
+                                  scrub=True)
+            self._host.drop_corrupt(key)
+            dropped += 1
+        if dropped:
+            self.timeline.instant("host_scrub", dropped=dropped,
+                                  resident=len(self._host))
+        return dropped
+
     def _demote_blocks(self, blocks: List[int], keys: List[bytes]) -> int:
         """Copy the given device blocks into the host arena under their
         chain keys — the sanctioned blocking demotion helper (lint GL007):
@@ -1436,6 +1582,8 @@ class ServingEngine:
         actually stored (the arena can refuse when it is full of in-flight
         entries — the demotion is then simply dropped; contents stay
         recomputable)."""
+        if blocks and not self._swap_transport_ok("demote"):
+            return 0                      # dropped: contents recomputable
         m = self.swap_batch
         stored = 0
         swap_t0 = self.timeline.now_us()
@@ -1577,6 +1725,10 @@ class ServingEngine:
             n_dev = self._prefix.probe(prompt_eff, plen - 1)
             keys = self._host.probe_run(prompt_eff, n_dev, plen - 1,
                                         self.block_size)
+            if keys:
+                # never stage corrupt arena bytes toward the device
+                # (integrity gate — the truncated tail recomputes)
+                keys = self._verified_keys(keys)
             if not keys:
                 self._prefetch_gate[req.uid] = gate
                 continue
@@ -1646,10 +1798,19 @@ class ServingEngine:
         tail host-resident."""
         keys = self._host.probe_run(prompt_eff, n_dev, plen - 1,
                                     self.block_size)
+        # integrity gate: a corrupt entry truncates the promotable run
+        # (dropped + counted; the tail recomputes), and a transport
+        # fault that survives the bounded retry abandons the promotion
+        # entirely — both fall back to the ordinary prefill recompute
+        if keys:
+            keys = self._verified_keys(keys)
+        if keys and not self._swap_transport_ok("promote"):
+            keys = []
         if not keys:
             # nothing host-resident to promote — but a prefetch staged for
             # this request may still exist (a sharing request promoted the
-            # chain first, or the trie drifted): it dies WITH the
+            # chain first, the trie drifted, or the run was just dropped
+            # by the integrity/transport gate): it dies WITH the
             # admission, or its record would pin in-flight entries and
             # occupy the double buffer for the rest of the trace
             rec = self._staged.pop(req.uid, None)
@@ -2093,6 +2254,12 @@ class ServingEngine:
         draft–verify) round, stage prefetches, audit.  Returns whether
         work remains — drive it in a loop (``serve``), from a replica
         worker thread (``deepspeed_tpu/serving/``), or by hand."""
+        if self._fault_injector is not None:
+            # chaos harness (serving/faults.py): may raise SimulatedCrash
+            # (the router/worker converts it into fail-and-re-home),
+            # stall this replica, or flip bits in the host arena — all on
+            # the armed plan's deterministic schedule
+            self._fault_injector.on_step(self)
         self._process_cancellations()
         if not self._pending and not self._active:
             if self._host is not None:
@@ -2174,6 +2341,58 @@ class ServingEngine:
                                   if self._host is not None else 0))
         return items
 
+    def salvage(self) -> List[_PendingItem]:
+        """Crash salvage (:meth:`ReplicaRouter.fail` — the hard twin of
+        :meth:`drain`): extract every live request's resume context using
+        HOST-SIDE bookkeeping only.  No device program runs and nothing
+        demotes — the engine is presumed crashed, so its device pool is
+        not to be trusted and its host tier is not exportable (survivors'
+        tiers are the KV-salvage source; the router pulls from them).
+        Active slots fold their already-streamed tokens into the resume
+        prompt (the preemption trick, so greedy resume on a survivor is
+        token-exact) and release their blocks in the host ownership
+        records, leaving the allocator/trie consistent for a later
+        restart + readmit.  Items return actives-first in admission
+        order, then the pending queue — the same hand-off order
+        :meth:`drain` produces.  Deferred cancel flags are honored: a
+        cancelled request resolves here instead of re-homing."""
+        cancels, self._cancel_flags = self._cancel_flags, set()
+        items: List[_PendingItem] = []
+        for slot in sorted(self._active,
+                           key=lambda s: self._active[s].admit_seq):
+            st = self._active[slot]
+            items.append(_PendingItem(
+                req=st.req, prior=st.prior + st.out, priority=st.priority,
+                slo_class=st.slo_class, eos=st.eos, handle=st.handle))
+        self._active.clear()
+        for slot in range(self.slots):
+            self._release_slot(slot)
+        items.extend(self._pending.drain())
+        if self._host is not None:
+            self._discard_all_staged()
+            self._prefetch_gate.clear()
+        self._blocked_gate = None
+        out: List[_PendingItem] = []
+        for item in items:
+            uid = item.req.uid
+            self._live_uids.discard(uid)
+            self._trace_times.pop(uid, None)
+            fid = self._flow_ids.pop(uid, None)
+            if fid is not None:
+                self.timeline.flow_end("route", fid, uid=str(uid),
+                                       salvaged=True)
+            if uid in cancels:
+                self._c_cancelled.inc()
+                self.timeline.instant("cancelled", uid=str(uid),
+                                      salvaged=True)
+                if item.handle is not None:
+                    item.handle._on_cancel()
+                continue
+            out.append(item)
+        self._g_queue_depth.set(0)
+        self.timeline.instant("salvage", items=len(out))
+        return out
+
     # ---------------------------------------------------- router probes/pull
     def affinity_probe(self, tokens) -> Dict[str, int]:
         """Routing probe (read-mostly, O(prompt)): leading full-block
@@ -2223,27 +2442,50 @@ class ServingEngine:
 
     def host_chain_export(self, tokens, start_block: int = 0,
                           max_tokens: Optional[int] = None):
-        """``(keys, per-block per-leaf byte COPIES)`` of the host-resident
-        run of ``tokens`` from ``start_block`` on — the cross-replica
-        KV-pull wire format (``HostBlockStore.export_chain``): the same
+        """``(keys, per-block per-leaf byte COPIES, checksums)`` of the
+        host-resident run of ``tokens`` from ``start_block`` on — the
+        cross-replica KV-pull wire format (``HostBlockStore
+        .export_chain`` + ``export_checksums``): the same
         content-addressed chain keys name the blocks on every replica,
-        and quantized ``{qp, ps}`` records travel as ordinary leaves so
-        int8 codes and scale rows move together, bit-identically."""
+        quantized ``{qp, ps}`` records travel as ordinary leaves so int8
+        codes and scale rows move together bit-identically, and the
+        per-block integrity checksums ride beside the bytes so the
+        importer verifies the transfer end-to-end.  Corrupt entries are
+        dropped before export (never exported); an armed fault plan may
+        raise :class:`~deepspeed_tpu.inference.paged.TransportError`
+        here — the router's pull retries with backoff."""
         if self._host is None:
-            return [], []
+            return [], [], []
+        if self._fault_injector is not None:
+            self._fault_injector.on_transport("export")
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         mt = int(tokens.size) if max_tokens is None else int(max_tokens)
         keys = self._host.probe_run(tokens, start_block, mt,
                                     self.block_size)
-        return keys, self._host.export_chain(keys)
+        if keys:
+            keys = self._verified_keys(keys)
+        return keys, self._host.export_chain(keys), \
+            self._host.export_checksums(keys)
 
-    def host_chain_import(self, keys, blocks) -> int:
+    def host_chain_import(self, keys, blocks, checksums=None) -> int:
         """Store a pulled chain into this replica's host tier (admission
         then promotes it on-device through the ordinary fixed-shape
-        scatter path).  Returns blocks stored."""
+        scatter path).  With ``checksums`` every arriving block re-hashes
+        against the exporter's record and a mismatch stops the import —
+        ticked into ``serving_checksum_failures_total``, the chain tail
+        recomputes locally.  Returns blocks stored."""
         if self._host is None or not keys:
             return 0
-        return self._host.import_chain(keys, blocks)
+        if self._fault_injector is not None:
+            self._fault_injector.on_transport("import")
+        before = self._host.checksum_rejects
+        n = self._host.import_chain(keys, blocks, checksums=checksums)
+        rejects = self._host.checksum_rejects - before
+        if rejects:
+            self._c_checksum_fail.inc(rejects)
+            self.timeline.instant("checksum_fail", op="import",
+                                  blocks=rejects)
+        return n
 
     # ----------------------------------------------------------- batch serve
     def serve(self, requests: Sequence[Request],

@@ -1,14 +1,23 @@
 """deepspeed_tpu.serving — the multi-replica serving front-end: a DP
-router over ``ServingEngine`` replicas (``router.py``) plus
-elastic-agent-style fleet supervision (``supervisor.py``).  The
-single-engine scheduler itself lives in ``inference/serving.py``; this
-package is the layer ABOVE it (host-side only — no compiled programs).
+router over ``ServingEngine`` replicas (``router.py``),
+elastic-agent-style fleet supervision (``supervisor.py``), and the
+deterministic chaos/fault-tolerance harness (``faults.py`` — seeded
+``FaultPlan`` injection, crash re-homing, integrity-checked transport,
+SLO-aware load shedding; docs/reliability.md).  The single-engine
+scheduler itself lives in ``inference/serving.py``; this package is the
+layer ABOVE it (host-side only — no compiled programs).
 """
 
-from ..inference.serving import (Request, RequestHandle,  # noqa: F401
-                                 SLO_PRIORITY, ServingEngine)
+from ..inference.paged import TransportError  # noqa: F401
+from ..inference.serving import (Request, RequestFailedError,  # noqa: F401
+                                 RequestHandle, SLO_PRIORITY,
+                                 ServingEngine)
+from .faults import (FaultInjector, FaultPlan,  # noqa: F401
+                     RequestRejected, SimulatedCrash)
 from .router import ReplicaRouter  # noqa: F401
 from .supervisor import RouterSupervisor  # noqa: F401
 
 __all__ = ["ReplicaRouter", "RouterSupervisor", "Request",
-           "RequestHandle", "ServingEngine", "SLO_PRIORITY"]
+           "RequestHandle", "ServingEngine", "SLO_PRIORITY",
+           "FaultPlan", "FaultInjector", "RequestRejected",
+           "RequestFailedError", "SimulatedCrash", "TransportError"]

@@ -87,6 +87,51 @@ violations raise at acquire time, contended-wait time lands in
 ``serving_lock_order_checks_total``, and ``stats()`` reports
 ``lock_order_checks`` / ``lock_violations`` (docs/static_analysis.md
 "graft-race").
+
+**Failure model** (``fail(rid)`` — the hard twin of ``drain``;
+docs/reliability.md): a replica that CRASHES mid-decode cannot run the
+polite drain protocol (its device state is not to be trusted and no
+program may run on it).  ``fail`` marks it dead without touching it,
+then re-homes every live request from its host-side bookkeeping
+(``ServingEngine.salvage``): pending items resubmit verbatim; in-flight
+requests fold their already-streamed tokens into the resume prompt (the
+preemption trick, cross-replica — greedy resume is token-exact) and
+pull whatever prefix blocks survive in *other* replicas' host tiers
+(the dead replica is excluded as a pull source), streaming onward on
+the SAME handles.  A request whose re-home budget (``max_rehomes``) is
+exhausted — or that has no live replica left to land on — resolves its
+handle with a typed
+:class:`~deepspeed_tpu.inference.serving.RequestFailedError` instead of
+hanging its caller.  Crashes are detected three ways: a worker thread's
+``step()`` raising (threaded mode), the deterministic ``step()`` loop
+catching :class:`~deepspeed_tpu.serving.faults.SimulatedCrash` (the
+chaos harness), and the supervisor's hard probe failure (capacity
+``< 0`` — process gone — fails immediately, no grace window;
+``serving/supervisor.py``).
+
+**Replica state machine** (transitions outside this table are loud
+no-ops, never crashes)::
+
+    state    | drain(rid)        | fail(rid)          | readmit(rid)
+    ---------+-------------------+--------------------+--------------
+    live     | -> drained        | -> failed (rehome) | no-op
+    drained  | no-op (log)       | -> failed (no work | -> live
+             |                   |    left to rehome) |
+    failed   | no-op (log: use   | no-op (log)        | -> live (clears
+             |  readmit instead) |                    |  fault record)
+
+**Load shedding** (``max_queue_depth`` / ``burn_threshold``;
+docs/reliability.md "Shedding policy"): admission is bounded.  When the
+fleet-wide pending depth reaches ``max_queue_depth`` — or a protected
+class's SLO error-budget burn rate crosses ``burn_threshold`` —
+``submit`` REJECTS ``shed_classes`` work (``batch`` by default) with a
+loud, typed :class:`~deepspeed_tpu.serving.faults.RequestRejected`
+instead of letting every class's latency collapse together; sheds tick
+``serving_requests_shed_total{slo_class=}`` and drop a ``shed``
+timeline event.  Higher classes keep admitting (the priority queue
+already ordered them first), so ``realtime``/``interactive`` TTFT holds
+while ``batch`` absorbs the rejections — the BENCH_r14 overload lane
+measures exactly this.
 """
 
 from __future__ import annotations
@@ -100,14 +145,22 @@ import numpy as np
 
 from ..analysis.concurrency import LockSanitizer, OrderedLock
 from ..analysis.invariants import audit_router
-from ..inference.paged import chain_keys
-from ..inference.serving import Request, RequestHandle, ServingEngine
+from ..inference.paged import TransportError, chain_keys
+from ..inference.serving import (Request, RequestFailedError,
+                                 RequestHandle, ServingEngine,
+                                 _PendingItem)
 from ..telemetry import (MetricsRegistry, TraceTimeline, federate,
                          merge_chrome_traces, merged_slo_report)
 from ..telemetry.server import MetricsServer
 from ..utils.logging import logger
+from .faults import FaultInjector, FaultPlan, RequestRejected, SimulatedCrash
 
 __all__ = ["ReplicaRouter"]
+
+#: SLO classes the burn-rate shed trigger protects: when one of THESE
+#: classes is burning error budget past ``burn_threshold``, shed-class
+#: work is rejected to shed load in its favor
+_PROTECTED_CLASSES = ("realtime", "interactive")
 
 _POLICIES = ("affinity", "balance", "round_robin")
 
@@ -133,12 +186,38 @@ class ReplicaRouter:
     debug_checks: audit router bookkeeping after every ``step`` (each
                 engine's own paged-state audit rides its
                 ``debug_checks`` flag as usual).
+    max_queue_depth: fleet-wide pending-queue bound; reaching it sheds
+                ``shed_classes`` submissions with a typed
+                :class:`RequestRejected` (``None`` = unbounded, no
+                shedding — the pre-PR-15 behavior).
+    shed_classes: the SLO classes that absorb rejections under overload
+                (module docstring "Load shedding").
+    burn_threshold: shed ``shed_classes`` work while any protected
+                class's SLO burn rate exceeds this (``None`` = depth
+                trigger only).
+    pull_retries: transient-transport retry budget per cross-replica KV
+                pull (exhaustion falls back to local recompute).
+    pull_backoff_s: base of the deterministic exponential backoff
+                between pull retries (``base * 2^attempt``; 0 = retry
+                immediately — the CPU-sim default).
+    pull_timeout_s: per-attempt wall budget on a pull; an attempt
+                running past it counts as a transient failure
+                (``None`` = no timeout).
+    max_rehomes: per-request crash re-home budget; past it the handle
+                resolves with :class:`RequestFailedError` instead of
+                bouncing between dying replicas forever.
     """
 
     def __init__(self, replicas: Sequence[ServingEngine], *,
                  policy: str = "affinity", kv_pull: bool = True,
                  threaded: bool = False, debug_checks: bool = False,
-                 trace_capacity: int = 4096):
+                 trace_capacity: int = 4096,
+                 max_queue_depth: Optional[int] = None,
+                 shed_classes: Sequence[str] = ("batch",),
+                 burn_threshold: Optional[float] = None,
+                 pull_retries: int = 2, pull_backoff_s: float = 0.0,
+                 pull_timeout_s: Optional[float] = None,
+                 max_rehomes: int = 3):
         replicas = list(replicas)
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
@@ -163,8 +242,24 @@ class ReplicaRouter:
         self.threaded = bool(threaded)
         self.debug_checks = bool(debug_checks)
         self._drained: set = set()
+        #: crash-failed replicas (⊆ _drained: failed implies out of
+        #: rotation) — excluded as KV-pull sources, cleared by readmit
+        self._failed: set = set()
         self._worker_errors: Dict[int, BaseException] = {}
         self._handles: Dict[Any, Tuple[RequestHandle, int]] = {}
+        #: per-request crash re-home count (pruned with the handle map)
+        self._rehomes: Dict[Any, int] = {}
+        self.max_rehomes = int(max_rehomes)
+        self.max_queue_depth = None if max_queue_depth is None \
+            else int(max_queue_depth)
+        self.shed_classes = tuple(shed_classes)
+        self.burn_threshold = None if burn_threshold is None \
+            else float(burn_threshold)
+        self.pull_retries = int(pull_retries)
+        self.pull_backoff_s = float(pull_backoff_s)
+        self.pull_timeout_s = pull_timeout_s
+        #: armed chaos harness (serving/faults.py); None = zero cost
+        self._injector: Optional[FaultInjector] = None
         self._rr = 0
         self.block_size = replicas[0].block_size
         #: chain_key -> last replica routed there (bounded LRU) — the
@@ -202,6 +297,24 @@ class ReplicaRouter:
         self._c_readmits = m.counter(
             "serving_readmits_total",
             "drained replicas re-admitted to routing")
+        self._c_failures = m.counter(
+            "serving_replica_failures_total",
+            "replica crash failures (fail(rid) — hard death, distinct "
+            "from polite drains)")
+        self._c_rehomed = m.counter(
+            "serving_requests_rehomed_total",
+            "requests re-homed onto survivors after a replica failure")
+        self._c_req_failed = m.counter(
+            "serving_requests_failed_total",
+            "requests permanently failed (re-home budget exhausted or "
+            "no live replica left) — handles resolve RequestFailedError")
+        self._c_pull_retries = m.counter(
+            "serving_kv_pull_retries_total",
+            "cross-replica KV-pull attempts retried after a transient "
+            "transport fault or per-attempt timeout")
+        #: per-class shed counters, created lazily on first shed so the
+        #: family only exists once shedding is actually configured
+        self._c_shed: Dict[str, Any] = {}
         self._g_blocks = [
             m.gauge("serving_replica_blocks_in_use",
                     "device KV blocks referenced on the replica",
@@ -371,10 +484,72 @@ class ReplicaRouter:
         self._note_hints(keys, rid)
         return rid, "balance", depth[rid]
 
+    def _pull_transfer_sync(self, src, tgt, prompt, start: int,
+                            plen: int) -> int:
+        """One hardened pull transfer under both replica locks (the
+        sanctioned blocking-transfer helper — the backoff sleep between
+        bounded retries is deliberate, exactly like the engine's
+        demote/promote waits): demote the source's device chain, export
+        bytes + checksums, import with verification on the target.
+        Transient faults and over-budget attempts retry with
+        deterministic exponential backoff (``pull_backoff_s *
+        2^attempt``); permanent faults and budget exhaustion return 0 —
+        the caller's admission path recomputes locally."""
+        for attempt in range(self.pull_retries + 1):
+            t0 = time.perf_counter()
+            try:
+                src.demote_chain(prompt, plen - 1, start_block=start)
+                keys, blocks, sums = src.host_chain_export(
+                    prompt, start, plen - 1)
+                stored = tgt.host_chain_import(keys, blocks,
+                                               checksums=sums)
+            except TransportError as e:
+                self.timeline.instant("kv_pull_fault", op=e.op,
+                                      attempt=attempt,
+                                      transient=e.transient)
+                if not e.transient:
+                    logger.warning(
+                        f"kv pull: permanent transport fault ({e}) — "
+                        "falling back to local recompute")
+                    return 0
+            else:
+                over = self.pull_timeout_s is not None and \
+                    time.perf_counter() - t0 > self.pull_timeout_s
+                if not over or stored:
+                    # landed (possibly late): a completed transfer is
+                    # never discarded — the timeout exists to retry
+                    # attempts that produced NOTHING, not to redo work
+                    if over:
+                        self.timeline.instant(
+                            "kv_pull_fault", op="timeout",
+                            attempt=attempt, transient=True, late=True)
+                    return stored
+                # over the per-attempt budget with nothing stored:
+                # treat as transient (the import is idempotent by chain
+                # key, a retry re-probes)
+                self.timeline.instant("kv_pull_fault", op="timeout",
+                                      attempt=attempt, transient=True)
+            if attempt < self.pull_retries:
+                self._c_pull_retries.inc()
+                if self.pull_backoff_s:
+                    time.sleep(self.pull_backoff_s * (2 ** attempt))
+        logger.warning(
+            f"kv pull: retry budget ({self.pull_retries}) exhausted — "
+            "falling back to local recompute")
+        return 0
+
     def _maybe_pull(self, rid: int, prompt) -> int:
         """Cross-replica KV pull (module docstring): extend the routed
         replica's resident chain for ``prompt`` from the deepest other
-        replica's tiers.  Returns blocks pulled."""
+        LIVE-TIERED replica's tiers — crash-failed replicas are never a
+        source (their host arenas died with their process).  The
+        transfer is hardened (docs/reliability.md): per-block checksums
+        travel beside the bytes and are verified on import, transient
+        :class:`TransportError`/per-attempt-timeout failures retry up
+        to ``pull_retries`` times with deterministic exponential
+        backoff, and a permanent fault (or an exhausted budget) falls
+        back to local recompute — the pull is an optimization, never a
+        correctness dependency.  Returns blocks pulled."""
         tgt = self.replicas[rid]
         if tgt._host is None or tgt._prefix is None:
             return 0
@@ -390,7 +565,8 @@ class ReplicaRouter:
             return 0
         best, best_depth = None, start
         for r in range(len(self.replicas)):
-            if r == rid or self.replicas[r]._host is None:
+            if r == rid or r in self._failed or \
+                    self.replicas[r]._host is None:
                 continue
             with self._locks[r]:
                 q = self.replicas[r].affinity_probe(prompt)
@@ -400,11 +576,10 @@ class ReplicaRouter:
         if best is None:
             return 0
         lo, hi = sorted((rid, best))        # lock order: replica index
+        src = self.replicas[best]
         with self._locks[lo], self._locks[hi]:
-            src = self.replicas[best]
-            src.demote_chain(prompt, plen - 1, start_block=start)
-            keys, blocks = src.host_chain_export(prompt, start, plen - 1)
-            stored = tgt.host_chain_import(keys, blocks)
+            stored = self._pull_transfer_sync(src, tgt, prompt, start,
+                                              plen)
         if stored:
             self._c_pulls.inc()
             self._c_pull_blocks.inc(stored)
@@ -427,6 +602,109 @@ class ReplicaRouter:
         if len(self._handles) > 64 + 4 * len(self.replicas):
             self._handles = {u: hr for u, hr in self._handles.items()
                              if not hr[0].done}
+            self._rehomes = {u: n for u, n in self._rehomes.items()
+                             if u in self._handles}
+
+    def _shed_counter(self, cls: str):
+        c = self._c_shed.get(cls)
+        if c is None:
+            c = self.metrics.counter(
+                "serving_requests_shed_total",
+                "requests rejected by SLO-class-aware load shedding "
+                "(bounded admission — docs/reliability.md)",
+                slo_class=cls)
+            self._c_shed[cls] = c
+        return c
+
+    #: burn-rate cache TTL: merging every replica's SLO report is
+    #: O(replicas x classes) — exactly the work NOT to repeat per
+    #: batch submit at the height of an overload burst.  Shedding is a
+    #: heuristic; a quarter-second-stale burn rate sheds the same way.
+    _BURN_TTL_S = 0.25
+    #: minimum fresh requests between refreshes for the WINDOWED burn
+    #: computation; thinner windows fall back to the lifetime rate
+    _BURN_WINDOW_MIN = 8
+
+    def _protected_burn(self):
+        """``(class, burn)`` for the worst-burning protected class with
+        traffic — computed from the merged fleet SLO report at most
+        every ``_BURN_TTL_S`` seconds (cached between, so a flood of
+        shed-class submits costs one dict read each, not a fleet-wide
+        histogram merge).  The burn is **windowed**: attainment is
+        computed over the requests finished since the previous refresh
+        (the multi-window burn-rate practice ``telemetry/slo.py``
+        cites), so shedding STOPS once the fleet recovers — a lifetime-
+        cumulative rate would keep rejecting batch work for thousands
+        of flawless requests after one past incident.  Windows thinner
+        than ``_BURN_WINDOW_MIN`` fresh requests fall back to the
+        lifetime rate (too few samples to call a recovery)."""
+        cached = getattr(self, "_burn_cache", None)
+        now = time.perf_counter()
+        if cached is not None and now - cached[0] <= self._BURN_TTL_S:
+            return cached[1], cached[2]
+        worst, worst_burn = None, 0.0
+        rep = self.slo_report()
+        prev = getattr(self, "_burn_prev", {})
+        cur = {}
+        for pc in _PROTECTED_CLASSES:
+            entry = rep.get(pc) or {}
+            n = int(entry.get("requests") or 0)
+            t_att = int(entry.get("ttft_attained") or 0)
+            p_att = int(entry.get("tpot_attained") or 0)
+            cur[pc] = (n, t_att, p_att)
+            if not n:
+                continue
+            pn, pt, pp = prev.get(pc, (0, 0, 0))
+            dn = n - pn
+            if dn >= self._BURN_WINDOW_MIN:
+                denom = max(1e-9, 1.0 - float(
+                    entry.get("objective") or 0.99))
+                burn = max((1.0 - (t_att - pt) / dn) / denom,
+                           (1.0 - (p_att - pp) / dn) / denom)
+            else:
+                # window still thin: keep the PREVIOUS anchor (so slow
+                # traffic accumulates a real window instead of
+                # degenerating back to lifetime forever) and use the
+                # lifetime rate meanwhile
+                cur[pc] = (pn, pt, pp) if pc in prev else cur[pc]
+                burn = max(entry.get("ttft_burn_rate") or 0.0,
+                           entry.get("tpot_burn_rate") or 0.0)
+            if worst is None or burn > worst_burn:
+                worst, worst_burn = pc, burn
+        self._burn_prev = cur
+        self._burn_cache = (now, worst, worst_burn)
+        return worst, worst_burn
+
+    def _maybe_shed(self, uid, slo_class: Optional[str]) -> None:
+        """Bounded admission (module docstring "Load shedding"), under
+        the fleet lock: raises :class:`RequestRejected` when this
+        submission's class is configured to absorb overload and a
+        threshold is tripped; otherwise a no-op.  Zero cost with
+        shedding unconfigured."""
+        if self.max_queue_depth is None and self.burn_threshold is None:
+            return
+        cls = slo_class if slo_class is not None else "standard"
+        if cls not in self.shed_classes:
+            return
+        reason = None
+        if self.max_queue_depth is not None:
+            depth = sum(len(self.replicas[r]._pending)
+                        for r in self._live())
+            if depth >= self.max_queue_depth:
+                reason = (f"fleet queue depth {depth} >= "
+                          f"max_queue_depth {self.max_queue_depth}")
+        if reason is None and self.burn_threshold is not None:
+            pc, burn = self._protected_burn()
+            if pc is not None and burn > self.burn_threshold:
+                reason = (f"{pc} SLO burn rate {burn:.2f} > "
+                          f"burn_threshold {self.burn_threshold}")
+        if reason is None:
+            return
+        self._shed_counter(cls).inc()
+        self.timeline.instant("shed", uid=str(uid), slo_class=cls,
+                              reason=reason)
+        logger.warning(f"shedding request {uid!r} ({cls}): {reason}")
+        raise RequestRejected(uid, slo_class, reason)
 
     def submit(self, request: Request, *, priority: int = 0,
                slo_class: Optional[str] = None,
@@ -435,12 +713,17 @@ class ReplicaRouter:
         returns the engine's :class:`RequestHandle` (streaming /
         ``result()`` / ``cancel()`` — cancel routes back through the
         router so it lands on whichever replica owns the request after
-        any drain handoffs)."""
+        any drain handoffs).  With shedding configured
+        (``max_queue_depth`` / ``burn_threshold``), an overloaded fleet
+        rejects ``shed_classes`` submissions with a typed
+        :class:`RequestRejected` instead of queueing them into latency
+        collapse."""
         if self._submit_observer is not None:
             self._submit_observer(request, priority=priority,
                                   slo_class=slo_class,
                                   eos_token_id=eos_token_id)
         with self._fleet_lock:
+            self._maybe_shed(request.uid, slo_class)
             rid, why, depth = self._route(request.prompt)
             if why == "affinity":
                 self._c_aff.inc()
@@ -490,13 +773,22 @@ class ReplicaRouter:
         more = False
         for rid in self._live():
             rep = self.replicas[rid]
-            with self._locks[rid]:
-                had_work = bool(rep._pending or rep._active or
-                                rep._cancel_flags)
-                t0 = time.perf_counter()
-                m = rep.step()
-                if had_work:
-                    self._busy_s[rid] += time.perf_counter() - t0
+            try:
+                with self._locks[rid]:
+                    had_work = bool(rep._pending or rep._active or
+                                    rep._cancel_flags)
+                    t0 = time.perf_counter()
+                    m = rep.step()
+                    if had_work:
+                        self._busy_s[rid] += time.perf_counter() - t0
+            except SimulatedCrash as e:
+                # the chaos harness killed this replica mid-iteration:
+                # exactly a worker death — fail it and re-home.  Real
+                # engine exceptions still propagate in deterministic
+                # mode (they are bugs, not chaos).
+                self._fail_replica(rid, e)
+                more = True
+                continue
             more = m or more
             self._refresh_gauges(rid)
         # the handle map is fleet state: pruning it unlocked would race
@@ -548,24 +840,224 @@ class ReplicaRouter:
                 time.sleep(0.001)           # idle: yield the core
 
     def _fail_replica(self, rid: int, exc: BaseException) -> None:
-        """A replica's scheduler raised: record the fault, stop routing
-        to it, and cancel every request it still holds so no handle
-        blocks forever on an engine nothing will step again.  The engine
-        state may be inconsistent past the raise, so nothing is handed
-        off — callers see ``cancelled`` and can resubmit."""
-        logger.error(f"replica {rid} worker died: {exc!r} — draining it "
-                     "out of routing and cancelling its requests")
+        """A replica's scheduler raised (worker thread death or a
+        :class:`SimulatedCrash` in deterministic stepping): record the
+        fault and run the crash protocol — :meth:`fail` pulls the
+        replica out of routing and re-homes its live requests onto
+        survivors, so streams continue on the same handles and only an
+        exhausted re-home budget resolves a handle with
+        :class:`RequestFailedError`."""
+        logger.error(f"replica {rid} worker died: {exc!r} — failing it "
+                     "out of routing and re-homing its requests")
         with self._fleet_lock:
             self._worker_errors[rid] = exc
-            self._drained.add(rid)
-            rep = self.replicas[rid]
-            victims = [item.handle for item in rep._pending] + \
+        self.fail(rid)
+
+    def fail(self, rid: int) -> int:
+        """Mark replica ``rid`` crash-dead WITHOUT touching its engine
+        (no drain, no demotion, no device program — its device state is
+        not to be trusted), then re-home every live request it held
+        (module docstring "Failure model"): host-side salvage
+        (``ServingEngine.salvage`` — streamed tokens fold into resume
+        prompts), re-route onto survivors with KV pulls from *their*
+        host tiers, streams continuing on the SAME handles.  Requests
+        whose re-home budget is exhausted (or with no live replica
+        left) resolve their handles with a typed
+        :class:`RequestFailedError`.  Idempotent per the state table in
+        the module docstring: ``fail`` on a failed replica and ``fail``
+        on a drained (quiesced) replica are loud no-ops for the re-home
+        step.  Returns the number of requests re-homed."""
+        with self._fleet_lock:
+            if rid in self._failed:
+                logger.warning(f"fail({rid}): replica already failed — "
+                               "no-op")
+                return 0
+            was_drained = rid in self._drained
+            self._failed.add(rid)
+            self._drained.add(rid)          # out of routing and stepping
+            self._c_failures.inc()
+            self.timeline.instant("replica_fail", replica=int(rid),
+                                  was_drained=bool(was_drained))
+            if was_drained:
+                # drain already quiesced it: nothing lives there to
+                # re-home; recording the death still matters (excluded
+                # as a pull source, readmit must clear the fault)
+                logger.warning(
+                    f"fail({rid}): replica was already drained "
+                    "(quiesced) — marking failed, nothing to re-home")
+                items = []
+            else:
+                salvage = getattr(self.replicas[rid], "salvage", None)
+                try:
+                    with self._locks[rid]:
+                        items = salvage() if salvage is not None \
+                            else self._fallback_salvage(rid)
+                except Exception as e:      # noqa: BLE001 — must not hang
+                    # the crash left even the HOST bookkeeping
+                    # inconsistent (exactly the state the paged audits
+                    # exist to catch) and salvage tripped over it: the
+                    # resume contexts are unrecoverable, but the one
+                    # inviolable rule stands — no caller may hang.
+                    # Resolve every handle the corpse references LOUDLY
+                    # and scrub the queue/active maps so the audit's
+                    # zero-uids invariant holds.
+                    logger.error(
+                        f"fail({rid}): salvage itself failed ({e!r}) — "
+                        "resolving the replica's handles as failed "
+                        "instead of re-homing")
+                    items = self._scrub_unsalvageable(rid, e)
+                for r in self._live():
+                    # migrated sessions promote on the survivors next —
+                    # same warm-up as drain (no-op without a host tier)
+                    with self._locks[r]:
+                        self.replicas[r].warm_swap_programs()
+            rehomed = self._rehome_items(items, rid)
+        self._refresh_gauges(rid)
+        return rehomed
+
+    def _fallback_salvage(self, rid: int) -> list:
+        """Salvage for duck-typed replicas without a ``salvage()``
+        method (called under the replica lock): extract ACTIVE requests
+        too, not just the queue — an active request left behind would
+        hang its caller forever, the exact failure mode ``fail`` exists
+        to prevent.  Streamed tokens fold into the resume prior exactly
+        like the engine's own salvage; the replica's deeper state is its
+        own problem (it is dead)."""
+        rep = self.replicas[rid]
+        items = []
+        for slot in sorted(rep._active,
+                           key=lambda s: getattr(rep._active[s],
+                                                 "admit_seq", s)):
+            st = rep._active[slot]
+            items.append(_PendingItem(
+                req=st.req,
+                prior=list(getattr(st, "prior", [])) +
+                list(getattr(st, "out", [])),
+                priority=getattr(st, "priority", 0),
+                slo_class=getattr(st, "slo_class", None),
+                eos=getattr(st, "eos", None),
+                handle=getattr(st, "handle", None)))
+        rep._active.clear()
+        items.extend(rep._pending.drain())
+        return items
+
+    def _scrub_unsalvageable(self, rid: int, exc: BaseException) -> list:
+        """Last-resort crash path (salvage raised): fail every handle
+        the dead replica still references with a typed
+        :class:`RequestFailedError` and empty its queue/active maps —
+        the engine's deeper state stays garbage (it is dead and needs a
+        restart before readmit), but no caller hangs and the router
+        audit's zero-uids invariant holds.  Returns an empty hand-off
+        list."""
+        rep = self.replicas[rid]
+        with self._locks[rid]:
+            victims = [it.handle for it in rep._pending] + \
                 [st.handle for st in rep._active.values()]
-        self.timeline.instant("replica_failed", replica=int(rid),
-                              error=repr(exc))
-        for handle in victims:
+            uids = [it.req.uid for it in rep._pending] + \
+                [st.req.uid for st in rep._active.values()]
+            rep._pending.drain()
+            rep._active.clear()
+            live = getattr(rep, "_live_uids", None)
+            if live is not None:
+                live.clear()
+        for uid, handle in zip(uids, victims):
+            self._c_req_failed.inc()
+            self.timeline.instant("request_failed", uid=str(uid),
+                                  reason="salvage failed")
             if handle is not None and not handle.done:
-                handle._on_cancel()
+                handle._on_fail(RequestFailedError(
+                    uid, f"replica {rid} crashed and salvage failed: "
+                         f"{exc!r}"))
+            self._handles.pop(uid, None)
+        return []
+
+    def _handoff_item(self, item, flow_arg: str) -> Tuple[int, str, int]:
+        """Route one handed-off pending item onto a live replica — the
+        shared half of BOTH hand-off protocols (drain re-route and
+        crash re-home, so a change to hand-off routing can never apply
+        to one and silently desynchronize the other): route + policy
+        counters, optional KV pull, flow start, enqueue via
+        ``_submit_item`` with the ROUTER's canceller (no window where a
+        cancel routes around the fleet locks straight into a bare
+        engine), handle-map update, gauges.  The caller emits its own
+        protocol event (``route resumed=True`` / ``rehome``).  Returns
+        ``(replica, policy_used, depth)``."""
+        prompt_eff = np.concatenate(
+            [item.req.prompt, np.asarray(item.prior, np.int32)]) \
+            if item.prior else item.req.prompt
+        new_rid, why, depth = self._route(prompt_eff)
+        if why == "affinity":
+            self._c_aff.inc()
+        else:
+            self._c_bal.inc()
+        if self.kv_pull:
+            self._maybe_pull(new_rid, prompt_eff)
+        with self._locks[new_rid]:
+            self._start_route_flow(new_rid, item.req.uid,
+                                   **{flow_arg: True})
+            self.replicas[new_rid]._submit_item(item,
+                                                canceller=self.cancel)
+        if item.handle is not None:
+            self._handles[item.req.uid] = (item.handle, new_rid)
+        self._refresh_gauges(new_rid)
+        return new_rid, why, depth
+
+    def _rehome_items(self, items, from_rid: int) -> int:
+        """Re-home salvaged requests onto live replicas (under the fleet
+        lock): route each (affinity first — its session prefix may be
+        resident or pullable on a survivor), pull KV, and hand the item
+        over with its handle intact.  Per-request ``max_rehomes``
+        budgets and a replica-less fleet resolve handles with
+        :class:`RequestFailedError` — LOUD failure, never a hang."""
+        rehomed = 0
+        for item in items:
+            uid = item.req.uid
+            n = self._rehomes.get(uid, 0)
+            live = self._live()
+            if not live or n >= self.max_rehomes:
+                reason = "no live replica left to take it" if not live \
+                    else f"re-home budget exhausted ({n} prior re-homes)"
+                self._c_req_failed.inc()
+                self.timeline.instant("request_failed", uid=str(uid),
+                                      reason=reason)
+                logger.error(f"request {uid!r} permanently failed: "
+                             f"{reason}")
+                if item.handle is not None:
+                    item.handle._on_fail(RequestFailedError(uid, reason))
+                self._handles.pop(uid, None)
+                continue
+            self._rehomes[uid] = n + 1
+            new_rid, why, depth = self._handoff_item(item, "rehomed")
+            self._c_rehomed.inc()
+            rehomed += 1
+            self.timeline.instant("rehome", uid=str(uid),
+                                  src=int(from_rid), dst=int(new_rid),
+                                  policy=why, depth_blocks=int(depth),
+                                  prior_tokens=len(item.prior))
+        return rehomed
+
+    def arm_faults(self, plan) -> FaultInjector:
+        """Arm a chaos plan fleet-wide (``serving/faults.py``): builds
+        the :class:`FaultInjector` (or takes one) and binds a per-replica
+        view onto every engine.  Returns the injector — its ``report()``
+        reconciles injected faults against recovery telemetry.  Zero
+        cost until armed; :meth:`disarm_faults` restores it."""
+        inj = plan if isinstance(plan, FaultInjector) else \
+            FaultInjector(plan if isinstance(plan, FaultPlan)
+                          else FaultPlan.from_json(plan))
+        self._injector = inj
+        for rid, rep in enumerate(self.replicas):
+            arm = getattr(rep, "arm_faults", None)
+            if arm is not None:
+                arm(inj.bind(rid))
+        return inj
+
+    def disarm_faults(self) -> None:
+        self._injector = None
+        for rep in self.replicas:
+            arm = getattr(rep, "arm_faults", None)
+            if arm is not None:
+                arm(None)
 
     def stop(self) -> None:
         self._stop_evt.set()
@@ -602,9 +1094,19 @@ class ReplicaRouter:
         every handed-off request onto live replicas — each with a KV pull
         for its chain, so the migrated sessions resume with zero prefix
         recompute.  Token streams continue on the original handles.
-        Returns the number of requests handed off."""
+        Returns the number of requests handed off.  Idempotent per the
+        module-docstring state table: draining an already-drained or
+        crash-failed replica is a loud no-op, never a crash."""
         with self._fleet_lock:
+            if rid in self._failed:
+                logger.warning(
+                    f"drain({rid}): replica is crash-failed (already "
+                    "out of rotation; readmit after a restart instead) "
+                    "— no-op")
+                return 0
             if rid in self._drained:
+                logger.warning(f"drain({rid}): replica already drained "
+                               "— no-op")
                 return 0
             if len(self._live()) <= 1:
                 raise RuntimeError(
@@ -623,33 +1125,11 @@ class ReplicaRouter:
             self.timeline.instant("drain", replica=int(rid),
                                   handoff=len(items))
             for item in items:
-                prompt_eff = np.concatenate(
-                    [item.req.prompt, np.asarray(item.prior, np.int32)]) \
-                    if item.prior else item.req.prompt
-                new_rid, why, depth = self._route(prompt_eff)
-                if why == "affinity":
-                    self._c_aff.inc()
-                else:
-                    self._c_bal.inc()
-                if self.kv_pull:
-                    self._maybe_pull(new_rid, prompt_eff)
-                with self._locks[new_rid]:
-                    self._start_route_flow(new_rid, item.req.uid,
-                                           resumed=True)
-                    # the handle keeps routing cancels through the
-                    # router (fleet + replica locks) — handed straight
-                    # to _submit_item so there is no window where a
-                    # cancel could land on the bare engine a worker is
-                    # stepping
-                    self.replicas[new_rid]._submit_item(
-                        item, canceller=self.cancel)
-                if item.handle is not None:
-                    self._handles[item.req.uid] = (item.handle, new_rid)
+                new_rid, why, depth = self._handoff_item(item, "resumed")
                 self.timeline.instant("route", uid=str(item.req.uid),
                                       replica=int(new_rid), policy=why,
                                       depth_blocks=int(depth),
                                       resumed=True)
-                self._refresh_gauges(new_rid)
         self._refresh_gauges(rid)
         return len(items)
 
@@ -664,8 +1144,10 @@ class ReplicaRouter:
         respawn = False
         with self._fleet_lock:
             if rid not in self._drained:
+                logger.warning(f"readmit({rid}): replica is live — no-op")
                 return
             self._drained.discard(rid)
+            self._failed.discard(rid)       # fault record dies with this
             respawn = self._worker_errors.pop(rid, None) is not None \
                 and bool(self._threads)
             self._c_readmits.inc()
@@ -680,6 +1162,12 @@ class ReplicaRouter:
     @property
     def drained(self) -> List[int]:
         return sorted(self._drained)
+
+    @property
+    def failed(self) -> List[int]:
+        """Crash-failed replicas (⊆ :attr:`drained`): out of rotation,
+        excluded as KV-pull sources, cleared only by :meth:`readmit`."""
+        return sorted(self._failed)
 
     # -------------------------------------------------------- fleet telemetry
     def _all_locks(self):
@@ -804,8 +1292,17 @@ class ReplicaRouter:
             "kv_pulls": int(self._c_pulls.value),
             "kv_pull_blocks": int(self._c_pull_blocks.value),
             "kv_pull_bytes": int(self._c_pull_bytes.value),
+            "kv_pull_retries": int(self._c_pull_retries.value),
             "drains": int(self._c_drains.value),
             "readmits": int(self._c_readmits.value),
+            # failure/recovery surface (docs/reliability.md): crash
+            # fails, re-homed/permanently-failed requests, sheds by class
+            "failed": self.failed,
+            "replica_failures": int(self._c_failures.value),
+            "requests_rehomed": int(self._c_rehomed.value),
+            "requests_failed": int(self._c_req_failed.value),
+            "requests_shed": {cls: int(c.value)
+                              for cls, c in sorted(self._c_shed.items())},
             "lock_order_checks": int(self._sanitizer.checks)
             if self._sanitizer is not None else 0,
             "lock_violations": int(self._sanitizer.violations)

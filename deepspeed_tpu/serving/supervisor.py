@@ -21,6 +21,19 @@ consecutive ticks.  The supervisor only re-admits replicas it drained
 itself: an operator's manual ``router.drain()`` stays drained until the
 operator says otherwise.
 
+**Slow vs dead** (docs/reliability.md): a missed probe (capacity 0, or
+absent from a list result) means *maybe slow* — the grace window plus a
+graceful ``drain`` apply, because draining runs device programs on the
+replica and only makes sense while it still works.  A capacity ``< 0``
+means *definitely dead* — the probe saw the process GONE (the launcher's
+worker monitor, a kernel-level liveness check), so the grace window is
+skipped and the replica is failed IMMEDIATELY via ``router.fail(rid)``:
+its requests re-home from host-side salvage without touching the corpse
+(``serving/router.py`` "Failure model").  ``launcher/runner.py --serve``
+closes the loop at the process level: a dead replica worker is
+restarted individually (the survivors keep serving) and a recovered
+probe re-admits it here.
+
 Tick-driven on purpose (``tick()`` — no sleeps, no threads): tests and
 embedding loops drive it explicitly; ``run()`` adds the wall-clock loop
 for standalone use.
@@ -90,25 +103,53 @@ class RouterSupervisor:
             self.router.metrics_server.stop()
             self.router.metrics_server = None
 
-    def _probe(self) -> set:
+    def _probe(self) -> tuple:
+        """``(live, hard_dead)`` replica-id sets: capacity ``> 0`` is
+        live, ``0`` (or list absence) is a soft miss subject to grace,
+        ``< 0`` is a hard probe failure — the process is GONE and the
+        replica fails immediately (module docstring "Slow vs dead")."""
         res = self.probe_replicas()
         if isinstance(res, Mapping):
-            return {int(r) for r, c in res.items() if c > 0}
-        return {int(r) for r in res}
+            return ({int(r) for r, c in res.items() if c > 0},
+                    {int(r) for r, c in res.items() if c < 0})
+        return {int(r) for r in res}, set()
 
     def tick(self) -> Dict[str, List[int]]:
         """One supervision round; returns ``{"drained": [...],
-        "readmitted": [...]}`` for this tick.  Serialized under the
-        supervisor lock (``run()`` on a thread and a directly-driven
-        ``tick()`` must not interleave their grace-tick accounting)."""
+        "failed": [...], "readmitted": [...]}`` for this tick.
+        Serialized under the supervisor lock (``run()`` on a thread and
+        a directly-driven ``tick()`` must not interleave their
+        grace-tick accounting)."""
         with self._sup_lock:
             return self._tick_locked()
 
     def _tick_locked(self) -> Dict[str, List[int]]:
         self.ticks += 1
-        live = self._probe()
-        actions: Dict[str, List[int]] = {"drained": [], "readmitted": []}
+        live, hard_dead = self._probe()
+        actions: Dict[str, List[int]] = {"drained": [], "failed": [],
+                                         "readmitted": []}
         for rid in range(len(self.router.replicas)):
+            if rid in hard_dead:
+                # process gone: no grace window, no graceful drain (the
+                # corpse cannot run the demotion programs drain needs) —
+                # fail NOW so its sessions re-home from host-side salvage
+                self._down_ticks.pop(rid, None)
+                if rid not in self.router._failed:
+                    was_operator_drained = \
+                        rid in self.router._drained and \
+                        rid not in self._drained_by_us
+                    rehomed = self.router.fail(rid)
+                    if not was_operator_drained:
+                        # same claim rule as drains: an OPERATOR-drained
+                        # replica that then died stays out of rotation
+                        # until the operator re-admits it
+                        self._drained_by_us.add(rid)
+                    actions["failed"].append(rid)
+                    logger.error(
+                        f"supervisor: replica {rid} hard probe failure "
+                        f"(process gone) — failed immediately, {rehomed} "
+                        "request(s) re-homed")
+                continue
             if rid not in self.router._drained:
                 # not drained (any more) — whoever re-admitted it, our
                 # claim on it is over; a STALE claim here would make a

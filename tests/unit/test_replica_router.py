@@ -332,7 +332,7 @@ def test_router_drain_hands_off_and_supervisor_grace():
     live = {0: 1, 1: 1}
     sup = RouterSupervisor(router, lambda: live, grace_ticks=1)
     router.readmit(0)
-    assert sup.tick() == {"drained": [], "readmitted": []}
+    assert sup.tick() == {"drained": [], "failed": [], "readmitted": []}
     live = {0: 1, 1: 0}                          # replica 1 goes dark
     assert sup.tick()["drained"] == []           # within grace
     assert sup.tick()["drained"] == [1]          # grace expired
@@ -378,10 +378,11 @@ def test_supervisor_survives_fleet_wide_outage():
     assert router.drained == []
 
 
-def test_threaded_worker_failure_fails_replica_not_silence():
+def test_threaded_worker_failure_rehomes_not_silence():
     """A replica whose step() raises must not die silently: the router
-    pulls it out of routing, records the fault, and cancels its handles
-    so no caller blocks forever."""
+    pulls it out of routing, records the fault, and RE-HOMES its
+    requests onto survivors (PR 15 crash protocol) so every caller gets
+    a result — nobody blocks forever, nothing is dropped."""
     class _Exploding(_FakeReplica):
         def step(self):
             raise RuntimeError("boom")
@@ -397,12 +398,14 @@ def test_threaded_worker_failure_fails_replica_not_silence():
             h.result(timeout=10)                 # nobody blocks forever
     finally:
         router.stop()
-    assert 0 in router.drained and 0 in router._worker_errors
-    on_bad = [h for h in handles if h.status == "cancelled"]
-    on_good = [h for h in handles if h.status == "finished"]
-    assert on_bad and on_good and len(on_bad) + len(on_good) == 4
+    assert 0 in router.drained and 0 in router.failed
+    assert 0 in router._worker_errors
+    assert all(h.status == "finished" for h in handles)
+    st = router.stats()
+    assert st["replica_failures"] == 1
+    assert st["requests_rehomed"] >= 1 and st["requests_failed"] == 0
     router.readmit(0)                            # operator says healthy
-    assert 0 not in router._worker_errors
+    assert 0 not in router._worker_errors and router.failed == []
 
 
 def test_router_audit_fault_injection():

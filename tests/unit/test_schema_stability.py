@@ -71,13 +71,18 @@ CONFIG_KEYS = frozenset({
 })
 
 #: ReplicaRouter.stats() — PR 11 keys + PR 12's "metrics_endpoint" +
-#: PR 14's lock-sanitizer counters (0 when debug_checks is off)
+#: PR 14's lock-sanitizer counters (0 when debug_checks is off) +
+#: PR 15's failure/recovery surface ("failed" replica list, crash and
+#: re-home counters, typed-failure count, pull retries, per-class sheds)
 ROUTER_STATS_KEYS = frozenset({
-    "busy_s", "drained", "drains", "generated_tokens", "kv_pull",
-    "kv_pull_blocks", "kv_pull_bytes", "kv_pulls", "lock_order_checks",
+    "busy_s", "drained", "drains", "failed", "generated_tokens",
+    "kv_pull", "kv_pull_blocks", "kv_pull_bytes", "kv_pull_retries",
+    "kv_pulls", "lock_order_checks",
     "lock_violations", "metrics_endpoint",
     "per_replica", "policy", "prefix_cache_hit_rate", "prompt_tokens",
-    "readmits", "replicas", "routed_affinity", "routed_balance",
+    "readmits", "replica_failures", "replicas", "requests_failed",
+    "requests_rehomed", "requests_shed", "routed_affinity",
+    "routed_balance",
 })
 
 PER_REPLICA_KEYS = frozenset({

@@ -186,3 +186,39 @@ def test_serving_bench_telemetry_lane(tmp_path):
     assert "serving_ttft_seconds_bucket" in text
     snap = json.load(open(str(prom) + ".json"))
     assert snap["serving_requests_admitted_total"]["series"][0]["value"] > 0
+
+
+def test_serving_bench_chaos_lane():
+    """BENCH_r14 (PR 15, docs/reliability.md): the chaos protocol's
+    deterministic gates at test scale — crash re-homing parity vs the
+    fault-free twin with zero hung handles, flaky-transport pulls
+    landing through retries, 100% checksum detection of injected
+    host-arena corruption (exit gates + patrol scrub), and the shed
+    lane rejecting only batch-class work.  The wall-clock 1.5x
+    protected-TTFT contract is recorded in the JSON (pinned by the
+    committed BENCH_r14.json, not asserted here — shared-box noise)."""
+    import serving_bench
+
+    res = serving_bench.run_chaos_bench(
+        requests=16, slots=4, layers=1, hidden=64, heads=4, vocab=512,
+        seed=0, prefix_len=96, sessions=6, swap_batch=4,
+        quantize=("kv8",))
+    assert res["token_parity"], res["mismatched"]
+    crash = res["crash"]
+    assert crash["hung_handles"] == 0 and crash["unfinished"] == 0
+    assert crash["requests_rehomed"] >= 1
+    assert crash["requests_failed"] == 0
+    assert crash["parity_exact_vs_faultfree"]
+    assert crash["compile_budgets_ok"]
+    assert crash["recovery_latency_s"] is not None
+    assert res["crash_kv8"]["bit_exact_vs_unfaulted_kv8"]
+    flk = res["flaky_transport"]
+    assert flk["pulls_landed_through_retries"]
+    assert flk["transport_faults_injected"]["transient"] >= 1
+    corr = res["corruption"]
+    assert corr["detected_100pct"], corr
+    assert corr["recovered_via_recompute_parity"]
+    shed = res["overload_shed"]
+    assert shed["batch_absorbed_all_rejections"]
+    assert shed["protected_shed"] == 0
+    assert shed["protected_finished"] == shed["protected_requests"]

@@ -420,3 +420,58 @@ def test_router_kv_pull_tp4_kv8_composition(tp4_engine, tiny_cfg):
     assert st["kv_pulls"] >= 1 and st["kv_pull_blocks"] >= 3
     assert all(p["compile_count"] <= p["compile_budget"]
                for p in st["per_replica"])
+
+
+def test_chaos_crash_rehoming_tp4_parity(tp4_engine, tiny_cfg):
+    """PR 15 chaos x tp composition: a seeded FaultPlan kills one of two
+    tp=4 replicas mid-decode — every request completes on the survivor
+    token-exactly vs the fault-free tp=4 fleet, with clean post-failure
+    audits and budgets intact (the 8-device chaos lane of the chaos
+    parity gate)."""
+    from deepspeed_tpu.serving import FaultPlan, ReplicaRouter
+
+    kw = dict(slots=3, max_seq_len=64, block_size=8, prefill_chunk=16,
+              prefill_batch=2, host_blocks=32, swap_batch=4,
+              debug_checks=True)
+    rng = np.random.default_rng(31)
+    prefixes = [rng.integers(0, tiny_cfg.vocab_size, 24)
+                for _ in range(2)]
+    reqs = [Request(uid=i,
+                    prompt=np.concatenate(
+                        [prefixes[i % 2],
+                         rng.integers(0, tiny_cfg.vocab_size,
+                                      int(rng.integers(3, 8)))]),
+                    max_new_tokens=10) for i in range(6)]
+
+    def _fleet():
+        deepspeed_tpu.comm.reset_topology()
+        peer = deepspeed_tpu.init_inference(
+            gpt2.build(tiny_cfg),
+            config={"dtype": "fp32", "tensor_parallel": {"tp_size": 4}},
+            params=tp4_engine.params)
+        reps = [ServingEngine(tp4_engine, **kw),
+                ServingEngine(peer, **kw)]
+        assert all(r.kv_sharded and r.tp_degree == 4 for r in reps)
+        return ReplicaRouter(reps, debug_checks=True)
+
+    free = _fleet()
+    outs_free = free.serve(reqs)
+
+    router = _fleet()
+    inj = router.arm_faults(FaultPlan(
+        seed=0, crashes=[{"replica": 1, "at_step": 4}]))
+    handles = [router.submit(r) for r in reqs]
+    while router.step():
+        pass
+    assert inj.report()["crashes_fired"] == [{"replica": 1, "step": 4}]
+    for r, h in zip(reqs, handles):
+        assert h.status == "finished", (r.uid, h.status)
+        np.testing.assert_array_equal(h.result(timeout=0),
+                                      outs_free[r.uid],
+                                      err_msg=f"uid {r.uid}")
+    st = router.stats()
+    assert st["failed"] == [1] and st["requests_failed"] == 0
+    assert all(p["compile_count"] <= p["compile_budget"]
+               for p in st["per_replica"])
+    from deepspeed_tpu.analysis.invariants import audit_router
+    audit_router(router)
