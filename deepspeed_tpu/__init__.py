@@ -207,7 +207,8 @@ def init_router(model=None, config=None, params=None, *, replicas=2,
 def init_serving(model=None, config=None, params=None, *, slots=8,
                  max_seq_len=None, prompt_buckets=None, prefill_batch=4,
                  block_size=32, num_blocks=None, chunked_prefill=None,
-                 prefill_chunk=128, prefix_caching=True, spec_tokens=0,
+                 prefill_chunk=128, prefix_caching=True, decode_steps=1,
+                 engine_mode="replicas", spec_tokens=0,
                  quantize=None, host_blocks=0, swap_batch=8, draft=None,
                  ngram_max=3, ngram_min=1,
                  shard_kv=None, topology=None, debug_checks=False,
@@ -222,6 +223,16 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
     prefill program) — instead of ``generate``'s run-to-longest static
     batches.  Passing ``prompt_buckets`` selects the bucket-ladder prefill
     fallback (no prefix reuse).
+
+    ``decode_steps=K`` fuses K decode iterations into ONE on-device
+    ``lax.while_loop`` program (the host-loop kill): per-slot eos/budget
+    checks run on device behind a fixed-shape active mask and the host
+    catches up once per window at the fence — token-exact with K=1 greedy
+    decode, ~K× fewer Python scheduler iterations per generated token.
+    ``engine_mode="dp_tp"`` runs ONE engine over the 2-D ``("dp","tp")``
+    mesh (slots + KV blocks dp-sharded, KV heads tp-sharded): one
+    compiled decode program serves what otherwise takes dp router-fronted
+    replicas.  See docs/inference.md "Multi-step fused decode".
 
     ``spec_tokens=K`` turns on speculative decoding (chunked mode only):
     each decode iteration drafts K tokens per slot — with a small
@@ -332,6 +343,7 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
                          chunked_prefill=chunked_prefill,
                          prefill_chunk=prefill_chunk,
                          prefix_caching=prefix_caching,
+                         decode_steps=decode_steps, engine_mode=engine_mode,
                          spec_tokens=spec_tokens, quantize=quantize,
                          host_blocks=host_blocks, swap_batch=swap_batch,
                          draft=draft,

@@ -120,7 +120,8 @@ def audit_paged_state(allocator, tables, held, *,
                       prefix=None,
                       active_needs: Optional[Dict[int, int]] = None,
                       block_size: int = 1,
-                      scale_live=None) -> None:
+                      scale_live=None,
+                      scratch_blocks=None) -> None:
     """Verify every invariant over one engine's host state; raises
     :class:`PagedStateError` naming the first violated invariant.
 
@@ -137,11 +138,19 @@ def audit_paged_state(allocator, tables, held, *,
     scale_live:    optional set of block ids whose int8-KV scale rows are
                    live (``quantize="kv8"`` engines); ``None`` skips the
                    ``scale-lockstep`` check entirely.
+    scratch_blocks: the set of reserved scratch block ids — default
+                   ``{SCRATCH_BLOCK}``; a dp_tp engine passes every dp
+                   group's base block (``inference/serving.py``).  Block
+                   id 0 stays the table-wide "unset" sentinel either way;
+                   a NONZERO scratch id appearing in a table span is an
+                   error in its own right.
     """
     ref, free = allocator.snapshot()
     num_blocks = allocator.num_blocks
     entries = prefix.entries() if prefix is not None else []
     active_needs = active_needs or {}
+    scratch = frozenset(int(b) for b in scratch_blocks) \
+        if scratch_blocks is not None else frozenset({SCRATCH_BLOCK})
 
     # ---- refcount-conservation: owners (held lists + trie) == refcounts
     expected = [0] * num_blocks
@@ -161,7 +170,7 @@ def audit_paged_state(allocator, tables, held, *,
                 f"{e.block} (pool has {num_blocks})")
         expected[int(e.block)] += 1
     for b in range(num_blocks):
-        if b == SCRATCH_BLOCK:
+        if b in scratch:
             continue
         if ref[b] != expected[b]:
             kind = "leaked (unreclaimable)" if ref[b] > expected[b] \
@@ -170,21 +179,24 @@ def audit_paged_state(allocator, tables, held, *,
                 "refcount-conservation",
                 f"block {b}: refcount {ref[b]} != {expected[b]} owners "
                 f"(held lists + trie entries) — {kind}")
-    if ref[SCRATCH_BLOCK] != 0 or expected[SCRATCH_BLOCK] != 0:
-        raise PagedStateError(
-            "scratch-aliasing",
-            f"scratch block {SCRATCH_BLOCK} is owned (refcount "
-            f"{ref[SCRATCH_BLOCK]}, {expected[SCRATCH_BLOCK]} holders) — "
-            "it must stay unallocated")
+    for sb in sorted(scratch):
+        if ref[sb] != 0 or expected[sb] != 0:
+            raise PagedStateError(
+                "scratch-aliasing",
+                f"scratch block {sb} is owned (refcount "
+                f"{ref[sb]}, {expected[sb]} holders) — "
+                "it must stay unallocated")
 
     # ---- free-list-disjoint
     free_set = set(int(b) for b in free)
     if len(free_set) != len(free):
         raise PagedStateError("free-list-disjoint",
                               "free list contains duplicate block ids")
-    if SCRATCH_BLOCK in free_set:
-        raise PagedStateError("free-list-disjoint",
-                              "scratch block is on the free list")
+    if free_set & scratch:
+        raise PagedStateError(
+            "free-list-disjoint",
+            f"scratch block(s) {sorted(free_set & scratch)} on the free "
+            "list")
     for b in free_set:
         if ref[b] != 0:
             raise PagedStateError(
@@ -195,7 +207,9 @@ def audit_paged_state(allocator, tables, held, *,
                 "free-list-disjoint",
                 f"block {b} is on the free list but has {expected[b]} "
                 "live holder(s)")
-    for b in range(1, num_blocks):
+    for b in range(num_blocks):
+        if b in scratch:
+            continue
         if ref[b] == 0 and b not in free_set:
             raise PagedStateError(
                 "free-list-disjoint",
@@ -206,10 +220,11 @@ def audit_paged_state(allocator, tables, held, *,
     live = set(id(e) for e in entries)
     child_count: Dict[int, int] = {}
     for e in entries:
-        if int(e.block) == SCRATCH_BLOCK:
+        if int(e.block) in scratch:
             raise PagedStateError(
                 "scratch-aliasing",
-                f"trie entry uid={e.uid} caches the scratch block")
+                f"trie entry uid={e.uid} caches a scratch block "
+                f"({e.block})")
         if e.parent is not None:
             if id(e.parent) not in live:
                 raise PagedStateError(
@@ -237,10 +252,10 @@ def audit_paged_state(allocator, tables, held, *,
 
     # ---- scale-lockstep (int8 KV only): scale rows live <=> block owned
     if scale_live is not None:
-        if SCRATCH_BLOCK in scale_live:
+        if scratch & set(int(b) for b in scale_live):
             raise PagedStateError(
                 "scale-lockstep",
-                "the scratch block is in the live-scale ledger — scratch "
+                "a scratch block is in the live-scale ledger — scratch "
                 "is never owned, its scale row is write-only garbage")
         for b in scale_live:
             if not (0 <= int(b) < num_blocks) or ref[int(b)] == 0:
@@ -249,7 +264,9 @@ def audit_paged_state(allocator, tables, held, *,
                     f"block {b} is in the live-scale ledger but has no "
                     "owner (refcount 0) — a stale scale row survived the "
                     "block free")
-        for b in range(1, num_blocks):
+        for b in range(num_blocks):
+            if b in scratch:
+                continue
             if (ref[b] > 0 or expected[b] > 0) and b not in scale_live:
                 raise PagedStateError(
                     "scale-lockstep",
@@ -272,6 +289,12 @@ def audit_paged_state(allocator, tables, held, *,
                     f"entry at {span} — allocated span must be contiguous")
         owned = sorted(int(b) for b in held[slot])
         mapped = sorted(int(row[li]) for li in range(span))
+        hit = scratch.intersection(mapped)
+        if hit:
+            raise PagedStateError(
+                "scratch-aliasing",
+                f"slot {slot}: table span maps scratch block(s) "
+                f"{sorted(hit)} — sequence KV would alias scratch garbage")
         if len(set(mapped)) != len(mapped):
             raise PagedStateError(
                 "length-occupancy",
@@ -453,7 +476,9 @@ def audit_serving_engine(srv, active) -> None:
                           block_size=srv.block_size,
                           scale_live=(srv._kv_scale_live
                                       if getattr(srv, "kv_quant", False)
-                                      else None))
+                                      else None),
+                          scratch_blocks=getattr(
+                              srv, "_scratch_blocks", None))
         if getattr(srv, "_host", None) is not None:
             audit_host_store(
                 srv._host,

@@ -63,6 +63,19 @@ GL008     metric family registration outside the telemetry naming
           closed set (``docs/observability.md``) — an ad-hoc label key
           is usually a per-request value about to become unbounded
           series cardinality.
+GL012     per-iteration scalar device sync in a host scheduler loop:
+          ``<jnp expr>.item()``, ``int()/float()/bool()`` over a
+          ``jnp``/``jax``-rooted expression, or a ``jnp``-rooted call as
+          an ``if``/``while`` test — each iteration round-trips ONE
+          scalar to the host, so the loop runs at device-latency per
+          token instead of dispatching ahead (the motivation for the
+          fused multi-step decode program, ``docs/inference.md``).
+          Batch the decision onto the device (``lax.while_loop`` with an
+          on-device ``active`` mask) and read results back once at a
+          sanctioned fence helper — GL007's transfer verbs plus
+          ``fence``/``harvest`` (e.g. ``ServingEngine._fence_harvest``).
+          (GL009..GL011, the lock-discipline rules, live in
+          ``analysis/concurrency.py``.)
 ========  =============================================================
 
 Suppression: append ``# graft: noqa(GLxxx)`` (one or more codes,
@@ -128,6 +141,9 @@ RULES: Dict[str, str] = {
              "a host loop body outside a sanctioned transfer helper",
     "GL008": "metric family name or label key outside the telemetry "
              "naming convention (docs/observability.md)",
+    "GL012": "per-iteration scalar device sync (.item()/int()/bool() or "
+             "jnp truthiness test) in a host scheduler loop outside a "
+             "sanctioned fence helper",
 }
 
 #: GL008 — the documented metric naming convention: registry method
@@ -141,8 +157,10 @@ _METRIC_LABEL_KEYS = frozenset(
 _METRIC_PARAM_KWARGS = frozenset({"help", "monitor_name", "buckets"})
 
 #: substrings marking a function as a sanctioned blocking-transfer helper
-#: for GL007 (the documented sync/swap commit points)
-_SANCTIONED_XFER = ("demote", "promote", "swap", "sync", "prefetch")
+#: for GL007/GL012 (the documented sync/swap commit points; "fence"/
+#: "harvest" name the fused-decode fence, e.g. ``_fence_harvest``)
+_SANCTIONED_XFER = ("demote", "promote", "swap", "sync", "prefetch",
+                    "fence", "harvest")
 
 #: ``time`` module entry points whose call inside a traced body is GL006;
 #: the bare spellings (from-imports) are distinctive enough to flag as
@@ -196,6 +214,16 @@ def _chain_attrs(node: ast.AST) -> Set[str]:
             attrs.add(node.attr)
         node = node.value
     return attrs
+
+
+def _jax_rooted(node: ast.AST) -> bool:
+    """True when an expression chain roots in the ``jnp``/``jax`` module
+    — walking THROUGH calls (``jnp.argmax(x).item()`` roots in ``jnp``),
+    so host numpy (``np.asarray(v).item()``) and plain variables never
+    match (GL012 stays a no-false-positive heuristic)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+        node = node.func if isinstance(node, ast.Call) else node.value
+    return isinstance(node, ast.Name) and node.id in ("jnp", "jax")
 
 
 class _Scope:
@@ -362,6 +390,19 @@ class _Analyzer:
             self._check_shape_capture(node, cur)
         elif isinstance(node, (ast.If, ast.While)) and in_jit:
             self._check_branch(node, cur)
+        elif isinstance(node, (ast.If, ast.While)) and not in_jit:
+            # GL012: a jnp-rooted call as a host branch test concretizes
+            # one bool per evaluation — per-iteration for a While's own
+            # test (the While IS the loop) or an If inside a loop body
+            per_iter = isinstance(node, ast.While) or in_loop
+            if per_iter and isinstance(node.test, ast.Call) and \
+                    _jax_rooted(node.test) and \
+                    not self._sanctioned_xfer(stack):
+                self._emit(node.test, "GL012",
+                           "jnp truthiness as a host loop test syncs one "
+                           "bool per iteration — fold the condition into "
+                           "an on-device lax.while_loop cond and fence "
+                           "once")
 
         if scope is not None:
             # function node: body runs per call (loop context cleared),
@@ -434,6 +475,26 @@ class _Analyzer:
                            "sanctioned transfer helper (demote/promote/"
                            "swap/sync/prefetch) or hoist it out of the "
                            "loop")
+            # GL012: a per-iteration SCALAR sync — same stall as GL007
+            # but spelled as a concretization, one token at a time
+            if in_unsanctioned_loop:
+                if tail == "item" and not node.args and \
+                        isinstance(node.func, ast.Attribute) and \
+                        _jax_rooted(node.func.value):
+                    self._emit(node, "GL012",
+                               ".item() on a jnp value in a host loop "
+                               "body syncs one scalar per iteration — "
+                               "move the loop on-device (lax.while_loop "
+                               "+ active mask) and read back once at a "
+                               "fence helper")
+                elif isinstance(node.func, ast.Name) and \
+                        node.func.id in ("int", "float", "bool") and \
+                        node.args and _jax_rooted(node.args[0]):
+                    self._emit(node, "GL012",
+                               f"{node.func.id}() over a jnp expression "
+                               "in a host loop body syncs one scalar per "
+                               "iteration — keep the decision on-device "
+                               "and harvest at a fence helper")
             return
         # GL006: a host timer inside a traced body stamps TRACE time —
         # the body executes once, while XLA replays the compiled program

@@ -40,7 +40,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["ModelGeom", "ServingKnobSpace", "kv_pool_bytes",
            "compile_budget", "workload_space", "DEFAULT_DOMAINS",
-           "CONSTRAINTS", "BASE_SERVING_CONFIG"]
+           "CONSTRAINTS", "BASE_SERVING_CONFIG", "DECODE_STEPS_MAX"]
 
 #: the verify kernel's widest speculative window (K+1 <= this);
 #: mirrored from ops/decode_attention.py without importing jax
@@ -64,6 +64,8 @@ BASE_SERVING_CONFIG: Dict[str, Any] = {
     "swap_batch": 8,
     "shard_kv": None,
     "topology": 1,
+    "decode_steps": 1,
+    "engine_mode": "replicas",
     "trace_capacity": 16384,
 }
 
@@ -74,7 +76,14 @@ DEFAULT_DOMAINS: Dict[str, Tuple[Any, ...]] = {
     "prefill_chunk": (64, 128, 256),
     "prefill_batch": (2, 4, 8),
     "spec_tokens": (0, 4),
+    "decode_steps": (1, 4, 8),
 }
+
+#: widest fused-decode window the space searches; a larger K only adds
+#: host-fence latency variance past the point where the Python loop is
+#: already off the critical path (the fused while_loop program is ONE
+#: compile for any K — the budget does not scale with it)
+DECODE_STEPS_MAX = 64
 
 
 @dataclasses.dataclass(frozen=True)
@@ -143,7 +152,13 @@ def compile_budget(config: Dict[str, Any]) -> int:
     """Mirror of the ctor's compiled-program budget: 2 chunked (prefill +
     decode / n-gram verify), buckets + 2 bucketed, + 2 swap programs with
     a host tier.  (A draft model would add 1; the space searches the
-    zero-extra-programs n-gram proposer.)"""
+    zero-extra-programs n-gram proposer.)
+
+    ``decode_steps > 1`` does NOT add a program: the fused multi-step
+    while_loop REPLACES the per-token decode program (same sentry name,
+    same budget slot), so the count is K-invariant.  ``engine_mode=
+    "dp_tp"`` likewise compiles the same two programs — one dp-sharded
+    decode instead of N per-replica copies."""
     if config.get("spec_tokens"):
         budget = 2
     elif config.get("chunked_prefill", True):
@@ -234,6 +249,42 @@ def _c_positive(config, space) -> Optional[str]:
     return None
 
 
+def _c_decode_steps(config, space) -> Optional[str]:
+    k = int(config.get("decode_steps") or 1)
+    if k < 1 or k > DECODE_STEPS_MAX:
+        return (f"decode_steps={k} outside [1, {DECODE_STEPS_MAX}]")
+    if k > 1 and int(config.get("spec_tokens") or 0):
+        # not invalid at the ctor (spec dispatch wins; K is inert) but a
+        # duplicate of the K=1 candidate — prune so the trial budget
+        # never pays for the same config twice
+        return (f"decode_steps={k} is inert under spec_tokens="
+                f"{config['spec_tokens']} (speculative dispatch wins) — "
+                "duplicate of the decode_steps=1 candidate")
+    return None
+
+
+def _c_engine_mode(config, space) -> Optional[str]:
+    mode = config.get("engine_mode") or "replicas"
+    if mode not in ("replicas", "dp_tp"):
+        return (f"engine_mode={mode!r} — expected 'replicas' or 'dp_tp'")
+    if mode != "dp_tp":
+        return None
+    if not config.get("chunked_prefill", True):
+        return "engine_mode='dp_tp' requires chunked-prefill mode"
+    for knob in ("spec_tokens", "host_blocks"):
+        if int(config.get(knob) or 0):
+            return (f"engine_mode='dp_tp' does not compose with "
+                    f"{knob}={config[knob]} (v1: dp groups would need "
+                    "cross-group scheduling)")
+    if config.get("quantize"):
+        return ("engine_mode='dp_tp' does not compose with quantize="
+                f"{config['quantize']!r}")
+    if config.get("prefix_caching", True):
+        return ("engine_mode='dp_tp' requires prefix_caching=False "
+                "(shared trie blocks cannot cross dp pool groups)")
+    return None
+
+
 #: ``(name, predicate)`` — predicate returns a violation message or None.
 #: Each has a loud ctor-validation twin (module docstring).
 CONSTRAINTS: Tuple[Tuple[str, Callable], ...] = (
@@ -246,6 +297,8 @@ CONSTRAINTS: Tuple[Tuple[str, Callable], ...] = (
     ("tiered_needs_prefix_cache", _c_tiered_prefix),
     ("swap_batch_bounds", _c_swap_batch),
     ("pool_min_blocks", _c_pool_min),
+    ("decode_steps_window", _c_decode_steps),
+    ("engine_mode_exclusive", _c_engine_mode),
 )
 
 

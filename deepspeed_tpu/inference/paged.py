@@ -207,6 +207,89 @@ class BlockAllocator:
             self._free.append(block)
 
 
+class GroupedBlockAllocator:
+    """:class:`BlockAllocator` partitioned into ``groups`` contiguous
+    spans of ``num_blocks // groups`` physical blocks — one span per dp
+    shard of a ``dp_tp``-mode pool (``inference/serving.py``).
+
+    Global block ids stay the currency everywhere (tables, audits,
+    telemetry); internally each group runs its own refcounted free list
+    over local ids and allocation is group-scoped, so a sequence's blocks
+    all land inside its dp shard's pool chunk.  Each group's local block 0
+    (global ``g * group_size``) is that group's scratch and is never
+    handed out; global block 0 doubles as the table-wide "unset" sentinel,
+    exactly as in the flat allocator.
+    """
+
+    def __init__(self, num_blocks: int, groups: int):
+        if groups < 1:
+            raise ValueError(f"groups must be >= 1, got {groups}")
+        if num_blocks % groups:
+            raise ValueError(
+                f"num_blocks ({num_blocks}) must divide evenly over "
+                f"{groups} groups")
+        self.num_blocks = int(num_blocks)
+        self.groups = int(groups)
+        self.group_size = self.num_blocks // self.groups
+        if self.group_size < 2:
+            raise ValueError(
+                f"{num_blocks} blocks over {groups} groups leaves "
+                f"{self.group_size} per group — need >= 2 (scratch + 1)")
+        self._groups = [BlockAllocator(self.group_size)
+                        for _ in range(self.groups)]
+
+    @property
+    def version(self) -> int:
+        return sum(g.version for g in self._groups)
+
+    @property
+    def free_blocks(self) -> int:
+        return sum(g.free_blocks for g in self._groups)
+
+    @property
+    def blocks_in_use(self) -> int:
+        """Blocks held by at least one owner (excludes every group's
+        scratch)."""
+        return self.num_blocks - self.groups - self.free_blocks
+
+    def group_of(self, block: int) -> int:
+        return int(block) // self.group_size
+
+    def group_free(self, group: int) -> int:
+        """Free blocks remaining in ``group`` (admission placement)."""
+        return self._groups[group].free_blocks
+
+    def refcount(self, block: int) -> int:
+        g, l = divmod(int(block), self.group_size)
+        return self._groups[g].refcount(l)
+
+    def snapshot(self):
+        """Merged global-id view, same shape as
+        :meth:`BlockAllocator.snapshot`: (refcounts list indexed by global
+        id, free list of global ids)."""
+        refs: List[int] = []
+        free: List[int] = []
+        for g, alloc in enumerate(self._groups):
+            r, f = alloc.snapshot()
+            refs.extend(r)
+            free.extend(g * self.group_size + l for l in f)
+        return refs, free
+
+    def alloc(self, group: int = 0) -> Optional[int]:
+        """A fresh block from ``group`` (global id), or ``None`` when that
+        group's span is dry — capacity pressure is per-group by design."""
+        local = self._groups[group].alloc()
+        return None if local is None else group * self.group_size + local
+
+    def incref(self, block: int) -> None:
+        g, l = divmod(int(block), self.group_size)
+        self._groups[g].incref(l)
+
+    def decref(self, block: int) -> None:
+        g, l = divmod(int(block), self.group_size)
+        self._groups[g].decref(l)
+
+
 @dataclasses.dataclass
 class _PrefixEntry:
     uid: int                    # stable id for child keys (never reused)

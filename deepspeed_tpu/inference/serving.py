@@ -194,6 +194,7 @@ lanes are bounded-divergence instead (above).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import inspect
 import threading
@@ -214,13 +215,13 @@ from ..analysis.sentry import (RecompileSentry, backend_compiles,
 from ..ops import paged_kv
 from ..ops.decode_attention import VERIFY_T_MAX
 from ..ops.paged_kv import blocks_for
-from ..parallel.topology import TP_AXIS
+from ..parallel.topology import DP_AXIS, TP_AXIS
 from ..telemetry import MetricsRegistry, ProfilerWindow, TraceTimeline
 from ..telemetry.slo import SLOTracker
 from ..utils.logging import log_dist
 from ..utils.lru import LRUCache
-from .paged import (BlockAllocator, HostBlockStore, PrefixCache,
-                    TransportError, chain_key, chain_keys)
+from .paged import (BlockAllocator, GroupedBlockAllocator, HostBlockStore,
+                    PrefixCache, TransportError, chain_key, chain_keys)
 from .spec import NGramProposer, greedy_accept
 
 
@@ -661,6 +662,35 @@ class ServingEngine:
                     fixed shape of the two swap programs; default 8).
                     Larger batches amortize transfer latency, smaller
                     ones waste less padding on short chains.
+    decode_steps:   K decode iterations fused into ONE on-device
+                    ``lax.while_loop`` program (default 1 = the classic
+                    per-token host loop, bit-identical to earlier PRs).
+                    With K > 1 the per-slot eos/budget checks move
+                    on-device behind a fixed-shape ``active`` mask, the
+                    program emits a ``[slots, K]`` token buffer, and the
+                    host catches up once per window at the fence
+                    (``_fence_harvest`` — the ONLY device sync of the
+                    decode path).  Block-table writes stay inside each
+                    slot's pre-reserved span, so the paged invariants
+                    hold across the whole fused window.  Token-exact
+                    with K=1 greedy decode by construction; the fused
+                    program REPLACES the single-token decode program
+                    (compile budget unchanged).  Inert in speculative
+                    mode — the draft/verify round already amortizes the
+                    host loop over K+1 tokens per program.
+    engine_mode:    ``"replicas"`` (default) or ``"dp_tp"``.  dp_tp runs
+                    ONE engine over a 2-D ``("dp", "tp")`` mesh: the
+                    slot/batch axis and the physical-block dim shard
+                    over ``dp`` (each dp group owns a contiguous span of
+                    slots AND of pool blocks, with its own scratch
+                    block), KV heads stay tp-sharded via the existing
+                    ``tp_context`` — one compiled decode program serves
+                    what otherwise takes dp router-fronted replicas,
+                    and the router demotes to front-end admission.
+                    v1 restrictions (ctor-validated): chunked prefill;
+                    no speculative decoding, host tier, prefix caching
+                    or quantization; ``slots`` and ``num_blocks``
+                    divisible by the mesh dp degree.
     draft:          draft proposer model — an ``init_inference`` engine or
                     a bare ModelSpec (wrapped with the target's inference
                     config) of a small same-family/same-tokenizer model.
@@ -705,6 +735,8 @@ class ServingEngine:
                  chunked_prefill: Optional[bool] = None,
                  prefill_chunk: int = 128,
                  prefix_caching: bool = True,
+                 decode_steps: int = 1,
+                 engine_mode: str = "replicas",
                  spec_tokens: int = 0,
                  quantize: Optional[str] = None,
                  host_blocks: int = 0,
@@ -788,13 +820,67 @@ class ServingEngine:
             raise ValueError(
                 f"prefill_batch must be >= 1, got {prefill_batch}")
 
-        if num_blocks is None:
-            num_blocks = 1 + self.slots * self._nbper
-        if num_blocks < 1 + self._nbper:
+        # ----- fused multi-step decode window + engine mode
+        self._K = int(decode_steps)
+        if self._K < 1:
             raise ValueError(
-                f"num_blocks {num_blocks} cannot hold one full sequence "
-                f"({self._nbper} blocks + 1 scratch)")
-        self._alloc = BlockAllocator(num_blocks)
+                f"decode_steps must be >= 1, got {decode_steps}")
+        self.engine_mode = str(engine_mode)
+        if self.engine_mode not in ("replicas", "dp_tp"):
+            raise ValueError(
+                f"engine_mode must be 'replicas' or 'dp_tp', got "
+                f"{engine_mode!r}")
+        dp = int(dict(engine.mesh.shape).get(DP_AXIS, 1)) \
+            if self.engine_mode == "dp_tp" else 1
+        if self.engine_mode == "dp_tp":
+            if not self.chunked_prefill:
+                raise ValueError(
+                    "engine_mode='dp_tp' requires chunked-prefill mode — "
+                    "drop prompt_buckets / pass chunked_prefill=True")
+            if spec_tokens or int(host_blocks) or quantize:
+                raise ValueError(
+                    "engine_mode='dp_tp' v1 excludes speculative decoding, "
+                    "the host KV tier and quantization — run those "
+                    "compositions in 'replicas' mode")
+            if prefix_caching:
+                raise ValueError(
+                    "engine_mode='dp_tp' v1 excludes prefix caching (the "
+                    "trie would share blocks across dp groups) — pass "
+                    "prefix_caching=False")
+            if self.slots % dp:
+                raise ValueError(
+                    f"engine_mode='dp_tp': slots ({self.slots}) must "
+                    f"divide evenly over the mesh dp axis ({dp})")
+        self.dp_degree = dp
+
+        if num_blocks is None:
+            num_blocks = self.dp_degree + self.slots * self._nbper
+        if self.dp_degree > 1:
+            # one allocation group per dp shard: each group owns a
+            # contiguous span of physical blocks (its local block 0 is that
+            # group's scratch), so every dp shard's gathers and scatters
+            # stay within its own pool chunk
+            if num_blocks % self.dp_degree:
+                raise ValueError(
+                    f"engine_mode='dp_tp': num_blocks ({num_blocks}) must "
+                    f"divide evenly over the mesh dp axis "
+                    f"({self.dp_degree})")
+            if num_blocks // self.dp_degree < 1 + self._nbper:
+                raise ValueError(
+                    f"num_blocks {num_blocks} over {self.dp_degree} dp "
+                    f"groups cannot hold one full sequence per group "
+                    f"({self._nbper} blocks + 1 scratch each)")
+            self._alloc = GroupedBlockAllocator(num_blocks, self.dp_degree)
+            self._scratch_blocks = frozenset(
+                g * (num_blocks // self.dp_degree)
+                for g in range(self.dp_degree))
+        else:
+            if num_blocks < 1 + self._nbper:
+                raise ValueError(
+                    f"num_blocks {num_blocks} cannot hold one full sequence "
+                    f"({self._nbper} blocks + 1 scratch)")
+            self._alloc = BlockAllocator(num_blocks)
+            self._scratch_blocks = None
         self._prefix = PrefixCache(self.block_size) \
             if (prefix_caching and self.chunked_prefill) else None
         self.host_blocks = int(host_blocks)
@@ -857,8 +943,20 @@ class ServingEngine:
         self.kv_sharded = divisible if shard_kv is None else \
             (bool(shard_kv) and divisible)
         rep = NamedSharding(engine.mesh, P())
-        pool_sharding = NamedSharding(engine.mesh, P(None, None, TP_AXIS)) \
-            if self.kv_sharded else rep
+        if self.dp_degree > 1:
+            # dp_tp: the physical-block dim shards over dp (each group owns
+            # a contiguous span, matching GroupedBlockAllocator's layout)
+            # and heads over tp when divisible — ``P(None, "dp", "tp")`` on
+            # the stacked [L, NB, HKV, bs, hd] buffer
+            pool_sharding = NamedSharding(
+                engine.mesh,
+                P(None, DP_AXIS, TP_AXIS) if self.kv_sharded
+                else P(None, DP_AXIS))
+        else:
+            pool_sharding = NamedSharding(
+                engine.mesh, P(None, None, TP_AXIS)) \
+                if self.kv_sharded else rep
+        self._pool_sharding = pool_sharding
         self._cache = jax.tree_util.tree_map(
             lambda x: jax.device_put(x, pool_sharding), pool)
         # host-side block tables; entry 0 = scratch doubles as "unset"
@@ -1016,6 +1114,14 @@ class ServingEngine:
             "serving_iterations_total", "scheduler iterations run")
         self._c_decode_steps = m.counter(
             "serving_decode_steps_total", "single-token decode steps")
+        self._c_fused_iterations = m.counter(
+            "serving_fused_iterations_total",
+            "device-side decode iterations executed inside fused "
+            "multi-step windows (0 when decode_steps == 1)")
+        self._c_host_fence_waits = m.counter(
+            "serving_host_fence_waits_total",
+            "host blocks on the device fence — one per fused decode "
+            "window, the ONLY sync of the fused decode path")
         self._c_prefill_calls = m.counter(
             "serving_prefill_calls_total", "prefill program invocations")
         self._c_admitted = m.counter(
@@ -1154,6 +1260,9 @@ class ServingEngine:
             + (f", speculative K={self.spec_tokens} "
                f"({'draft ' + self._draft.module.name if self._draft else 'n-gram'})"
                if self.spec_tokens else "")
+            + (f", fused decode K={self._K}" if self._K > 1 else "")
+            + (f", engine_mode=dp_tp (dp={self.dp_degree} groups)"
+               if self.engine_mode == "dp_tp" else "")
             + (f", kv sharded over tp={self.tp_degree} "
                f"({hkv // self.tp_degree} heads/chip)" if self.kv_sharded
                else (f", kv replicated (tp={self.tp_degree})"
@@ -1172,6 +1281,21 @@ class ServingEngine:
         in one process."""
         return paged_kv.tp_context(
             self.engine.mesh if self.kv_sharded else None)
+
+    def _decode_ctx(self):
+        """:meth:`_tp_ctx` plus the dp grouping for ``engine_mode='dp_tp'``:
+        the paged ops additionally shard batch rows and the physical-block
+        dim over the mesh ``dp`` axis, localizing each shard's block-table
+        reads into its own contiguous pool chunk (``ops/paged_kv.py
+        dp_context``)."""
+        if self.dp_degree > 1:
+            stack = contextlib.ExitStack()
+            stack.enter_context(self._tp_ctx())
+            stack.enter_context(paged_kv.dp_context(
+                self.engine.mesh, self.dp_degree,
+                self._alloc.num_blocks // self.dp_degree))
+            return stack
+        return self._tp_ctx()
 
     # -------------------------------------------------------------- telemetry
     # Legacy counter attributes are read-only views over the registry cells
@@ -1281,21 +1405,74 @@ class ServingEngine:
         # ignores donation with a warning, so only ask for it on TPU
         return (1,) if jax.default_backend() == "tpu" else ()
 
+    def _constrain_pool(self, cache):
+        """dp_tp only: pin the cache OUTPUT of every decode/prefill program
+        to the committed pool sharding.  Input shardings are part of the
+        jit cache key — without this, a prefill that resharded the pool
+        would hand the next decode a differently-placed argument and force
+        a silent retrace the sentry would (rightly) flag."""
+        if self.dp_degree <= 1:
+            return cache
+        sharding = self._pool_sharding
+        return jax.tree_util.tree_map(
+            lambda x: jax.lax.with_sharding_constraint(x, sharding), cache)
+
     def _get_decode_fn(self):
         if self._decode_fn is None:
             fwd, prepare = self._fwd, self.engine._prepare
+            K, constrain = self._K, self._constrain_pool
 
             def decode_step(params, cache, tokens, lengths, block_tables):
                 logits, cache = fwd(prepare(params), tokens[:, None], cache,
                                     0, lengths=lengths,
                                     block_tables=block_tables)
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+                    constrain(cache)
 
-            self._program_bodies["decode"] = decode_step
-            self._decode_fn = jax.jit(self.sentry.wrap(decode_step,
-                                                       "decode"),
+            def decode_fused(params, cache, tokens, lengths, block_tables,
+                             active, budgets, eos_ids):
+                """K greedy steps in ONE ``lax.while_loop``: per-slot
+                eos/budget checks live on-device behind the fixed-shape
+                ``active`` mask; ``out[slot, i]`` is the i-th token the
+                window committed for the slot, ``-1`` past its end (eos
+                fired or per-slot budget spent).  Frozen rows keep feeding
+                their last token at a frozen length — an idempotent
+                rewrite of already-written KV, never a new position — so
+                the loop stays fixed-shape with no gather/compaction."""
+                p = prepare(params)
+                out0 = jnp.full((tokens.shape[0], K), -1, jnp.int32)
+
+                def cond(state):
+                    i, _, _, _, act, _ = state
+                    return (i < K) & jnp.any(act)
+
+                def body(state):
+                    i, toks, lens, cache, act, out = state
+                    logits, cache = fwd(p, toks[:, None], cache, 0,
+                                        lengths=lens,
+                                        block_tables=block_tables)
+                    cache = constrain(cache)
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    out = out.at[:, i].set(jnp.where(act, nxt, -1))
+                    lens = lens + act.astype(lens.dtype)
+                    toks = jnp.where(act, nxt, toks)
+                    act = act & (nxt != eos_ids) & (i + 1 < budgets)
+                    return (i + 1, toks, lens, cache, act, out)
+
+                _, _, _, cache, _, out = jax.lax.while_loop(
+                    cond, body,
+                    (jnp.int32(0), tokens, lengths, cache, active, out0))
+                return out, cache
+
+            # the fused program REPLACES the per-token decode program —
+            # same sentry entry, same compile budget
+            body_fn = decode_step if K == 1 else decode_fused
+            self._program_bodies["decode"] = body_fn
+            self._decode_fn = jax.jit(self.sentry.wrap(body_fn, "decode"),
                                       donate_argnums=self._donate())
-            self.compiled_programs.append(("decode", self.slots))
+            self.compiled_programs.append(
+                ("decode", self.slots) if K == 1
+                else ("decode", self.slots, K))
         return self._decode_fn
 
     def _get_prefill_fn(self, width: int):
@@ -1306,6 +1483,7 @@ class ServingEngine:
         contract), so speculative prefill still costs one program."""
         fwd, prepare = self._fwd, self.engine._prepare
         draft = self._draft
+        constrain = self._constrain_pool
 
         def build():
             def prefill(params, cache, ids, block_tables, base, valid):
@@ -1314,7 +1492,8 @@ class ServingEngine:
                 [J] real tokens per row (pads write to scratch block 0)."""
                 logits, cache = fwd(prepare(params), ids, cache, base,
                                     lengths=valid, block_tables=block_tables)
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+                    constrain(cache)
 
             if draft is None:
                 self._program_bodies.setdefault("prefill", {})[width] = \
@@ -1931,12 +2110,23 @@ class ServingEngine:
         self.timeline.instant("preempt", uid=str(st.req.uid), slot=slot,
                               blocks_freed=nblocks)
 
+    def _slot_group(self, slot: int) -> int:
+        """dp group owning ``slot`` (always 0 outside dp_tp mode): slot
+        spans are contiguous, matching the shard_map ``P("dp")`` row
+        chunking of the fused decode program."""
+        return slot // (self.slots // self.dp_degree) \
+            if self.dp_degree > 1 else 0
+
     def _alloc_block(self, requester: int) -> Optional[int]:
         """One fresh block, reclaiming in order: free list -> LRU prefix-
         cache eviction -> preempting the latest-admitted sequence.  Returns
-        ``None`` iff the requester itself was preempted."""
+        ``None`` iff the requester itself was preempted.  In dp_tp mode
+        both the free list and the preemption victim pool are scoped to
+        the requester's dp group — blocks never cross pool shards."""
+        grp = self._slot_group(requester)
         while True:
-            b = self._alloc.alloc()
+            b = self._alloc.alloc(grp) if self.dp_degree > 1 \
+                else self._alloc.alloc()
             if b is not None:
                 if self.kv_quant:
                     self._kv_scale_live.add(b)
@@ -1954,10 +2144,13 @@ class ServingEngine:
                         self.timeline.instant("evict_block",
                                               block=int(evicted))
                         continue
-            victim = max(self._active,
-                         key=lambda s: self._active[s].admit_seq)
-            if victim == requester and len(self._active) == 1:
-                # cannot happen when num_blocks >= nbper+1 (ctor check)
+            cands = self._active if self.dp_degree == 1 else \
+                {s: st for s, st in self._active.items()
+                 if self._slot_group(s) == grp}
+            victim = max(cands, key=lambda s: cands[s].admit_seq)
+            if victim == requester and len(cands) == 1:
+                # cannot happen when num_blocks >= nbper+1 per group
+                # (ctor check)
                 raise RuntimeError(
                     "paged KV pool too small for a single sequence")
             self._preempt(victim)
@@ -2008,9 +2201,21 @@ class ServingEngine:
         pending, active = self._pending, self._active
         free = [s for s in range(self.slots) if s not in active]
         reserved = 0                       # blocks promised to this call's
+        reserved_g: Dict[int, int] = {}    # ... per dp group, in dp_tp mode
         while pending and free:            # earlier joiners, not yet alloc'd
             item = pending[0]
             req, prior = item.req, item.prior
+            if self.dp_degree > 1:
+                # placement: the free slot whose dp group has the most
+                # unpromised blocks — admission gates on THAT group's span
+                slot_pick = max(
+                    free,
+                    key=lambda s: (self._alloc.group_free(
+                        self._slot_group(s))
+                        - reserved_g.get(self._slot_group(s), 0), -s))
+                grp = self._slot_group(slot_pick)
+            else:
+                slot_pick, grp = free[0], None
             # blocked-head memo: while nothing refcount-related moved, the
             # gate's probe/evictable answer cannot change — skip the
             # O(prompt + trie) host walk every idle iteration
@@ -2028,6 +2233,9 @@ class ServingEngine:
                 if self._prefix is not None else 0
 
             def _avail():
+                if grp is not None:
+                    return self._alloc.group_free(grp) - \
+                        reserved_g.get(grp, 0)
                 return self._alloc.free_blocks - reserved + \
                     (self._prefix.evictable(self._alloc)
                      if self._prefix is not None else 0)
@@ -2059,8 +2267,11 @@ class ServingEngine:
                                                 len(hits), req))
                 need = total_need - len(hits)
             reserved += max(need, 0)
+            if grp is not None:
+                reserved_g[grp] = reserved_g.get(grp, 0) + max(need, 0)
             pending.popleft()
-            slot = free.pop(0)
+            slot = slot_pick
+            free.remove(slot)
             # latency probes: admit stamped once per request per trace (a
             # preemption resume keeps the original admission time, so its
             # TTFT/TPOT and its timeline span cover the whole wait)
@@ -2277,6 +2488,8 @@ class ServingEngine:
         # draft–verify round committing up to K+1 tokens per slot.
         if self.spec_tokens:
             self._run_spec_decode(params)
+        elif self._K > 1:
+            self._run_fused_decode(params)
         else:
             self._run_plain_decode(params)
         if self._host is not None:
@@ -2628,7 +2841,7 @@ class ServingEngine:
         bt = np.zeros_like(self._tables)
         bt[dec] = self._tables[dec]
         with self.timeline.span("decode", slots=len(dec)):
-            with self._tp_ctx():
+            with self._decode_ctx():
                 nxt, self._cache = self._get_decode_fn()(
                     params, self._cache, jnp.asarray(self._tokens),
                     jnp.asarray(self._lengths), jnp.asarray(bt))
@@ -2646,6 +2859,103 @@ class ServingEngine:
                 self._finish_slot(slot)
             else:
                 self._tokens[slot] = tok
+
+    def _fence_harvest(self, *arrays):
+        """The fused decode path's ONE host<->device synchronization point
+        (the fence): dispatch above it is fully asynchronous, the scheduler
+        blocks here exactly once per fused window, and every host-side
+        scalar read below it comes out of the numpy buffers this returns.
+        graft-lint GL012 sanctions per-token host harvesting only inside
+        this helper — anywhere else in a scheduler loop body it flags."""
+        self._c_host_fence_waits.inc()
+        arrays = jax.block_until_ready(arrays)
+        return tuple(np.asarray(a) for a in arrays)
+
+    def _run_fused_decode(self, params):
+        """``decode_steps`` decode iterations in ONE on-device program
+        (the tentpole fused window): per-slot eos/budget checks run on
+        device, and the host bookkeeping — lengths, token emission, SLO
+        stamps, slot finishes — catches up in one batch at the fence by
+        replaying the committed ``out[slot, i]`` tokens through the exact
+        K=1 commit sequence.  Block tables are pre-reserved for the whole
+        window before dispatch (``_ensure_blocks`` up to each slot's
+        remaining-token budget), so every in-window KV write lands inside
+        the slot's held span and the paged invariants hold at the next
+        iteration boundary exactly as in single-step mode."""
+        K = self._K
+        active = self._active
+        dec = sorted(
+            (s for s, st in active.items() if st.phase == "decode"),
+            key=lambda s: active[s].admit_seq)
+        want: Dict[int, int] = {}
+        for slot in dec:
+            if slot in active and active[slot].phase == "decode":
+                st = active[slot]
+                ln = int(self._lengths[slot])
+                w = max(1, min(K, st.req.max_new_tokens - st.gen_count))
+                want[slot] = w
+                self._ensure_blocks(slot, min(ln + w, self._cache_len))
+        dec = sorted(s for s, st in active.items()
+                     if st.phase == "decode")
+        if not dec:
+            return
+        budgets = np.zeros(self.slots, np.int32)
+        eos_ids = np.full(self.slots, -1, np.int32)
+        actv = np.zeros(self.slots, bool)
+        for slot in dec:
+            st = active[slot]
+            ln = int(self._lengths[slot])
+            # the device budget is additionally clamped to the held span —
+            # a window can never write past the blocks it reserved
+            span = int(np.count_nonzero(self._tables[slot])) \
+                * self.block_size
+            b = min(want.get(slot, K), max(span - ln, 0))
+            if b < 1:
+                continue
+            budgets[slot] = b
+            actv[slot] = True
+            if st.eos is not None:
+                eos_ids[slot] = int(st.eos)
+        dec = [s for s in dec if actv[s]]
+        if not dec:
+            return
+        bt = np.zeros_like(self._tables)
+        bt[dec] = self._tables[dec]
+        with self.timeline.span("decode", slots=len(dec), fused=K):
+            with self._decode_ctx():
+                out, self._cache = self._get_decode_fn()(
+                    params, self._cache, jnp.asarray(self._tokens),
+                    jnp.asarray(self._lengths), jnp.asarray(bt),
+                    jnp.asarray(actv), jnp.asarray(budgets),
+                    jnp.asarray(eos_ids))
+            out, = self._fence_harvest(out)
+        # ----- the fence catch-up: replay each slot's committed window
+        # tokens through the exact K=1 commit sequence (emission order,
+        # finish conditions, TTFT stamps — token- and event-identical)
+        trips = 0
+        for slot in dec:
+            st = active[slot]
+            emitted = 0
+            for i in range(K):
+                tok = int(out[slot, i])
+                if tok < 0:
+                    break
+                emitted += 1
+                self._lengths[slot] += 1
+                st.out.append(tok)
+                self._emit_tokens(st, (tok,))
+                self._mark_first(st)
+                if (st.eos is not None and tok == st.eos) \
+                        or st.gen_count >= st.req.max_new_tokens:
+                    self._finish_slot(slot)
+                    break
+                self._tokens[slot] = tok
+            trips = max(trips, emitted)
+        # decode_steps counts executed device ITERATIONS (the while_loop
+        # trip count = the deepest slot's window), keeping per-iteration
+        # FLOPs billing identical to single-step mode
+        self._c_decode_steps.inc(trips)
+        self._c_fused_iterations.inc(trips)
 
     def _run_spec_decode(self, params):
         """One speculative draft–verify round over every decode-phase slot.
@@ -2874,6 +3184,8 @@ class ServingEngine:
             "num_blocks": int(self._alloc.num_blocks),
             "chunked_prefill": bool(self.chunked_prefill),
             "prefill_chunk": int(self.prefill_chunk),
+            "decode_steps": self._K,
+            "engine_mode": self.engine_mode,
             "prompt_buckets": list(self.prompt_buckets) or None,
             "prefill_batch": self.prefill_batch,
             "prefix_caching": self._prefix is not None,
@@ -2918,8 +3230,11 @@ class ServingEngine:
             "kv_scale_bytes": scale_bytes,
             "kv_pool_shape": list(self._pool_shape),
             "kv_pool_bytes": total,
+            # dp_tp: the block dim shards over dp too, so each chip holds
+            # total / (dp * tp) — the same per-chip bytes as one tp-only
+            # replica serving 1/dp of the load
             "kv_pool_bytes_per_chip": total //
-            (self.tp_degree if self.kv_sharded else 1),
+            (self.dp_degree * (self.tp_degree if self.kv_sharded else 1)),
         }
         if self._dcache is not None:
             dtotal = _bytes(self._dcache)
@@ -2967,6 +3282,9 @@ class ServingEngine:
             "backend_compiles": backend_compiles(),
             "iterations": self.iterations,
             "decode_steps": self.decode_steps,
+            "engine_mode": self.engine_mode,
+            "fused_iterations": int(self._c_fused_iterations.value),
+            "host_fence_waits": int(self._c_host_fence_waits.value),
             "prefill_calls": self.prefill_calls,
             "admitted": self.admitted,
             "evicted": self.preempted,

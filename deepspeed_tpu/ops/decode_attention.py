@@ -34,6 +34,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
+from . import paged_kv
 from .paged_kv import (_paged_gather, head_shard_map, head_shards,
                        is_quantized_pool, pool_payload, tp_axis)
 
@@ -223,8 +224,36 @@ def _tp_shard_heads(body, q, k_pool, v_pool, block_tables, q_pos):
     """Run ``body(q, k_pool, v_pool, bt, pos)`` sharded over the head dims
     when the configured tp context divides them, else directly.  Int8 pool
     records shard whole: codes and their scale table both carry the head
-    dim at index 1, so the one head spec broadcasts over the record."""
+    dim at index 1, so the one head spec broadcasts over the record.
+
+    Under a configured dp context (``paged_kv.dp_context`` —
+    ``engine_mode='dp_tp'`` serving) the batch rows and the pool's
+    physical-block dim additionally shard over the mesh ``dp`` axis: each
+    dp shard attends its own contiguous row span against its own pool
+    chunk, localizing the global block-table ids into that chunk first
+    (``paged_kv.localize_block_tables``) — group-scoped allocation makes
+    the localization exact, so no cross-shard gather ever happens."""
     n = head_shards(pool_payload(k_pool).shape[1], q.shape[1])
+    if paged_kv.dp_groups() > 1:
+        from jax.experimental.shard_map import shard_map
+
+        mesh, _, gsize = paged_kv.dp_state()
+        dp = paged_kv.dp_axis()
+        pos = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1),
+                               (q.shape[0],))
+        qs = P(dp, tp_axis()) if n > 1 else P(dp)     # [B, H, T, D]
+        ps = P(dp, tp_axis()) if n > 1 else P(dp)     # [NB, HKV, bs, D]
+        rs = P(dp)                                    # [B, ...] row args
+
+        def dp_body(q, kp, vp, bt, pos):
+            bt = paged_kv.localize_block_tables(bt, gsize)
+            return body(q, kp, vp, bt, pos)
+
+        return shard_map(dp_body, mesh=mesh,
+                         in_specs=(qs, ps, ps, rs, rs),
+                         out_specs=qs, check_rep=False)(
+            q, k_pool, v_pool,
+            jnp.asarray(block_tables, jnp.int32), pos)
     if n <= 1:
         return body(q, k_pool, v_pool, block_tables, q_pos)
     pos = jnp.broadcast_to(jnp.asarray(q_pos, jnp.int32).reshape(-1),

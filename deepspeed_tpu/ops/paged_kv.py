@@ -152,6 +152,73 @@ def head_shard_map(fn, in_specs, out_specs):
                      out_specs=out_specs, check_rep=False)
 
 
+# ------------------------------------------------------------- dp context
+#: Data-parallel grouping for ``engine_mode="dp_tp"`` serving
+#: (``inference/serving.py``): the batch/slot dim AND the physical-block
+#: dim additionally shard over the mesh ``dp`` axis — each dp shard owns a
+#: contiguous span of rows and of pool blocks (group-scoped allocation,
+#: ``inference/paged.GroupedBlockAllocator``), so every shard's gathers
+#: and scatters are self-contained after localizing the global block ids
+#: into its own chunk (subtract the group base, clamp to the local
+#: scratch).  ``_DP_GROUPS == 1`` (the default) is the untouched tp-only
+#: behavior.
+_DP_AXIS = "dp"
+_DP_MESH = None
+_DP_GROUPS = 1
+_DP_GSIZE = 0
+
+
+def configure_dp(mesh=None, groups: int = 1, group_size: int = 0,
+                 axis: str = "dp") -> None:
+    """Install (mesh + group count + per-group block span) or clear
+    (``mesh=None``) the data-parallel context for the paged device ops."""
+    global _DP_MESH, _DP_GROUPS, _DP_GSIZE, _DP_AXIS
+    _DP_MESH = mesh
+    _DP_GROUPS = int(groups) if mesh is not None else 1
+    _DP_GSIZE = int(group_size) if mesh is not None else 0
+    _DP_AXIS = axis
+
+
+@contextlib.contextmanager
+def dp_context(mesh, groups: int, group_size: int, axis: str = "dp"):
+    """Scoped :func:`configure_dp` — the serving engine wraps dp_tp-mode
+    program invocations in this (nested inside :func:`tp_context`), so
+    only programs traced for THAT engine bake in the dp sharding."""
+    prev = (_DP_MESH, _DP_GROUPS, _DP_GSIZE, _DP_AXIS)
+    configure_dp(mesh, groups, group_size, axis)
+    try:
+        yield
+    finally:
+        configure_dp(*prev)
+
+
+def dp_groups() -> int:
+    return _DP_GROUPS
+
+
+def dp_axis() -> str:
+    return _DP_AXIS
+
+
+def dp_state():
+    """(mesh, groups, group_size) of the installed dp context."""
+    return _DP_MESH, _DP_GROUPS, _DP_GSIZE
+
+
+def localize_block_tables(block_tables, group_size):
+    """Map GLOBAL physical block ids into the calling dp shard's local
+    chunk: subtract the shard's group base and clamp into
+    ``[0, group_size)``.  Group-scoped allocation guarantees a row's real
+    entries live in its own group's span, so the subtraction is exact for
+    them; the global "unset" sentinel 0 (and any position past the span)
+    clamps to local block 0 — the shard's own scratch — preserving the
+    scratch-routing write contract shard-locally.  Must be called inside
+    ``shard_map`` over the dp axis."""
+    g = jax.lax.axis_index(_DP_AXIS)
+    return jnp.clip(block_tables.astype(jnp.int32) - g * group_size,
+                    0, group_size - 1)
+
+
 def blocks_for(num_tokens: int, block_size: int) -> int:
     """Blocks needed to cover ``num_tokens`` positions (ceil division) —
     the one accounting formula the allocator, scheduler, and speculative
@@ -256,6 +323,28 @@ def paged_cache_update(ck, cv, k, v, pos, block_tables, valid=None):
     b = k.shape[0]
     pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (b,))
     n = head_shards(pool_payload(ck).shape[1], k.shape[1])
+    if _DP_GROUPS > 1:
+        # dp_tp serving: rows + physical blocks shard over dp (heads over
+        # tp when divisible); each shard scatters into its own pool chunk
+        # through localized tables — no cross-shard traffic
+        from jax.experimental.shard_map import shard_map
+
+        hp = P(_DP_AXIS, _TP_AXIS) if n > 1 else P(_DP_AXIS)
+        dpsp = P(_DP_AXIS)
+        gsize = _DP_GSIZE
+        valid = jnp.full((b,), k.shape[2], jnp.int32) if valid is None \
+            else jnp.asarray(valid, jnp.int32)
+
+        def body(ck, cv, k, v, pos, bt, valid):
+            bt = localize_block_tables(bt, gsize)
+            return _paged_cache_update(ck, cv, k, v, pos, bt, valid)
+
+        return shard_map(
+            body, mesh=_DP_MESH,
+            in_specs=(hp, hp, hp, hp, dpsp, dpsp, dpsp),
+            out_specs=(hp, hp), check_rep=False)(
+                ck, cv, k, v, pos,
+                jnp.asarray(block_tables, jnp.int32), valid)
     if n <= 1:
         return _paged_cache_update(ck, cv, k, v, pos, block_tables, valid)
     # P(None, tp) is a valid spec for every record leaf too: qp

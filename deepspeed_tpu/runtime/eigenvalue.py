@@ -80,6 +80,6 @@ class Eigenvalue:
         out = {}
         for name, p in layer_params.items():
             lam = hessian_eigenvalue(loss_fn, p, *args, iters=self.max_iter)
-            out[name] = float(jnp.abs(lam)) + self.stability
+            out[name] = float(jnp.abs(lam)) + self.stability  # graft: noqa(GL012) one scalar per LAYER (not per step); the normalize below needs every λ on host anyway
         mx = max(out.values()) if out else 1.0
         return {k: v / mx for k, v in out.items()}

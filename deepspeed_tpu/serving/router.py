@@ -236,6 +236,15 @@ class ReplicaRouter:
                 f"kv_pull=True but replica host tiers disagree on the "
                 f"swap block layout ({sorted(layouts)} bytes/block) — "
                 "pulled bytes would scatter into mismatched pools")
+        dp_tp = [i for i, r in enumerate(replicas)
+                 if getattr(r, "engine_mode", "replicas") == "dp_tp"]
+        if dp_tp and len(replicas) > 1:
+            raise ValueError(
+                f"replica(s) {dp_tp} run engine_mode='dp_tp' — a dp×tp "
+                "engine already batches across its dp groups inside one "
+                "compiled program, so it must be the router's sole "
+                "replica (the router demotes to front-end admission); "
+                "mixing it with other replicas double-shards the fleet")
         self.replicas = replicas
         self.policy = policy
         self.kv_pull = bool(kv_pull)

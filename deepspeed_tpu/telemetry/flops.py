@@ -194,7 +194,11 @@ class ServingFlopsProfiler:
         cache = sds(srv._cache)
         slots, nb = srv.slots, srv._nbper
         if family == "decode":
-            return (params, cache, i32(slots), i32(slots), i32(slots, nb))
+            args = (params, cache, i32(slots), i32(slots), i32(slots, nb))
+            if getattr(srv, "_K", 1) > 1:    # fused multi-step decode adds
+                args += (jax.ShapeDtypeStruct((slots,), jnp.bool_),
+                         i32(slots), i32(slots))   # active, budgets, eos_ids
+            return args
         if family == "prefill":
             j = srv.prefill_batch
             if srv._draft is not None:       # fused target+draft prefill
@@ -238,9 +242,16 @@ class ServingFlopsProfiler:
             body = body.get(width)
         if body is None:
             return None
+        if family == "decode" and getattr(self.srv, "_K", 1) > 1:
+            # fused multi-step decode: the lowered body holds the whole
+            # while_loop but calls are billed per iteration — the backend
+            # cost would be off by up to K.  Use the analytic estimate.
+            return None
         try:
             args = self._abstract_args(family, width)
-            with self.srv._tp_ctx():
+            ctx = getattr(self.srv, "_decode_ctx", self.srv._tp_ctx) \
+                if family == "decode" else self.srv._tp_ctx
+            with ctx():
                 ca = jax.jit(body).lower(*args).cost_analysis()
             if isinstance(ca, (list, tuple)):
                 ca = ca[0] if ca else {}

@@ -373,6 +373,71 @@ def build(m):
     assert _codes(pragma) == []
 
 
+def test_gl012_scalar_sync_in_scheduler_loop_fires_and_near_miss():
+    """GL012: the host-loop scalar concretizations the fused multi-step
+    decode program exists to kill (one .item()/int()/bool() per decoded
+    token pins the scheduler to device latency)."""
+    fires = """
+import jax.numpy as jnp
+
+def scheduler(srv, toks):
+    while srv.pending:
+        tok = jnp.argmax(toks).item()        # scalar per iteration
+        if bool(jnp.any(toks > 0)):          # implicit bool sync
+            srv.finish()
+        n = int(jnp.sum(toks))               # int() concretization
+    while jnp.any(toks):                     # While test: per iteration
+        toks = step(toks)
+"""
+    codes = _codes(fires)
+    assert codes.count("GL012") == 4, codes
+    near_miss = """
+import numpy as np
+import jax.numpy as jnp
+
+def scheduler(srv, v, out):
+    while srv.pending:
+        tok = np.asarray(v).item()           # host numpy: no device sync
+        n = int(out[0, 0])                   # plain variable: unknowable
+        if srv.done:                         # host-state test
+            break
+    last = jnp.argmax(v).item()              # outside any loop: one-off
+
+def _fence_harvest(arrays):
+    for a in arrays:
+        n = int(jnp.sum(a))                  # sanctioned fence helper
+    return n
+
+def _swap_commit(blocks):
+    while blocks:
+        b = blocks.pop()
+        flag = bool(jnp.any(b))              # sanctioned transfer helper
+    return flag
+"""
+    assert "GL012" not in _codes(near_miss)
+    # inside a jit body the same spellings are GL001/GL005 territory —
+    # GL012 is host-scheduler-only (no double reporting)
+    in_jit = """
+import jax, jax.numpy as jnp
+
+def step(x, cache):
+    for _ in range(4):
+        v = x.item()
+    return cache
+
+jax.jit(step, donate_argnums=(1,))
+"""
+    assert "GL012" not in _codes(in_jit)
+    pragma = """
+import jax.numpy as jnp
+
+def probe(xs):
+    for x in xs:
+        v = float(jnp.abs(x))  # graft: noqa(GL012) per-layer harvest, documented
+"""
+    assert _codes(pragma) == []
+
+
 def test_noqa_pragma_suppresses_named_rule_only():
     src = """
 import jax

@@ -43,9 +43,11 @@ ENGINE_STATS_KEYS = frozenset({
     "acceptance_rate", "accepted_tokens", "admitted", "backend_compiles",
     "block_size", "blocks_in_use", "cancelled", "compile_budget",
     "compile_count", "config", "debug_checks", "decode_steps",
-    "drafted_tokens",
-    "evicted", "free_blocks", "generated_tokens", "host_blocks",
-    "host_blocks_in_use", "host_pool_bytes", "invariant_checks_run",
+    "drafted_tokens", "engine_mode",
+    "evicted", "free_blocks", "fused_iterations", "generated_tokens",
+    "host_blocks",
+    "host_blocks_in_use", "host_fence_waits", "host_pool_bytes",
+    "invariant_checks_run",
     "iterations", "kv_dtype", "kv_pool_bytes", "kv_pool_bytes_per_chip",
     "kv_pool_shape", "kv_scale_bytes", "kv_sharded", "mode",
     "num_blocks", "prefetch_misses", "prefetch_wait_p50_s",
@@ -63,7 +65,8 @@ ENGINE_STATS_KEYS = frozenset({
 #: dict pinned key-for-key: bench JSONs, ``best_config.json``, and the
 #: autotuner's trial records must stay mutually loadable across PRs
 CONFIG_KEYS = frozenset({
-    "block_size", "chunked_prefill", "debug_checks", "host_blocks",
+    "block_size", "chunked_prefill", "debug_checks", "decode_steps",
+    "engine_mode", "host_blocks",
     "max_seq_len", "ngram_max", "ngram_min", "num_blocks", "peak_flops",
     "prefill_batch", "prefill_chunk", "prefix_caching", "prompt_buckets",
     "quantize", "shard_kv", "slo_targets", "slots", "spec_tokens",

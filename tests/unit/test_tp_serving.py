@@ -475,3 +475,71 @@ def test_chaos_crash_rehoming_tp4_parity(tp4_engine, tiny_cfg):
                for p in st["per_replica"])
     from deepspeed_tpu.analysis.invariants import audit_router
     audit_router(router)
+
+
+def test_dp_tp_engine_token_identity_vs_router_fronted(tiny_cfg):
+    """PR 16 acceptance: the 2-D ``engine_mode="dp_tp"`` engine — ONE
+    compiled decode program over a dp-sharded slot batch with the KV
+    pool's physical-block dim sharded over ``dp`` and KV heads over
+    ``tp`` — is token-identical to the router-fronted replicas-mode
+    twin on a mixed trace (8-device CI mesh: dp=4 x tp=2), composes
+    with fused ``decode_steps=K``, keeps per-chip KV bytes equal to a
+    tp-only replica serving its share of the slots, and demotes the
+    router to front-end admission (mixing a dp_tp engine with another
+    replica raises)."""
+    from deepspeed_tpu.serving import ReplicaRouter
+
+    deepspeed_tpu.comm.reset_topology()
+    e2 = deepspeed_tpu.init_inference(
+        gpt2.build(tiny_cfg),
+        config={"dtype": "fp32", "tensor_parallel": {"tp_size": 2}})
+    dp = dict(e2.mesh.shape)["dp"]
+    assert dp == 4, e2.mesh.shape        # 8 devices / tp=2
+    kw = dict(max_seq_len=128, block_size=8, prefill_chunk=16,
+              prefill_batch=2, prefix_caching=False, debug_checks=True)
+    rng = np.random.default_rng(7)
+
+    def mixed_trace():
+        r = np.random.default_rng(7)
+        return [Request(uid=i,
+                        prompt=r.integers(0, tiny_cfg.vocab_size,
+                                          int(r.integers(4, 40))),
+                        max_new_tokens=int(r.integers(2, 12)))
+                for i in range(10)]
+
+    # replicas-mode twin on the SAME mesh: the token-identity reference
+    srv_ref = ServingEngine(e2, slots=8, **kw)
+    outs_ref = srv_ref.serve(mixed_trace())
+
+    srv_dp = ServingEngine(e2, slots=8, engine_mode="dp_tp", **kw)
+    assert srv_dp.dp_degree == 4 and srv_dp.tp_degree == 2
+    router = ReplicaRouter([srv_dp], debug_checks=True)
+    handles = [router.submit(r) for r in mixed_trace()]
+    while router.step():
+        pass
+    for r, h in zip(mixed_trace(), handles):
+        np.testing.assert_array_equal(h.result(timeout=0), outs_ref[r.uid],
+                                      err_msg=f"uid {r.uid}")
+    st = srv_dp.stats()
+    assert st["engine_mode"] == "dp_tp"
+    assert st["compile_count"] == 2      # ONE decode + ONE prefill program
+    assert st["retraces_observed"] == 0
+
+    # fused multi-step composes with the 2-D mesh: same tokens again
+    srv_dpf = ServingEngine(e2, slots=8, engine_mode="dp_tp",
+                            decode_steps=4, **kw)
+    outs_f = srv_dpf.serve(mixed_trace())
+    for r in mixed_trace():
+        np.testing.assert_array_equal(outs_f[r.uid], outs_ref[r.uid],
+                                      err_msg=f"uid {r.uid}")
+    assert srv_dpf.stats()["host_fence_waits"] > 0
+
+    # per-chip KV bytes: the dp_tp pool (4x blocks over 4x chips) costs
+    # each chip exactly what a tp-only replica serving slots/dp costs
+    tp_only = ServingEngine(e2, slots=8 // dp, **kw)
+    assert srv_dp.stats()["kv_pool_bytes_per_chip"] == \
+        tp_only.stats()["kv_pool_bytes_per_chip"]
+
+    # router demotion: a dp_tp engine must be the SOLE replica
+    with pytest.raises(ValueError, match="sole"):
+        ReplicaRouter([srv_dp, srv_ref])
