@@ -133,7 +133,7 @@ def init_router(model=None, config=None, params=None, *, replicas=2,
                 metrics_host="127.0.0.1", max_queue_depth=None,
                 shed_classes=("batch",), burn_threshold=None,
                 pull_retries=2, pull_backoff_s=0.0, pull_timeout_s=None,
-                max_rehomes=3, **serving_kwargs):
+                max_rehomes=3, prefill_workers=None, **serving_kwargs):
     """Multi-replica serving entry (ROADMAP item 1): ``replicas`` ×
     ``init_serving`` engines — all sharing ONE weight pytree (the first
     replica's initialized/loaded params are reused, so every replica is
@@ -182,12 +182,29 @@ def init_router(model=None, config=None, params=None, *, replicas=2,
     ``pull_backoff_s`` / ``pull_timeout_s``) with checksum-verified
     bytes, and ``max_queue_depth`` / ``burn_threshold`` bound admission
     by shedding ``shed_classes`` work with typed ``RequestRejected``
-    results under overload."""
-    from .serving import ReplicaRouter
+    results under overload.
 
+    ``prefill_workers=N`` disaggregates the fleet (docs/inference.md
+    "Disaggregated serving"): the first N replicas build with
+    ``role="prefill"`` (admission + chunked prefill only — they emit the
+    first token, demote the prompt chain to their host tier, and hand
+    the session off), the rest with ``role="decode"`` (steady-state
+    token generation over pulled KV).  Requires ``kv_pull=True`` and
+    ``host_blocks > 0`` in ``serving_kwargs`` (the handoff travels as a
+    host-tier chain export/import).  Default ``None`` keeps every
+    replica ``role="both"`` — bit-identical to the colocated fleet."""
+    from .serving import ReplicaRouter, plan_roles
+
+    if prefill_workers and "role" in serving_kwargs:
+        raise ValueError(
+            "pass prefill_workers= OR a per-fleet role=, not both — "
+            "prefill_workers already assigns each replica's role")
+    roles = plan_roles(int(replicas), prefill_workers)
     reps = []
-    for _ in range(int(replicas)):
-        srv = init_serving(model, config, params, **serving_kwargs)
+    for role in roles:
+        per = serving_kwargs if not prefill_workers else \
+            {**serving_kwargs, "role": role}
+        srv = init_serving(model, config, params, **per)
         if params is None:
             params = srv.engine.params
         reps.append(srv)
@@ -210,6 +227,8 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
                  prefill_chunk=128, prefix_caching=True, decode_steps=1,
                  engine_mode="replicas", spec_tokens=0,
                  quantize=None, host_blocks=0, swap_batch=8, draft=None,
+                 role="both", nvme_blocks=0, nvme_high_watermark=0.9,
+                 nvme_path=None,
                  ngram_max=3, ngram_min=1,
                  shard_kv=None, topology=None, debug_checks=False,
                  trace_capacity=16384, slo_targets=None, peak_flops=None,
@@ -273,6 +292,17 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
     parity loss (promoted bytes are bit-identical to what was demoted).
     ``host_blocks=0`` (default) is byte-identical to prior behavior.
     See docs/inference.md "Tiered KV".
+
+    ``nvme_blocks=N`` adds an NVMe spill file of N blocks BELOW the host
+    arena (``nvme_path=`` names the file; default mints a tempfile the
+    engine deletes on close): past ``nvme_high_watermark`` of the arena
+    the LRU tail spills to disk via ``ops/aio.py``, and promotion stages
+    spilled blocks back through the same double-buffered prefetch path —
+    every NVMe exit re-verified against the stored checksum.
+    ``role="prefill"|"decode"`` dedicates the engine to one phase of a
+    disaggregated fleet behind :func:`init_router` (``role="both"``, the
+    default, is bit-identical to prior behavior); see docs/inference.md
+    "Disaggregated serving".
 
     ``debug_checks=True`` turns on the correctness tooling
     (``deepspeed_tpu/analysis/``): the recompile sentry raises on any
@@ -346,7 +376,9 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
                          decode_steps=decode_steps, engine_mode=engine_mode,
                          spec_tokens=spec_tokens, quantize=quantize,
                          host_blocks=host_blocks, swap_batch=swap_batch,
-                         draft=draft,
+                         draft=draft, role=role, nvme_blocks=nvme_blocks,
+                         nvme_high_watermark=nvme_high_watermark,
+                         nvme_path=nvme_path,
                          ngram_max=ngram_max, ngram_min=ngram_min,
                          shard_kv=shard_kv, debug_checks=debug_checks,
                          trace_capacity=trace_capacity,

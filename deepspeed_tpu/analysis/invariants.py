@@ -384,6 +384,54 @@ def audit_host_store(store, staged_keys) -> None:
                 f"{_fmt_key(key)} that is NOT flagged in-flight — the "
                 "LRU could free its bytes mid-transfer")
 
+    # NVMe third tier (when attached): the *spilled* residency state must
+    # stay exclusive with arena residency (content-addressed bytes live in
+    # exactly one of the two host-side tiers), and the spill file's slot
+    # accounting must conserve exactly like the arena's.
+    nvme_snap = getattr(store, "nvme_snapshot", None)
+    if nvme_snap is None:
+        return
+    nfree, nentries = nvme_snap()
+    if not nentries and not nfree:
+        return
+    nnb = store.nvme_blocks
+    nfree_set = set(int(s) for s in nfree)
+    if len(nfree_set) != len(nfree):
+        raise PagedStateError(
+            "residency-conservation",
+            "NVMe free list contains duplicate file slots")
+    nowned = {}
+    for key, slot in nentries.items():
+        if key in entries:
+            raise PagedStateError(
+                "residency-conservation",
+                f"chain key {_fmt_key(key)} is resident in BOTH the host "
+                "arena and the NVMe spill file — tier residency must be "
+                "exclusive (the dedup rule frees the file slot when the "
+                "arena copy lands)")
+        if not (0 <= int(slot) < nnb):
+            raise PagedStateError(
+                "residency-conservation",
+                f"NVMe entry {_fmt_key(key)} maps out-of-range file slot "
+                f"{slot} (spill file has {nnb})")
+        if slot in nowned:
+            raise PagedStateError(
+                "residency-conservation",
+                f"NVMe file slot {slot} owned by two entries "
+                f"({_fmt_key(nowned[slot])} and {_fmt_key(key)})")
+        if slot in nfree_set:
+            raise PagedStateError(
+                "residency-conservation",
+                f"NVMe file slot {slot} is on the free list but owned "
+                f"by entry {_fmt_key(key)}")
+        nowned[int(slot)] = key
+    for slot in range(nnb):
+        if slot not in nfree_set and slot not in nowned:
+            raise PagedStateError(
+                "residency-conservation",
+                f"NVMe file slot {slot} is neither free nor owned — "
+                "leaked out of the spill file entirely")
+
 
 def audit_router(router) -> None:
     """Verify the router-level invariants (module docstring:

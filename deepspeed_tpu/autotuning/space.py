@@ -62,6 +62,11 @@ BASE_SERVING_CONFIG: Dict[str, Any] = {
     "quantize": None,
     "host_blocks": 0,
     "swap_batch": 8,
+    "role": "both",
+    "nvme_blocks": 0,
+    "nvme_high_watermark": 0.9,
+    "replicas": 1,
+    "prefill_workers": 0,
     "shard_kv": None,
     "topology": 1,
     "decode_steps": 1,
@@ -158,7 +163,10 @@ def compile_budget(config: Dict[str, Any]) -> int:
     while_loop REPLACES the per-token decode program (same sentry name,
     same budget slot), so the count is K-invariant.  ``engine_mode=
     "dp_tp"`` likewise compiles the same two programs — one dp-sharded
-    decode instead of N per-replica copies."""
+    decode instead of N per-replica copies.  ``nvme_blocks`` and ``role``
+    add NOTHING: the NVMe tier spills/promotes through the host arena's
+    existing two swap programs (the file I/O is host-side ``ops/aio``),
+    and a role only gates which host-side scheduler phases run."""
     if config.get("spec_tokens"):
         budget = 2
     elif config.get("chunked_prefill", True):
@@ -263,6 +271,56 @@ def _c_decode_steps(config, space) -> Optional[str]:
     return None
 
 
+def _c_role_tiered(config, space) -> Optional[str]:
+    role = config.get("role") or "both"
+    if role not in ("prefill", "decode", "both"):
+        return (f"role={role!r} — expected 'prefill', 'decode' or 'both'")
+    if role != "both" and not int(config.get("host_blocks") or 0):
+        return (f"role={role!r} needs the tiered KV cache "
+                "(host_blocks > 0): the prefill→decode handoff travels "
+                "as a host-tier chain export/import")
+    return None
+
+
+def _c_prefill_ratio(config, space) -> Optional[str]:
+    pw = int(config.get("prefill_workers") or 0)
+    reps = int(config.get("replicas") or 1)
+    if pw < 0:
+        return f"prefill_workers must be >= 0, got {pw}"
+    if pw and pw >= reps:
+        return (f"prefill_workers={pw} with replicas={reps}: the "
+                "prefill_workers:decode_workers ratio must keep at least "
+                "one worker on each side")
+    if pw and not int(config.get("host_blocks") or 0):
+        return ("a disaggregated fleet (prefill_workers > 0) needs "
+                "host_blocks > 0 on every replica — the handoff is a "
+                "host-tier chain pull")
+    return None
+
+
+def _c_nvme_tier(config, space) -> Optional[str]:
+    nb = int(config.get("nvme_blocks") or 0)
+    if nb < 0:
+        return f"nvme_blocks must be >= 0, got {nb}"
+    if nb and not int(config.get("host_blocks") or 0):
+        return (f"nvme_blocks={nb} needs the host tier above it "
+                "(host_blocks > 0)")
+    return None
+
+
+def _c_nvme_watermark(config, space) -> Optional[str]:
+    wm = float(config.get("nvme_high_watermark") or 0.9)
+    if not (0.0 < wm <= 1.0):
+        return f"nvme_high_watermark={wm} outside (0, 1]"
+    hb = int(config.get("host_blocks") or 0)
+    sb = int(config.get("swap_batch") or 0)
+    if int(config.get("nvme_blocks") or 0) and hb and sb > int(wm * hb):
+        return (f"swap_batch={sb} exceeds the host-arena watermark budget "
+                f"int({wm} * {hb}) — one promotion batch would "
+                "immediately re-spill its own head")
+    return None
+
+
 def _c_engine_mode(config, space) -> Optional[str]:
     mode = config.get("engine_mode") or "replicas"
     if mode not in ("replicas", "dp_tp"):
@@ -296,6 +354,10 @@ CONSTRAINTS: Tuple[Tuple[str, Callable], ...] = (
     ("spec_window", _c_spec_window),
     ("tiered_needs_prefix_cache", _c_tiered_prefix),
     ("swap_batch_bounds", _c_swap_batch),
+    ("role_needs_tiered_kv", _c_role_tiered),
+    ("prefill_decode_ratio", _c_prefill_ratio),
+    ("nvme_needs_host_tier", _c_nvme_tier),
+    ("nvme_watermark_window", _c_nvme_watermark),
     ("pool_min_blocks", _c_pool_min),
     ("decode_steps_window", _c_decode_steps),
     ("engine_mode_exclusive", _c_engine_mode),

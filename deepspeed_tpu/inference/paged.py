@@ -60,6 +60,25 @@ whenever they leave it (promotion staging, cross-replica export/import)
 is dropped, and the chain recomputes from tokens instead of serving
 corrupt KV (docs/reliability.md).
 
+**NVMe third tier** (ZeRO-Infinity's HBM↔DRAM↔NVMe ladder, serving
+edition): :class:`NvmeBlockStore` is a fixed-slot spill FILE below the
+host arena, driven by ``ops/aio.py`` (``async_pwrite`` spill /
+``async_pread`` load, batched per chain with per-op status).  A
+:class:`HostBlockStore` built with an attached NVMe store spills its LRU
+tail past a high watermark instead of discarding it, adding a FOURTH
+residency state — *spilled*: the entry's ``chain_key`` + ``checksum``
+stay in the host-side table but its bytes live only in the spill file.
+Spilled entries still answer :meth:`HostBlockStore.probe_run` (a
+returning session's prefix survives arbitrary idle), and
+:meth:`HostBlockStore.promote_spilled` moves them back into arena slots
+before staging — verifying the checksum at the NVMe exit exactly like
+every arena exit, so an NVMe bit flip (or a failed read, surfaced per-op
+by ``AsyncIOHandle.wait_statuses``) drops the entry and recomputes
+instead of serving stale or corrupt staging bytes.  Residency stays
+exclusive across all three tiers: a key is device-resident, arena-
+resident/in-flight, or spilled — never two at once (the
+``residency-conservation`` audit covers the ladder end to end).
+
 **Tensor parallelism**: everything in this module is per-host and
 head-sharding-invariant.  Block ids, refcounts, and trie keys index
 PHYSICAL BLOCKS (position spans), never attention heads — when the
@@ -81,6 +100,8 @@ from collections import OrderedDict, deque
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ..ops.aio import AsyncIOHandle, swap_chain_read, swap_chain_write
 
 #: physical block 0 is never allocated; discarded writes are routed there
 SCRATCH_BLOCK = 0
@@ -468,6 +489,155 @@ class PrefixCache:
 
 
 @dataclasses.dataclass
+class _NvmeEntry:
+    key: bytes                  # chain_key of the block's content
+    slot: int                   # file slot (byte offset = slot * nbytes)
+    checksum: int = 0           # block_checksum of the spilled bytes
+
+
+class NvmeBlockStore:
+    """NVMe spill file below the host arena — the third rung of the
+    serving KV ladder (module docstring "NVMe third tier").
+
+    A fixed-slot file of ``num_blocks`` whole-KV-block records (slot
+    ``i`` at byte offset ``i * block_nbytes``) driven by
+    :class:`~deepspeed_tpu.ops.aio.AsyncIOHandle` — batched chain writes
+    on spill, batched chain reads on load, each op's success surfaced
+    individually (``wait_statuses``) so one failed read drops exactly
+    one entry.  Entries keep the block's :func:`chain_key` and
+    :func:`block_checksum`; :meth:`swap_in` re-hashes the bytes read
+    back and refuses a mismatch — the NVMe exit is gated exactly like
+    every arena exit.  LRU within the tier: spilling onto a full store
+    discards the oldest spilled entry (the coldest bytes in the whole
+    ladder — recomputable from tokens, just not for free)."""
+
+    def __init__(self, num_blocks: int,
+                 block_specs: Sequence[Tuple[tuple, object]],
+                 path: str, *, io_threads: int = 4):
+        if num_blocks < 1:
+            raise ValueError(
+                f"nvme tier num_blocks must be >= 1, got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self.path = str(path)
+        self._specs: List[Tuple[tuple, np.dtype]] = [
+            (tuple(shape), np.dtype(dtype)) for shape, dtype in block_specs]
+        self._leaf_nbytes = [
+            int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+            for shape, dt in self._specs]
+        self.block_nbytes = int(sum(self._leaf_nbytes))
+        self._io = AsyncIOHandle(num_threads=int(io_threads))
+        self._free = deque(range(self.num_blocks))
+        self._entries: "OrderedDict[bytes, _NvmeEntry]" = OrderedDict()
+        # counters (the engine folds these into stats()/metrics)
+        self.spills = 0
+        self.loads = 0
+        self.evictions = 0
+        self.write_failures = 0
+        self.read_failures = 0
+        self.checksum_rejects = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def has(self, key: bytes) -> bool:
+        return key in self._entries
+
+    def touch(self, key: bytes) -> None:
+        self._entries.move_to_end(key)
+
+    def checksum_of(self, key: bytes) -> int:
+        return self._entries[key].checksum
+
+    def pop(self, key: bytes) -> None:
+        """Release an entry (its bytes now live in a tier above, or are
+        being discarded): the file slot frees for reuse."""
+        e = self._entries.pop(key)
+        self._free.append(e.slot)
+
+    def nvme_snapshot(self):
+        """(free-list copy, ``{key: slot}``) for the residency audit."""
+        return list(self._free), {
+            k: e.slot for k, e in self._entries.items()}
+
+    # ------------------------------------------------------- serialization
+    def _flatten(self, block_arrays: Sequence[np.ndarray]) -> np.ndarray:
+        buf = np.empty(self.block_nbytes, np.uint8)
+        off = 0
+        for (shape, dt), arr, n in zip(self._specs, block_arrays,
+                                       self._leaf_nbytes):
+            flat = np.ascontiguousarray(arr, dtype=dt).reshape(-1)
+            buf[off:off + n] = flat.view(np.uint8)
+            off += n
+        return buf
+
+    def _unflatten(self, buf: np.ndarray) -> List[np.ndarray]:
+        out: List[np.ndarray] = []
+        off = 0
+        for (shape, dt), n in zip(self._specs, self._leaf_nbytes):
+            out.append(buf[off:off + n].view(dt).reshape(shape))
+            off += n
+        return out
+
+    # ---------------------------------------------------------- transfers
+    def swap_out(self, key: bytes, block_arrays: Sequence[np.ndarray],
+                 checksum: int) -> bool:
+        """Spill one block's bytes to the file under ``key``; ``False``
+        when the write failed (the caller discards the block instead —
+        never trust a slot whose write may not have landed).  A duplicate
+        key keeps the existing record (content-addressed: same key, same
+        bytes) and refreshes recency."""
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return True
+        if not self._free:
+            old_key, old = next(iter(self._entries.items()))
+            del self._entries[old_key]
+            self._free.append(old.slot)
+            self.evictions += 1
+        slot = self._free.popleft()
+        ok = swap_chain_write(self._io, self.path, [self._flatten(
+            block_arrays)], [slot * self.block_nbytes])[0]
+        if not ok:
+            self._free.append(slot)
+            self.write_failures += 1
+            return False
+        self._entries[key] = _NvmeEntry(key=key, slot=slot,
+                                        checksum=int(checksum))
+        self.spills += 1
+        return True
+
+    def swap_in(self, key: bytes) -> Optional[List[np.ndarray]]:
+        """Read one spilled block's bytes back; verifies the per-op aio
+        status AND the stored checksum at this NVMe exit.  On success the
+        per-leaf arrays return and the entry REMAINS (the caller pops it
+        once the bytes land in the tier above); on a failed read or a
+        checksum mismatch the entry is dropped — the chain truncates
+        there and recomputes from tokens."""
+        e = self._entries[key]
+        buf = np.empty(self.block_nbytes, np.uint8)
+        ok = swap_chain_read(self._io, self.path, [buf],
+                             [e.slot * self.block_nbytes])[0]
+        if not ok:
+            self.read_failures += 1
+            self.pop(key)
+            return None
+        arrays = self._unflatten(buf)
+        if block_checksum(arrays) != e.checksum:
+            self.checksum_rejects += 1
+            self.pop(key)
+            return None
+        self.loads += 1
+        return arrays
+
+    def close(self) -> None:
+        self._io.close()
+
+
+@dataclasses.dataclass
 class _HostEntry:
     key: bytes                  # chain_key of the block's content
     slot: int                   # arena slot holding the block's bytes
@@ -492,19 +662,35 @@ class HostBlockStore:
     pool leaf — a quantized pool's codes and scale rows are separate
     leaves, so they demote/promote together by construction.
 
-    Entry states: *resident* (bytes live in the arena, slot owned) or
+    Entry states: *resident* (bytes live in the arena, slot owned),
     *in-flight* (a staged promotion — the engine has issued the H2D
-    ``device_put`` but not yet scattered into the pool).  In-flight
+    ``device_put`` but not yet scattered into the pool), or — with an
+    attached :class:`NvmeBlockStore` — *spilled* (bytes live only in the
+    spill file; the key + checksum stay discoverable).  In-flight
     entries are never LRU-evicted (the staged transfer would read freed
     bytes) and are released either by :meth:`pop` (promotion landed) or
     :meth:`mark_in_flight(key, False)`` (stale prefetch discarded).
+
+    ``nvme`` + ``nvme_watermark``: past the watermark (a fraction of
+    ``num_blocks``), :meth:`put` spills the arena's LRU tail to the NVMe
+    store instead of discarding it — demotion past a FULL arena likewise
+    spills the LRU victim.  :meth:`promote_spilled` is the way back up.
     """
 
     def __init__(self, num_blocks: int,
-                 block_specs: Sequence[Tuple[tuple, object]]):
+                 block_specs: Sequence[Tuple[tuple, object]],
+                 *, nvme: Optional[NvmeBlockStore] = None,
+                 nvme_watermark: float = 1.0):
         if num_blocks < 1:
             raise ValueError(
                 f"host tier num_blocks must be >= 1, got {num_blocks}")
+        if not (0.0 < float(nvme_watermark) <= 1.0):
+            raise ValueError(
+                f"nvme_watermark must be in (0, 1], got {nvme_watermark}")
+        self._nvme = nvme
+        #: arena occupancy above which put() spills LRU entries down
+        self._hi_blocks = max(1, int(float(nvme_watermark)
+                                     * int(num_blocks)))
         self.num_blocks = int(num_blocks)
         self.arenas: List[np.ndarray] = [
             np.zeros((self.num_blocks,) + tuple(shape), dtype)
@@ -541,7 +727,106 @@ class HostBlockStore:
             k: (e.slot, e.in_flight) for k, e in self._entries.items()}
 
     def has(self, key: bytes) -> bool:
-        return key in self._entries
+        """Tier membership: arena-resident, in-flight, OR spilled — the
+        key's bytes are somewhere in the host/NVMe ladder and a demotion
+        of the same content would be redundant."""
+        return key in self._entries or (
+            self._nvme is not None and self._nvme.has(key))
+
+    def is_spilled(self, key: bytes) -> bool:
+        """True when the key's bytes live only in the NVMe spill file
+        (they must :meth:`promote_spilled` before staging can read
+        them)."""
+        return key not in self._entries and \
+            self._nvme is not None and self._nvme.has(key)
+
+    def promote_spilled(self, keys: Sequence[bytes]) -> int:
+        """Load the spilled entries of a probed run back into arena
+        slots (NVMe → arena, the ladder's way up); returns the length of
+        the leading run that is arena-resident afterwards.  Each load is
+        verified at the NVMe exit (per-op aio status + checksum —
+        :meth:`NvmeBlockStore.swap_in`); a failed or corrupt load drops
+        that entry and truncates the run there, exactly like a failed
+        arena-exit verify.  Loading may itself spill OTHER cold arena
+        entries past the watermark (``put`` path) — never the run being
+        promoted, whose entries are the arena's newest: the promoted
+        count is capped at the watermark budget, because one more load
+        would start re-spilling this very run's head (thrash).  Longer
+        chains promote incrementally — the engine pops staged entries to
+        the device as it goes, freeing budget for the tail.
+
+        In-flight entries are subtracted from the budget: they occupy
+        arena slots but can never spill, so ``_spill_to_watermark``
+        skips them and would otherwise re-spill this run's own head the
+        moment promoted-count + pinned-count crossed the watermark."""
+        n = 0
+        if self._nvme is not None:
+            pinned = sum(1 for e in self._entries.values()
+                         if e.in_flight)
+            budget = max(0, self._hi_blocks - pinned)
+        else:
+            budget = self.num_blocks
+        for key in keys:
+            if n >= budget:
+                break
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                n += 1
+                continue
+            if self._nvme is None or not self._nvme.has(key):
+                break
+            checksum = self._nvme.checksum_of(key)
+            arrays = self._nvme.swap_in(key)
+            if arrays is None:
+                # dropped at the NVMe exit gate — probe results naming
+                # this key are stale now
+                self.version += 1
+                break
+            if self.put(key, arrays, checksum=checksum) is None:
+                break       # arena saturated in-flight; entry stays spilled
+            n += 1
+        return n
+
+    # ------------------------------------------------- nvme tier surface
+    @property
+    def nvme_blocks(self) -> int:
+        return 0 if self._nvme is None else self._nvme.num_blocks
+
+    @property
+    def nvme_blocks_in_use(self) -> int:
+        return 0 if self._nvme is None else self._nvme.blocks_in_use
+
+    @property
+    def nvme_spills(self) -> int:
+        return 0 if self._nvme is None else self._nvme.spills
+
+    @property
+    def nvme_loads(self) -> int:
+        return 0 if self._nvme is None else self._nvme.loads
+
+    @property
+    def nvme_evictions(self) -> int:
+        return 0 if self._nvme is None else self._nvme.evictions
+
+    @property
+    def nvme_read_failures(self) -> int:
+        return 0 if self._nvme is None else self._nvme.read_failures
+
+    @property
+    def nvme_write_failures(self) -> int:
+        return 0 if self._nvme is None else self._nvme.write_failures
+
+    @property
+    def nvme_checksum_rejects(self) -> int:
+        return 0 if self._nvme is None else self._nvme.checksum_rejects
+
+    def nvme_snapshot(self):
+        """(file free-list copy, ``{key: slot}``) — empty without an
+        attached NVMe store; the residency audit checks spilled/resident
+        exclusivity and file-slot conservation against it."""
+        if self._nvme is None:
+            return [], {}
+        return self._nvme.nvme_snapshot()
 
     def put(self, key: bytes,
             block_arrays: Sequence[np.ndarray],
@@ -556,16 +841,13 @@ class HostBlockStore:
         the exporter's sum); ``None`` computes it from the bytes."""
         if key in self._entries:
             self._entries.move_to_end(key)
+            if self._nvme is not None and self._nvme.has(key):
+                # exclusive residency: the arena copy wins (same bytes —
+                # content-addressed), the file slot frees
+                self._nvme.pop(key)
             return self._entries[key].slot
         if not self._free:
-            for k, e in self._entries.items():  # oldest first
-                if not e.in_flight:
-                    del self._entries[k]
-                    self._free.append(e.slot)
-                    self.evictions += 1
-                    self.version += 1
-                    break
-            if not self._free:
+            if not self._evict_oldest(spill=self._nvme is not None):
                 return None
         slot = self._free.popleft()
         for arena, arr in zip(self.arenas, block_arrays):
@@ -574,8 +856,41 @@ class HostBlockStore:
             key=key, slot=slot,
             checksum=(block_checksum(block_arrays)
                       if checksum is None else int(checksum)))
+        if self._nvme is not None and self._nvme.has(key):
+            self._nvme.pop(key)
         self.version += 1
+        self._spill_to_watermark()
         return slot
+
+    def _evict_oldest(self, spill: bool) -> bool:
+        """LRU-evict the oldest non-in-flight entry; with ``spill`` its
+        bytes move DOWN the ladder (``NvmeBlockStore.swap_out`` keeps
+        key + checksum) instead of being discarded.  ``False`` when every
+        entry is pinned in-flight."""
+        for k, e in self._entries.items():  # oldest first
+            if e.in_flight:
+                continue
+            if spill and self._nvme is not None:
+                # a failed write counts on the nvme store and the block
+                # simply discards — recomputable, never trusted half-spilled
+                self._nvme.swap_out(
+                    k, [arena[e.slot] for arena in self.arenas],
+                    e.checksum)
+            del self._entries[k]
+            self._free.append(e.slot)
+            self.evictions += 1
+            self.version += 1
+            return True
+        return False
+
+    def _spill_to_watermark(self) -> None:
+        """Demote the LRU tail to NVMe until arena occupancy is back at
+        the high watermark (no-op without an attached NVMe store)."""
+        if self._nvme is None:
+            return
+        while self.blocks_in_use > self._hi_blocks:
+            if not self._evict_oldest(spill=True):
+                break
 
     def read(self, key: bytes) -> List[np.ndarray]:
         """Per-leaf views of a resident block's bytes (no copy)."""
@@ -660,7 +975,9 @@ class HostBlockStore:
         """Keys of the longest host-resident run of full blocks
         ``start_block, start_block+1, ...`` of ``tokens[:max_tokens]`` —
         the continuation probe admission uses after the device trie's own
-        hits end.  No state is touched beyond LRU recency."""
+        hits end.  Spilled entries count as resident — their bytes are
+        still in the ladder (``promote_spilled`` brings them up before
+        staging).  No state is touched beyond LRU recency."""
         keys: List[bytes] = []
         n = min(len(tokens), int(max_tokens)) // int(block_size)
         if n <= int(start_block):
@@ -668,8 +985,11 @@ class HostBlockStore:
         run = chain_keys(tokens, n, block_size)
         for i in range(int(start_block), n):
             key = run[i]
-            if key not in self._entries:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+            elif self._nvme is not None and self._nvme.has(key):
+                self._nvme.touch(key)
+            else:
                 break
-            self._entries.move_to_end(key)
             keys.append(key)
         return keys

@@ -197,6 +197,8 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import inspect
+import os
+import tempfile
 import threading
 import time
 from collections import deque
@@ -221,7 +223,8 @@ from ..telemetry.slo import SLOTracker
 from ..utils.logging import log_dist
 from ..utils.lru import LRUCache
 from .paged import (BlockAllocator, GroupedBlockAllocator, HostBlockStore,
-                    PrefixCache, TransportError, chain_key, chain_keys)
+                    NvmeBlockStore, PrefixCache, TransportError, chain_key,
+                    chain_keys)
 from .spec import NGramProposer, greedy_accept
 
 
@@ -741,6 +744,10 @@ class ServingEngine:
                  quantize: Optional[str] = None,
                  host_blocks: int = 0,
                  swap_batch: int = 8,
+                 role: str = "both",
+                 nvme_blocks: int = 0,
+                 nvme_high_watermark: float = 0.9,
+                 nvme_path: Optional[str] = None,
                  draft=None,
                  ngram_max: int = 3,
                  ngram_min: int = 1,
@@ -900,6 +907,41 @@ class ServingEngine:
                 "prefill mode with prefix_caching=True — promoted chains "
                 "re-register in the prefix trie (drop prompt_buckets / "
                 "prefix_caching=False, or host_blocks)")
+
+        # ----- disaggregated serving role + NVMe third tier
+        self.role = str(role)
+        if self.role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode' or 'both', got {role!r}")
+        if self.role != "both" and not self.host_blocks:
+            raise ValueError(
+                f"role={self.role!r} needs the tiered KV cache "
+                "(host_blocks > 0): the prefill→decode handoff travels as "
+                "a host-tier chain export/import — pass host_blocks, or "
+                "role='both'")
+        self.nvme_blocks = int(nvme_blocks)
+        if self.nvme_blocks < 0:
+            raise ValueError(
+                f"nvme_blocks must be >= 0, got {nvme_blocks}")
+        if self.nvme_blocks and not self.host_blocks:
+            raise ValueError(
+                f"nvme_blocks={nvme_blocks} needs the host tier above it "
+                "(host_blocks > 0) — NVMe entries spill from and promote "
+                "through the host arena, never the device pool directly")
+        self.nvme_high_watermark = float(nvme_high_watermark)
+        if not (0.0 < self.nvme_high_watermark <= 1.0):
+            raise ValueError(
+                f"nvme_high_watermark must be in (0, 1], got "
+                f"{nvme_high_watermark}")
+        if self.nvme_blocks and \
+                self.swap_batch > int(self.nvme_high_watermark
+                                      * self.host_blocks):
+            raise ValueError(
+                f"swap_batch={swap_batch} exceeds the host-arena watermark "
+                f"budget int({nvme_high_watermark} * {host_blocks}) — one "
+                "promotion batch would immediately re-spill its own head; "
+                "lower swap_batch or raise nvme_high_watermark/host_blocks")
+        self._nvme_path_arg = nvme_path
 
         # ----- tensor parallelism: one pool, committed on the engine mesh so
         # the very first step sees the same placement as every later one —
@@ -1093,11 +1135,39 @@ class ServingEngine:
         self._promote_fn = None
         self._staged: Dict[Any, Dict[str, Any]] = {}
         self._prefetch_gate: Dict[Any, tuple] = {}
+        # prefill-role replicas park finished-prefill requests here (KV
+        # demoted, slot released) until the router pumps take_handoffs()
+        self._handoff_ready: List[_PendingItem] = []
         self._staging_shardings = None
+        self._nvme: Optional[NvmeBlockStore] = None
+        self.nvme_path: Optional[str] = None
+        self._nvme_owns_path = False
+        self._nvme_spills_seen = 0       # store-counter deltas already
+        self._nvme_loads_seen = 0        # mirrored into the registry
+        self._nvme_rejects_seen = 0
         if self.host_blocks:
             specs = [(tuple(l.shape[:1]) + tuple(l.shape[2:]), l.dtype)
                      for l in jax.tree_util.tree_leaves(self._swap_pools())]
-            self._host = HostBlockStore(self.host_blocks, specs)
+            if self.nvme_blocks:
+                # NVMe third tier below the arena: spill file at nvme_path
+                # (auto-minted tempfile when unset — the engine owns and
+                # unlinks it at close); entries keep chain_key + checksum
+                # and every NVMe exit re-verifies before bytes re-enter
+                # the arena (paged.py NvmeBlockStore)
+                path = self._nvme_path_arg
+                if path is None:
+                    fd, path = tempfile.mkstemp(
+                        prefix="ds_kv_spill_", suffix=".bin")
+                    os.close(fd)
+                    self._nvme_owns_path = True
+                self.nvme_path = str(path)
+                self._nvme = NvmeBlockStore(
+                    self.nvme_blocks, specs, self.nvme_path)
+                self._host = HostBlockStore(
+                    self.host_blocks, specs, nvme=self._nvme,
+                    nvme_watermark=self.nvme_high_watermark)
+            else:
+                self._host = HostBlockStore(self.host_blocks, specs)
             # per-leaf device_put specs are fixed for the engine's life
             self._staging_shardings = self._swap_leaf_shardings()
 
@@ -1159,14 +1229,37 @@ class ServingEngine:
         self._c_swap_out = m.counter(
             "serving_kv_swaps_total",
             "KV blocks swapped between the device pool and the host tier",
-            direction="out")
+            direction="out", tier="host")
         self._c_swap_in = m.counter(
             "serving_kv_swaps_total",
             "KV blocks swapped between the device pool and the host tier",
-            direction="in")
+            direction="in", tier="host")
         self._c_swap_bytes = m.counter(
             "serving_swap_bytes_total",
-            "bytes moved over the device<->host KV tier (both directions)")
+            "bytes moved over the device<->host KV tier (both directions)",
+            tier="host")
+        # NVMe third-tier traffic (tier="nvme"): host-arena spills past the
+        # watermark and verified promotions back — synced by delta from the
+        # NvmeBlockStore counters at every swap commit point
+        self._c_nvme_out = m.counter(
+            "serving_kv_swaps_total",
+            "KV blocks swapped between the device pool and the host tier",
+            direction="out", tier="nvme")
+        self._c_nvme_in = m.counter(
+            "serving_kv_swaps_total",
+            "KV blocks swapped between the device pool and the host tier",
+            direction="in", tier="nvme")
+        self._c_nvme_bytes = m.counter(
+            "serving_swap_bytes_total",
+            "bytes moved over the device<->host KV tier (both directions)",
+            tier="nvme")
+        self._g_nvme_in_use = m.gauge(
+            "serving_nvme_blocks_in_use",
+            "KV blocks currently resident in the NVMe spill file")
+        self._c_handoffs = m.counter(
+            "serving_handoffs_total",
+            "prefill->decode handoffs extracted from a prefill-role "
+            "replica's scheduler")
         self._c_prefetch_miss = m.counter(
             "serving_prefetch_misses_total",
             "promotions that had to stage synchronously at admission "
@@ -1270,8 +1363,32 @@ class ServingEngine:
             + (f", quantize={self.quantize}" if self.quantize else "")
             + (f", tiered KV (host_blocks={self.host_blocks}, "
                f"{self._host.arena_bytes / 1e6:.1f}MB host arena, "
-               f"swap_batch={self.swap_batch})" if self._host else ""),
+               f"swap_batch={self.swap_batch})" if self._host else "")
+            + (f", nvme tier (nvme_blocks={self.nvme_blocks}, watermark="
+               f"{self.nvme_high_watermark}, {self.nvme_path})"
+               if self._nvme is not None else "")
+            + (f", role={self.role}" if self.role != "both" else ""),
             ranks=[0])
+
+    def close(self) -> None:
+        """Release host-side tier resources: join the NVMe aio handle and
+        unlink an auto-minted spill file (a caller-provided ``nvme_path``
+        is the caller's to keep).  Idempotent; the device pool and
+        compiled programs are garbage-collected as usual."""
+        nvme, self._nvme = self._nvme, None
+        if nvme is not None:
+            nvme.close()
+            if self._nvme_owns_path and self.nvme_path:
+                try:
+                    os.unlink(self.nvme_path)
+                except OSError:
+                    pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def _tp_ctx(self):
         """Context every compiled-fn invocation runs under: tracing happens
@@ -1712,6 +1829,11 @@ class ServingEngine:
         corruption and is clean); it drops on the next unpinned pass."""
         cut = len(keys)
         for i, key in enumerate(keys):
+            if self._host.is_spilled(key):
+                # no arena bytes to check yet: a spilled entry verifies at
+                # the NVMe exit instead (promote_spilled — per-op aio
+                # status + checksum re-hash), before staging can read it
+                continue
             if self._host.verify(key):
                 continue
             cut = min(cut, i)
@@ -1753,6 +1875,39 @@ class ServingEngine:
                                   resident=len(self._host))
         return dropped
 
+    def _sync_nvme_metrics(self) -> None:
+        """Mirror :class:`NvmeBlockStore` counter deltas into the metrics
+        registry at the swap commit points (sanctioned sync helper, lint
+        GL007 naming): ``tier="nvme"`` swap/byte counters, the spill-file
+        occupancy gauge, ``nvme_spill``/``nvme_load`` timeline instants,
+        and NVMe-exit checksum rejects folded into
+        ``serving_checksum_failures_total`` (one integrity ledger across
+        every tier boundary)."""
+        if self._nvme is None:
+            return
+        h = self._host
+        d_out = h.nvme_spills - self._nvme_spills_seen
+        d_in = h.nvme_loads - self._nvme_loads_seen
+        d_rej = h.nvme_checksum_rejects - self._nvme_rejects_seen
+        if d_out:
+            self._nvme_spills_seen = h.nvme_spills
+            self._c_nvme_out.inc(d_out)
+            self._c_nvme_bytes.inc(d_out * h.block_nbytes)
+            self.timeline.instant("nvme_spill", blocks=d_out,
+                                  bytes=d_out * h.block_nbytes)
+        if d_in:
+            self._nvme_loads_seen = h.nvme_loads
+            self._c_nvme_in.inc(d_in)
+            self._c_nvme_bytes.inc(d_in * h.block_nbytes)
+            self.timeline.instant("nvme_load", blocks=d_in,
+                                  bytes=d_in * h.block_nbytes)
+        if d_rej:
+            self._nvme_rejects_seen = h.nvme_checksum_rejects
+            self._c_checksum_fail.inc(d_rej)
+            self.timeline.instant("checksum_fail", op="nvme",
+                                  blocks=d_rej)
+        self._g_nvme_in_use.set(h.nvme_blocks_in_use)
+
     def _demote_blocks(self, blocks: List[int], keys: List[bytes]) -> int:
         """Copy the given device blocks into the host arena under their
         chain keys — the sanctioned blocking demotion helper (lint GL007):
@@ -1791,6 +1946,7 @@ class ServingEngine:
             self.timeline.instant(
                 "demote", blocks=stored,
                 bytes=stored * self._host.block_nbytes)
+        self._sync_nvme_metrics()   # arena stores may have spilled LRU tail
         return stored
 
     def _demote_evict_batch(self) -> int:
@@ -1859,6 +2015,23 @@ class ServingEngine:
         template = jax.tree_util.tree_structure(self._swap_pools())
         for i in range(0, len(keys), m):
             chunk = keys[i:i + m]
+            short = False
+            if self._nvme is not None:
+                # NVMe promotion staged through this same double-buffered
+                # path: load the chunk's spilled entries back into arena
+                # slots (verified at the NVMe exit) just before the read —
+                # a shortfall (failed load, or the watermark budget is
+                # holding earlier still-in-flight chunks) truncates the
+                # stageable run here AND drops every later chunk (the
+                # chain is only walkable contiguously); the tail stays
+                # spilled and promotes on a later pass once the engine
+                # pops the staged prefix
+                n_ok = self._host.promote_spilled(chunk)
+                self._sync_nvme_metrics()
+                if n_ok < len(chunk):
+                    chunk, short = chunk[:n_ok], True
+                    if not chunk:
+                        break
             per_leaf = None
             for j, key in enumerate(chunk):
                 arrs = self._host.read(key)
@@ -1873,6 +2046,8 @@ class ServingEngine:
                 template, [jax.device_put(buf, sh)
                            for buf, sh in zip(per_leaf, shardings)])
             chunks.append((chunk, staged))
+            if short:
+                break
         return chunks
 
     def _issue_prefetch(self, pending) -> None:
@@ -1918,8 +2093,16 @@ class ServingEngine:
             # the queue head stays blocked — admission consumes the
             # staged prefix and stages the remainder there
             keys = keys[:2 * self.swap_batch]
-            self._staged[req.uid] = {
-                "keys": keys, "chunks": self._stage_chunks(keys)}
+            chunks = self._stage_chunks(keys)
+            # NVMe shortfalls truncate inside _stage_chunks — the record
+            # must name exactly the keys that really staged (and are
+            # pinned in-flight), or a later discard would try to unflag
+            # entries that never left the spill file
+            keys = [k for ck, _ in chunks for k in ck]
+            if not keys:
+                self._prefetch_gate[req.uid] = gate
+                continue
+            self._staged[req.uid] = {"keys": keys, "chunks": chunks}
             self.timeline.instant("prefetch_issue", uid=str(req.uid),
                                   blocks=len(keys))
 
@@ -1930,7 +2113,10 @@ class ServingEngine:
         pin must outlive either single record)."""
         still = {k for rec in self._staged.values() for k in rec["keys"]}
         for key in keys:
-            if key not in still and self._host.has(key):
+            if key not in still and self._host.has(key) \
+                    and not self._host.is_spilled(key):
+                # a spilled key has no arena entry to unflag (it re-spilled
+                # or never promoted) — nothing to roll back
                 self._host.mark_in_flight(key, False)
 
     def _discard_all_staged(self) -> None:
@@ -2482,6 +2668,11 @@ class ServingEngine:
         admitted0, preempted0 = self.admitted, self.preempted
         self._admit()
         self._run_prefill(params)
+        if self.role == "prefill":
+            # disaggregated mode: prefill-complete slots leave the decode
+            # rotation NOW — the decode dispatch below only ever advances
+            # prefilling/empty slots on this replica
+            self._extract_handoffs()
         # one decode step over every slot (per-sequence positions);
         # prefilling/empty slots point at the scratch block.  In
         # speculative mode the single-token step is replaced by a
@@ -2533,7 +2724,9 @@ class ServingEngine:
                 pass
             self._discard_all_staged()
             self._prefetch_gate.clear()
-        items = self._pending.drain()
+        # parked prefill-complete handoffs leave with the queue (their
+        # per-item trace cleanup already ran at extraction)
+        items = self.take_handoffs() + self._pending.drain()
         self._blocked_gate = None
         for item in items:
             # the latency span can only finish on the engine that admits
@@ -2580,6 +2773,7 @@ class ServingEngine:
         self._active.clear()
         for slot in range(self.slots):
             self._release_slot(slot)
+        items.extend(self.take_handoffs())
         items.extend(self._pending.drain())
         if self._host is not None:
             self._discard_all_staged()
@@ -2605,6 +2799,55 @@ class ServingEngine:
         self._g_queue_depth.set(0)
         self.timeline.instant("salvage", items=len(out))
         return out
+
+    # ------------------------------------------------ prefill-role handoffs
+    def _extract_handoffs(self) -> None:
+        """Prefill-role scheduling (``role="prefill"``): pull every slot
+        whose prefill just completed OUT of the decode rotation — its
+        first token is already streamed (TTFT is this replica's job), its
+        committed chain demotes to the host tier, and the request parks
+        in ``_handoff_ready`` for the router to re-route to a decode
+        worker as an ordinary integrity-checked KV pull.  Runs between
+        the prefill and decode dispatches of :meth:`step`, so a prefill
+        worker's decode program only ever sees empty/prefilling slots and
+        a long-prompt burst never time-shares a decode replica's TPOT.
+        The generated-so-far tokens fold into the resume prompt exactly
+        like a preemption, so the decode worker's greedy continuation is
+        token-exact."""
+        done = [s for s, st in self._active.items()
+                if st.phase == "decode"]
+        for slot in sorted(done, key=lambda s: self._active[s].admit_seq):
+            st = self._active.pop(slot)
+            nblocks = len(self._held[slot])
+            if self._host is not None:
+                self._demote_slot_blocks(slot, st)
+            self._release_slot(slot)
+            self._handoff_ready.append(_PendingItem(
+                req=st.req, prior=st.prior + st.out, priority=st.priority,
+                slo_class=st.slo_class, eos=st.eos, handle=st.handle))
+            uid = st.req.uid
+            # the TTFT span closed with the first token; the remaining
+            # latency accrues on the decode worker that admits the resume
+            # — this replica's stamp and route flow close now, exactly
+            # like drain()'s per-item cleanup
+            self._trace_times.pop(uid, None)
+            fid = self._flow_ids.pop(uid, None)
+            if fid is not None:
+                self.timeline.flow_end("route", fid, uid=str(uid),
+                                       handoff=True)
+            self._live_uids.discard(uid)
+            self._c_handoffs.inc()
+            self.timeline.instant("handoff", uid=str(uid), slot=slot,
+                                  blocks=nblocks,
+                                  tokens=len(st.prior) + len(st.out))
+
+    def take_handoffs(self) -> List[_PendingItem]:
+        """Hand the parked prefill-complete requests to the caller (the
+        router's per-step pump) and clear the parking list.  Empty on
+        ``role="both"``/``"decode"`` replicas — the list only ever fills
+        from :meth:`_extract_handoffs`."""
+        items, self._handoff_ready = self._handoff_ready, []
+        return items
 
     # ---------------------------------------------------- router probes/pull
     def affinity_probe(self, tokens) -> Dict[str, int]:
@@ -2677,6 +2920,14 @@ class ServingEngine:
                                     self.block_size)
         if keys:
             keys = self._verified_keys(keys)
+        if keys and self._nvme is not None:
+            # spilled entries must climb back into the arena before their
+            # bytes can be copied out (verified at the NVMe exit); the
+            # watermark budget may truncate a very long run — the importer
+            # gets the leading prefix, its tail recomputes or re-pulls
+            n_ok = self._host.promote_spilled(keys)
+            self._sync_nvme_metrics()
+            keys = keys[:n_ok]
         return keys, self._host.export_chain(keys), \
             self._host.export_checksums(keys)
 
@@ -2698,6 +2949,7 @@ class ServingEngine:
             self._c_checksum_fail.inc(rejects)
             self.timeline.instant("checksum_fail", op="import",
                                   blocks=rejects)
+        self._sync_nvme_metrics()   # imports may push the LRU tail to NVMe
         return n
 
     # ----------------------------------------------------------- batch serve
@@ -2735,6 +2987,12 @@ class ServingEngine:
         requests = list(requests)
         if not requests:
             return {}
+        if self.role != "both":
+            raise RuntimeError(
+                f"serve() on a role={self.role!r} replica — a "
+                "disaggregated worker only runs its half of the pipeline "
+                "(handoffs would never complete here); drive it behind a "
+                "ReplicaRouter, or build with role='both'")
         if self._pending or self._active:
             raise RuntimeError(
                 "serve() on a busy engine — requests are already in "
@@ -3195,6 +3453,13 @@ class ServingEngine:
             "quantize": self.quantize,
             "host_blocks": self.host_blocks,
             "swap_batch": self.swap_batch,
+            "role": self.role,
+            "nvme_blocks": self.nvme_blocks,
+            "nvme_high_watermark": self.nvme_high_watermark,
+            # the user-passed path (None = auto tempfile): a rebuilt
+            # engine mints its OWN spill file rather than contending for
+            # this engine's — behaviorally identical, never shared
+            "nvme_path": self._nvme_path_arg,
             "shard_kv": bool(self.kv_sharded),
             "topology": self.tp_degree,
             "debug_checks": self.debug_checks,
@@ -3270,6 +3535,8 @@ class ServingEngine:
         self._g_free_blocks.set(self._alloc.free_blocks)
         if self._host is not None:
             self._g_host_blocks_in_use.set(self._host.blocks_in_use)
+        if self._nvme is not None:
+            self._g_nvme_in_use.set(self._host.nvme_blocks_in_use)
         st = {
             "mode": "chunked" if self.chunked_prefill else "bucketed",
             "compile_count": self.compile_count,
@@ -3327,6 +3594,17 @@ class ServingEngine:
             "prefetch_wait_p50_s": self._h_prefetch_wait.quantile(0.50),
             "prefetch_wait_p95_s": self._h_prefetch_wait.quantile(0.95),
             "resume_recompute_tokens": int(self._c_resume_recompute.value),
+            # disaggregated serving + NVMe third tier (role="both" /
+            # nvme_blocks=0: "both" and zeros — schema stays stable)
+            "role": self.role,
+            "handoffs": int(self._c_handoffs.value),
+            "nvme_blocks": self.nvme_blocks,
+            "nvme_blocks_in_use": self._host.nvme_blocks_in_use
+            if self._host is not None else 0,
+            "nvme_spills": self._host.nvme_spills
+            if self._host is not None else 0,
+            "nvme_loads": self._host.nvme_loads
+            if self._host is not None else 0,
             # timeline ring health (telemetry/trace.py): dropped > 0 means
             # the ring wrapped — raise trace_capacity for longer history
             "trace_capacity": self.timeline.capacity,

@@ -70,6 +70,14 @@ def parse_args(args=None):
     parser.add_argument("--replicas", type=int, default=1,
                         help="local replica count for --serve when no "
                         "hostfile is present")
+    parser.add_argument("--prefill_workers", type=int, default=0,
+                        help="disaggregate the --serve fleet: the first N "
+                        "replicas take role=prefill (admission + chunked "
+                        "prefill, then hand sessions off as host-tier KV "
+                        "pulls) and the rest role=decode; workers read the "
+                        "assignment from DS_REPLICA_ROLE.  0 (default) "
+                        "keeps every replica role=both — the colocated "
+                        "fleet (serving/supervisor.py plan_roles)")
     parser.add_argument("--elastic_config", type=str, default="",
                         help="ds config json with the elasticity block; "
                         "defaults to the --deepspeed_config in the script "
@@ -353,9 +361,11 @@ def _serve_main(args) -> int:
     import socket
 
     from ..elasticity.elastic_agent import ElasticAgent
+    from ..serving.supervisor import plan_roles
 
     local_names = {"localhost", "127.0.0.1", socket.gethostname()}
     n = max(1, int(args.replicas))
+    prefill_workers = int(getattr(args, "prefill_workers", 0) or 0)
 
     def probe_hosts():
         pool = fetch_hostfile(args.hostfile)
@@ -368,6 +378,13 @@ def _serve_main(args) -> int:
     def launch_cmd(host, env):
         env["DS_REPLICA_ID"] = env.get("JAX_PROCESS_ID", "0")
         env["DS_NUM_REPLICAS"] = env.get("JAX_NUM_PROCESSES", str(n))
+        # role assignment rides the same env channel: the worker builds
+        # its engine with role=$DS_REPLICA_ROLE.  plan_roles validates
+        # the ratio once, at fleet-spec time, so a bad split fails the
+        # launch instead of each worker
+        roles = plan_roles(int(env["DS_NUM_REPLICAS"]), prefill_workers)
+        env["DS_REPLICA_ROLE"] = roles[int(env["DS_REPLICA_ID"]) %
+                                       len(roles)]
         inner = [sys.executable, "-u", args.user_script] + \
             list(args.user_args)
         if args.launcher == "local" or host in local_names or \

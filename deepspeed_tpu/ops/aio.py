@@ -5,17 +5,29 @@ Reference: ``csrc/aio/py_lib/deepspeed_py_aio_handle.cpp`` (libaio-backed
 ``runtime/swap_tensor/*``).  Here the backend is the worker-thread C++ library
 from ``op_builder.AsyncIOBuilder``; a synchronous numpy fallback keeps the API
 working without a toolchain.
+
+Submissions return an **op ticket** and :meth:`AsyncIOHandle.wait_statuses`
+reports per-ticket success — the serving NVMe tier
+(``inference/paged.py NvmeBlockStore``) needs to know *which* block read
+failed so exactly that chain entry is dropped and recomputed, instead of a
+batch-level failure count poisoning (or worse: silently passing) every
+staged buffer in the batch.  :meth:`AsyncIOHandle.wait` keeps the original
+aggregate-count contract.  :func:`swap_chain_write` / :func:`swap_chain_read`
+are the batched chain helpers: submit every block of a chain, wait ONCE,
+return per-block status aligned to the input order.
 """
 
 from __future__ import annotations
 
 import ctypes
 import os
-from typing import Optional
+from typing import Dict, List, Sequence
 
 import numpy as np
 
 from .op_builder import AsyncIOBuilder
+
+__all__ = ["AsyncIOHandle", "swap_chain_write", "swap_chain_read"]
 
 
 class AsyncIOHandle:
@@ -23,14 +35,18 @@ class AsyncIOHandle:
 
     Mirrors the reference aio_handle: ops are queued to worker threads at
     submit time; ``wait()`` blocks until all submitted ops complete and
-    returns the number of failures since the last wait.
+    returns the number of failures since the last wait.  Every submission
+    returns a monotonically increasing op ticket; ``wait_statuses()`` is
+    the per-op variant of ``wait()`` — ``{ticket: ok}`` for the batch.
     """
 
     def __init__(self, num_threads: int = 8):
         self._lib = AsyncIOBuilder.bind()
         self._handle = None
         self._inflight = []   # keep buffer refs alive until wait()
-        self._sync_failures = 0
+        self._ops: List[int] = []      # tickets submitted since last wait
+        self._failed: set = set()      # python-fallback per-op failures
+        self._next_op = 0
         if self._lib is not None:
             self._handle = self._lib.ds_aio_handle_new(num_threads)
 
@@ -47,14 +63,21 @@ class AsyncIOHandle:
         return "io_uring" if self._lib.ds_aio_backend(self._handle) else \
             "threads"
 
-    def async_pread(self, buf: np.ndarray, path: str, offset: int = 0) -> None:
+    def _ticket(self) -> int:
+        t = self._next_op
+        self._next_op += 1
+        self._ops.append(t)
+        return t
+
+    def async_pread(self, buf: np.ndarray, path: str, offset: int = 0) -> int:
         assert buf.flags.c_contiguous
+        t = self._ticket()
         if self._handle is not None:
             self._inflight.append(buf)
             self._lib.ds_aio_pread(self._handle, path.encode(),
                                    buf.ctypes.data_as(ctypes.c_void_p),
                                    buf.nbytes, offset)
-            return
+            return t
         try:
             with open(path, "rb") as f:
                 f.seek(offset)
@@ -64,35 +87,59 @@ class AsyncIOHandle:
             if len(data) < buf.nbytes:
                 # short read = failure, matching the native path's semantics
                 # (a truncated swap file must not be silently consumed)
-                self._sync_failures += 1
+                self._failed.add(t)
         except OSError:
-            self._sync_failures += 1
+            self._failed.add(t)
+        return t
 
-    def async_pwrite(self, buf: np.ndarray, path: str, offset: int = 0) -> None:
+    def async_pwrite(self, buf: np.ndarray, path: str, offset: int = 0) -> int:
         assert buf.flags.c_contiguous
+        t = self._ticket()
         if self._handle is not None:
             self._inflight.append(buf)
             self._lib.ds_aio_pwrite(self._handle, path.encode(),
                                     buf.ctypes.data_as(ctypes.c_void_p),
                                     buf.nbytes, offset)
-            return
+            return t
         try:
             mode = "r+b" if os.path.exists(path) else "wb"
             with open(path, mode) as f:
                 f.seek(offset)
                 f.write(buf.tobytes())
         except OSError:
-            self._sync_failures += 1
+            self._failed.add(t)
+        return t
 
     def wait(self) -> int:
         """Block until all submitted ops finish; returns failure count."""
         if self._handle is not None:
+            self._ops.clear()
             n = int(self._lib.ds_aio_wait(self._handle))
             self._inflight.clear()
             return n
-        n = self._sync_failures
-        self._sync_failures = 0
+        self._ops.clear()
+        n = len(self._failed)
+        self._failed.clear()
         return n
+
+    def wait_statuses(self) -> Dict[int, bool]:
+        """Block until all submitted ops finish; returns ``{ticket: ok}``
+        for every op submitted since the last wait.
+
+        The python fallback attributes failures exactly.  The native
+        library only reports an aggregate count, so any nonzero count
+        marks the WHOLE batch failed — a conservative overestimate that
+        sends every suspect block through the caller's recompute fallback
+        rather than trusting bytes whose op may be the one that failed.
+        """
+        ops, self._ops = self._ops, []
+        if self._handle is not None:
+            n = int(self._lib.ds_aio_wait(self._handle))
+            self._inflight.clear()
+            ok = n == 0
+            return {t: ok for t in ops}
+        failed, self._failed = self._failed, set()
+        return {t: t not in failed for t in ops}
 
     def close(self) -> None:
         if self._handle is not None:
@@ -105,3 +152,30 @@ class AsyncIOHandle:
             self.close()
         except Exception:
             pass
+
+
+def swap_chain_write(handle: AsyncIOHandle, path: str,
+                     bufs: Sequence[np.ndarray],
+                     offsets: Sequence[int]) -> List[bool]:
+    """Write a chain of block buffers at the given file offsets; one
+    submit per block, ONE wait for the batch; per-block status aligned
+    to the input order (the sanctioned swap commit point for NVMe spill,
+    ``inference/paged.py``)."""
+    tickets = [handle.async_pwrite(np.ascontiguousarray(b), path, int(off))
+               for b, off in zip(bufs, offsets)]
+    statuses = handle.wait_statuses()
+    return [statuses.get(t, False) for t in tickets]
+
+
+def swap_chain_read(handle: AsyncIOHandle, path: str,
+                    bufs: Sequence[np.ndarray],
+                    offsets: Sequence[int]) -> List[bool]:
+    """Read a chain of block buffers from the given file offsets into
+    preallocated ``bufs``; one wait for the batch; per-block status
+    aligned to the input order.  A ``False`` entry means that buffer's
+    bytes must NOT be trusted (short read / OS error) — the NVMe load
+    path drops that entry and falls back to recompute."""
+    tickets = [handle.async_pread(b, path, int(off))
+               for b, off in zip(bufs, offsets)]
+    statuses = handle.wait_statuses()
+    return [statuses.get(t, False) for t in tickets]

@@ -15,9 +15,9 @@ from ..inference.serving import (Request, RequestFailedError,  # noqa: F401
 from .faults import (FaultInjector, FaultPlan,  # noqa: F401
                      RequestRejected, SimulatedCrash)
 from .router import ReplicaRouter  # noqa: F401
-from .supervisor import RouterSupervisor  # noqa: F401
+from .supervisor import RouterSupervisor, plan_roles  # noqa: F401
 
-__all__ = ["ReplicaRouter", "RouterSupervisor", "Request",
+__all__ = ["ReplicaRouter", "RouterSupervisor", "plan_roles", "Request",
            "RequestHandle", "ServingEngine", "SLO_PRIORITY",
            "FaultPlan", "FaultInjector", "RequestRejected",
            "RequestFailedError", "SimulatedCrash", "TransportError"]

@@ -245,6 +245,35 @@ class ReplicaRouter:
                 "compiled program, so it must be the router's sole "
                 "replica (the router demotes to front-end admission); "
                 "mixing it with other replicas double-shards the fleet")
+        # ----- disaggregated prefill/decode fleet (ISSUE 17): a replica's
+        # role gates which admissions may route to it — new prompts to
+        # prefill-capable replicas, in-flight resumes to decode-capable
+        # ones.  An all-"both" fleet (the default) disables the filter
+        # entirely: routing is bit-identical to the non-disaggregated
+        # router.
+        roles = [getattr(r, "role", "both") for r in replicas]
+        self.roles = roles
+        self._prefill_capable = frozenset(
+            i for i, ro in enumerate(roles) if ro in ("prefill", "both"))
+        self._decode_capable = frozenset(
+            i for i, ro in enumerate(roles) if ro in ("decode", "both"))
+        self.disaggregated = any(ro != "both" for ro in roles)
+        if self.disaggregated:
+            if not self._prefill_capable or not self._decode_capable:
+                missing = "prefill" if not self._prefill_capable \
+                    else "decode"
+                raise ValueError(
+                    f"disaggregated fleet has no {missing}-capable "
+                    f"replica (roles={roles}) — the prefill_workers:"
+                    "decode_workers ratio must keep at least one worker "
+                    "on each side of the pipeline (or run every replica "
+                    "role='both')")
+            if not kv_pull:
+                raise ValueError(
+                    "disaggregated fleet needs kv_pull=True — the "
+                    "prefill->decode handoff travels as a cross-replica "
+                    "KV pull; without it every decode worker would "
+                    "re-run the prefill it exists to avoid")
         self.replicas = replicas
         self.policy = policy
         self.kv_pull = bool(kv_pull)
@@ -321,6 +350,10 @@ class ReplicaRouter:
             "serving_kv_pull_retries_total",
             "cross-replica KV-pull attempts retried after a transient "
             "transport fault or per-attempt timeout")
+        self._c_handoffs = m.counter(
+            "serving_handoffs_total",
+            "prefill->decode handoffs routed across the disaggregated "
+            "fleet")
         #: per-class shed counters, created lazily on first shed so the
         #: family only exists once shedding is actually configured
         self._c_shed: Dict[str, Any] = {}
@@ -452,14 +485,27 @@ class ReplicaRouter:
         while len(self._hints) > self._hint_cap:
             self._hints.popitem(last=False)
 
-    def _route(self, prompt) -> Tuple[int, str, int]:
+    def _route(self, prompt, need: str = "any") -> Tuple[int, str, int]:
         """Pick a replica for ``prompt``: ``(rid, policy_used, depth)``
         where ``policy_used`` is ``"affinity"`` (a prefix hit decided)
-        or ``"balance"`` (load decided)."""
+        or ``"balance"`` (load decided).  ``need`` restricts candidates
+        by role capability in a disaggregated fleet — ``"prefill"`` for
+        new admissions, ``"decode"`` for in-flight resumes/handoffs; on
+        an all-"both" fleet every replica satisfies either, so the
+        filter is a no-op and routing is bit-identical."""
         live = self._live()
         if not live:
             raise RuntimeError("every replica is drained — readmit one "
                                "before submitting")
+        if need == "prefill":
+            live = [r for r in live if r in self._prefill_capable]
+        elif need == "decode":
+            live = [r for r in live if r in self._decode_capable]
+        if not live:
+            raise RuntimeError(
+                f"no live {need}-capable replica — the disaggregated "
+                f"fleet lost its last {need} worker; readmit one before "
+                "submitting")
         if self.policy == "round_robin":
             rid = live[self._rr % len(live)]
             self._rr += 1
@@ -733,7 +779,9 @@ class ReplicaRouter:
                                   eos_token_id=eos_token_id)
         with self._fleet_lock:
             self._maybe_shed(request.uid, slo_class)
-            rid, why, depth = self._route(request.prompt)
+            # new admissions carry an un-prefilled prompt: they need a
+            # prefill-capable replica (no-op filter on a "both" fleet)
+            rid, why, depth = self._route(request.prompt, need="prefill")
             if why == "affinity":
                 self._c_aff.inc()
             else:
@@ -800,6 +848,10 @@ class ReplicaRouter:
                 continue
             more = m or more
             self._refresh_gauges(rid)
+            if self.disaggregated and \
+                    getattr(rep, "role", "both") == "prefill" and \
+                    self._pump_handoffs(rid):
+                more = True     # handoffs enqueued work elsewhere
         # the handle map is fleet state: pruning it unlocked would race
         # a concurrent submit's insert (graft-race GL010)
         with self._fleet_lock:
@@ -845,6 +897,9 @@ class ReplicaRouter:
                 self._fail_replica(rid, e)
                 return
             self._refresh_gauges(rid)
+            if self.disaggregated and \
+                    getattr(rep, "role", "both") == "prefill":
+                self._pump_handoffs(rid)
             if not more:
                 time.sleep(0.001)           # idle: yield the core
 
@@ -994,7 +1049,11 @@ class ReplicaRouter:
         prompt_eff = np.concatenate(
             [item.req.prompt, np.asarray(item.prior, np.int32)]) \
             if item.prior else item.req.prompt
-        new_rid, why, depth = self._route(prompt_eff)
+        # an item with prior tokens already prefilled somewhere (its KV
+        # pulls or recomputes as a short resume) — it needs a decode-
+        # capable target; a never-admitted queue item still needs prefill
+        new_rid, why, depth = self._route(
+            prompt_eff, need="decode" if item.prior else "prefill")
         if why == "affinity":
             self._c_aff.inc()
         else:
@@ -1044,6 +1103,51 @@ class ReplicaRouter:
                                   policy=why, depth_blocks=int(depth),
                                   prior_tokens=len(item.prior))
         return rehomed
+
+    def _pump_handoffs(self, rid: int) -> int:
+        """Drain a prefill worker's parked handoffs and route each onto
+        a decode-capable replica (the tentpole's handoff state machine):
+        take under the replica lock, release, then run the shared
+        hand-off protocol under the fleet lock — the same
+        ``_handoff_item`` path as drain/re-home, so the resume travels
+        as an ordinary integrity-checked KV pull from the prefill
+        worker's host tier.  A fleet with no live decode-capable replica
+        left resolves the handles LOUDLY (:class:`RequestFailedError`)
+        instead of bouncing requests between prefill workers forever.
+        Returns handoffs routed."""
+        rep = self.replicas[rid]
+        take = getattr(rep, "take_handoffs", None)
+        if take is None:
+            return 0
+        with self._locks[rid]:
+            items = take()
+        if not items:
+            return 0
+        routed = 0
+        with self._fleet_lock:
+            for item in items:
+                uid = item.req.uid
+                try:
+                    new_rid, why, depth = self._handoff_item(item,
+                                                             "handoff")
+                except RuntimeError as e:
+                    self._c_req_failed.inc()
+                    self.timeline.instant("request_failed", uid=str(uid),
+                                          reason=str(e))
+                    logger.error(f"handoff of {uid!r} failed: {e}")
+                    if item.handle is not None:
+                        item.handle._on_fail(
+                            RequestFailedError(uid, str(e)))
+                    self._handles.pop(uid, None)
+                    continue
+                routed += 1
+                self._c_handoffs.inc()
+                self.timeline.instant(
+                    "handoff", uid=str(uid), src=int(rid),
+                    dst=int(new_rid), policy=why,
+                    depth_blocks=int(depth),
+                    prior_tokens=len(item.prior))
+        return routed
 
     def arm_faults(self, plan) -> FaultInjector:
         """Arm a chaos plan fleet-wide (``serving/faults.py``): builds
@@ -1275,6 +1379,7 @@ class ReplicaRouter:
             gen_tokens += gen
             per.append({
                 "replica": rid,
+                "role": getattr(rep, "role", "both"),
                 "drained": rid in self._drained,
                 "blocks_in_use": rep._alloc.blocks_in_use,
                 "queue_depth": len(rep._pending),
@@ -1304,6 +1409,7 @@ class ReplicaRouter:
             "kv_pull_retries": int(self._c_pull_retries.value),
             "drains": int(self._c_drains.value),
             "readmits": int(self._c_readmits.value),
+            "handoffs": int(self._c_handoffs.value),
             # failure/recovery surface (docs/reliability.md): crash
             # fails, re-homed/permanently-failed requests, sheds by class
             "failed": self.failed,

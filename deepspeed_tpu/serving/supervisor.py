@@ -49,7 +49,37 @@ from ..analysis.concurrency import OrderedLock
 from ..utils.logging import logger
 from .router import ReplicaRouter
 
-__all__ = ["RouterSupervisor"]
+__all__ = ["RouterSupervisor", "plan_roles"]
+
+
+def plan_roles(replicas: int,
+               prefill_workers: Optional[int] = None) -> List[str]:
+    """Role assignment for a disaggregated fleet: the first
+    ``prefill_workers`` replicas run admission + chunked prefill, the
+    rest run decode (``docs/inference.md`` "Disaggregated serving").
+    ``prefill_workers=None`` (or 0) keeps every replica ``"both"`` —
+    the colocated fleet, bit-identical to prior behavior.
+
+    Prefill workers come FIRST so the launcher's replica ids stay
+    stable when the split ratio changes: decode workers (which hold
+    long-lived session KV) keep their ids as the prefill pool grows.
+    """
+    replicas = int(replicas)
+    if replicas < 1:
+        raise ValueError(f"replicas must be >= 1, got {replicas}")
+    if not prefill_workers:
+        return ["both"] * replicas
+    prefill_workers = int(prefill_workers)
+    if prefill_workers < 0:
+        raise ValueError(
+            f"prefill_workers must be >= 0, got {prefill_workers}")
+    if prefill_workers >= replicas:
+        raise ValueError(
+            f"prefill_workers={prefill_workers} with replicas={replicas}: "
+            "the prefill_workers:decode_workers ratio must keep at least "
+            "one worker on each side (prefill_workers < replicas)")
+    return ["prefill"] * prefill_workers + \
+        ["decode"] * (replicas - prefill_workers)
 
 
 class RouterSupervisor:

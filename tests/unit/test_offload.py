@@ -10,7 +10,8 @@ import pytest
 
 import deepspeed_tpu
 from deepspeed_tpu.models import gpt2
-from deepspeed_tpu.ops.aio import AsyncIOHandle
+from deepspeed_tpu.ops.aio import (AsyncIOHandle, swap_chain_read,
+                                   swap_chain_write)
 from deepspeed_tpu.ops.cpu_adam import DeepSpeedCPUAdam
 from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
 
@@ -90,6 +91,53 @@ def test_aio_read_missing_file_reports_failure(tmp_path):
     buf = np.zeros(16, np.float32)
     h.async_pread(buf, str(tmp_path / "nope.bin"), 0)
     assert h.wait() == 1
+    h.close()
+
+
+def test_aio_wait_statuses_surfaces_the_failed_op(tmp_path):
+    """Per-op contract behind the NVMe tier's recompute fallback: a batch
+    mixing a good read with a missing-file read must mark the bad ticket
+    False.  The python fallback attributes exactly; the native library
+    only reports an aggregate count, so there any failure conservatively
+    fails the whole batch — either way the bad op is never trusted."""
+    path = str(tmp_path / "ok.bin")
+    payload = np.arange(64, dtype=np.float32)
+    h = AsyncIOHandle(num_threads=2)
+    h.async_pwrite(payload, path, 0)
+    assert h.wait() == 0
+    good_buf = np.zeros_like(payload)
+    good = h.async_pread(good_buf, path, 0)
+    bad = h.async_pread(np.zeros(16, np.float32),
+                        str(tmp_path / "nope.bin"), 0)
+    st = h.wait_statuses()
+    assert set(st) == {good, bad}
+    assert st[bad] is False
+    if not h.has_native:                 # python fallback: exact per-op
+        assert st[good] is True
+        np.testing.assert_array_equal(good_buf, payload)
+    h.close()
+
+
+def test_aio_wait_statuses_python_fallback_short_read(tmp_path,
+                                                      monkeypatch):
+    """Force the python fallback (no native lib) and check a short read —
+    a truncated spill file — fails EXACTLY the op that ran off the end,
+    and the chain helpers align per-block status to input order."""
+    from deepspeed_tpu.ops import aio as aio_mod
+
+    monkeypatch.setattr(aio_mod.AsyncIOBuilder, "bind",
+                        classmethod(lambda cls: None))
+    h = aio_mod.AsyncIOHandle()
+    assert h.backend == "python"
+    path = str(tmp_path / "chain.bin")
+    blocks = [np.full(32, i, np.float32) for i in range(2)]
+    assert swap_chain_write(h, path, blocks, [0, 128]) == [True, True]
+    outs = [np.zeros(32, np.float32) for _ in range(3)]
+    # third read starts past EOF -> short read -> that op alone fails
+    ok = swap_chain_read(h, path, outs, [0, 128, 256])
+    assert ok == [True, True, False]
+    np.testing.assert_array_equal(outs[0], blocks[0])
+    np.testing.assert_array_equal(outs[1], blocks[1])
     h.close()
 
 

@@ -48,13 +48,16 @@ ENGINE_STATS_KEYS = frozenset({
     "host_blocks",
     "host_blocks_in_use", "host_fence_waits", "host_pool_bytes",
     "invariant_checks_run",
+    "handoffs",
     "iterations", "kv_dtype", "kv_pool_bytes", "kv_pool_bytes_per_chip",
     "kv_pool_shape", "kv_scale_bytes", "kv_sharded", "mode",
-    "num_blocks", "prefetch_misses", "prefetch_wait_p50_s",
+    "num_blocks", "nvme_blocks", "nvme_blocks_in_use", "nvme_loads",
+    "nvme_spills", "prefetch_misses", "prefetch_wait_p50_s",
     "prefetch_wait_p95_s", "prefill_calls", "prefix_cache_entries",
     "prefix_cache_evictions", "prefix_cache_hit_rate",
     "prefix_hit_tokens", "prompt_tokens", "quantize", "queue_depth",
     "requests_finished", "resume_recompute_tokens", "retraces_observed",
+    "role",
     "spec_rounds", "spec_tokens", "speculative", "swap_bytes", "swap_in",
     "swap_out", "tp_degree", "tpot_p50_s", "tpot_p95_s",
     "trace_capacity", "trace_events", "trace_events_dropped",
@@ -67,9 +70,10 @@ ENGINE_STATS_KEYS = frozenset({
 CONFIG_KEYS = frozenset({
     "block_size", "chunked_prefill", "debug_checks", "decode_steps",
     "engine_mode", "host_blocks",
-    "max_seq_len", "ngram_max", "ngram_min", "num_blocks", "peak_flops",
+    "max_seq_len", "ngram_max", "ngram_min", "num_blocks",
+    "nvme_blocks", "nvme_high_watermark", "nvme_path", "peak_flops",
     "prefill_batch", "prefill_chunk", "prefix_caching", "prompt_buckets",
-    "quantize", "shard_kv", "slo_targets", "slots", "spec_tokens",
+    "quantize", "role", "shard_kv", "slo_targets", "slots", "spec_tokens",
     "swap_batch", "topology", "trace_capacity",
 })
 
@@ -79,6 +83,7 @@ CONFIG_KEYS = frozenset({
 #: re-home counters, typed-failure count, pull retries, per-class sheds)
 ROUTER_STATS_KEYS = frozenset({
     "busy_s", "drained", "drains", "failed", "generated_tokens",
+    "handoffs",
     "kv_pull", "kv_pull_blocks", "kv_pull_bytes", "kv_pull_retries",
     "kv_pulls", "lock_order_checks",
     "lock_violations", "metrics_endpoint",
@@ -91,7 +96,7 @@ ROUTER_STATS_KEYS = frozenset({
 PER_REPLICA_KEYS = frozenset({
     "active", "admitted", "blocks_in_use", "busy_s", "compile_budget",
     "compile_count", "config", "drained", "generated_tokens",
-    "prefix_cache_hit_rate", "queue_depth", "replica",
+    "prefix_cache_hit_rate", "queue_depth", "replica", "role",
 })
 
 #: slo_report() — one entry per class, each with this exact shape

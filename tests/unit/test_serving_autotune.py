@@ -275,6 +275,18 @@ def test_every_constraint_has_a_loud_ctor_twin(tiny_engine):
         ("positive_knobs", {**base, "slots": 0}, "slots"),
         ("positive_knobs", {**base, "prefill_batch": 0}, "prefill_batch"),
         ("positive_knobs", {**base, "block_size": 0}, "block_size"),
+        # PR 17: disaggregated role + NVMe third tier
+        ("role_needs_tiered_kv", {**base, "role": "prefill"},
+         "host_blocks"),
+        ("role_needs_tiered_kv", {**base, "role": "sideways"}, "role"),
+        ("nvme_needs_host_tier", {**base, "nvme_blocks": 8},
+         "host tier"),
+        ("nvme_watermark_window",
+         {**base, "host_blocks": 8, "swap_batch": 4, "nvme_blocks": 8,
+          "nvme_high_watermark": 1.5}, "nvme_high_watermark"),
+        ("nvme_watermark_window",
+         {**base, "host_blocks": 8, "swap_batch": 4, "nvme_blocks": 8,
+          "nvme_high_watermark": 0.2}, "watermark budget"),
     ]
     for name, kwargs, fragment in cases:
         with pytest.raises(ValueError, match=fragment):
@@ -284,6 +296,35 @@ def test_every_constraint_has_a_loud_ctor_twin(tiny_engine):
         cfg = {**BASE_SERVING_CONFIG, **kwargs}
         cfg.pop("draft", None)
         assert any(n == name for n, _ in space.check(cfg)), name
+
+
+def test_prefill_ratio_constraint_has_router_twins(tiny_engine):
+    """``prefill_decode_ratio`` lives at the FLEET layer, so its loud
+    twins are ``plan_roles`` (the launcher/init_router assignment) and
+    the ``ReplicaRouter`` ctor (a hand-built all-prefill fleet), not the
+    engine ctor."""
+    from deepspeed_tpu.serving import ReplicaRouter, plan_roles
+
+    engine, _ = tiny_engine
+    space = ServingKnobSpace(_geom(), max_seq_len=64)
+    cfg = {**BASE_SERVING_CONFIG, "max_seq_len": 64, "replicas": 2,
+           "prefill_workers": 2, "host_blocks": 8}
+    assert any(n == "prefill_decode_ratio" for n, _ in space.check(cfg))
+    with pytest.raises(ValueError,
+                       match="prefill_workers:decode_workers ratio"):
+        plan_roles(2, 2)
+    # a disaggregated fleet without host_blocks is inadmissible too
+    cfg2 = {**BASE_SERVING_CONFIG, "max_seq_len": 64, "replicas": 2,
+            "prefill_workers": 1}
+    assert any(n == "prefill_decode_ratio" for n, _ in space.check(cfg2))
+    # hand-built fleet twins: one-sided roles, and kv_pull=False
+    mk = lambda role: ServingEngine(  # noqa: E731
+        engine, slots=2, max_seq_len=64, block_size=8, prefill_chunk=16,
+        host_blocks=8, swap_batch=4, role=role)
+    with pytest.raises(ValueError, match="ratio must keep at least one"):
+        ReplicaRouter([mk("prefill"), mk("prefill")])
+    with pytest.raises(ValueError, match="kv_pull"):
+        ReplicaRouter([mk("prefill"), mk("decode")], kv_pull=False)
 
 
 # ---------------------------------------------------------- fitting

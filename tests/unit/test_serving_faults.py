@@ -855,3 +855,123 @@ def test_fail_survives_salvage_raising():
 def test_simulated_crash_type():
     e = SimulatedCrash(2, 7)
     assert e.replica == 2 and e.step == 7 and "iteration 7" in str(e)
+
+
+# ------------------------------------------- PR 17: disaggregated + nvme
+def test_prefill_crash_mid_handoff_rehomes_token_exact(tiny):
+    """Chaos composition (ISSUE 17): a disaggregated fleet (2 prefill +
+    1 decode) loses a prefill worker mid-run — requests parked in its
+    handoff buffer and requests still mid-prefill must re-home (salvage
+    + host-chain pull on the decode side, re-prefill on the surviving
+    prefill worker) with token output exactly matching the sequential
+    reference, zero hung handles, and clean post-failure audits."""
+    spec, cfg, engine = tiny
+    _, reqs = _session_trace(cfg, n=9, max_new=12)
+    seq = _sequential(engine, reqs)
+
+    roles = ("prefill", "prefill", "decode")
+    router = ReplicaRouter(
+        [_mk_srv(spec, engine.params, role=r) for r in roles],
+        debug_checks=True)
+    inj = router.arm_faults(
+        FaultPlan(seed=0, crashes=[{"replica": 0, "at_step": 4}]))
+    handles = [router.submit(r) for r in reqs]
+    while router.step():
+        pass
+    assert inj.report()["crashes_fired"] == [{"replica": 0, "step": 4}]
+    for r, h in zip(reqs, handles):
+        assert h.status == "finished", (r.uid, h.status)
+        np.testing.assert_array_equal(h.result(timeout=0), seq[r.uid],
+                                      err_msg=f"uid {r.uid}")
+    st = router.stats()
+    assert st["failed"] == [0] and st["requests_failed"] == 0
+    assert st["handoffs"] >= 1          # the disaggregated path ran
+    audit_router(router)
+    # the decode worker never prefills a PROMPT: every admission arrives
+    # as a handoff/re-home whose committed blocks ride the host-chain
+    # pull, so its recompute is bounded by the sub-block tail of each
+    # prior (< block_size tokens per admission), never the prompt length
+    dec = router.replicas[2]
+    assert dec.role == "decode"
+    ds = dec.stats()
+    if ds["admitted"]:
+        assert ds["resume_recompute_tokens"] <= \
+            ds["admitted"] * dec.block_size
+
+
+def test_last_decode_worker_lost_fails_handoffs_loudly(tiny):
+    """If the fleet loses its LAST decode-capable replica, parked
+    handoffs must resolve their handles with RequestFailedError — not
+    bounce forever between prefill workers, not hang the caller."""
+    spec, cfg, engine = tiny
+    _, reqs = _session_trace(cfg, n=4, max_new=8)
+    router = ReplicaRouter(
+        [_mk_srv(spec, engine.params, role=r)
+         for r in ("prefill", "decode")], debug_checks=True)
+    handles = [router.submit(r) for r in reqs]
+    router.step()                        # prefill admits, maybe hands off
+    router.fail(1)                       # the only decode worker dies
+    while router.step():
+        pass
+    for h in handles:
+        assert h.done                    # nobody hangs
+        if h.status == "failed":
+            with pytest.raises(RequestFailedError):
+                h.result(timeout=0)
+    assert router.stats()["requests_failed"] >= 1
+    audit_router(router)
+
+
+def test_nvme_bit_flip_caught_by_checksum_gate_unit(tmp_path):
+    """NvmeBlockStore: a flipped byte in the spill file is caught at the
+    NVMe exit — swap_in refuses the bytes, drops exactly that entry, and
+    counts the reject."""
+    from deepspeed_tpu.inference.paged import NvmeBlockStore
+
+    specs = [((2, 8, 4), np.float32), ((2, 8, 4), np.float32)]
+    store = NvmeBlockStore(4, specs, str(tmp_path / "spill.bin"))
+    rng = np.random.default_rng(3)
+    arrays = [rng.normal(size=s).astype(dt) for s, dt in specs]
+    key = b"chain-key-0"
+    assert store.swap_out(key, arrays, block_checksum(arrays))
+    assert store.swap_in(key) is not None     # clean round trip
+    with open(store.path, "r+b") as f:        # flip one payload byte
+        f.seek(17)
+        b = f.read(1)
+        f.seek(17)
+        f.write(bytes([b[0] ^ 0x40]))
+    assert store.swap_in(key) is None
+    assert store.checksum_rejects == 1
+    assert not store.has(key)                 # entry dropped, slot freed
+    assert store.blocks_in_use == 0
+    store.close()
+
+
+def test_nvme_corruption_recomputes_with_parity(tiny, tmp_path):
+    """Engine-level checksum gate: corrupt the WHOLE spill file under a
+    live engine, then resume a session whose prefix lives on NVMe — the
+    promote path must reject the bytes, truncate the chain, recompute
+    from tokens, and still serve token-exact output."""
+    spec, cfg, engine = tiny
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, cfg.vocab_size, 32) for _ in range(8)]
+    reqs = [Request(uid=i, prompt=p, max_new_tokens=6)
+            for i, p in enumerate(prompts)]
+    seq = _sequential(engine, reqs)
+    srv = _mk_srv(spec, engine.params, slots=2, num_blocks=12,
+                  host_blocks=8, swap_batch=2, nvme_blocks=32,
+                  nvme_high_watermark=0.5,
+                  nvme_path=str(tmp_path / "spill.bin"))
+    outs = srv.serve(reqs)
+    for r in reqs:
+        np.testing.assert_array_equal(outs[r.uid], seq[r.uid])
+    assert srv.stats()["nvme_spills"] > 0
+    with open(srv.nvme_path, "r+b") as f:     # scribble over every slot
+        size = f.seek(0, 2)
+        f.seek(0)
+        f.write(rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+    resumed = srv.serve([Request(uid="resume", prompt=prompts[0],
+                                 max_new_tokens=6)])
+    np.testing.assert_array_equal(resumed["resume"], seq[0])
+    assert srv._host.nvme_checksum_rejects > 0
+    srv.close()
