@@ -1460,6 +1460,16 @@ def run_chaos_bench(requests: int = 64, slots: int = 8,
        within 1.5x of the unloaded baseline while batch absorbs every
        rejection (bench-side stamps — engine TTFT excludes queue wait,
        and queue wait is exactly what shedding bounds).
+     - **flight-recorder lane** (ISSUE 18): the crash lane re-run with
+       an :class:`IncidentRecorder` armed — the dumped bundle must pass
+       the structural audit and ``replay_bundle`` must reproduce the
+       trigger at the recorded scheduler iteration with token-exact
+       pre-crash streams; recorder-on tokens must be identical to the
+       recorder-off twin (<=2% wall overhead recorded, warn-only).
+     - **stall-watchdog lane**: traffic submitted, stepping withheld —
+       the :class:`StallWatchdog` must detect no-progress within its
+       deadline and dump a ``watchdog_stall`` bundle carrying every
+       thread's stack; the parked traffic then serves out cleanly.
     """
     import deepspeed_tpu
     from deepspeed_tpu.inference.serving import Request, ServingEngine
@@ -1771,6 +1781,106 @@ def run_chaos_bench(requests: int = 64, slots: int = 8,
             if not uid.startswith("b") and h.status == "finished"),
     }
 
+    # ------------------------------------------- flight-recorder lane
+    # (ISSUE 18, docs/observability.md "Incident response"): the crash
+    # lane re-run with the black-box recorder armed.  Gates: the
+    # recorder must not perturb the schedule (token identity vs the
+    # recorder-off chaos twin above), the dumped bundle must pass the
+    # structural audit, and an in-process ``replay_bundle()`` must
+    # re-execute it to the SAME trigger at the SAME scheduler iteration
+    # with token-exact pre-crash streams.  The <=2% recorder-overhead
+    # contract is recorded and warned on breach (wall-clock-noise-prone
+    # on shared runners, like every wall-clock contract in this bench).
+    import tempfile
+
+    from deepspeed_tpu.analysis.invariants import audit_incident_bundle
+    from deepspeed_tpu.telemetry.incident import (IncidentRecorder,
+                                                  StallWatchdog,
+                                                  gpt2_model_meta,
+                                                  is_bundle,
+                                                  replay_bundle)
+
+    inc_dir = tempfile.mkdtemp(prefix="graft_incidents_")
+    rec = IncidentRecorder(inc_dir, vocab=vocab,
+                           model_meta=gpt2_model_meta(cfg, dtype=dtype))
+    inc_fleet = fleet()
+    rec.attach(inc_fleet)
+    inc_fleet.arm_faults(FaultPlan(
+        seed=seed, crashes=[{"replica": 1, "at_step": crash_step}]))
+    h_inc = [inc_fleet.submit(r) for r in reqs]
+    t0 = time.perf_counter()
+    outs_inc = drive_handles(inc_fleet, h_inc)
+    inc_wall = time.perf_counter() - t0
+    rec.detach()
+    gate("incident-recorder-on", outs_chaos, outs_inc)
+    bundles = sorted(d for d in os.listdir(inc_dir)
+                     if is_bundle(os.path.join(inc_dir, d)))
+    bundle_audit_ok, replay_report = False, None
+    if bundles:
+        bpath = os.path.join(inc_dir, bundles[0])
+        try:
+            audit_incident_bundle(bpath)
+            bundle_audit_ok = True
+        except Exception as e:
+            print(f"WARNING: incident bundle fails audit: {e}",
+                  file=sys.stderr)
+        replay_report = replay_bundle(bpath)
+
+    # stall-watchdog lane: traffic submitted, stepping withheld — the
+    # "fleet merely STOPPED" failure mode membership probes can't see.
+    # The watchdog must detect no-progress within its deadline and dump
+    # a watchdog_stall bundle carrying every thread's stack; afterwards
+    # the parked traffic is served out so nothing leaks from the lane.
+    stall_dir = tempfile.mkdtemp(prefix="graft_incidents_stall_")
+    rec_s = IncidentRecorder(stall_dir, vocab=vocab,
+                             model_meta=gpt2_model_meta(cfg, dtype=dtype))
+    stall_fleet = fleet()
+    rec_s.attach(stall_fleet)
+    stall_handles = [stall_fleet.submit(r) for r in reqs[:4]]
+    wd = StallWatchdog(stall_fleet, deadline_s=0.05, poll_s=0.01,
+                       recorder=rec_s).start()
+    t_w = time.perf_counter()
+    while wd.stalls == 0 and time.perf_counter() - t_w < 10.0:
+        time.sleep(0.01)
+    wd.stop()
+    while stall_fleet.step():
+        pass
+    rec_s.detach()
+    stall_bundles = [d for d in os.listdir(stall_dir)
+                     if is_bundle(os.path.join(stall_dir, d))]
+    stall_has_stacks = False
+    for d in stall_bundles:
+        tpath = os.path.join(stall_dir, d, "threads.txt")
+        if d.split("-")[-1] == "watchdog_stall" and \
+                os.path.isfile(tpath) and os.path.getsize(tpath) > 0:
+            stall_has_stacks = True
+    wd_counter = int(stall_fleet.metrics.counter(
+        "serving_watchdog_stalls_total", "").value)
+    incident = {
+        "bundle_dir": inc_dir,
+        "bundles": bundles,
+        "bundle_audit_ok": bundle_audit_ok,
+        "replay_reproduced": bool(replay_report
+                                  and replay_report["reproduced"]),
+        "replay_trigger": replay_report["trigger"]
+        if replay_report else None,
+        "replay_mismatches": replay_report["mismatches"]
+        if replay_report else ["no bundle dumped"],
+        "recorder_token_identity": not any(
+            t == "incident-recorder-on" for t, _ in mismatched),
+        "recorder_wall_s": inc_wall,
+        "recorder_off_wall_s": chaos_wall,
+        "recorder_overhead_frac": inc_wall / chaos_wall - 1.0,
+        "recorder_overhead_within_2pct":
+            inc_wall <= 1.02 * chaos_wall,
+        "watchdog_stalls_detected": wd.stalls,
+        "watchdog_counter": wd_counter,
+        "watchdog_bundles": stall_bundles,
+        "watchdog_stall_has_thread_stacks": stall_has_stacks,
+        "watchdog_parked_served_out": all(
+            h.status == "finished" for h in stall_handles),
+    }
+
     return {
         "protocol": "fault-tolerant serving fleet (PR 15, BENCH_r14): "
                     "seeded crash-at-iteration / flaky-transport / "
@@ -1788,6 +1898,7 @@ def run_chaos_bench(requests: int = 64, slots: int = 8,
         "flaky_transport": flaky,
         "corruption": corruption,
         "overload_shed": overload_shed,
+        "incident": incident,
         "token_parity": not mismatched,
         "mismatched": mismatched,
         "model": f"gpt2-{layers}l-{hidden}d-{vocab}v ({dtype})",
@@ -2689,7 +2800,13 @@ def main():
             res["corruption"]["detected_100pct"] and \
             res["corruption"]["recovered_via_recompute_parity"] and \
             res["overload_shed"]["batch_absorbed_all_rejections"] and \
-            res["overload_shed"]["protected_shed"] == 0
+            res["overload_shed"]["protected_shed"] == 0 and \
+            res["incident"]["bundle_audit_ok"] and \
+            res["incident"]["replay_reproduced"] and \
+            res["incident"]["recorder_token_identity"] and \
+            res["incident"]["watchdog_stalls_detected"] >= 1 and \
+            res["incident"]["watchdog_stall_has_thread_stacks"] and \
+            res["incident"]["watchdog_parked_served_out"]
         fail_msg = "chaos recovery gate failed (see JSON lanes)"
         if not res["overload_shed"]["protected_within_1p5x"]:
             # wall-clock contract: recorded and warned, not exit-fatal —
@@ -2699,6 +2816,13 @@ def main():
                   f"{res['overload_shed']['protected_p95_ratio']} "
                   "exceeds the 1.5x shed contract on this run "
                   "(see overload_shed in the JSON)", file=sys.stderr)
+        if not res["incident"]["recorder_overhead_within_2pct"]:
+            # same convention: the <=2% flight-recorder overhead is a
+            # wall-clock contract — recorded + warned, never exit-fatal
+            print("WARNING: incident recorder overhead "
+                  f"{res['incident']['recorder_overhead_frac']:+.2%} "
+                  "exceeds the 2% contract on this run "
+                  "(see incident in the JSON)", file=sys.stderr)
     elif args.disaggregated:
         res = run_disaggregated_bench(
             requests=args.requests, slots=args.slots,

@@ -539,3 +539,73 @@ def audit_serving_engine(srv, active) -> None:
     if timeline is not None:
         timeline.instant("invariant_audit", slots_active=len(needs),
                          blocks_in_use=srv._alloc.blocks_in_use)
+
+
+def audit_incident_bundle(path) -> None:
+    """Internal-consistency audit of a flight-recorder incident bundle
+    (``telemetry/incident.py``): the manifest's file list matches the
+    directory exactly, the trigger kind is in the pinned vocabulary,
+    every progress entry carries a legal handle status, and a bundle
+    claiming ``replayable`` actually ships its replay inputs.  Raises
+    :class:`PagedStateError` naming the broken invariant —
+    ``bin/graft-replay --validate`` and the incident tests run this
+    before trusting a bundle's contents."""
+    import json
+    import os
+
+    from ..telemetry.incident import (MANIFEST_KEYS, TRIGGER_KINDS,
+                                      is_bundle)
+
+    if not is_bundle(path):
+        raise PagedStateError(
+            "bundle-complete",
+            f"{path!r} has no parseable manifest.json with the "
+            "graft-incident format marker — a partial dump (the hidden "
+            ".tmp dir) or not a bundle at all")
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    if set(manifest) != MANIFEST_KEYS:
+        raise PagedStateError(
+            "bundle-manifest-schema",
+            f"manifest keys {sorted(set(manifest) ^ MANIFEST_KEYS)} "
+            "differ from the pinned set")
+    listed = set(manifest["files"])
+    on_disk = {f for f in os.listdir(path)
+               if os.path.isfile(os.path.join(path, f))}
+    if listed != on_disk:
+        raise PagedStateError(
+            "bundle-file-list",
+            f"manifest lists {sorted(listed - on_disk)} missing from "
+            f"disk / disk holds {sorted(on_disk - listed)} unlisted — "
+            "the dump was tampered with or truncated")
+    trig = manifest["trigger"]
+    if trig["kind"] not in TRIGGER_KINDS:
+        raise PagedStateError(
+            "bundle-trigger-kind",
+            f"unknown trigger kind {trig['kind']!r} (expected one of "
+            f"{TRIGGER_KINDS})")
+    prog_path = os.path.join(path, "progress.json")
+    if os.path.isfile(prog_path):
+        with open(prog_path) as f:
+            progress = json.load(f)
+        legal = {"queued", "active", "finished", "cancelled", "failed"}
+        for uid, entry in progress.items():
+            if entry.get("status") not in legal:
+                raise PagedStateError(
+                    "bundle-progress-status",
+                    f"uid {uid!r} carries illegal status "
+                    f"{entry.get('status')!r}")
+    if manifest["replayable"]:
+        for needed in ("request_trace.json", "replica_configs.json",
+                       "progress.json"):
+            if needed not in listed:
+                raise PagedStateError(
+                    "bundle-replay-inputs",
+                    f"manifest claims replayable but {needed} is "
+                    "missing")
+    if manifest["trigger"]["kind"] == "watchdog_stall" and \
+            "threads.txt" not in listed:
+        raise PagedStateError(
+            "bundle-stall-evidence",
+            "a watchdog_stall bundle must carry threads.txt — the "
+            "thread stacks ARE the stall evidence")

@@ -76,6 +76,15 @@ GL012     per-iteration scalar device sync in a host scheduler loop:
           ``fence``/``harvest`` (e.g. ``ServingEngine._fence_harvest``).
           (GL009..GL011, the lock-discipline rules, live in
           ``analysis/concurrency.py``.)
+GL013     silent exception swallow in fleet-path code (``serving/``,
+          ``telemetry/``, ``inference/serving.py``): an ``except`` body
+          that neither re-raises, nor references the caught exception
+          (typed-error store, repr into a report), nor emits telemetry
+          (a counter ``.inc()``, a timeline ``.instant()``/flow event)
+          or a logger/warnings message.  The serving fleet's whole
+          observability story (docs/observability.md) rests on "every
+          swallowed failure leaves a trace" — a bare ``except: pass``
+          here is an incident the flight recorder can never trigger on.
 ========  =============================================================
 
 Suppression: append ``# graft: noqa(GLxxx)`` (one or more codes,
@@ -144,6 +153,9 @@ RULES: Dict[str, str] = {
     "GL012": "per-iteration scalar device sync (.item()/int()/bool() or "
              "jnp truthiness test) in a host scheduler loop outside a "
              "sanctioned fence helper",
+    "GL013": "except block in serving/telemetry fleet code swallows the "
+             "exception without re-raise, caught-name use, or a "
+             "telemetry/log emit",
 }
 
 #: GL008 — the documented metric naming convention: registry method
@@ -169,6 +181,31 @@ _HOST_TIMER_ATTRS = frozenset({
     "time", "time_ns", "perf_counter", "perf_counter_ns", "monotonic",
     "monotonic_ns", "process_time", "process_time_ns"})
 _HOST_TIMER_NAMES = _HOST_TIMER_ATTRS - {"time"}
+
+#: GL013 — directories whose modules are fleet-path code (plus the one
+#: file-level exception, ``inference/serving.py``), and the method names
+#: whose call inside an except body counts as "the swallow left a
+#: trace": telemetry registry emits (``Counter.inc`` / ``Gauge.set`` /
+#: ``Histogram.observe``), timeline events (``instant`` / flow pairs /
+#: ``complete``), and logger/``warnings`` emit methods.  Name-based on
+#: purpose (the lint runs without importing the package); ``set`` is the
+#: noisiest member but a false CLEAN is a near-miss, never a false fire.
+_GL013_DIRS = frozenset({"serving", "telemetry"})
+_GL013_EMITS = frozenset({
+    "inc", "observe", "set", "instant", "flow_start", "flow_end",
+    "complete", "warning", "warn", "error", "exception", "info",
+    "debug", "critical"})
+
+
+def _gl013_in_scope(path: str) -> bool:
+    """True for modules under a ``serving/`` or ``telemetry/`` directory
+    and for ``inference/serving.py`` — the code whose swallowed
+    exceptions the incident recorder exists to observe."""
+    parts = Path(path).as_posix().split("/")
+    if set(parts[:-1]) & _GL013_DIRS:
+        return True
+    return parts[-1] == "serving.py" and "inference" in parts[:-1]
+
 
 _NOQA_RE = re.compile(
     r"#\s*graft:\s*noqa(?:\s*\(\s*([A-Za-z0-9_,\s]+)\s*\))?")
@@ -271,6 +308,7 @@ class _Analyzer:
                  axes: frozenset = DEFAULT_MESH_AXES):
         self.path = path
         self.axes = axes
+        self._gl013 = _gl013_in_scope(path)
         self.findings: List[Finding] = []
         self._scopes: Dict[ast.AST, _Scope] = {}
         self._by_name: Dict[str, List[ast.AST]] = {}
@@ -384,6 +422,8 @@ class _Analyzer:
         if isinstance(node, ast.Call):
             self._check_call(node, cur, in_jit,
                              in_loop and self._sanctioned_xfer(stack) is False)
+        elif isinstance(node, ast.ExceptHandler) and self._gl013:
+            self._check_except(node)
         elif isinstance(node, ast.JoinedStr) and in_jit:
             self._check_fstring(node, cur)
         elif isinstance(node, ast.Attribute) and in_jit:
@@ -650,6 +690,30 @@ class _Analyzer:
                        "program at closure creation (pass the array into "
                        "the jit body instead)")
 
+    def _check_except(self, node: ast.ExceptHandler) -> None:
+        """GL013: in fleet-path modules, an except body must do ONE of —
+        re-raise (any ``raise``), reference the caught exception by name
+        (a typed-error store / repr into a report IS observation), or
+        call a telemetry/log emit method.  Finding lands on the
+        ``except`` line, so that's where a justifying
+        ``# graft: noqa(GL013)`` goes."""
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Raise):
+                    return
+                if node.name and isinstance(sub, ast.Name) and \
+                        sub.id == node.name and isinstance(sub.ctx, ast.Load):
+                    return
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr in _GL013_EMITS:
+                    return
+        self._emit(node, "GL013",
+                   "except block swallows the exception without a trace — "
+                   "re-raise, store/log the caught exception, or emit a "
+                   "telemetry counter/timeline event (a failure nothing "
+                   "records is an incident nothing can trigger on)")
+
     @staticmethod
     def _truthy_parts(expr):
         """Subexpressions evaluated for their truth value by a test:
@@ -750,7 +814,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="graft-lint",
         description="TPU/JAX recompile + host-sync hazard lint "
-                    "(rules GL001..GL008; suppress with "
+                    "(rules GL001..GL013; suppress with "
                     "'# graft: noqa(GLxxx)')")
     ap.add_argument("paths", nargs="*", default=["deepspeed_tpu"],
                     help="files/dirs to lint (default: deepspeed_tpu)")

@@ -276,31 +276,51 @@ class TraceRecorder:
     verbatim-token entry in arrival order.  ``trace()`` snapshots the
     recording; ``detach()`` removes the hook.  One recorder per target
     (attaching over a foreign observer raises — silently dropping
-    someone else's recording would be worse than failing)."""
+    someone else's recording would be worse than failing) — unless
+    ``chain=True``, which wraps the prior observer instead: the
+    incumbent keeps seeing every submit FIRST, this recorder second,
+    and ``detach()`` restores the incumbent (the incident recorder's
+    always-on capture must not evict a user's own recording)."""
 
     def __init__(self, vocab: int):
         self.vocab = int(vocab)
         self.entries: List[TraceEntry] = []
+        #: (target, previous observer, installed observer) per attach
         self._targets: list = []
 
-    def attach(self, target) -> "TraceRecorder":
+    def attach(self, target, chain: bool = False) -> "TraceRecorder":
         current = getattr(target, "_submit_observer", "missing")
         if current == "missing":
             raise TypeError(
                 f"{type(target).__name__} has no _submit_observer hook — "
                 "expected a ServingEngine or ReplicaRouter")
         if current is not None and current != self._observe:
-            raise RuntimeError(
-                f"{type(target).__name__} already has a submit observer "
-                "attached — detach it first")
+            if not chain:
+                raise RuntimeError(
+                    f"{type(target).__name__} already has a submit "
+                    "observer attached — detach it first (or attach "
+                    "with chain=True)")
+            prev = current
+
+            def chained(request, *, priority=0, slo_class=None,
+                        eos_token_id=None):
+                prev(request, priority=priority, slo_class=slo_class,
+                     eos_token_id=eos_token_id)
+                self._observe(request, priority=priority,
+                              slo_class=slo_class,
+                              eos_token_id=eos_token_id)
+
+            target._submit_observer = chained
+            self._targets.append((target, prev, chained))
+            return self
         target._submit_observer = self._observe
-        self._targets.append(target)
+        self._targets.append((target, None, self._observe))
         return self
 
     def detach(self) -> None:
-        for t in self._targets:
-            if getattr(t, "_submit_observer", None) == self._observe:
-                t._submit_observer = None
+        for t, prev, installed in self._targets:
+            if getattr(t, "_submit_observer", None) == installed:
+                t._submit_observer = prev
         self._targets = []
 
     def _observe(self, request, *, priority=0, slo_class=None,

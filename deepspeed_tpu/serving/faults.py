@@ -279,6 +279,10 @@ class FaultInjector:
     # --------------------------------------------------------------- report
     def report(self) -> Dict[str, Any]:
         return {
+            # plan provenance: an incident bundle stores report() beside
+            # fault_plan.json — the seed ties them together when bundles
+            # from several chaos runs land in one out_dir
+            "seed": int(self.plan.seed),
             "steps": dict(self._steps),
             "crashes_fired": list(self.crashes_fired),
             "stalls_fired": self.stalls_fired,

@@ -311,6 +311,11 @@ class ReplicaRouter:
         #: per submit() with the caller's knobs, before routing — the
         #: recorded arrival order is the fleet-wide one
         self._submit_observer = None
+        #: flight recorder (telemetry/incident.py IncidentRecorder):
+        #: notified on replica failure / engine error / per-step poll;
+        #: None = one attribute test per hook site (the faults.py
+        #: zero-cost-disarmed idiom)
+        self._incident = None
 
         # family names carry the serving_ namespace prefix (lint GL008:
         # the federated fleet registry stays greppable by subsystem)
@@ -412,7 +417,7 @@ class ReplicaRouter:
                 # attribute fine)
                 try:
                     rep._lock_sanitizer = self._sanitizer
-                except AttributeError:
+                except AttributeError:  # graft: noqa(GL013) duck-typed fakes may forbid attribute set
                     pass
         else:
             self._sanitizer = None
@@ -846,6 +851,14 @@ class ReplicaRouter:
                 self._fail_replica(rid, e)
                 more = True
                 continue
+            except Exception as e:
+                # a REAL engine/audit exception (invariant violation,
+                # retrace, ...) still propagates — but the flight
+                # recorder dumps first, while the evidence is intact
+                inc = self._incident
+                if inc is not None:
+                    inc.on_engine_error(self, rid, e)
+                raise
             more = m or more
             self._refresh_gauges(rid)
             if self.disaggregated and \
@@ -857,7 +870,16 @@ class ReplicaRouter:
         with self._fleet_lock:
             self._prune_handles()
         if self.debug_checks:
-            audit_router(self)
+            try:
+                audit_router(self)
+            except Exception as e:
+                inc = self._incident
+                if inc is not None:
+                    inc.on_engine_error(self, None, e)
+                raise
+        inc = self._incident
+        if inc is not None:
+            inc.on_step_poll(self)
         return more
 
     def start(self) -> "ReplicaRouter":
@@ -900,6 +922,9 @@ class ReplicaRouter:
             if self.disaggregated and \
                     getattr(rep, "role", "both") == "prefill":
                 self._pump_handoffs(rid)
+            inc = self._incident
+            if inc is not None:
+                inc.on_step_poll(self)
             if not more:
                 time.sleep(0.001)           # idle: yield the core
 
@@ -977,6 +1002,13 @@ class ReplicaRouter:
                         self.replicas[r].warm_swap_programs()
             rehomed = self._rehome_items(items, rid)
         self._refresh_gauges(rid)
+        # the flight recorder dumps AFTER the crash protocol, outside
+        # every lock (its gather re-takes them): the bundle captures the
+        # post-salvage fleet — re-home records included — at the exact
+        # point replay's probe will compare against
+        inc = self._incident
+        if inc is not None:
+            inc.on_replica_fail(self, rid, self._worker_errors.get(rid))
         return rehomed
 
     def _fallback_salvage(self, rid: int) -> list:
@@ -1366,6 +1398,27 @@ class ReplicaRouter:
         return self.metrics_server
 
     # ------------------------------------------------------------------- stats
+    def resolved_config(self) -> Dict[str, Any]:
+        """The router's constructor kwargs, resolved and JSON-able — the
+        fleet-level counterpart of ``ServingEngine.resolved_config()``:
+        ``ReplicaRouter(replicas, **resolved_config())`` rebuilds an
+        identically-configured router (incident bundles persist it so
+        ``graft-replay`` reconstructs the fleet from artifacts alone)."""
+        return {
+            "policy": self.policy,
+            "kv_pull": self.kv_pull,
+            "threaded": self.threaded,
+            "debug_checks": self.debug_checks,
+            "trace_capacity": self.timeline.capacity,
+            "max_queue_depth": self.max_queue_depth,
+            "shed_classes": list(self.shed_classes),
+            "burn_threshold": self.burn_threshold,
+            "pull_retries": self.pull_retries,
+            "pull_backoff_s": self.pull_backoff_s,
+            "pull_timeout_s": self.pull_timeout_s,
+            "max_rehomes": self.max_rehomes,
+        }
+
     def stats(self) -> Dict[str, Any]:
         """Router observability: routed/pull/drain counters, aggregate
         prefix hit rate over the fleet, per-replica load and busy time.

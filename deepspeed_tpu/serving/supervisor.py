@@ -90,7 +90,9 @@ class RouterSupervisor:
                                                     Mapping[int, int]]],
                  *, grace_ticks: int = 1,
                  metrics_port: Optional[int] = None,
-                 metrics_host: str = "127.0.0.1"):
+                 metrics_host: str = "127.0.0.1",
+                 watchdog_deadline_s: Optional[float] = None,
+                 watchdog_poll_s: float = 1.0):
         self.router = router
         self.probe_replicas = probe_replicas
         self.grace_ticks = int(grace_ticks)
@@ -117,6 +119,19 @@ class RouterSupervisor:
         if metrics_port is not None:
             router.start_metrics_server(port=metrics_port,
                                         host=metrics_host)
+        # membership probes catch replicas that DIE; the stall watchdog
+        # (telemetry/incident.py) catches fleets that merely STOP — the
+        # supervisor owning both closes "0 hung (we hope)" from each
+        # side.  Opt-in (a deadline), thread-owned here, stopped by
+        # close(); it feeds whatever incident recorder is attached.
+        self.watchdog = None
+        if watchdog_deadline_s is not None:
+            from ..telemetry.incident import StallWatchdog
+
+            self.watchdog = StallWatchdog(
+                router, deadline_s=watchdog_deadline_s,
+                poll_s=watchdog_poll_s,
+                recorder=router._incident).start()
 
     @property
     def metrics_server(self):
@@ -127,7 +142,11 @@ class RouterSupervisor:
         started itself: a server the operator attached via
         ``init_router(metrics_port=)`` outlives supervision (drained
         state is likewise untouched — supervision can resume with a new
-        supervisor)."""
+        supervisor).  A watchdog this supervisor started always stops
+        with it (nothing else owns its thread)."""
+        if self.watchdog is not None:
+            self.watchdog.stop()
+            self.watchdog = None
         if self._owns_metrics_server and \
                 self.router.metrics_server is not None:
             self.router.metrics_server.stop()

@@ -21,11 +21,18 @@ Coordinated pieces (design notes in each module):
    ``/stats`` (JSON), and ``/trace`` (merged Chrome trace).
  - :mod:`~deepspeed_tpu.telemetry.slo` — per-``slo_class`` TTFT/TPOT
    histograms, attainment counters against configurable targets, and
-   burn-rate gauges behind ``slo_report()``.
+   burn-rate gauges behind ``slo_report()``; ``merged_windowed_burn``
+   reports burn over a rolling window (the autoscaling / incident
+   signal).
  - :mod:`~deepspeed_tpu.telemetry.flops` — the serving FLOPs/MFU
    profiler: XLA ``cost_analysis`` per compiled program family (analytic
    fallback), ``serving_model_flops_total``, the MFU gauge, and the
    busy-fraction breakdown.
+ - :mod:`~deepspeed_tpu.telemetry.incident` — the black-box flight
+   recorder: trigger-driven atomic incident bundles
+   (:class:`IncidentRecorder`), the no-progress
+   :class:`StallWatchdog`, and ``replay_bundle`` / ``bin/graft-replay``
+   deterministic re-execution.
 
 See ``docs/observability.md`` for the metric name table, label
 conventions, the fleet-endpoint walkthrough, and the overhead contract.
@@ -36,12 +43,17 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
 from .trace import ProfilerWindow, TraceTimeline, validate_chrome_trace
 from .aggregate import federate, merge_chrome_traces, merge_histograms
 from .server import MetricsServer
-from .slo import DEFAULT_SLO_TARGETS, SLOTracker, merged_slo_report
+from .slo import (DEFAULT_SLO_TARGETS, SLOTracker, merged_slo_report,
+                  merged_windowed_burn)
+from .incident import (IncidentRecorder, StallWatchdog, is_bundle,
+                       load_bundle, replay_bundle)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "DEFAULT_TIME_BUCKETS_S", "ProfilerWindow", "TraceTimeline",
     "validate_chrome_trace", "federate", "merge_chrome_traces",
     "merge_histograms", "MetricsServer", "DEFAULT_SLO_TARGETS",
-    "SLOTracker", "merged_slo_report",
+    "SLOTracker", "merged_slo_report", "merged_windowed_burn",
+    "IncidentRecorder", "StallWatchdog", "is_bundle", "load_bundle",
+    "replay_bundle",
 ]

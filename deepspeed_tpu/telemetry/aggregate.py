@@ -116,7 +116,7 @@ def federate(sources: Mapping[str, MetricsRegistry],
                                             help=fam.help,
                                             monitor_name=fam.monitor_name,
                                             **agg_labels)
-                    except ValueError:
+                    except ValueError:  # graft: noqa(GL013) degrade, don't fail: bucket ladders disagree
                         # sources disagree on the bucket ladder — the
                         # per-replica series above still expose
                         # everything; only the sum is impossible

@@ -42,12 +42,15 @@ Everything is host-side, jax-free, and O(1) per finished request.
 
 from __future__ import annotations
 
+import time
+from collections import deque
 from typing import Any, Dict, Mapping, Optional, Sequence
 
 from .aggregate import merge_histograms
 from .metrics import DEFAULT_TIME_BUCKETS_S, MetricsRegistry
 
-__all__ = ["DEFAULT_SLO_TARGETS", "SLOTracker", "merged_slo_report"]
+__all__ = ["DEFAULT_SLO_TARGETS", "SLOTracker", "merged_slo_report",
+           "merged_windowed_burn"]
 
 #: per-class latency targets + attainment objective.  The classes mirror
 #: ``inference/serving.py SLO_PRIORITY``; targets are deliberately
@@ -62,6 +65,11 @@ DEFAULT_SLO_TARGETS: Dict[str, Dict[str, float]] = {
 
 _DIMS = ("ttft", "tpot")
 
+#: bucket count of the rolling attainment window (per class): the window
+#: is quantised into this many time buckets of ``window_s / N`` seconds
+#: each, so windowed burn costs O(1) per observation and O(N) per query
+_WINDOW_BUCKETS = 16
+
 
 class SLOTracker:
     """Per-class SLO accounting over one engine's finished requests.
@@ -74,12 +82,22 @@ class SLOTracker:
                merged OVER :data:`DEFAULT_SLO_TARGETS` per class (a
                partial override keeps the other fields' defaults); new
                class names are allowed.
+    window_s:  span of the rolling attainment window behind
+               :meth:`windowed_burn` (the cumulative ``slo_report``
+               surface is unaffected).
+    clock:     second-denominated monotonic clock (injectable for
+               tests; defaults to :func:`time.monotonic`).
     """
 
     def __init__(self, registry: MetricsRegistry,
                  targets: Optional[Mapping[str, Mapping[str, float]]]
-                 = None):
+                 = None, *, window_s: float = 60.0, clock=None):
         self.registry = registry
+        self.window_s = float(window_s)
+        self._clock = clock or time.monotonic
+        self._bucket_w = max(self.window_s / _WINDOW_BUCKETS, 1e-6)
+        #: cls -> ring of [bucket_index, n, ttft_attained, tpot_attained]
+        self._window: Dict[str, deque] = {}
         self.targets: Dict[str, Dict[str, float]] = {
             cls: dict(t) for cls, t in DEFAULT_SLO_TARGETS.items()}
         for cls, t in (targets or {}).items():
@@ -146,6 +164,26 @@ class SLOTracker:
             attainment = cells[f"{dim}_attained"].value / total
             allowed = max(1.0 - tgt["objective"], 1e-9)
             cells[f"{dim}_burn"].set((1.0 - attainment) / allowed)
+        # rolling window: fold the observation into the current time
+        # bucket (ring bounded at one spare bucket past the window)
+        idx = int(self._clock() / self._bucket_w)
+        ring = self._window.setdefault(
+            cls, deque(maxlen=_WINDOW_BUCKETS + 1))
+        if not ring or ring[-1][0] != idx:
+            ring.append([idx, 0, 0, 0])
+        b = ring[-1]
+        b[1] += 1
+        b[2] += 1 if ttft_s <= tgt["ttft_s"] else 0
+        b[3] += 1 if tpot_s <= tgt["tpot_s"] else 0
+
+    def windowed_burn(self, window_s: Optional[float] = None
+                      ) -> Dict[str, Dict[str, Any]]:
+        """Per-class burn rate over the last ``window_s`` seconds only
+        (defaults to the tracker's configured window) — the scale-up /
+        incident-trigger signal, where the process-lifetime cumulative
+        ``burn_rate`` in :meth:`report` is useless after the first hour
+        of healthy traffic has banked budget."""
+        return merged_windowed_burn([self], window_s=window_s)
 
     # ------------------------------------------------------------ reporting
     def report(self) -> Dict[str, Dict[str, Any]]:
@@ -195,5 +233,56 @@ def merged_slo_report(trackers: Sequence["SLOTracker"]
                 else None
             entry[f"{dim}_p95_s"] = merged.quantile(0.95) if merged \
                 else None
+        out[cls] = entry
+    return out
+
+
+def merged_windowed_burn(trackers: Sequence["SLOTracker"],
+                         window_s: Optional[float] = None
+                         ) -> Dict[str, Dict[str, Any]]:
+    """Fleet-wide per-class burn rate over the last ``window_s`` seconds
+    (default: the first tracker's window; capped per tracker by its own
+    ring retention).  Buckets whose span overlaps the window sum across
+    trackers; attainment and burn recompute from the windowed totals
+    exactly like :func:`merged_slo_report` does from the cumulative
+    ones.  Classes with zero windowed traffic report ``attainment=None``
+    and ``burn_rate=0.0`` — a quiet class is not a burning class."""
+    if not trackers:
+        return {}
+    w = float(window_s) if window_s is not None else trackers[0].window_s
+    classes: Dict[str, Dict[str, float]] = {}
+    for t in trackers:
+        for cls, tgt in t.targets.items():
+            classes.setdefault(cls, tgt)
+        for cls in t._window:
+            classes.setdefault(cls, DEFAULT_SLO_TARGETS["standard"])
+    out: Dict[str, Dict[str, Any]] = {}
+    for cls in sorted(classes):
+        tgt = classes[cls]
+        n = ttft_att = tpot_att = 0
+        for t in trackers:
+            ring = t._window.get(cls)
+            if not ring:
+                continue
+            # a bucket overlaps (now - w, now] iff its span's right edge
+            # is past the window's left edge
+            min_idx = int((t._clock() - min(w, t.window_s))
+                          / t._bucket_w)
+            for idx, bn, ba, bp in ring:
+                if idx >= min_idx:
+                    n += bn
+                    ttft_att += ba
+                    tpot_att += bp
+        entry: Dict[str, Any] = {"requests": n, "window_s": w,
+                                 "objective": tgt["objective"]}
+        allowed = max(1.0 - tgt["objective"], 1e-9)
+        for dim, att in (("ttft", ttft_att), ("tpot", tpot_att)):
+            if n:
+                attainment = att / n
+                entry[f"{dim}_attainment"] = attainment
+                entry[f"{dim}_burn_rate"] = (1.0 - attainment) / allowed
+            else:
+                entry[f"{dim}_attainment"] = None
+                entry[f"{dim}_burn_rate"] = 0.0
         out[cls] = entry
     return out

@@ -254,9 +254,18 @@ def validate_chrome_trace(doc: Dict[str, Any],
     strict pairing is a whole-fleet property.  Unpaired flows are
     counted in ``flow_unmatched`` either way (in a merged document a
     nonzero count means the other end was never emitted or fell off a
-    ring — check ``dropped_events``).  Summary counts let callers assert
-    content (e.g. per-request span count, cross-replica flow count)
-    without re-walking."""
+    ring — check ``dropped_events``).
+
+    Disaggregated ``handoff`` instants pair the same way per ``uid``:
+    the prefill engine emits its half first (args carry ``slot``), the
+    router's pump emits the routing half second (args carry
+    ``src``/``dst``), so a router-side handoff with no preceding
+    engine-side one is a fabricated hop — an error under strict, else
+    counted.  An engine-side handoff with no router half is a PARKED
+    request the pump has not collected yet (legal at dump time) and
+    only counts in ``handoff_unmatched``.  Summary counts let callers
+    assert content (e.g. per-request span count, cross-replica flow
+    count) without re-walking."""
     if strict_flows is None:
         strict_flows = bool(doc.get("otherData", {}).get("sources"))
     events = doc.get("traceEvents")
@@ -265,10 +274,13 @@ def validate_chrome_trace(doc: Dict[str, Any],
     last_ts = None
     open_spans: Dict[tuple, int] = {}
     flow_started: Dict[Any, int] = {}      # flow id -> finish count
+    handoff_parked: Dict[Any, int] = {}    # uid -> unconsumed engine half
     summary = {"events": len(events), "complete": 0, "instant": 0,
                "metadata": 0, "request_spans": 0, "flow_starts": 0,
-               "flow_ends": 0, "flow_unmatched": 0}
+               "flow_ends": 0, "flow_unmatched": 0, "handoffs": 0,
+               "handoff_unmatched": 0}
     orphan_ends = 0
+    orphan_handoffs = 0
     for i, e in enumerate(events):
         for field in ("name", "ph", "ts", "pid", "tid"):
             if field not in e:
@@ -295,6 +307,23 @@ def validate_chrome_trace(doc: Dict[str, Any],
                 summary["request_spans"] += 1
         elif ph == "i":
             summary["instant"] += 1
+            if e["name"] == "handoff":
+                args = e.get("args", {})
+                uid = args.get("uid")
+                if "src" in args or "dst" in args:     # router pump half
+                    summary["handoffs"] += 1
+                    if handoff_parked.get(uid, 0) > 0:
+                        handoff_parked[uid] -= 1
+                    elif strict_flows:
+                        raise ValueError(
+                            f"event {i}: router handoff for uid {uid!r} "
+                            "without a preceding engine-side handoff — "
+                            "a request was routed off a prefill replica "
+                            "that never parked it")
+                    else:
+                        orphan_handoffs += 1
+                else:                                  # engine park half
+                    handoff_parked[uid] = handoff_parked.get(uid, 0) + 1
         elif ph == "B":
             key = (e["pid"], e["tid"])
             open_spans[key] = open_spans.get(key, 0) + 1
@@ -328,6 +357,10 @@ def validate_chrome_trace(doc: Dict[str, Any],
     if unfinished and strict_flows:
         raise ValueError(f"flow start(s) without a finish: {unfinished}")
     summary["flow_unmatched"] = orphan_ends + len(unfinished)
+    # engine-side handoffs never pumped are legitimately parked (tolerated
+    # even under strict — a dump can land mid-park), but they are visible:
+    summary["handoff_unmatched"] = orphan_handoffs + \
+        sum(handoff_parked.values())
     return summary
 
 

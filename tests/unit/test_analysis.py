@@ -438,6 +438,68 @@ def probe(xs):
     assert _codes(pragma) == []
 
 
+def test_gl013_swallowed_exception_fires_scoped_and_pragma():
+    """GL013: an ``except`` in fleet-path code (serving/, telemetry/,
+    inference/serving.py) that neither re-raises, nor uses the caught
+    name, nor emits telemetry/logging swallows the failure — invisible
+    to the flight recorder."""
+    fires = """
+def pull(rep):
+    try:
+        rep.step()
+    except Exception:
+        pass
+"""
+    in_scope = "deepspeed_tpu/serving/router.py"
+    codes = [f.code for f in lint.check_source(fires, path=in_scope)]
+    assert codes == ["GL013"], codes
+    # finding anchors to the `except` line (where the pragma goes)
+    f = lint.check_source(fires, path=in_scope)[0]
+    assert f.line == 5
+    # same source outside the fleet path: silent by design (tests,
+    # analysis tools, and models/ are allowed terse cleanup handlers)
+    assert lint.check_source(fires, path="deepspeed_tpu/models/gpt2.py") \
+        == []
+    assert lint.check_source(fires) == []
+    # inference/serving.py is in scope despite not living under serving/
+    assert [f.code for f in lint.check_source(
+        fires, path="deepspeed_tpu/inference/serving.py")] == ["GL013"]
+
+    near_misses = """
+from ..utils.logging import logger
+
+def pull(rep, metrics, errors):
+    try:
+        rep.step()
+    except Exception:
+        raise
+    try:
+        rep.step()
+    except Exception as e:
+        errors["step"] = repr(e)
+    try:
+        rep.step()
+    except Exception:
+        metrics.counter("serving_pull_fail_total").inc()
+    try:
+        rep.step()
+    except Exception:
+        logger.warning("step failed; degrading")
+"""
+    assert lint.check_source(near_misses, path=in_scope) == []
+
+    pragma = """
+def close(path):
+    try:
+        os.unlink(path)
+    except OSError:  # graft: noqa(GL013) best-effort temp cleanup
+        pass
+"""
+    assert lint.check_source(pragma, path=in_scope) == []
+    kept = lint.check_source(pragma, path=in_scope, keep_suppressed=True)
+    assert [f.code for f in kept] == ["GL013"]
+
+
 def test_noqa_pragma_suppresses_named_rule_only():
     src = """
 import jax

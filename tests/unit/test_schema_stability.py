@@ -109,6 +109,33 @@ SLO_CLASS_KEYS = frozenset({
     "tpot_p50_s", "tpot_p95_s", "tpot_target_s",
 })
 
+#: windowed_burn() — PR 18's incident-trigger signal, per class
+SLO_WINDOW_KEYS = frozenset({
+    "objective", "requests", "window_s",
+    "ttft_attainment", "ttft_burn_rate",
+    "tpot_attainment", "tpot_burn_rate",
+})
+
+#: ReplicaRouter.resolved_config() — PR 18: incident bundles persist
+#: this dict and ``graft-replay`` rebuilds the fleet by splatting it
+#: back into the constructor, so its key set is a compatibility surface
+#: between bundles dumped by one build and replayed by another
+ROUTER_CONFIG_KEYS = frozenset({
+    "policy", "kv_pull", "threaded", "debug_checks", "trace_capacity",
+    "max_queue_depth", "shed_classes", "burn_threshold", "pull_retries",
+    "pull_backoff_s", "pull_timeout_s", "max_rehomes",
+})
+
+#: incident bundle manifest.json — PR 18: the on-disk contract between
+#: the flight recorder and ``graft-replay``/postmortem tooling; bundles
+#: outlive the process that dumped them, so a key change here needs a
+#: BUNDLE_SCHEMA_VERSION bump, not a silent rename
+MANIFEST_KEYS = frozenset({
+    "schema_version", "bundle_format", "trigger", "wall_time_s",
+    "wall_time_iso", "step_clocks", "seeds", "git_describe", "files",
+    "replicas", "model", "router_config", "replayable", "gather_errors",
+})
+
 
 def test_engine_stats_keys_pinned(served):
     srv, _ = served
@@ -184,6 +211,38 @@ def test_slo_report_schema_pinned(served):
         assert set(rep.keys()) == SLO_CLASSES
         for cls, entry in rep.items():
             assert set(entry.keys()) == SLO_CLASS_KEYS, cls
+
+
+def test_windowed_burn_schema_pinned(served):
+    srv, _ = served
+    win = srv._slo.windowed_burn()
+    assert set(win.keys()) == SLO_CLASSES
+    for cls, entry in win.items():
+        assert set(entry.keys()) == SLO_WINDOW_KEYS, cls
+
+
+def test_router_resolved_config_keys_pinned(served):
+    _, router = served
+    cfg = router.resolved_config()
+    assert set(cfg.keys()) == ROUTER_CONFIG_KEYS
+    import json
+
+    json.dumps(cfg)
+    srv = served[0]
+    rebuilt = ReplicaRouter([ServingEngine(
+        srv.engine, slots=2, max_seq_len=64, block_size=8,
+        prefill_chunk=16)], **cfg)
+    assert rebuilt.resolved_config() == cfg
+
+
+def test_incident_manifest_keys_pinned():
+    from deepspeed_tpu.telemetry import incident
+
+    assert incident.MANIFEST_KEYS == MANIFEST_KEYS
+    assert incident.BUNDLE_SCHEMA_VERSION == 1
+    assert incident.TRIGGER_KINDS == (
+        "replica_fail", "invariant_violation", "retrace",
+        "checksum_burst", "burn_rate_breach", "watchdog_stall")
 
 
 def test_flops_report_schema_pinned(served):

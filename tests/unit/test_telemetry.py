@@ -223,6 +223,38 @@ def test_validate_chrome_trace_rejects_schema_violations():
         ev(ph="B"), ev(ph="E", ts=2.0), ev(ph="X", ts=3.0, dur=1.0)]})
 
 
+def test_validate_chrome_trace_pairs_disagg_handoffs():
+    """PR 18 regression: ``handoff`` instants pair per uid — engine park
+    half (args carry ``slot``) first, router pump half (``src``/``dst``)
+    second.  A router half with no preceding park is a fabricated hop
+    (error under strict, counted otherwise); a park the pump never
+    collected is legal at dump time and only counts."""
+    def ev(ts, **args):
+        return {"name": "handoff", "ph": "i", "s": "t", "ts": ts,
+                "pid": 0, "tid": 0, "args": args}
+
+    strict = {"otherData": {"sources": ["router", "replica 0"]}}
+    paired = {"traceEvents": [ev(1.0, uid="a", slot=2),
+                              ev(2.0, uid="a", src=0, dst=1)], **strict}
+    s = validate_chrome_trace(paired)
+    assert s["handoffs"] == 1 and s["handoff_unmatched"] == 0
+
+    fabricated = {"traceEvents": [ev(1.0, uid="a", src=0, dst=1)],
+                  **strict}
+    with pytest.raises(ValueError, match="never parked"):
+        validate_chrome_trace(fabricated)
+    s = validate_chrome_trace(fabricated, strict_flows=False)
+    assert s["handoffs"] == 1 and s["handoff_unmatched"] == 1
+
+    # parked-but-not-pumped tolerated EVEN under strict (dump mid-park),
+    # but visible in the summary; pairing is per-uid, order per event
+    parked = {"traceEvents": [ev(1.0, uid="a", slot=2),
+                              ev(2.0, uid="b", slot=3),
+                              ev(3.0, uid="b", src=1, dst=0)], **strict}
+    s = validate_chrome_trace(parked)
+    assert s["handoffs"] == 1 and s["handoff_unmatched"] == 1
+
+
 # ----------------------------------------------------------- serving engine
 @pytest.fixture(scope="module")
 def tiny_engine():
