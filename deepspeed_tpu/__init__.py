@@ -133,7 +133,8 @@ def init_router(model=None, config=None, params=None, *, replicas=2,
                 metrics_host="127.0.0.1", max_queue_depth=None,
                 shed_classes=("batch",), burn_threshold=None,
                 pull_retries=2, pull_backoff_s=0.0, pull_timeout_s=None,
-                max_rehomes=3, prefill_workers=None, **serving_kwargs):
+                max_rehomes=3, prefill_workers=None,
+                giant_context_tokens=0, **serving_kwargs):
     """Multi-replica serving entry (ROADMAP item 1): ``replicas`` ×
     ``init_serving`` engines — all sharing ONE weight pytree (the first
     replica's initialized/loaded params are reused, so every replica is
@@ -215,7 +216,8 @@ def init_router(model=None, config=None, params=None, *, replicas=2,
         max_queue_depth=max_queue_depth, shed_classes=shed_classes,
         burn_threshold=burn_threshold, pull_retries=pull_retries,
         pull_backoff_s=pull_backoff_s, pull_timeout_s=pull_timeout_s,
-        max_rehomes=max_rehomes)
+        max_rehomes=max_rehomes,
+        giant_context_tokens=giant_context_tokens)
     if metrics_port is not None:
         router.start_metrics_server(port=metrics_port, host=metrics_host)
     return router
@@ -225,7 +227,8 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
                  max_seq_len=None, prompt_buckets=None, prefill_batch=4,
                  block_size=32, num_blocks=None, chunked_prefill=None,
                  prefill_chunk=128, prefix_caching=True, decode_steps=1,
-                 engine_mode="replicas", spec_tokens=0,
+                 engine_mode="replicas", sp=1, resident_window_blocks=0,
+                 spec_tokens=0,
                  quantize=None, host_blocks=0, swap_batch=8, draft=None,
                  role="both", nvme_blocks=0, nvme_high_watermark=0.9,
                  nvme_path=None,
@@ -304,6 +307,21 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
     default, is bit-identical to prior behavior); see docs/inference.md
     "Disaggregated serving".
 
+    **Long-context serving**: ``sp=N`` adds a sequence-parallel
+    (Ulysses-style) ``sp`` mesh axis — prefill shards the prompt chunk
+    over N ranks, converting heads<->sequence around attention with a
+    pair of ``lax.all_to_all`` collectives (``ops/sp_attention``) and
+    committing KV into the SAME paged pool, so everything downstream
+    (prefix trie, tiers, kv8, tp, router pulls) is untouched; ``sp=1``
+    (default) is bit-identical to prior behavior.  Composes with
+    ``topology=`` tp on an ``sp×tp`` mesh.  ``resident_window_blocks=W``
+    turns on resident-window decode for 100k+-token contexts: only a
+    sliding W-block window plus pinned landmark (attention-sink) blocks
+    stay device-resident — older KV demotes to the host/NVMe tiers under
+    its chain keys and is masked out of attention — so the device pool
+    can be far smaller than one logical context (requires
+    ``host_blocks``).  See docs/inference.md "Long-context serving".
+
     ``debug_checks=True`` turns on the correctness tooling
     (``deepspeed_tpu/analysis/``): the recompile sentry raises on any
     trace past the engine's compile budget (with an abstract-signature
@@ -337,6 +355,17 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
         else:
             config = config.model_copy(deep=True)
             config.tensor_parallel.tp_size = tp
+    if int(sp) > 1:
+        # sp= injects sequence_parallel the same way topology= injects
+        # tensor_parallel: the engine builds the (dp, sp, tp) mesh, the
+        # serving ctor validates the axis matches
+        if isinstance(config, dict):
+            config = {**config, "sequence_parallel": int(sp)}
+        elif config is None:
+            kwargs["sequence_parallel"] = int(sp)
+        else:
+            config = config.model_copy(deep=True)
+            config.sequence_parallel = int(sp)
     if quantize and "w8a8" in str(quantize):
         # route the engine's weights through the K-grouped int8 records the
         # w8a8 serving kernels consume.  An EXPLICIT quant block in config
@@ -374,6 +403,8 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
                          prefill_chunk=prefill_chunk,
                          prefix_caching=prefix_caching,
                          decode_steps=decode_steps, engine_mode=engine_mode,
+                         sp=sp,
+                         resident_window_blocks=resident_window_blocks,
                          spec_tokens=spec_tokens, quantize=quantize,
                          host_blocks=host_blocks, swap_batch=swap_batch,
                          draft=draft, role=role, nvme_blocks=nvme_blocks,

@@ -121,7 +121,9 @@ def audit_paged_state(allocator, tables, held, *,
                       active_needs: Optional[Dict[int, int]] = None,
                       block_size: int = 1,
                       scale_live=None,
-                      scratch_blocks=None) -> None:
+                      scratch_blocks=None,
+                      window_frontiers: Optional[Dict[int, int]] = None,
+                      landmark_blocks: int = 0) -> None:
     """Verify every invariant over one engine's host state; raises
     :class:`PagedStateError` naming the first violated invariant.
 
@@ -144,6 +146,18 @@ def audit_paged_state(allocator, tables, held, *,
                    id 0 stays the table-wide "unset" sentinel either way;
                    a NONZERO scratch id appearing in a table span is an
                    error in its own right.
+    window_frontiers: resident-window serving
+                   (``ServingEngine(resident_window_blocks=N)``): ``slot
+                   -> first device-resident non-landmark block index``.
+                   A slot whose frontier exceeds ``landmark_blocks`` is
+                   audited with the WINDOW occupancy shape instead of the
+                   contiguous one: entries ``[0, landmark_blocks)`` set
+                   (pinned landmarks), ``[landmark_blocks, frontier)``
+                   unset (demoted to the host tier — the slide must zero
+                   exactly what it demotes), ``[frontier, span)`` set
+                   contiguously, and ``owned == mapped`` over the two
+                   resident runs.
+    landmark_blocks: leading blocks pinned on-device per windowed slot.
     """
     ref, free = allocator.snapshot()
     num_blocks = allocator.num_blocks
@@ -276,9 +290,33 @@ def audit_paged_state(allocator, tables, held, *,
 
     # ---- length-occupancy + scratch-aliasing over the tables
     nslots = len(tables)
+    window_frontiers = window_frontiers or {}
     for slot in range(nslots):
         row = tables[slot]
-        span = 0
+        frontier = int(window_frontiers.get(slot, 0))
+        lm = min(int(landmark_blocks), frontier)
+        if frontier > lm:
+            # resident-window shape: landmarks set, demoted middle unset,
+            # then one contiguous resident run from the frontier
+            for li in range(lm):
+                if int(row[li]) == SCRATCH_BLOCK:
+                    raise PagedStateError(
+                        "length-occupancy",
+                        f"slot {slot}: landmark entry {li} unset below "
+                        f"the window frontier {frontier}")
+            for li in range(lm, frontier):
+                if int(row[li]) != SCRATCH_BLOCK:
+                    raise PagedStateError(
+                        "length-occupancy",
+                        f"slot {slot}: entry {li} still set inside the "
+                        f"demoted window region [{lm}, {frontier}) — the "
+                        "slide must zero exactly what it demotes")
+            span = frontier
+            resident = list(range(lm))
+        else:
+            span = 0
+            resident = []
+        run_start = span
         while span < len(row) and int(row[span]) != SCRATCH_BLOCK:
             span += 1
         for li in range(span, len(row)):
@@ -287,8 +325,9 @@ def audit_paged_state(allocator, tables, held, *,
                     "length-occupancy",
                     f"slot {slot}: table entry {li} set after an unset "
                     f"entry at {span} — allocated span must be contiguous")
+        resident.extend(range(run_start, span))
         owned = sorted(int(b) for b in held[slot])
-        mapped = sorted(int(row[li]) for li in range(span))
+        mapped = sorted(int(row[li]) for li in resident)
         hit = scratch.intersection(mapped)
         if hit:
             raise PagedStateError(
@@ -517,6 +556,9 @@ def audit_serving_engine(srv, active) -> None:
     right next to the scheduler events that corrupted the state."""
     needs = {slot: max(int(srv._lengths[slot]), st.base)
              for slot, st in active.items()}
+    frontiers = {slot: st.window_blk for slot, st in active.items()
+                 if getattr(st, "window_blk", 0)} \
+        if getattr(srv, "resident_window_blocks", 0) else None
     timeline = getattr(srv, "timeline", None)
     try:
         audit_paged_state(srv._alloc, srv._tables, srv._held,
@@ -526,7 +568,10 @@ def audit_serving_engine(srv, active) -> None:
                                       if getattr(srv, "kv_quant", False)
                                       else None),
                           scratch_blocks=getattr(
-                              srv, "_scratch_blocks", None))
+                              srv, "_scratch_blocks", None),
+                          window_frontiers=frontiers,
+                          landmark_blocks=getattr(
+                              srv, "_landmark_blocks", 0))
         if getattr(srv, "_host", None) is not None:
             audit_host_store(
                 srv._host,

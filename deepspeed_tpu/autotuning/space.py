@@ -71,6 +71,8 @@ BASE_SERVING_CONFIG: Dict[str, Any] = {
     "topology": 1,
     "decode_steps": 1,
     "engine_mode": "replicas",
+    "sp": 1,
+    "resident_window_blocks": 0,
     "trace_capacity": 16384,
 }
 
@@ -166,7 +168,11 @@ def compile_budget(config: Dict[str, Any]) -> int:
     decode instead of N per-replica copies.  ``nvme_blocks`` and ``role``
     add NOTHING: the NVMe tier spills/promotes through the host arena's
     existing two swap programs (the file I/O is host-side ``ops/aio``),
-    and a role only gates which host-side scheduler phases run."""
+    and a role only gates which host-side scheduler phases run.
+    ``sp > 1`` and ``resident_window_blocks > 0`` are likewise +0: the
+    sp prefill reshapes the SAME chunked prefill program through
+    shard_map, and the windowed decode/prefill bodies REPLACE the plain
+    ones one-for-one (one extra traced operand, same sentry names)."""
     if config.get("spec_tokens"):
         budget = 2
     elif config.get("chunked_prefill", True):
@@ -244,9 +250,18 @@ def _c_pool_min(config, space) -> Optional[str]:
     if int(config.get("block_size") or 0) < 1:
         return None                    # positive_knobs owns this failure
     need = 1 + _blocks_per_seq(config)
+    win = int(config.get("resident_window_blocks") or 0)
+    if win:
+        # a windowed slot never holds more than landmark + window +
+        # one in-flight prefill chunk on device — the pool floor drops
+        # accordingly (the ctor applies the same min())
+        chunk_blocks = int(math.ceil(int(config.get("prefill_chunk") or 1)
+                                     / int(config["block_size"])))
+        need = min(need, 1 + 1 + win + chunk_blocks)
     if resolved_num_blocks(config) < need:
         return (f"num_blocks={resolved_num_blocks(config)} cannot hold "
-                f"one full sequence ({need} blocks incl. scratch)")
+                f"one {'resident window' if win else 'full sequence'} "
+                f"({need} blocks incl. scratch)")
     return None
 
 
@@ -343,6 +358,69 @@ def _c_engine_mode(config, space) -> Optional[str]:
     return None
 
 
+def _c_sp(config, space) -> Optional[str]:
+    sp = int(config.get("sp") or 1)
+    if sp < 1:
+        return f"sp must be >= 1, got {sp}"
+    if sp == 1:
+        return None
+    if not config.get("chunked_prefill", True):
+        return "sp > 1 requires chunked-prefill mode"
+    chunk = int(config.get("prefill_chunk") or 0)
+    if chunk % sp:
+        return (f"prefill_chunk={chunk} must divide by sp={sp} — every "
+                "rank owns an equal sequence shard of the chunk")
+    if int(config.get("spec_tokens") or 0):
+        return (f"sp={sp} does not compose with spec_tokens="
+                f"{config['spec_tokens']} (v1: the verify window is not "
+                "sequence-sharded)")
+    if (config.get("engine_mode") or "replicas") == "dp_tp":
+        return (f"sp={sp} does not compose with engine_mode='dp_tp' "
+                "(v1: the mesh carries either dp or sp, not both)")
+    return None
+
+
+def _c_resident_window(config, space) -> Optional[str]:
+    win = int(config.get("resident_window_blocks") or 0)
+    if win < 0:
+        return f"resident_window_blocks must be >= 0, got {win}"
+    if not win:
+        return None
+    if not (config.get("chunked_prefill", True)
+            and config.get("prefix_caching", True)):
+        return ("resident_window_blocks > 0 requires chunked prefill "
+                "with prefix_caching=True (slid blocks demote through "
+                "the chain-keyed host tier)")
+    if not int(config.get("host_blocks") or 0):
+        return ("resident_window_blocks > 0 needs the host tier "
+                "(host_blocks > 0) to hold demoted cold context")
+    for knob in ("spec_tokens",):
+        if int(config.get(knob) or 0):
+            return (f"resident_window_blocks={win} does not compose "
+                    f"with {knob}={config[knob]} (v1: the verify span "
+                    "assumes a contiguous block table)")
+    if int(config.get("decode_steps") or 1) > 1:
+        return (f"resident_window_blocks={win} does not compose with "
+                f"decode_steps={config['decode_steps']} (v1: the fused "
+                "loop cannot slide the window mid-program)")
+    if (config.get("engine_mode") or "replicas") == "dp_tp":
+        return (f"resident_window_blocks={win} does not compose with "
+                "engine_mode='dp_tp'")
+    if int(config.get("sp") or 1) > 1:
+        return (f"resident_window_blocks={win} does not compose with "
+                f"sp={config['sp']} (v1: windowing is decode-side, sp "
+                "is prefill-side — composition is untested)")
+    if int(config.get("block_size") or 0) >= 1:
+        chunk_blocks = int(math.ceil(int(config.get("prefill_chunk")
+                                         or 1)
+                                     / int(config["block_size"])))
+        if win < chunk_blocks + 1:
+            return (f"resident_window_blocks={win} smaller than one "
+                    f"prefill chunk + 1 ({chunk_blocks + 1}): the "
+                    "window would slide past its own in-flight chunk")
+    return None
+
+
 #: ``(name, predicate)`` — predicate returns a violation message or None.
 #: Each has a loud ctor-validation twin (module docstring).
 CONSTRAINTS: Tuple[Tuple[str, Callable], ...] = (
@@ -361,6 +439,8 @@ CONSTRAINTS: Tuple[Tuple[str, Callable], ...] = (
     ("pool_min_blocks", _c_pool_min),
     ("decode_steps_window", _c_decode_steps),
     ("engine_mode_exclusive", _c_engine_mode),
+    ("sp_prefill_exclusive", _c_sp),
+    ("resident_window_span", _c_resident_window),
 )
 
 

@@ -100,6 +100,10 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     return_tuple: bool = True
     training_mp_size: int = 1
     max_batch_size: int = Field(1, alias="max_out_batch")
+    # Ulysses-style sequence parallelism: size of the mesh ``sp`` axis.
+    # Prefill shards the prompt over it (ops/sp_attention); the reference
+    # has no analog (pre-Ulysses) — TPU-native extension.
+    sequence_parallel: int = Field(1, alias="sp")
 
     @property
     def jnp_dtype(self):

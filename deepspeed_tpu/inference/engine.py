@@ -99,10 +99,16 @@ class InferenceEngine:
             mc.scan_group_size = 1
 
         tp = config.tensor_parallel.tp_size if config.tensor_parallel.enabled else 1
+        sp = int(getattr(config, "sequence_parallel", 1) or 1)
         dist.init_distributed()
         n = len(jax.devices())
         assert n % max(tp, 1) == 0, f"tp_size {tp} does not divide {n} devices"
-        self.topology = MeshTopology(tp=tp, dp=n // max(tp, 1))
+        if n % (max(tp, 1) * max(sp, 1)) != 0:
+            raise ValueError(
+                f"sequence_parallel={sp} x tp_size={tp} does not divide "
+                f"{n} devices")
+        self.topology = MeshTopology(tp=tp, sp=sp,
+                                     dp=n // (max(tp, 1) * max(sp, 1)))
         dist.configure(topology=self.topology)
         self.mesh = self.topology.mesh
 

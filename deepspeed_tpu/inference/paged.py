@@ -41,8 +41,9 @@ gather twins live in ``ops/paged_kv.py`` and ``ops/decode_attention.py``.
 below the device pool — a host numpy arena (the pinned-staging analog of
 ``runtime/zero/offload.py``'s moment buffers) sized in whole KV blocks,
 with its own free list and LRU entry table.  Entries are
-content-addressed by :func:`chain_key` — the byte string of ALL tokens
-from position 0 through the end of the block — so the same key that
+content-addressed by :func:`chain_key` — a fixed-width rolling digest
+over ALL tokens from position 0 through the end of the block — so the
+same key that
 names a block span in the prefix trie names its host copy, and a chain
 demoted block-by-block is re-discoverable block-by-block (each key
 stands alone; no host-side parent pointers).  Residency is exclusive by
@@ -95,6 +96,7 @@ topologies.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import zlib
 from collections import OrderedDict, deque
 from typing import List, Optional, Sequence, Tuple
@@ -140,28 +142,51 @@ def block_checksum(block_arrays: Sequence[np.ndarray]) -> int:
     return c & 0xFFFFFFFF
 
 
+#: chain keys are fixed-width blake2b digests; 16 bytes keeps the alias
+#: probability below 2^-64 even across billions of cached blocks
+CHAIN_KEY_BYTES = 16
+
+#: seed digest for block 0 of every chain (the "empty prefix" state)
+_CHAIN_SEED = b"\x00" * CHAIN_KEY_BYTES
+
+
 def chain_key(tokens, block_index: int, block_size: int) -> bytes:
     """Content address of the ``block_index``-th KV block of a sequence:
-    the int32 bytes of EVERY token from position 0 through the end of that
-    block.  Cumulative on purpose — KV at a position attends over the
-    whole prefix, so two blocks hold identical KV iff their full leading
-    token chains match, and each key stands alone (a host-resident run is
-    probed block-by-block with no parent pointers)."""
-    n = (int(block_index) + 1) * int(block_size)
-    return np.ascontiguousarray(
-        np.asarray(tokens[:n], np.int32)).tobytes()
+    a rolling blake2b digest chained over every token from position 0
+    through the end of that block.  Cumulative on purpose — KV at a
+    position attends over the whole prefix, so two blocks hold identical
+    KV iff their full leading token chains match, and each key stands
+    alone (a host-resident run is probed block-by-block with no parent
+    pointers).
+
+    Keys are a FIXED :data:`CHAIN_KEY_BYTES` bytes regardless of chain
+    depth.  Earlier builds used the raw int32 byte string of the whole
+    leading chain, which grew without bound (block ``i``'s key was
+    ``4 * block_size * (i + 1)`` bytes — quadratic total at 128k-token
+    contexts) and made key handling depend on chain position; the rolling
+    digest keeps the prefix-dependence property (``h_i = H(h_{i-1} ||
+    tokens of block i)``) with O(1) keys.  MIGRATION: host/NVMe stores
+    persisted by a pre-digest build hold raw-chain keys that will never
+    match — drop such stores (entries are caches; chains recompute from
+    tokens) rather than carrying them across the format change."""
+    return chain_keys(tokens, int(block_index) + 1, block_size)[-1]
 
 
 def chain_keys(tokens, n_blocks: int, block_size: int) -> List[bytes]:
     """:func:`chain_key` for blocks ``0..n_blocks-1`` in one pass:
-    serialize the tokens once and slice byte prefixes (4 bytes per int32
-    token), instead of re-serializing the growing chain per block —
-    O(len) total where the naive loop is O(len^2).  Byte-for-byte equal
-    to per-block :func:`chain_key` calls (pinned by a tier-1 test)."""
-    n = int(n_blocks) * int(block_size)
+    serialize the tokens once and roll the digest forward block by block
+    — O(len) total.  Byte-for-byte equal to per-block :func:`chain_key`
+    calls (pinned by a tier-1 test)."""
+    bs = int(block_size)
+    n = int(n_blocks) * bs
     buf = np.ascontiguousarray(np.asarray(tokens[:n], np.int32)).tobytes()
-    return [buf[:4 * (i + 1) * int(block_size)]
-            for i in range(int(n_blocks))]
+    keys: List[bytes] = []
+    h = _CHAIN_SEED
+    for i in range(int(n_blocks)):
+        h = hashlib.blake2b(h + buf[4 * bs * i:4 * bs * (i + 1)],
+                            digest_size=CHAIN_KEY_BYTES).digest()
+        keys.append(h)
+    return keys
 
 
 class BlockAllocator:

@@ -214,17 +214,17 @@ from ..analysis.concurrency import (LockSanitizer, caller_site,
 from ..analysis.invariants import audit_serving_engine
 from ..analysis.sentry import (RecompileSentry, backend_compiles,
                                install_compile_listener)
-from ..ops import paged_kv
+from ..ops import decode_attention, paged_kv, sp_attention
 from ..ops.decode_attention import VERIFY_T_MAX
 from ..ops.paged_kv import blocks_for
-from ..parallel.topology import DP_AXIS, TP_AXIS
+from ..parallel.topology import DP_AXIS, SP_AXIS, TP_AXIS
 from ..telemetry import MetricsRegistry, ProfilerWindow, TraceTimeline
 from ..telemetry.slo import SLOTracker
 from ..utils.logging import log_dist
 from ..utils.lru import LRUCache
-from .paged import (BlockAllocator, GroupedBlockAllocator, HostBlockStore,
-                    NvmeBlockStore, PrefixCache, TransportError, chain_key,
-                    chain_keys)
+from .paged import (SCRATCH_BLOCK, BlockAllocator, GroupedBlockAllocator,
+                    HostBlockStore, NvmeBlockStore, PrefixCache,
+                    TransportError, chain_key, chain_keys)
 from .spec import NGramProposer, greedy_accept
 
 
@@ -352,7 +352,16 @@ class Request:
 #: ``slo_class`` -> default admission priority (``submit``): an SLO class
 #: is a coarse priority band with a stable name — explicit ``priority=``
 #: (nonzero) always wins over the class default
-SLO_PRIORITY = {"realtime": 2, "interactive": 1, "standard": 0, "batch": -1}
+SLO_PRIORITY = {"realtime": 2, "interactive": 1, "standard": 0, "batch": -1,
+                "giant_context": 0}
+
+#: resident-window serving: leading blocks that stay device-resident and
+#: attention-visible forever (attention-sink landmarks — the softmax needs
+#: the early positions to stay numerically sane once the middle of the
+#: context is masked out).  One block is enough for the sink effect; the
+#: knob is a module constant rather than a ctor parameter to keep the
+#: config surface at a single ``resident_window_blocks`` dial.
+_LANDMARK_BLOCKS = 1
 
 
 class RequestHandle:
@@ -587,6 +596,10 @@ class _SlotState:
     priority: int = 0
     slo_class: Optional[str] = None
     handle: Optional[RequestHandle] = None
+    #: resident-window serving: first block index of the device-resident
+    #: window (blocks in [landmark, window_blk) are demoted + masked);
+    #: stays 0 when resident_window_blocks == 0 or nothing has slid yet
+    window_blk: int = 0
 
     @property
     def plen_eff(self) -> int:
@@ -740,6 +753,8 @@ class ServingEngine:
                  prefix_caching: bool = True,
                  decode_steps: int = 1,
                  engine_mode: str = "replicas",
+                 sp: int = 1,
+                 resident_window_blocks: int = 0,
                  spec_tokens: int = 0,
                  quantize: Optional[str] = None,
                  host_blocks: int = 0,
@@ -860,6 +875,90 @@ class ServingEngine:
                     f"divide evenly over the mesh dp axis ({dp})")
         self.dp_degree = dp
 
+        # ----- sequence-parallel (Ulysses) prefill over the mesh sp axis
+        self.sp_degree = int(sp)
+        if self.sp_degree < 1:
+            raise ValueError(f"sp must be >= 1, got {sp}")
+        if self.sp_degree > 1:
+            mesh_sp = int(dict(engine.mesh.shape).get(SP_AXIS, 1))
+            if mesh_sp != self.sp_degree:
+                raise ValueError(
+                    f"sp={sp} but the engine mesh carries an sp axis of "
+                    f"size {mesh_sp} — build the engine with "
+                    f"config={{'sequence_parallel': {sp}}} "
+                    "(init_serving(sp=...) does this for you)")
+            if not self.chunked_prefill:
+                raise ValueError(
+                    "sp > 1 requires chunked-prefill mode — the Ulysses "
+                    "all-to-all shards the fixed prefill_chunk window; "
+                    "drop prompt_buckets / pass chunked_prefill=True")
+            if self.prefill_chunk % self.sp_degree:
+                raise ValueError(
+                    f"prefill_chunk ({self.prefill_chunk}) must divide "
+                    f"evenly over sp={sp} — each sp rank owns a "
+                    "prefill_chunk/sp sequence shard")
+            if self.dp_degree > 1:
+                raise ValueError(
+                    "sp > 1 composes with tp, not with engine_mode="
+                    "'dp_tp' — run sequence-parallel prefill in "
+                    "'replicas' mode")
+            if self.spec_tokens:
+                raise ValueError(
+                    "sp > 1 v1 excludes speculative decoding — the "
+                    "draft/verify programs are decode-side (T <= "
+                    f"{VERIFY_T_MAX}) where sequence parallelism has "
+                    "nothing to shard; drop spec_tokens")
+
+        # ----- resident-window context paging for 100k+-token prompts
+        self.resident_window_blocks = int(resident_window_blocks)
+        if self.resident_window_blocks < 0:
+            raise ValueError(
+                f"resident_window_blocks must be >= 0, got "
+                f"{resident_window_blocks}")
+        if self.resident_window_blocks:
+            if not self.chunked_prefill:
+                raise ValueError(
+                    "resident_window_blocks > 0 requires chunked-prefill "
+                    "mode — giant prompts stream through the fixed "
+                    "prefill window; drop prompt_buckets / pass "
+                    "chunked_prefill=True")
+            if not int(host_blocks):
+                raise ValueError(
+                    "resident_window_blocks > 0 needs the tiered KV cache "
+                    "(host_blocks > 0): cold context blocks demote to the "
+                    "host arena when the window slides past them")
+            if self.spec_tokens:
+                raise ValueError(
+                    "resident_window_blocks > 0 v1 excludes speculative "
+                    "decoding — the verify window's span math assumes a "
+                    "dense block table; drop spec_tokens")
+            if self._K > 1:
+                raise ValueError(
+                    "resident_window_blocks > 0 v1 excludes decode_steps "
+                    "> 1 — the fused window derives the table span from a "
+                    "dense leading run, which window slides punch holes "
+                    "in; use decode_steps=1")
+            if self.dp_degree > 1:
+                raise ValueError(
+                    "resident_window_blocks > 0 v1 excludes engine_mode="
+                    "'dp_tp' — run resident-window serving in 'replicas' "
+                    "mode")
+            if self.sp_degree > 1:
+                raise ValueError(
+                    "resident_window_blocks > 0 v1 excludes sp > 1 — "
+                    "sequence-parallel prefill assumes every committed "
+                    "block is device-resident; pick one per engine")
+            min_win = blocks_for(self.prefill_chunk, self.block_size) + 1
+            if self.resident_window_blocks < min_win:
+                raise ValueError(
+                    f"resident_window_blocks ({resident_window_blocks}) "
+                    f"must be >= {min_win} (one prefill_chunk span "
+                    f"+ 1 decode block) or the window would slide out "
+                    "from under the chunk currently being prefilled")
+        #: leading blocks pinned device-resident + attention-visible
+        self._landmark_blocks = _LANDMARK_BLOCKS \
+            if self.resident_window_blocks else 0
+
         if num_blocks is None:
             num_blocks = self.dp_degree + self.slots * self._nbper
         if self.dp_degree > 1:
@@ -882,10 +981,25 @@ class ServingEngine:
                 g * (num_blocks // self.dp_degree)
                 for g in range(self.dp_degree))
         else:
-            if num_blocks < 1 + self._nbper:
+            # resident-window serving exists precisely so the device pool
+            # can be SMALLER than one full logical sequence: only the
+            # landmark prefix + sliding window (+ the chunk being
+            # prefilled) must fit at once
+            min_need = 1 + self._nbper
+            if self.resident_window_blocks:
+                min_need = min(
+                    min_need,
+                    1 + self._landmark_blocks + self.resident_window_blocks
+                    + blocks_for(self.prefill_chunk, self.block_size))
+            if num_blocks < min_need:
                 raise ValueError(
                     f"num_blocks {num_blocks} cannot hold one full sequence "
-                    f"({self._nbper} blocks + 1 scratch)")
+                    f"({self._nbper} blocks + 1 scratch)"
+                    if not self.resident_window_blocks else
+                    f"num_blocks {num_blocks} cannot hold one resident "
+                    f"window ({self._landmark_blocks} landmark + "
+                    f"{self.resident_window_blocks} window + chunk span "
+                    f"+ 1 scratch = {min_need})")
             self._alloc = BlockAllocator(num_blocks)
             self._scratch_blocks = None
         self._prefix = PrefixCache(self.block_size) \
@@ -984,6 +1098,19 @@ class ServingEngine:
                 "shared across chips); drop shard_kv or lower tp_size")
         self.kv_sharded = divisible if shard_kv is None else \
             (bool(shard_kv) and divisible)
+        if self.sp_degree > 1:
+            # mirror ops/sp_attention.sp_shards so a config that would
+            # silently fall back to single-rank prefill fails loud here
+            nh = getattr(engine.module.model_config, "num_heads", None)
+            sp_tp = self.tp_degree if self.kv_sharded else 1
+            if nh is not None and (
+                    int(nh) % hkv or int(nh) % sp_tp
+                    or (int(nh) // sp_tp) % self.sp_degree):
+                raise ValueError(
+                    f"sp={sp}: the {nh} query heads must shard evenly "
+                    f"over tp={sp_tp} then sp={sp} (and divide the "
+                    f"{hkv} KV heads) for the Ulysses all-to-all — "
+                    "lower sp or pick a head-count-compatible mesh")
         rep = NamedSharding(engine.mesh, P())
         if self.dp_degree > 1:
             # dp_tp: the physical-block dim shards over dp (each group owns
@@ -1006,6 +1133,11 @@ class ServingEngine:
         self._held: List[List[int]] = [[] for _ in range(self.slots)]
         self._tokens = np.zeros(self.slots, np.int32)
         self._lengths = np.zeros(self.slots, np.int32)
+        #: resident-window serving: per-slot first attention-visible token
+        #: past the landmark prefix (== landmark span while nothing has
+        #: been demoted; rows of idle slots stay 0 and are never read by a
+        #: windowed program because their batch rows are masked inactive)
+        self._window_start = np.zeros(self.slots, np.int32)
 
         # compiled-program caches (true LRU, utils/lru.py — shared policy
         # with InferenceEngine._generate_fns); sized past the ladder so a
@@ -1040,6 +1172,12 @@ class ServingEngine:
             # kv_promote (block scatter), both fixed-shape at swap_batch —
             # H2D/D2H traffic itself never compiles anything further
             self.compile_budget += 2
+        # long-context amendments are ZERO by construction and the sentry
+        # enforces it: windowed programs REPLACE the plain decode/prefill
+        # bodies one-for-one (the window mask is traced into the same
+        # sentry entries, window_start rides as a [slots] int32 operand),
+        # and sp prefill reshapes the SAME prefill program through
+        # shard_map — neither adds a program
         self.sentry = RecompileSentry(name="serving",
                                       strict=self.debug_checks,
                                       total_budget=self.compile_budget)
@@ -1279,6 +1417,16 @@ class ServingEngine:
         self._g_host_blocks_in_use = m.gauge(
             "serving_host_blocks_in_use",
             "host-tier arena slots holding demoted KV blocks")
+        # long-context lane (zero-valued when sp == 1 and
+        # resident_window_blocks == 0 — stable dashboard schema)
+        self._c_window_slides = m.counter(
+            "serving_context_window_slides_total",
+            "resident-window slides: cold context block runs demoted to "
+            "the host tier and masked out of decode attention")
+        self._c_sp_a2a_bytes = m.counter(
+            "serving_sp_alltoall_bytes_total",
+            "cross-rank bytes moved by the Ulysses all-to-all pair "
+            "during sequence-parallel prefill (analytic, host-computed)")
         self._h_ttft = m.histogram(
             "serving_ttft_seconds", help="per-request time to first token")
         self._h_tpot = m.histogram(
@@ -1367,7 +1515,12 @@ class ServingEngine:
             + (f", nvme tier (nvme_blocks={self.nvme_blocks}, watermark="
                f"{self.nvme_high_watermark}, {self.nvme_path})"
                if self._nvme is not None else "")
-            + (f", role={self.role}" if self.role != "both" else ""),
+            + (f", role={self.role}" if self.role != "both" else "")
+            + (f", sp={self.sp_degree} (Ulysses prefill)"
+               if self.sp_degree > 1 else "")
+            + (f", resident window={self.resident_window_blocks} blocks "
+               f"(+{self._landmark_blocks} landmark)"
+               if self.resident_window_blocks else ""),
             ranks=[0])
 
     def close(self) -> None:
@@ -1398,6 +1551,17 @@ class ServingEngine:
         in one process."""
         return paged_kv.tp_context(
             self.engine.mesh if self.kv_sharded else None)
+
+    def _sp_ctx(self):
+        """Sequence-parallel tracing context (``ops/sp_attention``):
+        prefill invocations — and ONLY prefill invocations — enter it, so
+        the T > 1 paged-attention dispatch sees the sp mesh and reshapes
+        through the Ulysses all-to-all, while decode/verify programs
+        (T <= VERIFY_T_MAX windows with nothing to shard) trace with the
+        hook dormant."""
+        if self.sp_degree > 1:
+            return sp_attention.sp_context(self.engine.mesh)
+        return contextlib.nullcontext()
 
     def _decode_ctx(self):
         """:meth:`_tp_ctx` plus the dp grouping for ``engine_mode='dp_tp'``:
@@ -1584,6 +1748,22 @@ class ServingEngine:
             # the fused program REPLACES the per-token decode program —
             # same sentry entry, same compile budget
             body_fn = decode_step if K == 1 else decode_fused
+            if self.resident_window_blocks:
+                # the windowed program also REPLACES plain decode (same
+                # sentry entry, +0 budget): window_start rides as a
+                # [slots] int32 operand and the mask is traced into the
+                # body via the ops-module window context (entered HERE,
+                # inside the traced python, so only this program bakes it)
+                lm_tokens = self._landmark_blocks * self.block_size
+
+                def decode_windowed(params, cache, tokens, lengths,
+                                    block_tables, window_start):
+                    with decode_attention.window_context(
+                            window_start, lm_tokens):
+                        return decode_step(params, cache, tokens,
+                                           lengths, block_tables)
+
+                body_fn = decode_windowed
             self._program_bodies["decode"] = body_fn
             self._decode_fn = jax.jit(self.sentry.wrap(body_fn, "decode"),
                                       donate_argnums=self._donate())
@@ -1612,6 +1792,26 @@ class ServingEngine:
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
                     constrain(cache)
 
+            if self.resident_window_blocks:
+                # windowed prefill REPLACES the plain program (+0 budget):
+                # later chunks of a giant prompt must not attend into the
+                # demoted middle (those table entries now point at
+                # scratch), so the same window mask gates the T > 1 path
+                lm_tokens = self._landmark_blocks * self.block_size
+
+                def prefill_windowed(params, cache, ids, block_tables,
+                                     base, valid, window_start):
+                    with decode_attention.window_context(
+                            window_start, lm_tokens):
+                        return prefill(params, cache, ids, block_tables,
+                                       base, valid)
+
+                self._program_bodies.setdefault("prefill", {})[width] = \
+                    prefill_windowed
+                return jax.jit(
+                    self.sentry.wrap(prefill_windowed,
+                                     f"prefill[w{width}]"),
+                    donate_argnums=self._donate())
             if draft is None:
                 self._program_bodies.setdefault("prefill", {})[width] = \
                     prefill
@@ -2003,6 +2203,64 @@ class ServingEngine:
         if blocks:
             self._demote_blocks(blocks, keys)
 
+    def _slide_windows(self) -> None:
+        """Resident-window maintenance (one pass per scheduler iteration):
+        for every active slot whose committed span has outgrown
+        ``resident_window_blocks``, demote the oldest non-landmark block
+        run to the host tier under its chain keys, zero the table entries
+        (the windowed programs' attention mask already hides those
+        positions — zeroed entries read scratch garbage that never
+        reaches the softmax), release the blocks, and advance the slot's
+        window start.  The demoted middle stays recoverable through the
+        ordinary host/NVMe promotion path (e.g. for a later re-prefill at
+        full attention); NOTHING downstream of the table sees a special
+        case — the hole is just more scratch entries."""
+        if not self.resident_window_blocks:
+            return
+        W, lm = self.resident_window_blocks, self._landmark_blocks
+        for slot in sorted(self._active):
+            st = self._active[slot]
+            committed = max(int(self._lengths[slot]), st.base)
+            nfull = committed // self.block_size
+            f = max(st.window_blk, lm)
+            cut = nfull - W
+            if cut <= f:
+                continue
+            seq = np.concatenate([st.prompt_eff,
+                                  np.asarray(st.out, np.int32)])
+            run = chain_keys(seq, min(cut, seq.size // self.block_size),
+                             self.block_size)
+            demote_b, demote_k = [], []
+            for li in range(f, cut):
+                b = int(self._tables[slot, li])
+                if b == 0 or li >= len(run):
+                    continue
+                if self._alloc.refcount(b) == 1 \
+                        and not self._host.has(run[li]):
+                    demote_b.append(b)
+                    demote_k.append(run[li])
+            if demote_b:
+                self._demote_blocks(demote_b, demote_k)
+            freed = 0
+            for li in range(f, cut):
+                b = int(self._tables[slot, li])
+                if b == 0:
+                    continue
+                # drop THIS slot's mapping + reference; a trie-shared
+                # block stays alive under the trie's refs and frees when
+                # the LRU eviction path gets to it
+                self._decref(b)
+                self._held[slot].remove(b)
+                self._tables[slot, li] = 0
+                freed += 1
+            st.window_blk = cut
+            self._window_start[slot] = cut * self.block_size
+            self._c_window_slides.inc()
+            self.timeline.instant(
+                "window_slide", slot=slot, uid=str(st.req.uid),
+                window_start=cut * self.block_size, blocks_freed=freed,
+                demoted=len(demote_b))
+
     def _stage_chunks(self, keys: List[bytes]):
         """Assemble host-resident blocks into ``swap_batch``-shaped staging
         buffers and issue their H2D ``jax.device_put`` (async — dispatch
@@ -2277,6 +2535,7 @@ class ServingEngine:
         self._tables[slot] = 0
         self._tokens[slot] = 0
         self._lengths[slot] = 0
+        self._window_start[slot] = 0
 
     def _preempt(self, slot: int) -> None:
         """Evict a sequence under block pressure: free its blocks and
@@ -2345,8 +2604,16 @@ class ServingEngine:
 
     def _ensure_blocks(self, slot: int, upto: int) -> bool:
         """Make the slot's table cover positions ``[0, upto)``; may preempt
-        other slots (or the slot itself — returns False)."""
+        other slots (or the slot itself — returns False).  Resident-window
+        serving: the demoted region ``[landmark, window_blk)`` is a
+        DELIBERATE scratch hole — its entries stay 0 (attention masks them
+        out) and must never be re-allocated here."""
+        st = self._active.get(slot)
+        skip_hi = getattr(st, "window_blk", 0) \
+            if self.resident_window_blocks and st is not None else 0
         for li in range(blocks_for(upto, self.block_size)):
+            if self._landmark_blocks <= li < skip_hi:
+                continue
             if slot not in self._active:
                 return False
             if self._tables[slot, li] == 0:
@@ -2415,6 +2682,16 @@ class ServingEngine:
             # gate on a non-mutating probe first: while the queue head is
             # blocked, iterations must not churn refcounts / LRU recency
             total_need = blocks_for(plen + 1, self.block_size)
+            if self.resident_window_blocks:
+                # resident-window serving admits on the RESIDENT footprint
+                # only — landmark + window + the chunk being prefilled —
+                # because everything older demotes as the window slides;
+                # gating on the full logical span would block every giant
+                # prompt the window exists to serve
+                total_need = min(
+                    total_need,
+                    self._landmark_blocks + self.resident_window_blocks
+                    + blocks_for(self.prefill_chunk, self.block_size))
             n_hit = self._prefix.probe(prompt_eff, plen - 1) \
                 if self._prefix is not None else 0
 
@@ -2443,12 +2720,16 @@ class ServingEngine:
                 self._blocked_gate = (id(req), len(prior),
                                       self._alloc.version)
                 break
-            if self._host is not None:
+            if self._host is not None and not self.resident_window_blocks:
                 # tiered KV: the chain's continuation may live in host
                 # DRAM (earlier eviction or this request's own preempted
                 # state) — promote it back and extend the claimed prefix;
                 # the gate above just proved the device blocks this costs
-                # are coverable, so promotion never preempts anyone
+                # are coverable, so promotion never preempts anyone.
+                # Resident-window mode skips this: demoted middle blocks
+                # belong OUT of the device pool (the window mask hides
+                # them) — promoting a 100k-token chain would flood the
+                # deliberately small pool at admission
                 hits.extend(self._promote_chain(prompt_eff, plen,
                                                 len(hits), req))
                 need = total_need - len(hits)
@@ -2473,6 +2754,9 @@ class ServingEngine:
                             slo_class=item.slo_class, handle=item.handle)
             self._admit_seq += 1
             active[slot] = st
+            if self.resident_window_blocks:
+                # fresh slot: full attention until the first slide
+                self._window_start[slot] = 0
             if st.handle is not None:
                 st.handle._on_active()
             if self._admission_log is not None:
@@ -2683,6 +2967,10 @@ class ServingEngine:
             self._run_fused_decode(params)
         else:
             self._run_plain_decode(params)
+        # resident-window maintenance AFTER both phases committed this
+        # iteration's tokens: mid-prefill giant prompts slide too (the
+        # next chunk's program then masks the demoted middle)
+        self._slide_windows()
         if self._host is not None:
             # stage next iteration's promotions NOW: the H2D copies
             # run while the next decode step computes (module
@@ -3099,10 +3387,12 @@ class ServingEngine:
         bt = np.zeros_like(self._tables)
         bt[dec] = self._tables[dec]
         with self.timeline.span("decode", slots=len(dec)):
-            with self._decode_ctx():
-                nxt, self._cache = self._get_decode_fn()(
-                    params, self._cache, jnp.asarray(self._tokens),
+            args = (params, self._cache, jnp.asarray(self._tokens),
                     jnp.asarray(self._lengths), jnp.asarray(bt))
+            if self.resident_window_blocks:
+                args += (jnp.asarray(self._window_start),)
+            with self._decode_ctx():
+                nxt, self._cache = self._get_decode_fn()(*args)
             nxt = np.asarray(nxt)
         self._c_decode_steps.inc()
         for slot in dec:
@@ -3385,12 +3675,31 @@ class ServingEngine:
                             self._dcache, jnp.asarray(ids), jnp.asarray(bt),
                             jnp.asarray(base), jnp.asarray(valid))
             else:
-                with self._tp_ctx():
-                    first, self._cache = self._get_prefill_fn(width)(
-                        params, self._cache, jnp.asarray(ids),
+                args = (params, self._cache, jnp.asarray(ids),
                         jnp.asarray(bt), jnp.asarray(base),
                         jnp.asarray(valid))
+                if self.resident_window_blocks:
+                    # per-ROW window starts (prefill batches rows from
+                    # arbitrary slots); pad rows stay 0 = fully visible
+                    ws = np.zeros(j, np.int32)
+                    for row, slot in enumerate(group):
+                        ws[row] = self._window_start[slot]
+                    args += (jnp.asarray(ws),)
+                with self._tp_ctx(), self._sp_ctx():
+                    first, self._cache = self._get_prefill_fn(width)(*args)
             first = np.asarray(first)
+        if self.sp_degree > 1:
+            nbytes = sp_attention.alltoall_bytes(
+                int(self._pool_shape[0]), len(group), width,
+                getattr(self.engine.module.model_config, "num_heads",
+                        int(self._pool_shape[2])),
+                int(self._pool_shape[4]),
+                jnp.dtype(self.engine._config.jnp_dtype).itemsize,
+                self.sp_degree)
+            self._c_sp_a2a_bytes.inc(nbytes)
+            self.timeline.instant("sp_prefill", width=width,
+                                  rows=len(group), bytes=nbytes,
+                                  sp=self.sp_degree)
         self._c_prefill_calls.inc()
         self._prefill_calls_by_width[width] = \
             self._prefill_calls_by_width.get(width, 0) + 1
@@ -3404,6 +3713,16 @@ class ServingEngine:
                 # cache the prompt's FULL blocks (the trailing partial block
                 # will also hold generated tokens — never shared)
                 nfull = st.plen_eff // self.block_size
+                if self.resident_window_blocks:
+                    # window slides during prefill punch a scratch hole in
+                    # the table — only the leading still-resident run is a
+                    # valid trie chain (the demoted middle lives host-side
+                    # under its chain keys, not in the trie)
+                    run = 0
+                    trow = self._tables[slot]
+                    while run < nfull and int(trow[run]) != SCRATCH_BLOCK:
+                        run += 1
+                    nfull = run
                 if nfull:
                     self._prefix.register(st.prompt_eff,
                                           self._tables[slot, :nfull],
@@ -3444,6 +3763,8 @@ class ServingEngine:
             "prefill_chunk": int(self.prefill_chunk),
             "decode_steps": self._K,
             "engine_mode": self.engine_mode,
+            "sp": self.sp_degree,
+            "resident_window_blocks": self.resident_window_blocks,
             "prompt_buckets": list(self.prompt_buckets) or None,
             "prefill_batch": self.prefill_batch,
             "prefix_caching": self._prefix is not None,
@@ -3581,6 +3902,12 @@ class ServingEngine:
             "accepted_tokens": self.accepted_tokens,
             "acceptance_rate": (self.accepted_tokens / self.drafted_tokens
                                 if self.drafted_tokens else 0.0),
+            # long-context lane (sp=1 / window off: 1-and-zeros — schema
+            # stays stable)
+            "sp": self.sp_degree,
+            "resident_window_blocks": self.resident_window_blocks,
+            "context_window_slides": int(self._c_window_slides.value),
+            "sp_alltoall_bytes": int(self._c_sp_a2a_bytes.value),
             # tiered KV (host_blocks=0: zeros — schema stays stable)
             "host_blocks": self.host_blocks,
             "host_blocks_in_use": self._host.blocks_in_use

@@ -239,7 +239,20 @@ def _alibi_cached_attention(cfg: BloomConfig, q, k, v, ck, cv, pos,
     scores = scores.astype(jnp.float32) + \
         slopes[None, :, None, None] * rel[:, None]
     mask = kpos[None, None, :] <= qpos[:, :, None]        # [B | 1, T, S]
-    scores = jnp.where(mask[:, None], scores, -1e30)
+    mask = mask[:, None]                                  # [B | 1, 1, T, S]
+    from ..ops.decode_attention import window_state
+
+    win = window_state()
+    if win is not None:
+        # resident-window serving: the demoted middle region
+        # [landmark, window_start) is masked out (its table entries point
+        # at scratch), exactly like the shared decode-attention path
+        wstart, landmark = win
+        wstart = jnp.asarray(wstart, jnp.int32).reshape(-1)
+        keep = (kpos[None, :] < landmark) | \
+            (kpos[None, :] >= wstart[:, None])            # [B, S]
+        mask = mask & keep[:, None, None, :]
+    scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhts,bhsd->bhtd", probs, vv), ck, cv
 
@@ -294,6 +307,13 @@ def forward_cached(cfg: BloomConfig, params, input_ids, cache, pos,
         if (block_tables is not None and lengths is not None and t > 1) \
         else None
     x = _embed(cfg, params, input_ids)
+    from ..ops.sp_attention import shard_seq
+
+    # sequence-parallel prefill hook: BLOOM's ALiBi attention has no
+    # Ulysses all-to-all path (the additive bias rules out the shared
+    # kernels), so sp here token-shards the projection/MLP chain and lets
+    # GSPMD partition the bias-attention einsums
+    x = shard_seq(x)
 
     x, ks, vs = decode_over_layers(
         lambda x, get, mm, ck, cv: _block_cached_body(

@@ -547,6 +547,11 @@ def forward_cached(cfg: GPT2Config, params, input_ids, cache, pos,
         step_pos = pos
         wpe = jax.lax.dynamic_slice(params["wpe"], (pos, 0), (t, d))
     x = (params["wte"][input_ids] + wpe).astype(params["wte"].dtype)
+    from ..ops.sp_attention import shard_seq
+
+    # sequence-parallel prefill hook: token-shard hidden states over the
+    # mesh sp axis (no-op outside an sp context or when T == 1)
+    x = shard_seq(x)
 
     chunk_valid = jnp.asarray(lengths, jnp.int32) \
         if (block_tables is not None and lengths is not None and t > 1) \

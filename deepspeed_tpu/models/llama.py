@@ -315,6 +315,10 @@ def forward_cached(cfg: LlamaConfig, params, input_ids, cache, pos,
         if (block_tables is not None and lengths is not None and t > 1) \
         else None
     x = params["embed"][input_ids].astype(params["embed"].dtype)
+    from ..ops.sp_attention import shard_seq
+
+    # sequence-parallel prefill hook (no-op outside an sp context)
+    x = shard_seq(x)
 
     if mlp_fn is None:
         x, ks, vs = decode_over_layers(

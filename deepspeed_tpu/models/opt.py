@@ -360,6 +360,10 @@ def forward_cached(cfg: OPTConfig, params, input_ids, cache, pos,
         if (block_tables is not None and lengths is not None and t > 1) \
         else None
     x = _embed(cfg, params, input_ids, pos0=step_pos)
+    from ..ops.sp_attention import shard_seq
+
+    # sequence-parallel prefill hook (no-op outside an sp context)
+    x = shard_seq(x)
 
     x, ks, vs = decode_over_layers(
         lambda x, get, mm, ck, cv: _block_cached_body(

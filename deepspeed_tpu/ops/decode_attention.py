@@ -24,6 +24,7 @@ Two paths, one API:
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import math
 from typing import Optional
@@ -48,6 +49,42 @@ _CompilerParams = getattr(pltpu, "CompilerParams",
 
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+# --------------------------------------------------------- window context
+#: Resident-window attention state for 100k+-token serving
+#: (``ServingEngine(resident_window_blocks=N)``): ``(window_start,
+#: landmark_tokens)`` where ``window_start`` is a TRACED int32 [B] operand
+#: of the serving program (per-row first token of the device-resident
+#: window) and ``landmark_tokens`` a static int — the pinned leading span
+#: that never leaves the device.  A query keeps a key iff it is causal AND
+#: (``key < landmark_tokens`` OR ``key >= window_start[b]``); the masked
+#: middle region's blocks have been demoted to the host/NVMe tiers and
+#: their table entries re-point at scratch, so the mask is what makes the
+#: scratch garbage unreachable.  Like the tp/dp contexts this is module
+#: state read at TRACE time — the engine enters it INSIDE the jitted
+#: program body, so only windowed programs bake in the extra mask and
+#: ``window_start = landmark_tokens`` rows reduce to exact full attention.
+_WINDOW = None
+
+
+@contextlib.contextmanager
+def window_context(window_start, landmark_tokens: int):
+    """Scoped install of the resident-window mask state (see ``_WINDOW``).
+    Entered inside a traced serving-program body; nesting restores the
+    previous state on exit."""
+    global _WINDOW
+    prev = _WINDOW
+    _WINDOW = (window_start, int(landmark_tokens))
+    try:
+        yield
+    finally:
+        _WINDOW = prev
+
+
+def window_state():
+    """``(window_start int32 [B], landmark_tokens int)`` or ``None``."""
+    return _WINDOW
 
 
 def decode_attention_reference(q, k_cache, v_cache, q_pos, *,
@@ -81,6 +118,13 @@ def decode_attention_reference(q, k_cache, v_cache, q_pos, *,
         query_idx = q_pos[:, None] + jnp.arange(t)[None, :]
         mask = key_idx[None, None, :] <= query_idx[:, :, None]  # [B, T, S]
         mask = mask[:, None]                          # [B, 1, T, S]
+    win = window_state()
+    if win is not None:
+        wstart, landmark = win
+        wstart = jnp.asarray(wstart, jnp.int32).reshape(-1)
+        keep = (key_idx[None, :] < landmark) | \
+            (key_idx[None, :] >= wstart[:, None])     # [B, S]
+        mask = mask & keep[:, None, None, :]
     scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhts,bhsd->bhtd", probs, v_cache)
@@ -538,8 +582,17 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, q_pos, *,
     """Dispatch: block-table-walking Pallas kernels on TPU — single-token
     decode (T == 1) or the speculative K+1 verify window (T <=
     ``VERIFY_T_MAX``); gather + XLA reference otherwise (prefill chunks,
-    CPU-sim)."""
-    if jax.default_backend() == "tpu":
+    CPU-sim).  A configured sp context (``ops/sp_attention``) routes
+    prefill chunks through the Ulysses all-to-all path; a resident-window
+    context forces the reference path, which carries the window mask."""
+    if q.shape[2] > 1:
+        from . import sp_attention
+
+        if sp_attention.sp_shards(q.shape[1], pool_payload(k_pool).shape[1],
+                                  q.shape[2]) > 1:
+            return sp_attention.sp_prefill_attention(
+                q, k_pool, v_pool, block_tables, q_pos, sm_scale=sm_scale)
+    if jax.default_backend() == "tpu" and window_state() is None:
         if q.shape[2] == 1:
             return paged_decode_attention_pallas(
                 q, k_pool, v_pool, block_tables, q_pos, sm_scale=sm_scale)

@@ -27,9 +27,10 @@ request counts get wrong), tie-broken by queue depth then rotation.
 ``policy="balance"`` skips the affinity preference.
 
 **Cross-replica KV pull**: PR 9's ``HostBlockStore`` made KV chains
-content-addressed — ``chain_key`` = the int32 bytes of every token
-through the block — which makes host-resident chains a replica-portable
-exchange format.  When the routed replica lacks a prefix another
+content-addressed — ``chain_key`` = a fixed-width rolling blake2b
+digest over the int32 token bytes through the block (each key hashes
+the previous block's key, so it commits to the whole prefix) — which
+makes host-resident chains a replica-portable exchange format.  When the routed replica lacks a prefix another
 replica holds, the router pulls it: the source snapshots its device-trie
 chain into its host tier (``demote_chain`` — the same fixed-shape
 ``paged_block_gather`` + one ``device_get`` the tiered engine swaps
@@ -217,7 +218,8 @@ class ReplicaRouter:
                  burn_threshold: Optional[float] = None,
                  pull_retries: int = 2, pull_backoff_s: float = 0.0,
                  pull_timeout_s: Optional[float] = None,
-                 max_rehomes: int = 3):
+                 max_rehomes: int = 3,
+                 giant_context_tokens: int = 0):
         replicas = list(replicas)
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
@@ -296,6 +298,18 @@ class ReplicaRouter:
         self.pull_retries = int(pull_retries)
         self.pull_backoff_s = float(pull_backoff_s)
         self.pull_timeout_s = pull_timeout_s
+        #: prompts at/above this length route as the "giant_context"
+        #: request class: session affinity is forced (even under
+        #: round_robin — migrating a 100k-token KV chain dwarfs any
+        #: balance gain), a migration cost model gates KV pulls (only a
+        #: chain covering >= half the missing span is worth moving), and
+        #: an unset slo_class defaults to "giant_context" so the
+        #: dedicated SLO targets apply.  0 (default) disables the class.
+        self.giant_context_tokens = int(giant_context_tokens)
+        if self.giant_context_tokens < 0:
+            raise ValueError(
+                f"giant_context_tokens must be >= 0, got "
+                f"{giant_context_tokens}")
         #: armed chaos harness (serving/faults.py); None = zero cost
         self._injector: Optional[FaultInjector] = None
         self._rr = 0
@@ -359,6 +373,10 @@ class ReplicaRouter:
             "serving_handoffs_total",
             "prefill->decode handoffs routed across the disaggregated "
             "fleet")
+        self._c_giant = m.counter(
+            "serving_giant_context_total",
+            "requests routed as the giant_context class (prompt >= "
+            "giant_context_tokens; affinity-pinned, pull-cost-gated)")
         #: per-class shed counters, created lazily on first shed so the
         #: family only exists once shedding is actually configured
         self._c_shed: Dict[str, Any] = {}
@@ -490,14 +508,19 @@ class ReplicaRouter:
         while len(self._hints) > self._hint_cap:
             self._hints.popitem(last=False)
 
-    def _route(self, prompt, need: str = "any") -> Tuple[int, str, int]:
+    def _route(self, prompt, need: str = "any",
+               force_affinity: bool = False) -> Tuple[int, str, int]:
         """Pick a replica for ``prompt``: ``(rid, policy_used, depth)``
         where ``policy_used`` is ``"affinity"`` (a prefix hit decided)
         or ``"balance"`` (load decided).  ``need`` restricts candidates
         by role capability in a disaggregated fleet — ``"prefill"`` for
         new admissions, ``"decode"`` for in-flight resumes/handoffs; on
         an all-"both" fleet every replica satisfies either, so the
-        filter is a no-op and routing is bit-identical."""
+        filter is a no-op and routing is bit-identical.
+        ``force_affinity`` (the giant_context class) runs the affinity
+        preference even under ``policy="round_robin"``/``"balance"`` —
+        re-prefilling a 100k-token context costs more than any
+        rotation fairness buys."""
         live = self._live()
         if not live:
             raise RuntimeError("every replica is drained — readmit one "
@@ -511,7 +534,7 @@ class ReplicaRouter:
                 f"no live {need}-capable replica — the disaggregated "
                 f"fleet lost its last {need} worker; readmit one before "
                 "submitting")
-        if self.policy == "round_robin":
+        if self.policy == "round_robin" and not force_affinity:
             rid = live[self._rr % len(live)]
             self._rr += 1
             return rid, "balance", 0
@@ -525,7 +548,7 @@ class ReplicaRouter:
         load = {r: (probes[r]["blocks_in_use"],
                     probes[r]["queue_depth"] + probes[r]["active"])
                 for r in live}
-        if self.policy == "affinity":
+        if self.policy == "affinity" or force_affinity:
             best_depth = max(depth.values())
             if best_depth > 0:
                 rid = min((r for r in live if depth[r] == best_depth),
@@ -598,7 +621,8 @@ class ReplicaRouter:
             "falling back to local recompute")
         return 0
 
-    def _maybe_pull(self, rid: int, prompt) -> int:
+    def _maybe_pull(self, rid: int, prompt,
+                    min_gain_blocks: int = 0) -> int:
         """Cross-replica KV pull (module docstring): extend the routed
         replica's resident chain for ``prompt`` from the deepest other
         LIVE-TIERED replica's tiers — crash-failed replicas are never a
@@ -609,7 +633,12 @@ class ReplicaRouter:
         to ``pull_retries`` times with deterministic exponential
         backoff, and a permanent fault (or an exhausted budget) falls
         back to local recompute — the pull is an optimization, never a
-        correctness dependency.  Returns blocks pulled."""
+        correctness dependency.  Returns blocks pulled.
+
+        ``min_gain_blocks`` is the migration cost model's floor (the
+        giant_context class sets it to half the missing span): a foreign
+        chain shallower than that is not worth moving — the request
+        stays pinned and recomputes locally."""
         tgt = self.replicas[rid]
         if tgt._host is None or tgt._prefix is None:
             return 0
@@ -634,6 +663,12 @@ class ReplicaRouter:
             if d > best_depth:
                 best, best_depth = r, d
         if best is None:
+            return 0
+        if min_gain_blocks and best_depth - start < min_gain_blocks:
+            self.timeline.instant(
+                "giant_pin", dst=int(rid), src=int(best),
+                gain_blocks=int(best_depth - start),
+                min_gain_blocks=int(min_gain_blocks))
             return 0
         lo, hi = sorted((rid, best))        # lock order: replica index
         src = self.replicas[best]
@@ -778,6 +813,12 @@ class ReplicaRouter:
         rejects ``shed_classes`` submissions with a typed
         :class:`RequestRejected` instead of queueing them into latency
         collapse."""
+        giant = bool(self.giant_context_tokens) and \
+            len(request.prompt) >= self.giant_context_tokens
+        if giant and slo_class is None:
+            # unset class defaults to the dedicated giant_context SLO
+            # targets (telemetry/slo.py); an explicit class always wins
+            slo_class = "giant_context"
         if self._submit_observer is not None:
             self._submit_observer(request, priority=priority,
                                   slo_class=slo_class,
@@ -785,14 +826,31 @@ class ReplicaRouter:
         with self._fleet_lock:
             self._maybe_shed(request.uid, slo_class)
             # new admissions carry an un-prefilled prompt: they need a
-            # prefill-capable replica (no-op filter on a "both" fleet)
-            rid, why, depth = self._route(request.prompt, need="prefill")
+            # prefill-capable replica (no-op filter on a "both" fleet);
+            # giant contexts additionally force session affinity
+            rid, why, depth = self._route(request.prompt, need="prefill",
+                                          force_affinity=giant)
             if why == "affinity":
                 self._c_aff.inc()
             else:
                 self._c_bal.inc()
+            if giant:
+                self._c_giant.inc()
+                self.timeline.instant(
+                    "giant_context", uid=str(request.uid),
+                    replica=int(rid),
+                    prompt_tokens=int(len(request.prompt)))
             if self.kv_pull:
-                self._maybe_pull(rid, request.prompt)
+                min_gain = 0
+                if giant:
+                    # migration cost model: a 100k-token chain only moves
+                    # when the foreign tier covers at least half of what
+                    # this replica is missing — anything less and local
+                    # recompute beats the transfer
+                    usable = (len(request.prompt) - 1) // self.block_size
+                    min_gain = max(1, (usable - depth) // 2)
+                self._maybe_pull(rid, request.prompt,
+                                 min_gain_blocks=min_gain)
             # distributed trace linkage: the flow START must be on the
             # ring before the replica can possibly admit (a threaded
             # worker could admit the moment submit enqueues), so the
@@ -1417,6 +1475,7 @@ class ReplicaRouter:
             "pull_backoff_s": self.pull_backoff_s,
             "pull_timeout_s": self.pull_timeout_s,
             "max_rehomes": self.max_rehomes,
+            "giant_context_tokens": self.giant_context_tokens,
         }
 
     def stats(self) -> Dict[str, Any]:
@@ -1463,6 +1522,7 @@ class ReplicaRouter:
             "drains": int(self._c_drains.value),
             "readmits": int(self._c_readmits.value),
             "handoffs": int(self._c_handoffs.value),
+            "giant_context": int(self._c_giant.value),
             # failure/recovery surface (docs/reliability.md): crash
             # fails, re-homed/permanently-failed requests, sheds by class
             "failed": self.failed,

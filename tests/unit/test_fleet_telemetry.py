@@ -441,7 +441,9 @@ def test_slo_accounting_deterministic_attainment(tiny):
     while srv.step():
         pass
     rep = srv.slo_report()
-    assert set(rep) == {"realtime", "interactive", "standard", "batch"}
+    # PR 19 added the giant_context class (pinned in test_schema_stability)
+    assert set(rep) == {"realtime", "interactive", "standard", "batch",
+                        "giant_context"}
     rt, bt = rep["realtime"], rep["batch"]
     assert rt["requests"] == bt["requests"] == 3
     assert rt["ttft_attainment"] == rt["tpot_attainment"] == 1.0

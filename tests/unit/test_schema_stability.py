@@ -58,6 +58,8 @@ ENGINE_STATS_KEYS = frozenset({
     "prefix_hit_tokens", "prompt_tokens", "quantize", "queue_depth",
     "requests_finished", "resume_recompute_tokens", "retraces_observed",
     "role",
+    "sp", "resident_window_blocks", "context_window_slides",
+    "sp_alltoall_bytes",
     "spec_rounds", "spec_tokens", "speculative", "swap_bytes", "swap_in",
     "swap_out", "tp_degree", "tpot_p50_s", "tpot_p95_s",
     "trace_capacity", "trace_events", "trace_events_dropped",
@@ -73,7 +75,8 @@ CONFIG_KEYS = frozenset({
     "max_seq_len", "ngram_max", "ngram_min", "num_blocks",
     "nvme_blocks", "nvme_high_watermark", "nvme_path", "peak_flops",
     "prefill_batch", "prefill_chunk", "prefix_caching", "prompt_buckets",
-    "quantize", "role", "shard_kv", "slo_targets", "slots", "spec_tokens",
+    "quantize", "resident_window_blocks", "role", "shard_kv",
+    "slo_targets", "slots", "sp", "spec_tokens",
     "swap_batch", "topology", "trace_capacity",
 })
 
@@ -83,7 +86,7 @@ CONFIG_KEYS = frozenset({
 #: re-home counters, typed-failure count, pull retries, per-class sheds)
 ROUTER_STATS_KEYS = frozenset({
     "busy_s", "drained", "drains", "failed", "generated_tokens",
-    "handoffs",
+    "giant_context", "handoffs",
     "kv_pull", "kv_pull_blocks", "kv_pull_bytes", "kv_pull_retries",
     "kv_pulls", "lock_order_checks",
     "lock_violations", "metrics_endpoint",
@@ -100,7 +103,8 @@ PER_REPLICA_KEYS = frozenset({
 })
 
 #: slo_report() — one entry per class, each with this exact shape
-SLO_CLASSES = frozenset({"realtime", "interactive", "standard", "batch"})
+SLO_CLASSES = frozenset({"realtime", "interactive", "standard", "batch",
+                         "giant_context"})
 SLO_CLASS_KEYS = frozenset({
     "objective", "requests",
     "ttft_attained", "ttft_attainment", "ttft_burn_rate",
@@ -124,6 +128,7 @@ ROUTER_CONFIG_KEYS = frozenset({
     "policy", "kv_pull", "threaded", "debug_checks", "trace_capacity",
     "max_queue_depth", "shed_classes", "burn_threshold", "pull_retries",
     "pull_backoff_s", "pull_timeout_s", "max_rehomes",
+    "giant_context_tokens",
 })
 
 #: incident bundle manifest.json — PR 18: the on-disk contract between

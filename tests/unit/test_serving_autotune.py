@@ -287,6 +287,23 @@ def test_every_constraint_has_a_loud_ctor_twin(tiny_engine):
         ("nvme_watermark_window",
          {**base, "host_blocks": 8, "swap_batch": 4, "nvme_blocks": 8,
           "nvme_high_watermark": 0.2}, "watermark budget"),
+        # PR 19: long-context lane — sp prefill + resident window
+        # (tiny_engine carries no sp mesh axis, so the ctor's loud sp
+        # failure is the mesh-shape check; the space predicate prunes
+        # the same config on its chunk-divisibility rule)
+        ("sp_prefill_exclusive", {**base, "sp": 3}, "sp=3"),
+        ("resident_window_span",
+         {**base, "resident_window_blocks": 4, "swap_batch": 4},
+         "host_blocks"),
+        ("resident_window_span",
+         {**base, "resident_window_blocks": 2, "host_blocks": 8,
+          "swap_batch": 4}, "must be >= 3"),
+        ("resident_window_span",
+         {**base, "resident_window_blocks": 8, "host_blocks": 8,
+          "swap_batch": 4, "spec_tokens": 2}, "speculative"),
+        ("pool_min_blocks",
+         {**base, "resident_window_blocks": 4, "host_blocks": 8,
+          "swap_batch": 4, "num_blocks": 5}, "resident"),
     ]
     for name, kwargs, fragment in cases:
         with pytest.raises(ValueError, match=fragment):

@@ -49,7 +49,12 @@ def test_chain_key_is_cumulative_and_block_indexed():
     toks = np.arange(40, dtype=np.int32)
     k0 = chain_key(toks, 0, 8)
     k1 = chain_key(toks, 1, 8)
-    assert k0 == toks[:8].tobytes() and k1 == toks[:16].tobytes()
+    # PR 19: keys are fixed-width rolling digests (the raw-chain byte
+    # strings grew linearly with block index — quadratic total at 128k
+    # contexts); depth never changes the width and distinct chains
+    # never share a key
+    from deepspeed_tpu.inference.paged import CHAIN_KEY_BYTES
+    assert len(k0) == CHAIN_KEY_BYTES == len(k1) and k0 != k1
     # same leading chain => same key, regardless of what follows
     other = np.concatenate([toks[:16], np.full(8, 999, np.int32)])
     assert chain_key(other, 1, 8) == k1
