@@ -171,6 +171,27 @@ telemetry twin's <=2% overhead contract are recorded and warn on
 breach (wall-clock on shared boxes is noise-prone; the committed
 BENCH_r15.json pins passing measurements).
 
+``--sampling`` runs the BENCH_r18 **on-device sampling** protocol
+(PR 20, docs/inference.md "Sampled decoding"): per-slot temperature/
+top-k/top-p/seed ride as fixed-shape ``[slots]`` device operands of the
+SAME compiled programs (greedy is the temperature-0 row — zero extra
+programs, zero recompiles across greedy/sampled/constrained mixes), and
+every gate is DETERMINISTIC because the counter-based PRNG keys are
+pure functions of (request seed, tokens emitted).  Lanes: fresh-twin
+stream determinism, temp-0 bit parity vs a ``sampling=False`` engine
+and sequential ``generate``, ``decode_steps=K`` fused decode token-
+EXACT vs K=1 (``grid_keys`` ≡ per-step ``slot_keys``) with the host-
+iteration-reduction floor, speculative **rejection sampling** (n-gram
++ 1-layer draft model) gated on twin determinism, the 2-/3-program
+compile budget, the deterministic tokens-per-host-decode-iteration
+ratio >= ``--sampling-min-spec-speedup`` x plain sampling, and a
+statistical-parity TV gate (rejection sampling is distribution-exact
+for ANY proposer, so spec-sampled token histograms must sit inside the
+self-calibrated reseeded-plain null band), plus the mixed greedy +
+sampled + constrained-JSON trace on a ``logit_masks=True`` engine —
+still 2 programs, sentry strict, every constrained completion valid
+JSON.  CPU-sim wall tok/s is recorded, never gated.
+
 ``--long-context`` runs the BENCH_r17 **long-context serving** protocol
 (PR 19, docs/inference.md "Long-context serving"): the sp=1 chunked
 engine vs the ``sp=N`` Ulysses sequence-parallel prefill twin on
@@ -189,7 +210,7 @@ Usage:
       [--prefix-len 256] [--grid] [--decode-heavy] [--speculative K]
       [--tp N] [--quantize kv8,w8a8+kv8 | --quant-suite]
       [--replicas N] [--slo] [--chaos] [--host-loop] [--long-context]
-      [--hidden 128] [--seed 0] [--json out.json]
+      [--sampling] [--hidden 128] [--seed 0] [--json out.json]
 """
 
 from __future__ import annotations
@@ -1459,7 +1480,11 @@ def run_chaos_bench(requests: int = 64, slots: int = 8,
        Recovery latency = the timeline gap from ``replica_fail`` to the
        last ``rehome``.  A ``kv8`` lane repeats the kill vs an
        unfaulted kv8 twin (bit-exact) and records the bounded token
-       match vs full-precision sequential.
+       match vs full-precision sequential.  A **sampled** twin (PR 20)
+       repeats the kill with odd-uid requests sampling at temperature
+       0.8 — the counter-based PRNG streams must replay token-EXACTLY
+       on the survivor (keys are pure functions of (request seed,
+       tokens emitted), never of replica/slot state).
      - **flaky-transport lane**: transient TransportErrors on the pull
        path; a drain-forced migration must still land its pulls through
        the retry/backoff machinery with exact parity.
@@ -1617,6 +1642,44 @@ def run_chaos_bench(requests: int = 64, slots: int = 8,
             "requests_rehomed":
                 chaos_q.stats()["requests_rehomed"],
         }
+
+    # ---------------------------------------------- sampled crash lane
+    # PR 20: the crash lane repeated with odd-uid requests SAMPLING
+    # (temperature 0.8, per-request seeds).  Re-homing must replay the
+    # streams token-EXACTLY on the survivor: the counter-based PRNG key
+    # is a pure function of (request seed, tokens emitted), never of
+    # the replica/slot that drew it, so a rebuilt slot resumes the
+    # stream mid-request with no drift.
+    srng = np.random.default_rng([seed, 1009])
+    sreqs = [Request(uid=r.uid, prompt=r.prompt,
+                     max_new_tokens=r.max_new_tokens,
+                     temperature=0.8, top_k=20, top_p=0.95,
+                     seed=int(srng.integers(1, 2 ** 31 - 1)))
+             if r.uid % 2 else
+             Request(uid=r.uid, prompt=r.prompt,
+                     max_new_tokens=r.max_new_tokens)
+             for r in reqs]
+    free_s = fleet()
+    outs_free_s = free_s.serve(sreqs)
+    chaos_s = fleet()
+    inj_s = chaos_s.arm_faults(FaultPlan(
+        seed=seed, crashes=[{"replica": 1, "at_step": crash_step}]))
+    handles_s = [chaos_s.submit(r) for r in sreqs]
+    outs_chaos_s = drive_handles(chaos_s, handles_s)
+    gate("crash-sampled", outs_free_s, outs_chaos_s)
+    st_s = chaos_s.stats()
+    crash_sampled = {
+        "sampled_requests": sum(1 for r in sreqs if r.sampled),
+        "crashes_fired": inj_s.report()["crashes_fired"],
+        "hung_handles": sum(1 for h in handles_s if not h.done),
+        "requests_rehomed": st_s["requests_rehomed"],
+        "replica_failures": st_s["replica_failures"],
+        "compile_budgets_ok": all(
+            p["compile_count"] <= p["compile_budget"]
+            for p in st_s["per_replica"]),
+        "parity_exact_vs_faultfree": not any(
+            t == "crash-sampled" for t, _ in mismatched),
+    }
 
     # ------------------------------------------------- flaky transport lane
     flaky_plan = FaultPlan(
@@ -1907,6 +1970,7 @@ def run_chaos_bench(requests: int = 64, slots: int = 8,
         "sequential": {"tok_s": gen_tokens / seq_wall,
                        "wall_s": seq_wall},
         "crash": crash,
+        "crash_sampled": crash_sampled,
         "crash_kv8": crash_kv8,
         "flaky_transport": flaky,
         "corruption": corruption,
@@ -2595,6 +2659,306 @@ def run_host_loop_bench(requests: int = 64, slots: int = 8,
     return res
 
 
+def run_sampling_bench(requests: int = 48, slots: int = 8,
+                       prefill_batch: int = 4, layers: int = 2,
+                       hidden: int = 128, heads: int = 4,
+                       vocab: int = 2048, seed: int = 0,
+                       dtype: str = "fp32", block_size: int = 32,
+                       prefill_chunk: int = 128, spec_tokens: int = 4,
+                       decode_steps: int = 8, temperature: float = 0.25,
+                       top_k: int = 20, top_p: float = 0.95,
+                       min_spec_speedup: float = 1.3,
+                       min_iter_reduction: float = 4.0,
+                       max_tv: float = 0.12):
+    """The BENCH_r18 on-device sampling protocol (PR 20, module
+    docstring ``--sampling``): per-slot temperature/top-k/top-p sampling
+    as fixed-shape device operands on the decode-heavy trace, with the
+    speculative rejection verifier, fused decode, and constrained-
+    decoding compositions — every gate DETERMINISTIC (counter-based PRNG
+    streams are pure functions of (request seed, tokens emitted), so
+    the same trace replays bit-identically on any engine/fleet shape).
+
+     - **plain_sampled**: the default (``sampling=True``) engine on a
+       mixed greedy+sampled trace; a FRESH twin engine must reproduce
+       every stream token-exactly, and at least one sampled stream must
+       deviate from greedy (no silent argmax collapse).
+     - **greedy_row**: the same prompts at temperature=0 through the
+       sampling engine vs a ``sampling=False`` twin vs sequential
+       ``generate`` — bit parity (greedy is the temp-0 ROW of the same
+       program, not a separate program).
+     - **fused**: ``decode_steps=K`` on the sampled trace — token-EXACT
+       vs the K=1 engine (``grid_keys`` == per-step ``slot_keys``), host
+       iterations per token down >= ``min_iter_reduction``.
+     - **speculative**: ``spec_tokens=K`` n-gram with the rejection
+       verifier — deterministic twin parity, 2 compiled programs, and
+       the throughput headline gated on the DETERMINISTIC counter ratio
+       tokens-per-host-decode-iteration >= ``min_spec_speedup`` x the
+       plain sampled engine (CPU-sim wall tok/s is recorded, not gated).
+     - **statistical parity**: aggregate sampled-token histogram TV
+       between the spec and plain lanes must stay within the
+       self-calibrated null band — 1.5x the TV between two plain lanes
+       differing only in request seeds (+0.02), floored at ``max_tv``.
+       Rejection sampling is distribution-exact for any proposer, so
+       the spec lane must look statistically identical to plain
+       sampling even though the streams differ draw-for-draw.
+     - **draft**: a 1-layer draft model on the same trace — exactly 3
+       programs (draft/prefill/verify) and twin determinism (draft
+       params are seeded, rejection needs no draft probabilities).
+     - **constrained / mixed**: a ``logit_masks=True`` engine serving
+       greedy + sampled + JSON-constrained requests in ONE trace —
+       still 2 programs, sentry strict, every constrained completion
+       parses as valid JSON; repeated on a speculative engine.
+    """
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.constrain import (JsonMaskBuilder,
+                                                   ascii_token_strings)
+    from deepspeed_tpu.inference.serving import Request, ServingEngine
+    from deepspeed_tpu.models import gpt2
+
+    def sampled_trace(trace_seed, greedy_every=4):
+        """The decode-heavy trace with per-request sampling params:
+        every ``greedy_every``-th request stays greedy (temp 0), the
+        rest alternate temperature T / 2T with per-request seeds —
+        prompts identical across ``trace_seed`` so reseeded twins
+        differ ONLY in the sampling streams."""
+        base_reqs = build_trace(requests, vocab, seed, False,
+                                decode_heavy=True)
+        rng = np.random.default_rng([trace_seed, 7919])
+        out = []
+        for r in base_reqs:
+            if greedy_every and r.uid % greedy_every == greedy_every - 1:
+                out.append(Request(uid=r.uid, prompt=r.prompt,
+                                   max_new_tokens=r.max_new_tokens))
+                continue
+            t = temperature * (2.0 if r.uid % greedy_every == 1 else 1.0)
+            out.append(Request(uid=r.uid, prompt=r.prompt,
+                               max_new_tokens=r.max_new_tokens,
+                               temperature=t, top_k=top_k, top_p=top_p,
+                               seed=int(rng.integers(1, 2 ** 31 - 1))))
+        return out
+
+    reqs = sampled_trace(seed)
+    reseeded = sampled_trace(seed + 1)
+    greedy_reqs = [Request(uid=r.uid, prompt=r.prompt,
+                           max_new_tokens=r.max_new_tokens) for r in reqs]
+    budget_tokens = sum(r.max_new_tokens for r in reqs)
+    max_total = DECODE_HEAVY_PROMPT_RANGE[1] + DECODE_HEAVY_NEW_RANGE[1]
+    cfg = gpt2.GPT2Config(vocab_size=vocab, max_seq_len=1024,
+                          num_layers=layers, num_heads=heads,
+                          hidden_size=hidden)
+    deepspeed_tpu.comm.reset_topology()
+    engine = deepspeed_tpu.init_inference(
+        gpt2.build(cfg),
+        config={"dtype": dtype, "tensor_parallel": {"tp_size": 1}})
+
+    def mk(**extra):
+        kw = dict(slots=slots, max_seq_len=max_total,
+                  prefill_batch=prefill_batch, block_size=block_size,
+                  prefill_chunk=prefill_chunk)
+        kw.update(extra)
+        return ServingEngine(engine, **kw)
+
+    def run_lane(srv, trace, eos=None):
+        t0 = time.perf_counter()
+        outs = srv.serve(trace, eos_token_id=eos)
+        wall = time.perf_counter() - t0
+        st = srv.stats()
+        gen = st["generated_tokens"]
+        # host scheduler decode work: one dispatch per decode program
+        # (plain), per verify round (spec), per K-token fence (fused)
+        if st["config"]["decode_steps"] > 1:
+            host_iters = st["host_fence_waits"]
+        else:
+            host_iters = st["decode_steps"] + st["spec_rounds"]
+        return {
+            "tok_s": gen / wall,
+            "wall_s": wall,
+            "generated_tokens": gen,
+            "compiled_programs": srv.compile_count,
+            "program_names": sorted(p[0] for p in srv.compiled_programs),
+            "host_decode_iterations": host_iters,
+            "tokens_per_host_iteration": gen / max(host_iters, 1),
+            "sampled_requests": st["sampled_requests"],
+            "retraces": st["retraces_observed"],
+            "acceptance_rate": st["acceptance_rate"],
+            "spec_draft_rejected": st["spec_draft_rejected"],
+        }, outs
+
+    def exact(a, b, trace):
+        return all(np.array_equal(a[r.uid], b[r.uid]) for r in trace)
+
+    # ------------------------------------------- plain sampled + twin
+    plain, plain_outs = run_lane(mk(), reqs)
+    _, twin_outs = run_lane(mk(), reqs)
+    determinism = exact(plain_outs, twin_outs, reqs)
+
+    # --------------------------------------------------- greedy row
+    greedy_on, greedy_on_outs = run_lane(mk(), greedy_reqs)
+    greedy_off, greedy_off_outs = run_lane(mk(sampling=False),
+                                           greedy_reqs)
+    greedy_parity = exact(greedy_on_outs, greedy_off_outs, greedy_reqs)
+    seq_subset = all(
+        np.array_equal(greedy_on_outs[r.uid],
+                       engine.generate(r.prompt[None, :],
+                                       max_new_tokens=r.max_new_tokens)[0])
+        for r in greedy_reqs[:6])
+    deviates = any(not np.array_equal(plain_outs[r.uid],
+                                      greedy_on_outs[r.uid])
+                   for r in reqs if r.sampled)
+
+    # -------------------------------------------------------- fused
+    fused, fused_outs = run_lane(mk(decode_steps=decode_steps), reqs)
+    fused_exact = exact(plain_outs, fused_outs, reqs)
+    iter_reduction = plain["host_decode_iterations"] / \
+        max(fused["host_decode_iterations"], 1)
+
+    # -------------------------------------------------- speculative
+    spec, spec_outs = run_lane(mk(spec_tokens=spec_tokens), reqs)
+    _, spec_twin_outs = run_lane(mk(spec_tokens=spec_tokens), reqs)
+    spec_det = exact(spec_outs, spec_twin_outs, reqs)
+    spec_speedup = spec["tokens_per_host_iteration"] / \
+        plain["tokens_per_host_iteration"]
+
+    # --------------------------------------------- statistical parity
+    _, reseed_outs = run_lane(mk(), reseeded)
+
+    def tail_hist(outs, trace):
+        h = np.zeros(vocab, np.float64)
+        for r in trace:
+            if not r.sampled:
+                continue
+            h += np.bincount(np.asarray(outs[r.uid])[len(r.prompt):],
+                             minlength=vocab)
+        return h / max(h.sum(), 1.0)
+
+    def tv(a, b):
+        return 0.5 * float(np.abs(a - b).sum())
+
+    h_plain = tail_hist(plain_outs, reqs)
+    tv_null = tv(h_plain, tail_hist(reseed_outs, reseeded))
+    tv_spec = tv(h_plain, tail_hist(spec_outs, reqs))
+    tv_threshold = max(max_tv, 1.5 * tv_null + 0.02)
+    stat_parity = tv_spec <= tv_threshold
+
+    # -------------------------------------------------------- draft
+    dcfg = gpt2.GPT2Config(vocab_size=vocab, max_seq_len=1024,
+                           num_layers=1, num_heads=heads,
+                           hidden_size=max(hidden // 2, heads * 8))
+    draft, draft_outs = run_lane(
+        mk(spec_tokens=spec_tokens, draft=gpt2.build(dcfg)), reqs)
+    _, draft_twin_outs = run_lane(
+        mk(spec_tokens=spec_tokens, draft=gpt2.build(dcfg)), reqs)
+    draft_det = exact(draft_outs, draft_twin_outs, reqs)
+
+    # -------------------------------------------- constrained / mixed
+    toks = ascii_token_strings(vocab)
+
+    def constrained_reqs(cseed, n=4, max_new=24):
+        rng = np.random.default_rng([cseed, 911])
+        return [Request(uid=1000 + i,
+                        prompt=rng.integers(0, vocab, 12),
+                        max_new_tokens=max_new,
+                        temperature=0.7, top_k=0, top_p=1.0,
+                        seed=int(rng.integers(1, 2 ** 31 - 1)),
+                        mask_builder=JsonMaskBuilder(toks,
+                                                     eos_token_id=0))
+                for i in range(n)]
+
+    def json_valid(outs, trace):
+        for r in trace:
+            gen = [int(t) for t in np.asarray(outs[r.uid])[len(r.prompt):]]
+            if 0 in gen:
+                gen = gen[: gen.index(0)]
+            try:
+                json.loads("".join(toks[t] for t in gen))
+            except (ValueError, IndexError):
+                return False
+        return True
+
+    mixed_trace = reqs[: min(len(reqs), 12)]
+    mixed_srv = mk(logit_masks=True)
+    cons_a = constrained_reqs(seed)
+    mixed_outs = mixed_srv.serve(mixed_trace + cons_a, eos_token_id=0)
+    mixed_json_ok = json_valid(mixed_outs, cons_a)
+    spec_mixed_srv = mk(spec_tokens=spec_tokens, logit_masks=True)
+    cons_b = constrained_reqs(seed + 1)
+    spec_mixed_outs = spec_mixed_srv.serve(mixed_trace + cons_b,
+                                           eos_token_id=0)
+    spec_mixed_json_ok = json_valid(spec_mixed_outs, cons_b)
+
+    return {
+        "protocol": "on-device sampling stack (PR 20, BENCH_r18): "
+                    "per-slot temperature/top-k/top-p as fixed-shape "
+                    "device operands + distribution-exact rejection "
+                    "speculative sampling + fused-decode and "
+                    "constrained-JSON composition on the decode-heavy "
+                    "trace — every gate deterministic (counter-based "
+                    "PRNG), zero recompiles across greedy/sampled/"
+                    "constrained mixes",
+        "trace": f"{requests} decode-heavy requests, prompts "
+                 f"{DECODE_HEAVY_PROMPT_RANGE}, new "
+                 f"{DECODE_HEAVY_NEW_RANGE}; temps "
+                 f"({temperature}, {2 * temperature}, greedy every 4th), "
+                 f"top_k={top_k}, top_p={top_p}, per-request seeds",
+        "requests": requests,
+        "generated_tokens_budget": budget_tokens,
+        "plain_sampled": plain,
+        "greedy_row": {"on": greedy_on, "off": greedy_off},
+        "fused": fused,
+        "host_iteration_reduction": iter_reduction,
+        "speculative": spec,
+        "speedup_spec_tokens_per_host_iter": spec_speedup,
+        "draft": draft,
+        "statistical_parity": {
+            "tv_spec_vs_plain": tv_spec,
+            "tv_null_reseeded_plain": tv_null,
+            "tv_threshold": tv_threshold,
+            "max_tv_floor": max_tv,
+        },
+        "constrained": {
+            "requests": len(cons_a) + len(cons_b),
+            "mixed_programs": mixed_srv.compile_count,
+            "spec_mixed_programs": spec_mixed_srv.compile_count,
+            "mixed_retraces": mixed_srv.sentry.retraces_observed,
+            "spec_mixed_retraces":
+                spec_mixed_srv.sentry.retraces_observed,
+        },
+        "gates": {
+            "sampled_determinism_exact": determinism,
+            "sampled_streams_deviate_from_greedy": deviates,
+            "greedy_row_bit_parity": greedy_parity and seq_subset,
+            "fused_token_exact_vs_plain": fused_exact,
+            "min_iter_reduction": min_iter_reduction,
+            "fused_iter_reduction_ok":
+                iter_reduction >= min_iter_reduction,
+            "spec_determinism_exact": spec_det,
+            "draft_determinism_exact": draft_det,
+            "min_spec_speedup": min_spec_speedup,
+            "spec_host_iter_speedup_ok":
+                spec_speedup >= min_spec_speedup,
+            "statistical_parity_ok": stat_parity,
+            "constrained_json_valid":
+                mixed_json_ok and spec_mixed_json_ok,
+            "mixed_compile_budget_ok":
+                mixed_srv.compile_count == 2
+                and spec_mixed_srv.compile_count == 2
+                and mixed_srv.sentry.retraces_observed == 0
+                and spec_mixed_srv.sentry.retraces_observed == 0,
+            "compile_budgets_ok":
+                plain["compiled_programs"] == 2
+                and fused["compiled_programs"] == 2
+                and spec["compiled_programs"] == 2
+                and draft["compiled_programs"] == 3,
+            "zero_retraces_ok": all(
+                lane["retraces"] == 0
+                for lane in (plain, greedy_on, greedy_off, fused,
+                             spec, draft)),
+        },
+        "model": f"gpt2-{layers}l-{hidden}d-{vocab}v ({dtype})",
+        "backend": __import__("jax").default_backend(),
+    }
+
+
 def run_long_context_bench(requests: int = 3, slots: int = 2,
                            prefill_batch: int = 2, layers: int = 2,
                            hidden: int = 128, heads: int = 4,
@@ -2949,6 +3313,34 @@ def main():
                     help="fail the --host-loop lane unless host "
                          "scheduler iterations per generated token drop "
                          "by >= F vs the K=1 baseline")
+    ap.add_argument("--sampling", action="store_true",
+                    help="run the BENCH_r18 on-device sampling "
+                         "protocol (PR 20): per-slot temperature/"
+                         "top-k/top-p as fixed-shape device operands "
+                         "on the decode-heavy trace — fresh-twin "
+                         "determinism, temp-0 bit parity vs greedy, "
+                         "fused decode_steps=K token-exact "
+                         "composition, spec rejection sampling gated "
+                         "on the deterministic tokens-per-host-"
+                         "iteration ratio + statistical parity (TV), "
+                         "and the mixed greedy/sampled/constrained-"
+                         "JSON 2-program zero-recompile gate "
+                         "(uses --speculative K and --decode-steps)")
+    ap.add_argument("--temperature", type=float, default=0.25,
+                    metavar="T",
+                    help="headline temperature for the --sampling "
+                         "lanes (sampled rows alternate T and 2T)")
+    ap.add_argument("--sampling-min-spec-speedup", type=float,
+                    default=1.3, metavar="F",
+                    help="fail the --sampling lane unless the spec "
+                         "engine's tokens per host decode iteration "
+                         ">= F x the plain sampled engine's")
+    ap.add_argument("--sampling-max-tv", type=float, default=0.12,
+                    metavar="TV",
+                    help="statistical-parity floor for the --sampling "
+                         "lane: spec-vs-plain token-histogram total "
+                         "variation must stay within max(TV, 1.5 x "
+                         "the reseeded-plain null TV + 0.02)")
     ap.add_argument("--quant-suite", action="store_true",
                     help="run the BENCH_r07 protocol: mixed + prefix-heavy "
                          "+ decode-heavy traces with quantized lanes and a "
@@ -3038,6 +3430,10 @@ def main():
             res["crash"]["unfinished"] == 0 and \
             res["crash"]["requests_rehomed"] >= 1 and \
             res["crash"]["compile_budgets_ok"] and \
+            res["crash_sampled"]["parity_exact_vs_faultfree"] and \
+            res["crash_sampled"]["requests_rehomed"] >= 1 and \
+            res["crash_sampled"]["hung_handles"] == 0 and \
+            res["crash_sampled"]["compile_budgets_ok"] and \
             res["flaky_transport"]["pulls_landed_through_retries"] and \
             res["corruption"]["detected_100pct"] and \
             res["corruption"]["recovered_via_recompute_parity"] and \
@@ -3146,6 +3542,34 @@ def main():
                   f"speedup {res['sp_speedup']:.2f}x < 1 on this "
                   "CPU-sim run (see sp_speedup in the JSON)",
                   file=sys.stderr)
+    elif args.sampling:
+        res = run_sampling_bench(
+            requests=args.requests, slots=args.slots,
+            prefill_batch=args.prefill_batch, layers=args.layers,
+            hidden=args.hidden, heads=args.heads, vocab=args.vocab,
+            seed=args.seed, dtype=args.dtype,
+            block_size=args.block_size,
+            prefill_chunk=args.prefill_chunk,
+            spec_tokens=args.speculative or 4,
+            decode_steps=args.decode_steps,
+            temperature=args.temperature,
+            min_spec_speedup=args.sampling_min_spec_speedup,
+            max_tv=args.sampling_max_tv)
+        g = res["gates"]
+        ok = g["sampled_determinism_exact"] and \
+            g["sampled_streams_deviate_from_greedy"] and \
+            g["greedy_row_bit_parity"] and \
+            g["fused_token_exact_vs_plain"] and \
+            g["fused_iter_reduction_ok"] and \
+            g["spec_determinism_exact"] and \
+            g["draft_determinism_exact"] and \
+            g["spec_host_iter_speedup_ok"] and \
+            g["statistical_parity_ok"] and \
+            g["constrained_json_valid"] and \
+            g["mixed_compile_budget_ok"] and \
+            g["compile_budgets_ok"] and \
+            g["zero_retraces_ok"]
+        fail_msg = "sampling gate failed (see gates in the JSON)"
     elif args.host_loop:
         res = run_host_loop_bench(
             requests=args.requests, slots=args.slots,

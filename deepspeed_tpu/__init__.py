@@ -233,6 +233,8 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
                  role="both", nvme_blocks=0, nvme_high_watermark=0.9,
                  nvme_path=None,
                  ngram_max=3, ngram_min=1,
+                 sampling=True, spec_verifier="rejection",
+                 logit_masks=False,
                  shard_kv=None, topology=None, debug_checks=False,
                  trace_capacity=16384, slo_targets=None, peak_flops=None,
                  **kwargs):
@@ -321,6 +323,19 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
     its chain keys and is masked out of attention — so the device pool
     can be far smaller than one logical context (requires
     ``host_blocks``).  See docs/inference.md "Long-context serving".
+
+    **Sampling** (default on): per-request ``temperature`` / ``top_k`` /
+    ``top_p`` / ``seed`` (``Request`` fields) run ON DEVICE as per-slot
+    operand vectors inside the same compiled programs — greedy requests
+    are the ``temperature=0`` rows, so mixed traces keep the compile
+    contract with zero recompiles, and speculative decoding verifies
+    sampled streams with the distribution-exact rejection sampler
+    (``spec_verifier="rejection"``).  ``logit_masks=True`` adds the
+    constrained-decoding lane: requests carrying a ``mask_builder``
+    (``inference/constrain.py``) sample under a host-built
+    ``[slots, vocab]`` allow-mask (e.g. guaranteed-valid JSON).
+    ``sampling=False`` strips the sampling operands for a byte-identical
+    legacy greedy engine.  See docs/inference.md "Sampled decoding".
 
     ``debug_checks=True`` turns on the correctness tooling
     (``deepspeed_tpu/analysis/``): the recompile sentry raises on any
@@ -411,6 +426,8 @@ def init_serving(model=None, config=None, params=None, *, slots=8,
                          nvme_high_watermark=nvme_high_watermark,
                          nvme_path=nvme_path,
                          ngram_max=ngram_max, ngram_min=ngram_min,
+                         sampling=sampling, spec_verifier=spec_verifier,
+                         logit_masks=logit_masks,
                          shard_kv=shard_kv, debug_checks=debug_checks,
                          trace_capacity=trace_capacity,
                          slo_targets=slo_targets, peak_flops=peak_flops)

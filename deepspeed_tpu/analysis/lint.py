@@ -85,6 +85,16 @@ GL013     silent exception swallow in fleet-path code (``serving/``,
           observability story (docs/observability.md) rests on "every
           swallowed failure leaves a trace" — a bare ``except: pass``
           here is an incident the flight recorder can never trigger on.
+GL014     module-level RNG singleton (``random.*`` / ``np.random.*``
+          calls on the process-global generators) in fleet-path code
+          (same scope as GL013): global-stream draws are order-dependent
+          across requests, so a crash replay / re-homed request can
+          never reproduce the sampled stream — exactly the determinism
+          the serving sampler's counter-based PRNG (``ops/sampling.py``,
+          keyed by request seed + emission position) exists to provide.
+          Seeded instances (``np.random.default_rng``, ``Generator``,
+          ``SeedSequence``, ``RandomState``, ``random.Random``) are
+          fine — the seed pins the stream to the owner, not the process.
 ========  =============================================================
 
 Suppression: append ``# graft: noqa(GLxxx)`` (one or more codes,
@@ -156,6 +166,9 @@ RULES: Dict[str, str] = {
     "GL013": "except block in serving/telemetry fleet code swallows the "
              "exception without re-raise, caught-name use, or a "
              "telemetry/log emit",
+    "GL014": "process-global RNG draw (random.*/np.random.* singleton) "
+             "in serving/telemetry fleet code — order-dependent streams "
+             "break replay/re-homing determinism; seed an instance",
 }
 
 #: GL008 — the documented metric naming convention: registry method
@@ -165,7 +178,7 @@ _METRIC_CTORS = frozenset({"counter", "gauge", "histogram"})
 _METRIC_NAMESPACES = ("serving_", "train_", "inference_")
 _METRIC_LABEL_KEYS = frozenset(
     {"replica", "direction", "timer", "slo_class", "slo", "phase",
-     "lock", "tier"})
+     "lock", "tier", "mode"})
 _METRIC_PARAM_KWARGS = frozenset({"help", "monitor_name", "buckets"})
 
 #: substrings marking a function as a sanctioned blocking-transfer helper
@@ -191,6 +204,11 @@ _HOST_TIMER_NAMES = _HOST_TIMER_ATTRS - {"time"}
 #: purpose (the lint runs without importing the package); ``set`` is the
 #: noisiest member but a false CLEAN is a near-miss, never a false fire.
 _GL013_DIRS = frozenset({"serving", "telemetry"})
+
+#: GL014 — constructors that SEED a private generator instance: calling
+#: them through the random/np.random module is the fix, not the bug
+_GL014_SEEDED_CTORS = frozenset({
+    "default_rng", "Generator", "SeedSequence", "RandomState", "Random"})
 _GL013_EMITS = frozenset({
     "inc", "observe", "set", "instant", "flow_start", "flow_end",
     "complete", "warning", "warn", "error", "exception", "info",
@@ -492,6 +510,9 @@ class _Analyzer:
         # GL003 runs everywhere (the jit CALL lives in host code)
         if tail in ("jit", "pjit"):
             self._check_donation(node)
+        # GL014 shares GL013's fleet-path scope
+        if self._gl013:
+            self._check_global_rng(node)
         # GL008 runs everywhere too (registries are built in host code)
         if tail in _METRIC_CTORS and isinstance(node.func, ast.Attribute):
             self._check_metric_convention(node, tail)
@@ -690,6 +711,35 @@ class _Analyzer:
                        "program at closure creation (pass the array into "
                        "the jit body instead)")
 
+    def _check_global_rng(self, node: ast.Call) -> None:
+        """GL014: a draw from the PROCESS-GLOBAL generator —
+        ``random.<fn>(...)`` or ``np.random.<fn>(...)`` /
+        ``numpy.random.<fn>(...)`` — in fleet-path code.  The global
+        stream advances in whatever order requests happen to interleave,
+        so a crash replay or a re-homed request can never reproduce its
+        draws.  Seeded-instance constructors called through the same
+        modules (``default_rng`` & co.) are the sanctioned spelling."""
+        func = node.func
+        if not isinstance(func, ast.Attribute) or \
+                func.attr in _GL014_SEEDED_CTORS:
+            return
+        base = func.value
+        if isinstance(base, ast.Name) and base.id == "random":
+            spelled = f"random.{func.attr}"
+        elif isinstance(base, ast.Attribute) and base.attr == "random" and \
+                isinstance(base.value, ast.Name) and \
+                base.value.id in ("np", "numpy"):
+            spelled = f"{base.value.id}.random.{func.attr}"
+        else:
+            return
+        self._emit(node, "GL014",
+                   f"{spelled}() draws from the process-global RNG in "
+                   "fleet scheduler code — the stream is interleaving-"
+                   "order dependent, so replay/re-homing cannot reproduce "
+                   "it; seed a private instance (np.random.default_rng / "
+                   "random.Random) or use the engine's counter-based "
+                   "sampler")
+
     def _check_except(self, node: ast.ExceptHandler) -> None:
         """GL013: in fleet-path modules, an except body must do ONE of —
         re-raise (any ``raise``), reference the caught exception by name
@@ -814,7 +864,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="graft-lint",
         description="TPU/JAX recompile + host-sync hazard lint "
-                    "(rules GL001..GL013; suppress with "
+                    "(rules GL001..GL014; suppress with "
                     "'# graft: noqa(GLxxx)')")
     ap.add_argument("paths", nargs="*", default=["deepspeed_tpu"],
                     help="files/dirs to lint (default: deepspeed_tpu)")

@@ -73,6 +73,9 @@ BASE_SERVING_CONFIG: Dict[str, Any] = {
     "engine_mode": "replicas",
     "sp": 1,
     "resident_window_blocks": 0,
+    "sampling": True,
+    "spec_verifier": "rejection",
+    "logit_masks": False,
     "trace_capacity": 16384,
 }
 
@@ -172,7 +175,12 @@ def compile_budget(config: Dict[str, Any]) -> int:
     ``sp > 1`` and ``resident_window_blocks > 0`` are likewise +0: the
     sp prefill reshapes the SAME chunked prefill program through
     shard_map, and the windowed decode/prefill bodies REPLACE the plain
-    ones one-for-one (one extra traced operand, same sentry names)."""
+    ones one-for-one (one extra traced operand, same sentry names).
+    ``sampling`` / ``spec_verifier`` / ``logit_masks`` are +0 too: the
+    per-slot sampling params (and the optional ``[slots, vocab]`` mask)
+    ride as extra fixed-shape operands of the SAME programs, and the
+    rejection verifier replaces the greedy matcher inside the one verify
+    program."""
     if config.get("spec_tokens"):
         budget = 2
     elif config.get("chunked_prefill", True):
@@ -421,6 +429,33 @@ def _c_resident_window(config, space) -> Optional[str]:
     return None
 
 
+def _c_spec_sampling(config, space) -> Optional[str]:
+    verifier = config.get("spec_verifier") or "rejection"
+    if verifier not in ("rejection", "greedy"):
+        return (f"spec_verifier={verifier!r} — expected 'rejection' or "
+                "'greedy'")
+    if (int(config.get("spec_tokens") or 0)
+            and config.get("sampling", True) and verifier == "greedy"):
+        return ("speculative decoding on a sampling engine requires the "
+                "rejection verifier (spec_verifier='rejection') — the "
+                "greedy prefix-matcher would silently reshape sampled "
+                "output distributions")
+    return None
+
+
+def _c_logit_masks(config, space) -> Optional[str]:
+    if not config.get("logit_masks"):
+        return None
+    if not config.get("sampling", True):
+        return ("logit_masks=True needs the sampling stack — constrained "
+                "decoding applies the mask inside the sampler programs")
+    if (config.get("engine_mode") or "replicas") == "dp_tp":
+        return ("engine_mode='dp_tp' v1 excludes logit_masks — the "
+                "dp-sharded decode program does not carry the "
+                "[slots, vocab] mask operand")
+    return None
+
+
 #: ``(name, predicate)`` — predicate returns a violation message or None.
 #: Each has a loud ctor-validation twin (module docstring).
 CONSTRAINTS: Tuple[Tuple[str, Callable], ...] = (
@@ -441,6 +476,8 @@ CONSTRAINTS: Tuple[Tuple[str, Callable], ...] = (
     ("engine_mode_exclusive", _c_engine_mode),
     ("sp_prefill_exclusive", _c_sp),
     ("resident_window_span", _c_resident_window),
+    ("spec_sampling_needs_rejection", _c_spec_sampling),
+    ("logit_masks_excludes_dp_tp", _c_logit_masks),
 )
 
 

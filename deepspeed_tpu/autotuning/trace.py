@@ -45,7 +45,11 @@ import numpy as np
 __all__ = ["TraceEntry", "ServingTrace", "TraceRecorder", "fit_trace",
            "sessions_trace"]
 
-TRACE_VERSION = 1
+#: Format v2 (PR 20) adds per-request sampling params (``temperature``,
+#: ``top_k``, ``top_p``, ``seed``).  Absent fields default to greedy
+#: (``temperature=0``), so every committed v1 trace loads unchanged and
+#: replays byte-identically — the defaults ARE the v1 semantics.
+TRACE_VERSION = 2
 
 # rng stream salts: sessions and tails must never collide even when a
 # session id equals an entry index
@@ -70,6 +74,11 @@ class TraceEntry:
     priority: int = 0
     eos_token_id: Optional[int] = None   # submit-time eos (early stop)
     tokens: Optional[List[int]] = None   # recorded verbatim prompt
+    # v2 sampling params — defaults are exactly the greedy v1 semantics
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0                        # per-request sampling seed
 
     def to_dict(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
@@ -77,9 +86,12 @@ class TraceEntry:
                   "tokens"):                           # None = default
             if d[k] is None:
                 del d[k]
-        for k in ("tail_len", "prompt_len", "priority"):   # 0 = default
+        for k in ("tail_len", "prompt_len", "priority",
+                  "temperature", "top_k", "seed"):         # 0 = default
             if not d[k]:
                 del d[k]
+        if d["top_p"] == 1.0:                          # 1.0 = default
+            del d["top_p"]
         return d
 
     @classmethod
@@ -190,7 +202,9 @@ class ServingTrace:
         from ..inference.serving import Request
 
         return [(Request(uid=e.uid, prompt=self.prompt_for(i),
-                         max_new_tokens=e.max_new_tokens), e)
+                         max_new_tokens=e.max_new_tokens,
+                         temperature=e.temperature, top_k=e.top_k,
+                         top_p=e.top_p, seed=e.seed), e)
                 for i, e in enumerate(self.entries)]
 
     def submit_all(self, target, eos_token_id=None) -> list:
@@ -239,33 +253,43 @@ def sessions_trace(n_requests: int, *, vocab: int, seed: int = 0,
                    sessions: int = 0, prefix_len: int = 0,
                    tail_range: Tuple[int, int] = (16, 64),
                    new_range: Tuple[int, int] = (8, 32),
-                   slo_classes: Optional[Sequence[Optional[str]]] = None
-                   ) -> ServingTrace:
+                   slo_classes: Optional[Sequence[Optional[str]]] = None,
+                   temperature: float = 0.0, top_k: int = 0,
+                   top_p: float = 1.0) -> ServingTrace:
     """The BENCH_r09 returning-session workload as a :class:`ServingTrace`:
     ``sessions`` distinct shared prefixes dealt round-robin (request ``i``
     returns to session ``i % sessions`` with a fresh tail), per-request
     tail/decode budgets drawn deterministically from ``seed``.
     ``sessions=0`` produces a sessionless mixed trace with prompt lengths
-    in ``tail_range``."""
+    in ``tail_range``.  ``temperature > 0`` makes every request sampled
+    with the given params and a per-request sampling seed drawn from the
+    SAME deterministic stream — the trace file fully determines the
+    sampled token streams (the engine's counter-based PRNG is keyed only
+    by request seed + emission position)."""
     rng = np.random.default_rng([int(seed), 39916801])
     classes = list(slo_classes or [None])
     entries = []
     for i in range(int(n_requests)):
         tail = int(rng.integers(tail_range[0], tail_range[1] + 1))
         mnew = int(rng.integers(new_range[0], new_range[1] + 1))
+        samp_seed = int(rng.integers(1, 2 ** 31 - 1)) \
+            if float(temperature) > 0 else 0
         entries.append(TraceEntry(
             uid=i, max_new_tokens=mnew,
             session=(i % sessions) if sessions else None,
             tail_len=tail if sessions else 0,
             prompt_len=0 if sessions else tail,
-            slo_class=classes[i % len(classes)]))
+            slo_class=classes[i % len(classes)],
+            temperature=float(temperature), top_k=int(top_k),
+            top_p=float(top_p), seed=samp_seed))
     return ServingTrace(vocab=vocab, seed=seed,
                         prefix_len=prefix_len if sessions else 0,
                         entries=entries,
                         meta={"generator": "sessions_trace",
                               "sessions": int(sessions),
                               "tail_range": list(tail_range),
-                              "new_range": list(new_range)})
+                              "new_range": list(new_range),
+                              "temperature": float(temperature)})
 
 
 class TraceRecorder:
@@ -330,7 +354,11 @@ class TraceRecorder:
             max_new_tokens=int(request.max_new_tokens),
             slo_class=slo_class, priority=int(priority),
             eos_token_id=None if eos_token_id is None else int(eos_token_id),
-            tokens=[int(t) for t in np.asarray(request.prompt).reshape(-1)]))
+            tokens=[int(t) for t in np.asarray(request.prompt).reshape(-1)],
+            temperature=float(getattr(request, "temperature", 0.0)),
+            top_k=int(getattr(request, "top_k", 0)),
+            top_p=float(getattr(request, "top_p", 1.0)),
+            seed=int(getattr(request, "seed", 0))))
 
     def __len__(self) -> int:
         return len(self.entries)

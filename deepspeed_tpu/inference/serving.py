@@ -215,6 +215,7 @@ from ..analysis.invariants import audit_serving_engine
 from ..analysis.sentry import (RecompileSentry, backend_compiles,
                                install_compile_listener)
 from ..ops import decode_attention, paged_kv, sp_attention
+from ..ops import sampling as sampling_ops
 from ..ops.decode_attention import VERIFY_T_MAX
 from ..ops.paged_kv import blocks_for
 from ..parallel.topology import DP_AXIS, SP_AXIS, TP_AXIS
@@ -225,7 +226,7 @@ from ..utils.lru import LRUCache
 from .paged import (SCRATCH_BLOCK, BlockAllocator, GroupedBlockAllocator,
                     HostBlockStore, NvmeBlockStore, PrefixCache,
                     TransportError, chain_key, chain_keys)
-from .spec import NGramProposer, greedy_accept
+from .spec import NGramProposer, greedy_accept, rejection_accept
 
 
 class RequestFailedError(RuntimeError):
@@ -260,7 +261,8 @@ def _parse_quantize(quantize):
 
 
 def _validate_decode_hooks(module, *, speculative: bool = False,
-                           kv_quant: bool = False, role: str = "model"):
+                           kv_quant: bool = False, sampling: bool = False,
+                           role: str = "model"):
     """Fail fast at engine construction, naming the exact missing hook,
     instead of a TypeError deep inside the first prefill call.  Checks the
     hook dict AND the ``forward_cached`` signature (a family can carry a
@@ -290,6 +292,13 @@ def _validate_decode_hooks(module, *, speculative: bool = False,
             f"{role} {name}'s decode hooks lack the speculative verify "
             "head (supports_verify) — add all-position logits "
             "(all_positions=True) to its forward_cached first")
+    if sampling and not hooks.get("supports_sampling"):
+        raise ValueError(
+            f"{role} {name}'s decode hooks do not declare sampling "
+            "support (supports_sampling) — a family qualifies when its "
+            "forward_cached returns full-vocab logits the on-device "
+            "sampler can filter; set the flag after verifying that, or "
+            "build the engine with sampling=False (greedy-only)")
     if kv_quant and not hooks.get("supports_kv_quant"):
         raise ValueError(
             f"{role} {name}'s decode hooks do not declare int8-KV support "
@@ -335,10 +344,24 @@ def default_buckets(max_seq_len: int, lo: int = 32) -> Tuple[int, ...]:
 
 @dataclasses.dataclass
 class Request:
-    """One serving request: prompt token ids + a completion budget."""
+    """One serving request: prompt token ids + a completion budget, plus
+    the per-request sampling contract (PR 20).  ``temperature=0`` (the
+    default) is greedy — bit-identical to every prior PR — and rides the
+    SAME compiled programs as sampled traffic; ``seed`` keys the
+    counter-based PRNG (``ops/sampling.py``), so a request's sampled
+    stream is a pure function of ``(prompt, params, seed)`` — replayable
+    across crash re-homes, preemptions, and fused/plain decode paths.
+    ``mask_builder`` (a :class:`~deepspeed_tpu.inference.constrain
+    .LogitMaskBuilder`) opens the constrained-decoding lane; it needs an
+    engine built with ``logit_masks=True``."""
     uid: Any
     prompt: np.ndarray                      # int32 [prompt_len]
     max_new_tokens: int = 32
+    temperature: float = 0.0                # 0 = greedy (the default row)
+    top_k: int = 0                          # 0 = off
+    top_p: float = 1.0                      # 1 = off
+    seed: int = 0                           # counter-based PRNG root
+    mask_builder: Optional[Any] = None      # constrained-decoding hook
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -347,6 +370,21 @@ class Request:
         if self.max_new_tokens < 1:
             raise ValueError(f"request {self.uid!r}: max_new_tokens must "
                              "be >= 1")
+        if self.temperature < 0:
+            raise ValueError(f"request {self.uid!r}: temperature must be "
+                             f">= 0 (0 = greedy), got {self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"request {self.uid!r}: top_k must be >= 0 "
+                             f"(0 = off), got {self.top_k}")
+        if not 0 < self.top_p <= 1:
+            raise ValueError(f"request {self.uid!r}: top_p must be in "
+                             f"(0, 1] (1 = off), got {self.top_p}")
+
+    @property
+    def sampled(self) -> bool:
+        """True when this request draws from the sampler (any nonzero
+        temperature); greedy requests never touch the PRNG streams."""
+        return self.temperature > 0
 
 
 #: ``slo_class`` -> default admission priority (``submit``): an SLO class
@@ -767,6 +805,9 @@ class ServingEngine:
                  ngram_max: int = 3,
                  ngram_min: int = 1,
                  shard_kv: Optional[bool] = None,
+                 sampling: bool = True,
+                 spec_verifier: str = "rejection",
+                 logit_masks: bool = False,
                  debug_checks: bool = False,
                  trace_capacity: int = 16384,
                  slo_targets: Optional[Dict[str, Dict[str, float]]] = None,
@@ -784,6 +825,27 @@ class ServingEngine:
             raise ValueError(
                 "a draft model was given but spec_tokens is 0 — pass "
                 "spec_tokens=K to enable speculative decoding")
+        # ----- on-device sampling stack (PR 20)
+        self.sampling = bool(sampling)
+        self.spec_verifier = str(spec_verifier)
+        if self.spec_verifier not in ("rejection", "greedy"):
+            raise ValueError(
+                f"spec_verifier must be 'rejection' or 'greedy', got "
+                f"{spec_verifier!r}")
+        if self.spec_tokens and self.sampling and \
+                self.spec_verifier == "greedy":
+            raise ValueError(
+                "speculative decoding on a sampling engine requires the "
+                "rejection verifier (spec_verifier='rejection') — the "
+                "greedy prefix-matcher would silently reshape sampled "
+                "output distributions; pass sampling=False to keep the "
+                "legacy greedy verifier")
+        self.logit_masks = bool(logit_masks)
+        if self.logit_masks and not self.sampling:
+            raise ValueError(
+                "logit_masks=True needs the sampling stack — constrained "
+                "decoding applies the mask inside the sampler programs; "
+                "drop sampling=False")
         self.quantize, self.kv_quant, want_w8a8 = _parse_quantize(quantize)
         qcfg = engine._config.quant
         self.weight_quant = qcfg.type if qcfg.enabled else None
@@ -795,7 +857,8 @@ class ServingEngine:
                 "'w8a8'}} (init_serving(quantize=...) does this for you)")
         hooks = _validate_decode_hooks(engine.module,
                                        speculative=bool(self.spec_tokens),
-                                       kv_quant=self.kv_quant)
+                                       kv_quant=self.kv_quant,
+                                       sampling=self.sampling)
         self.engine = engine
         self._fwd = hooks["forward_cached"]
         self._init_cache = hooks["init_cache"]
@@ -869,6 +932,11 @@ class ServingEngine:
                     "engine_mode='dp_tp' v1 excludes prefix caching (the "
                     "trie would share blocks across dp groups) — pass "
                     "prefix_caching=False")
+            if self.logit_masks:
+                raise ValueError(
+                    "engine_mode='dp_tp' v1 excludes logit_masks — the "
+                    "[slots, vocab] mask operand is not dp-sharded yet; "
+                    "run constrained decoding in 'replicas' mode")
             if self.slots % dp:
                 raise ValueError(
                     f"engine_mode='dp_tp': slots ({self.slots}) must "
@@ -1138,6 +1206,25 @@ class ServingEngine:
         #: been demoted; rows of idle slots stay 0 and are never read by a
         #: windowed program because their batch rows are masked inactive)
         self._window_start = np.zeros(self.slots, np.int32)
+        #: per-slot sampling state (PR 20): fixed-shape device operands —
+        #: knob changes are operand VALUE changes, never recompiles.
+        #: Rows of idle slots keep greedy defaults (temp 0), so inactive
+        #: batch rows always take the bit-exact argmax lane.
+        self._temps = np.zeros(self.slots, np.float32)
+        self._topks = np.zeros(self.slots, np.int32)
+        self._topps = np.ones(self.slots, np.float32)
+        self._seeds = np.zeros(self.slots, np.uint32)
+        self._vocab = int(getattr(engine.module.model_config, "vocab_size",
+                                  0) or 0)
+        #: constrained decoding: per-slot bool logit mask (True = allowed);
+        #: only materialized when the engine opts into the mask operand
+        self._masks = np.ones((self.slots, self._vocab), bool) \
+            if self.logit_masks else None
+        if self.logit_masks and not self._vocab:
+            raise ValueError(
+                "logit_masks=True needs the model config to expose "
+                "vocab_size — the [slots, vocab] mask operand is sized "
+                "from it")
 
         # compiled-program caches (true LRU, utils/lru.py — shared policy
         # with InferenceEngine._generate_fns); sized past the ladder so a
@@ -1213,7 +1300,8 @@ class ServingEngine:
                 if not isinstance(draft, InferenceEngine):
                     draft = InferenceEngine(draft, engine._config)
                 _validate_decode_hooks(draft.module, role="draft model",
-                                       kv_quant=self.kv_quant)
+                                       kv_quant=self.kv_quant,
+                                       sampling=self.sampling)
                 tv = getattr(engine.module.model_config, "vocab_size", None)
                 dv = getattr(draft.module.model_config, "vocab_size", None)
                 if tv is not None and dv is not None and tv != dv:
@@ -1348,6 +1436,19 @@ class ServingEngine:
             "serving_spec_drafted_tokens_total", "draft tokens proposed")
         self._c_accepted = m.counter(
             "serving_spec_accepted_tokens_total", "draft tokens accepted")
+        self._c_spec_rejected = m.counter(
+            "serving_spec_draft_rejected_total",
+            "draft tokens the verifier rejected (rejection sampler or "
+            "greedy mismatch)")
+        self._c_sampled = {
+            mode: m.counter("serving_sampled_requests_total",
+                            "submitted requests by sampling mode",
+                            mode=mode)
+            for mode in ("greedy", "sampled", "constrained")}
+        self._h_accept_ratio = m.histogram(
+            "serving_spec_accept_ratio",
+            buckets=(0.0, 0.25, 0.5, 0.75, 1.0),
+            help="per-round fraction of drafted tokens accepted")
         self._c_finished = m.counter(
             "serving_requests_finished_total", "requests run to completion")
         self._c_cancelled = m.counter(
@@ -1698,28 +1799,137 @@ class ServingEngine:
         return jax.tree_util.tree_map(
             lambda x: jax.lax.with_sharding_constraint(x, sharding), cache)
 
+    def _next_tokens(self, logits, samp):
+        """The per-row token rule shared by every program body: argmax for
+        a greedy-only engine; otherwise a per-row ``where(temp > 0)``
+        select between the on-device sampler (``ops/sampling.py``,
+        counter-keyed by the row's seed + emitted count) and the SAME
+        masked argmax — so one traced program serves mixed
+        greedy+sampled+constrained batches with zero recompiles and the
+        temp=0 rows stay bit-identical to the legacy greedy path."""
+        if samp is None:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        temps, topks, topps, seeds, counts, masks = samp
+        greedy, lp = sampling_ops.filtered_logprobs(
+            logits, temps, topks, topps, masks)
+        keys = sampling_ops.slot_keys(seeds, counts,
+                                      sampling_ops.SALT_TOKEN)
+        return jnp.where(temps > 0,
+                         sampling_ops.sample_tokens(lp, keys), greedy)
+
+    @staticmethod
+    def _pack_samp(tail):
+        """Normalize a program's ``*samp`` operand tail: ``()`` (greedy
+        engine) -> None, a 5-tuple (no mask operand) -> 6-tuple with
+        ``masks=None``."""
+        if not tail:
+            return None
+        t = tuple(tail)
+        return t + (None,) if len(t) == 5 else t
+
+    def _samp_args(self, counts):
+        """The per-dispatch sampling operand tail (slot-indexed): the
+        per-slot knob vectors + this dispatch's emitted-count vector
+        (+ the mask matrix when the engine carries the mask operand).
+        Empty for sampling=False engines — callers splat it, so greedy
+        engines keep the exact legacy call signature."""
+        if not self.sampling:
+            return ()
+        args = (jnp.asarray(self._temps), jnp.asarray(self._topks),
+                jnp.asarray(self._topps), jnp.asarray(self._seeds),
+                jnp.asarray(np.asarray(counts, np.int32)))
+        if self.logit_masks:
+            args += (jnp.asarray(self._masks),)
+        return args
+
+    def _decode_counts(self):
+        """[slots] emitted counts for a decode-phase dispatch (idle rows
+        0 — their greedy lane never touches the PRNG)."""
+        counts = np.zeros(self.slots, np.int32)
+        for slot, st in self._active.items():
+            if st.phase == "decode":
+                counts[slot] = st.gen_count
+        return counts
+
+    def _samp_args_rows(self, group, rows):
+        """The ROW-gathered sampling operand tail for a prefill dispatch
+        (prefill batches arbitrary slots into ``rows`` rows; pad rows
+        keep the greedy/unmasked defaults, so their discarded lane never
+        touches the PRNG)."""
+        if not self.sampling:
+            return ()
+        temps = np.zeros(rows, np.float32)
+        topks = np.zeros(rows, np.int32)
+        topps = np.ones(rows, np.float32)
+        seeds = np.zeros(rows, np.uint32)
+        counts = np.zeros(rows, np.int32)
+        for row, slot in enumerate(group):
+            temps[row] = self._temps[slot]
+            topks[row] = self._topks[slot]
+            topps[row] = self._topps[slot]
+            seeds[row] = self._seeds[slot]
+            counts[row] = self._active[slot].gen_count
+        args = (jnp.asarray(temps), jnp.asarray(topks),
+                jnp.asarray(topps), jnp.asarray(seeds),
+                jnp.asarray(counts))
+        if self.logit_masks:
+            masks = np.ones((rows, self._vocab), bool)
+            for row, slot in enumerate(group):
+                masks[row] = self._masks[slot]
+            args += (jnp.asarray(masks),)
+        return args
+
+    def _refresh_masks(self) -> None:
+        """Rebuild every constrained slot's ``[vocab]`` mask row from its
+        host-side builder (``inference/constrain.py`` protocol: bool
+        allow-vector over the generated-so-far tokens + remaining
+        budget).  Runs once per scheduler iteration BEFORE the dispatches
+        — tokens only commit at iteration boundaries, so one refresh
+        covers prefill-emit, decode, and verify alike.  Unconstrained
+        rows were reset to all-True at admit/release and stay that way."""
+        if self._masks is None:
+            return
+        for slot, st in self._active.items():
+            mb = st.req.mask_builder
+            if mb is None:
+                continue
+            row = np.asarray(
+                mb.allowed(st.prior + st.out,
+                           st.req.max_new_tokens - st.gen_count),
+                dtype=bool)
+            if row.shape != (self._vocab,):
+                raise ValueError(
+                    f"request {st.req.uid!r}: mask_builder.allowed() "
+                    f"returned shape {row.shape}, expected "
+                    f"({self._vocab},) (the model's vocab_size)")
+            self._masks[slot, :] = row
+
     def _get_decode_fn(self):
         if self._decode_fn is None:
             fwd, prepare = self._fwd, self.engine._prepare
             K, constrain = self._K, self._constrain_pool
+            next_tokens = self._next_tokens
 
-            def decode_step(params, cache, tokens, lengths, block_tables):
+            def step_core(params, cache, tokens, lengths, block_tables,
+                          samp):
                 logits, cache = fwd(prepare(params), tokens[:, None], cache,
                                     0, lengths=lengths,
                                     block_tables=block_tables)
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
-                    constrain(cache)
+                return next_tokens(logits, samp), constrain(cache)
 
-            def decode_fused(params, cache, tokens, lengths, block_tables,
-                             active, budgets, eos_ids):
-                """K greedy steps in ONE ``lax.while_loop``: per-slot
+            def fused_core(params, cache, tokens, lengths, block_tables,
+                           active, budgets, eos_ids, samp):
+                """K decode steps in ONE ``lax.while_loop``: per-slot
                 eos/budget checks live on-device behind the fixed-shape
                 ``active`` mask; ``out[slot, i]`` is the i-th token the
                 window committed for the slot, ``-1`` past its end (eos
                 fired or per-slot budget spent).  Frozen rows keep feeding
                 their last token at a frozen length — an idempotent
                 rewrite of already-written KV, never a new position — so
-                the loop stays fixed-shape with no gather/compaction."""
+                the loop stays fixed-shape with no gather/compaction.
+                Sampled rows draw step ``i`` with the counter key
+                ``counts + i`` — the same keys the K=1 path uses, so
+                fused and plain sampled streams are token-identical."""
                 p = prepare(params)
                 out0 = jnp.full((tokens.shape[0], K), -1, jnp.int32)
 
@@ -1733,7 +1943,12 @@ class ServingEngine:
                                         lengths=lens,
                                         block_tables=block_tables)
                     cache = constrain(cache)
-                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    if samp is None:
+                        nxt = next_tokens(logits, None)
+                    else:
+                        temps, topks, topps, seeds, counts, masks = samp
+                        nxt = next_tokens(logits, (temps, topks, topps,
+                                                   seeds, counts + i, masks))
                     out = out.at[:, i].set(jnp.where(act, nxt, -1))
                     lens = lens + act.astype(lens.dtype)
                     toks = jnp.where(act, nxt, toks)
@@ -1744,6 +1959,23 @@ class ServingEngine:
                     cond, body,
                     (jnp.int32(0), tokens, lengths, cache, active, out0))
                 return out, cache
+
+            # *samp is the engine's sampling operand tail — () for
+            # sampling=False (the exact legacy programs, bit-path
+            # identical), (temps, topks, topps, seeds, counts[, masks])
+            # otherwise; _samp_args builds it to match per dispatch
+            pack = self._pack_samp
+
+            def decode_step(params, cache, tokens, lengths, block_tables,
+                            *samp):
+                return step_core(params, cache, tokens, lengths,
+                                 block_tables, pack(samp))
+
+            def decode_fused(params, cache, tokens, lengths, block_tables,
+                             active, budgets, eos_ids, *samp):
+                return fused_core(params, cache, tokens, lengths,
+                                  block_tables, active, budgets, eos_ids,
+                                  pack(samp))
 
             # the fused program REPLACES the per-token decode program —
             # same sentry entry, same compile budget
@@ -1757,11 +1989,14 @@ class ServingEngine:
                 lm_tokens = self._landmark_blocks * self.block_size
 
                 def decode_windowed(params, cache, tokens, lengths,
-                                    block_tables, window_start):
+                                    block_tables, window_start, *samp):
+                    # *samp is the engine's sampling operand tail (empty
+                    # for sampling=False) — the window wrapper stays
+                    # agnostic to it
                     with decode_attention.window_context(
                             window_start, lm_tokens):
                         return decode_step(params, cache, tokens,
-                                           lengths, block_tables)
+                                           lengths, block_tables, *samp)
 
                 body_fn = decode_windowed
             self._program_bodies["decode"] = body_fn
@@ -1782,15 +2017,22 @@ class ServingEngine:
         draft = self._draft
         constrain = self._constrain_pool
 
+        next_tokens, pack = self._next_tokens, self._pack_samp
+
         def build():
-            def prefill(params, cache, ids, block_tables, base, valid):
+            def prefill(params, cache, ids, block_tables, base, valid,
+                        *samp):
                 """ids [J, width] right-padded; base int32 [J] per-row chunk
                 start (reused-prefix length for fresh slots); valid int32
-                [J] real tokens per row (pads write to scratch block 0)."""
+                [J] real tokens per row (pads write to scratch block 0).
+                *samp is the ROW-gathered sampling tail (empty for greedy
+                engines): the first emitted token of a sampled request
+                draws with the SAME counter key (seed, emitted count) the
+                decode path would use — that is what makes preempt/crash
+                resumes, which re-emit through prefill, token-exact."""
                 logits, cache = fwd(prepare(params), ids, cache, base,
                                     lengths=valid, block_tables=block_tables)
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
-                    constrain(cache)
+                return next_tokens(logits, pack(samp)), constrain(cache)
 
             if self.resident_window_blocks:
                 # windowed prefill REPLACES the plain program (+0 budget):
@@ -1800,11 +2042,11 @@ class ServingEngine:
                 lm_tokens = self._landmark_blocks * self.block_size
 
                 def prefill_windowed(params, cache, ids, block_tables,
-                                     base, valid, window_start):
+                                     base, valid, window_start, *samp):
                     with decode_attention.window_context(
                             window_start, lm_tokens):
                         return prefill(params, cache, ids, block_tables,
-                                       base, valid)
+                                       base, valid, *samp)
 
                 self._program_bodies.setdefault("prefill", {})[width] = \
                     prefill_windowed
@@ -1822,9 +2064,9 @@ class ServingEngine:
             dprepare = draft._prepare
 
             def prefill_fused(params, dparams, cache, dcache, ids,
-                              block_tables, base, valid):
+                              block_tables, base, valid, *samp):
                 first, cache = prefill(params, cache, ids, block_tables,
-                                       base, valid)
+                                       base, valid, *samp)
                 _, dcache = dfwd(dprepare(dparams), ids, dcache, base,
                                  lengths=valid, block_tables=block_tables)
                 return first, cache, dcache
@@ -1843,22 +2085,80 @@ class ServingEngine:
 
     def _get_verify_fn(self):
         """The speculative K+1 verify program: one fixed-shape paged
-        forward through the chunked-prefill T>1 path, returning the
-        target's greedy argmax at EVERY window position (the
-        ``all_positions`` verify head) — this replaces the single-token
-        decode program entirely in speculative mode."""
+        forward through the chunked-prefill T>1 path, scoring EVERY
+        window position (the ``all_positions`` verify head) — this
+        replaces the single-token decode program entirely in speculative
+        mode.  On a sampling engine the same forward feeds the
+        distribution-exact rejection sampler (delta-proposal form of
+        Leviathan/Chen: the proposer — draft model OR n-gram — is treated
+        as a point mass at its proposed token ``d``, so accept w.p.
+        ``p_target(d)`` and resample the zeroed-``d`` residual on
+        reject); ``temperature == 0`` rows degenerate bit-exactly to the
+        greedy prefix-match, so one program serves mixed traces."""
         if self._verify_fn is None:
             fwd, prepare = self._fwd, self.engine._prepare
+            k, pack = self.spec_tokens, self._pack_samp
 
-            def verify(params, cache, ids, block_tables, base, valid):
+            def verify(params, cache, ids, block_tables, base, valid,
+                       *samp):
                 """ids [slots, K+1] = [pending, d_1..d_K] per row; base
                 int32 [slots] committed lengths; valid int32 [slots] real
                 window tokens (0 for non-decode rows — all writes land in
-                scratch)."""
+                scratch).  Greedy engines return ``(scored, cache)``;
+                sampling engines return ``(scored, accept, fallback,
+                cache)`` where ``accept[s, i]`` is the rejection verdict
+                for draft ``d_{i+1}`` and ``fallback[s, i]`` is the token
+                to emit if the host walker stops at window position ``i``
+                (residual draw on reject, plain draw at the accept cap /
+                bonus position — both exact, see docs/inference.md)."""
                 logits, cache = fwd(prepare(params), ids, cache, base,
                                     lengths=valid, block_tables=block_tables,
                                     all_positions=True)
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+                samp_t = pack(samp)
+                if samp_t is None:
+                    return jnp.argmax(logits, -1).astype(jnp.int32), cache
+                temps, topks, topps, seeds, counts, masks = samp_t
+                slots, width = ids.shape          # width == K + 1
+                flat = logits.reshape((-1, logits.shape[-1]))
+                rep = lambda x: jnp.repeat(x, width)  # noqa: E731
+                mrep = None if masks is None else \
+                    jnp.repeat(masks, width, axis=0)
+                greedy, lp = sampling_ops.filtered_logprobs(
+                    flat, rep(temps), rep(topks), rep(topps), mrep)
+                scored = greedy.reshape(slots, width)
+                lp = lp.reshape(slots, width, -1)
+                # accept test: position i decides emission counts + i
+                pos = lp[:, :-1].reshape((-1, lp.shape[-1]))  # [S*K, V]
+                drafts = ids[:, 1:].reshape(-1)
+                p_d = sampling_ops.token_probs(pos, drafts) \
+                    .reshape(slots, k)
+                u = sampling_ops.accept_uniforms(sampling_ops.grid_keys(
+                    seeds, counts, sampling_ops.SALT_ACCEPT, k))
+                accept = u < p_d
+                # fallback lane: plain draw (accept-cap / bonus stop) vs
+                # residual draw (rejection stop) share the RESIDUAL-salt
+                # key at their emission index — only one is ever consumed
+                # per position, and the accept uniforms live on their own
+                # salt, so the consumed stream stays i.i.d.
+                fkeys = sampling_ops.grid_keys(
+                    seeds, counts, sampling_ops.SALT_RESIDUAL, width)
+                fkeys = fkeys.reshape((-1,) + fkeys.shape[2:])
+                plain = sampling_ops.sample_tokens(
+                    lp.reshape((-1, lp.shape[-1])), fkeys) \
+                    .reshape(slots, width)
+                rkeys = sampling_ops.grid_keys(
+                    seeds, counts, sampling_ops.SALT_RESIDUAL, k)
+                resid = sampling_ops.sample_tokens(
+                    sampling_ops.residual_logits(pos, drafts),
+                    rkeys.reshape((-1,) + rkeys.shape[2:])) \
+                    .reshape(slots, k)
+                fallback = jnp.concatenate(
+                    [jnp.where(accept, plain[:, :k], resid),
+                     plain[:, k:]], axis=1)
+                # temp == 0 rows: bit-exact greedy (already implied by the
+                # one-hot algebra; the select makes it unconditional)
+                fallback = jnp.where(temps[:, None] > 0, fallback, scored)
+                return scored, accept, fallback, cache
 
             self._program_bodies["verify"] = verify
             self._verify_fn = jax.jit(self.sentry.wrap(verify, "verify"),
@@ -1868,29 +2168,49 @@ class ServingEngine:
         return self._verify_fn
 
     def _get_draft_fn(self):
-        """The draft rollout program: K greedy single-token steps of the
-        draft model inside ONE ``lax.scan`` — the whole proposal costs one
+        """The draft rollout program: K single-token steps of the draft
+        model inside ONE ``lax.scan`` — the whole proposal costs one
         compiled program per trace, and the draft pool advances through the
-        target's own block tables."""
+        target's own block tables.  Sampling engines draw each step from
+        the draft's own filtered distribution (DRAFT-salt counter keys, so
+        proposals are deterministic from the committed prefix — what makes
+        re-homed replay round-identical); the delta-form rejection
+        verifier needs no draft probabilities back, so the output shape is
+        unchanged.  Drafts never see logit masks: constrained slots run
+        with ``max_accept = 0`` host-side."""
         if self._draft_fn is None:
             draft = self._draft
             dfwd = draft.module.decode_hooks["forward_cached"]
             dprepare = draft._prepare
-            k = self.spec_tokens
+            k, pack = self.spec_tokens, self._pack_samp
 
-            def propose(dparams, dcache, tokens, lengths, block_tables):
+            def propose(dparams, dcache, tokens, lengths, block_tables,
+                        *samp):
                 dp = dprepare(dparams)
+                samp_t = pack(samp)
 
-                def rollout_step(carry, _):
+                def rollout_step(carry, i):
                     tok, lens, cache = carry
                     logits, cache = dfwd(dp, tok[:, None], cache, 0,
                                          lengths=lens,
                                          block_tables=block_tables)
-                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    if samp_t is None:
+                        nxt = jnp.argmax(logits, axis=-1) \
+                            .astype(jnp.int32)
+                    else:
+                        temps, topks, topps, seeds, counts, _ = samp_t
+                        greedy, lp = sampling_ops.filtered_logprobs(
+                            logits, temps, topks, topps, None)
+                        keys = sampling_ops.slot_keys(
+                            seeds, counts + i, sampling_ops.SALT_DRAFT)
+                        nxt = jnp.where(
+                            temps > 0,
+                            sampling_ops.sample_tokens(lp, keys), greedy)
                     return (nxt, lens + 1, cache), nxt
 
                 (_, _, dcache), drafts = jax.lax.scan(
-                    rollout_step, (tokens, lengths, dcache), None, length=k)
+                    rollout_step, (tokens, lengths, dcache),
+                    jnp.arange(k, dtype=jnp.int32))
                 return drafts.T, dcache            # [slots, K]
 
             self._program_bodies["draft"] = propose
@@ -2536,6 +2856,15 @@ class ServingEngine:
         self._tokens[slot] = 0
         self._lengths[slot] = 0
         self._window_start[slot] = 0
+        # idle rows revert to the greedy/unmasked defaults — their lanes
+        # still run in every dispatch (outputs discarded), so they must
+        # stay deterministic and NaN-free
+        self._temps[slot] = 0.0
+        self._topks[slot] = 0
+        self._topps[slot] = 1.0
+        self._seeds[slot] = 0
+        if self._masks is not None:
+            self._masks[slot, :] = True
 
     def _preempt(self, slot: int) -> None:
         """Evict a sequence under block pressure: free its blocks and
@@ -2754,6 +3083,13 @@ class ServingEngine:
                             slo_class=item.slo_class, handle=item.handle)
             self._admit_seq += 1
             active[slot] = st
+            if self.sampling:
+                self._temps[slot] = np.float32(req.temperature)
+                self._topks[slot] = np.int32(req.top_k)
+                self._topps[slot] = np.float32(req.top_p)
+                self._seeds[slot] = np.uint32(req.seed)
+                if self._masks is not None:
+                    self._masks[slot, :] = True
             if self.resident_window_blocks:
                 # fresh slot: full attention until the first slide
                 self._window_start[slot] = 0
@@ -2789,6 +3125,18 @@ class ServingEngine:
                 f"request {r.uid!r}: prompt ({len(r.prompt)}) + "
                 f"max_new_tokens ({r.max_new_tokens}) = {total} exceeds "
                 f"max_seq_len {self.max_seq_len}")
+        if r.sampled and not self.sampling:
+            raise ValueError(
+                f"request {r.uid!r} asks for temperature="
+                f"{r.temperature} but this engine was built with "
+                "sampling=False (greedy-only programs) — rebuild with "
+                "sampling=True to serve sampled traffic")
+        if r.mask_builder is not None and not self.logit_masks:
+            raise ValueError(
+                f"request {r.uid!r} carries a mask_builder but this "
+                "engine was built without the constrained-decoding lane "
+                "— pass logit_masks=True (the [slots, vocab] mask "
+                "operand is only threaded through the programs then)")
         if not self.chunked_prefill:
             self._bucket_for(len(r.prompt))  # raises if no bucket fits
 
@@ -2832,6 +3180,9 @@ class ServingEngine:
         self._pending.push(_PendingItem(
             req=request, prior=[], priority=priority, slo_class=slo_class,
             eos=eos_token_id, handle=handle))
+        self._c_sampled["constrained" if request.mask_builder is not None
+                        else "sampled" if request.sampled
+                        else "greedy"].inc()
         self._live_uids.add(request.uid)
         self._g_queue_depth.set(len(self._pending))
         self.timeline.instant("submit", uid=str(request.uid),
@@ -2951,6 +3302,7 @@ class ServingEngine:
         self._c_iterations.inc()
         admitted0, preempted0 = self.admitted, self.preempted
         self._admit()
+        self._refresh_masks()
         self._run_prefill(params)
         if self.role == "prefill":
             # disaggregated mode: prefill-complete slots leave the decode
@@ -2961,6 +3313,10 @@ class ServingEngine:
         # prefilling/empty slots point at the scratch block.  In
         # speculative mode the single-token step is replaced by a
         # draft–verify round committing up to K+1 tokens per slot.
+        # a slot that finished prefill above decodes in this SAME
+        # iteration — its first generated token must gate the second, so
+        # constrained rows rebuild between the two dispatches
+        self._refresh_masks()
         if self.spec_tokens:
             self._run_spec_decode(params)
         elif self._K > 1:
@@ -3391,6 +3747,7 @@ class ServingEngine:
                     jnp.asarray(self._lengths), jnp.asarray(bt))
             if self.resident_window_blocks:
                 args += (jnp.asarray(self._window_start),)
+            args += self._samp_args(self._decode_counts())
             with self._decode_ctx():
                 nxt, self._cache = self._get_decode_fn()(*args)
             nxt = np.asarray(nxt)
@@ -3441,6 +3798,13 @@ class ServingEngine:
                 st = active[slot]
                 ln = int(self._lengths[slot])
                 w = max(1, min(K, st.req.max_new_tokens - st.gen_count))
+                if self._masks is not None \
+                        and st.req.mask_builder is not None:
+                    # constrained slots advance ONE token per dispatch:
+                    # the mask row is a host-built function of every
+                    # token emitted so far, and the host can only refresh
+                    # it between dispatches
+                    w = 1
                 want[slot] = w
                 self._ensure_blocks(slot, min(ln + w, self._cache_len))
         dec = sorted(s for s, st in active.items()
@@ -3475,7 +3839,8 @@ class ServingEngine:
                     params, self._cache, jnp.asarray(self._tokens),
                     jnp.asarray(self._lengths), jnp.asarray(bt),
                     jnp.asarray(actv), jnp.asarray(budgets),
-                    jnp.asarray(eos_ids))
+                    jnp.asarray(eos_ids),
+                    *self._samp_args(self._decode_counts()))
             out, = self._fence_harvest(out)
         # ----- the fence catch-up: replay each slot's committed window
         # tokens through the exact K=1 commit sequence (emission order,
@@ -3539,6 +3904,7 @@ class ServingEngine:
             return
         bt = np.zeros_like(self._tables)
         bt[dec] = self._tables[dec]
+        samp = self._samp_args(self._decode_counts())
         with self.timeline.span(
                 "spec_propose", slots=len(dec),
                 mode="draft" if self._draft is not None else "ngram"):
@@ -3547,7 +3913,8 @@ class ServingEngine:
                     drafts, self._dcache = self._get_draft_fn()(
                         self._draft.params, self._dcache,
                         jnp.asarray(self._tokens),
-                        jnp.asarray(self._lengths), jnp.asarray(bt))
+                        jnp.asarray(self._lengths), jnp.asarray(bt),
+                        *samp)
                 drafts = np.asarray(drafts)
             else:
                 drafts = np.zeros((self.slots, k), np.int32)
@@ -3563,9 +3930,15 @@ class ServingEngine:
         valid[dec] = k + 1
         with self.timeline.span("spec_verify", slots=len(dec), window=k + 1):
             with self._tp_ctx():
-                scored, self._cache = self._get_verify_fn()(
+                out = self._get_verify_fn()(
                     params, self._cache, jnp.asarray(ids), jnp.asarray(bt),
-                    jnp.asarray(self._lengths), jnp.asarray(valid))
+                    jnp.asarray(self._lengths), jnp.asarray(valid), *samp)
+            if self.sampling:
+                scored, accept, fallback, self._cache = out
+                accept = np.asarray(accept)
+                fallback = np.asarray(fallback)
+            else:
+                scored, self._cache = out
             scored = np.asarray(scored)
         self._c_spec_rounds.inc()
         # a draft-model proposer caps acceptance at K-1: the K-th draft's
@@ -3575,11 +3948,27 @@ class ServingEngine:
         accept_lens = []
         for slot in dec:
             st = active[slot]
-            emitted, accepted, finished = greedy_accept(
-                ids[slot].tolist(), scored[slot].tolist(), max_accept,
-                st.eos, st.req.max_new_tokens - st.gen_count)
+            cap = max_accept
+            if self._masks is not None and st.req.mask_builder is not None:
+                # constrained slots accept 0 drafts per round: the mask
+                # row is host-built per emitted token, so only the first
+                # window position's (masked) distribution is valid.
+                # fallback[0] is exact there — the plain/residual blend
+                # marginalizes to the masked target distribution
+                cap = 0
+            if self.sampling:
+                emitted, accepted, finished = rejection_accept(
+                    ids[slot].tolist(), accept[slot].tolist(),
+                    fallback[slot].tolist(), cap, st.eos,
+                    st.req.max_new_tokens - st.gen_count)
+            else:
+                emitted, accepted, finished = greedy_accept(
+                    ids[slot].tolist(), scored[slot].tolist(), cap,
+                    st.eos, st.req.max_new_tokens - st.gen_count)
             self._c_drafted.inc(k)
             self._c_accepted.inc(accepted)
+            self._c_spec_rejected.inc(k - accepted)
+            self._h_accept_ratio.observe(accepted / k)
             accept_lens.append(accepted)
             st.out.extend(emitted)
             self._emit_tokens(st, emitted)
@@ -3665,6 +4054,7 @@ class ServingEngine:
             base[row] = st.base
             valid[row] = v
             rows.append((slot, v))
+        samp = self._samp_args_rows(group, j)
         with self.timeline.span("prefill", width=width, rows=len(group),
                                 slots=list(map(int, group))):
             if self._draft is not None:
@@ -3673,7 +4063,7 @@ class ServingEngine:
                         self._get_prefill_fn(width)(
                             params, self._draft.params, self._cache,
                             self._dcache, jnp.asarray(ids), jnp.asarray(bt),
-                            jnp.asarray(base), jnp.asarray(valid))
+                            jnp.asarray(base), jnp.asarray(valid), *samp)
             else:
                 args = (params, self._cache, jnp.asarray(ids),
                         jnp.asarray(bt), jnp.asarray(base),
@@ -3685,6 +4075,7 @@ class ServingEngine:
                     for row, slot in enumerate(group):
                         ws[row] = self._window_start[slot]
                     args += (jnp.asarray(ws),)
+                args += samp
                 with self._tp_ctx(), self._sp_ctx():
                     first, self._cache = self._get_prefill_fn(width)(*args)
             first = np.asarray(first)
@@ -3727,6 +4118,21 @@ class ServingEngine:
                     self._prefix.register(st.prompt_eff,
                                           self._tables[slot, :nfull],
                                           self._alloc)
+            if self.spec_tokens and self.sampling and st.prior \
+                    and st.req.sampled:
+                # spec-sampled RESUME: the original stream's token at
+                # emission index len(prior) came out of a verify round
+                # (accept/residual salts), not the prefill TOKEN salt —
+                # so don't emit here.  Back up one position instead: feed
+                # the last resumed token as the pending window head with
+                # lengths = plen_eff - 1 (the verify scatter rewrites
+                # that position's KV with identical values), and the next
+                # round starts at count len(prior) — the exact boundary
+                # the original round structure had, so replay is
+                # round-identical and token-exact
+                self._tokens[slot] = int(st.prompt_eff[-1])
+                self._lengths[slot] = st.plen_eff - 1
+                continue
             tok = int(first[row])
             st.out.append(tok)
             self._emit_tokens(st, (tok,))
@@ -3771,6 +4177,9 @@ class ServingEngine:
             "spec_tokens": self.spec_tokens,
             "ngram_max": self.ngram_max,
             "ngram_min": self.ngram_min,
+            "sampling": self.sampling,
+            "spec_verifier": self.spec_verifier,
+            "logit_masks": self.logit_masks,
             "quantize": self.quantize,
             "host_blocks": self.host_blocks,
             "swap_batch": self.swap_batch,
@@ -3902,6 +4311,15 @@ class ServingEngine:
             "accepted_tokens": self.accepted_tokens,
             "acceptance_rate": (self.accepted_tokens / self.drafted_tokens
                                 if self.drafted_tokens else 0.0),
+            # sampling stack (sampling=False: flags off, zeros — schema
+            # stays stable)
+            "sampling": self.sampling,
+            "spec_verifier": self.spec_verifier,
+            "logit_masks": self.logit_masks,
+            "sampled_requests": int(
+                self._c_sampled["sampled"].value
+                + self._c_sampled["constrained"].value),
+            "spec_draft_rejected": int(self._c_spec_rejected.value),
             # long-context lane (sp=1 / window off: 1-and-zeros — schema
             # stays stable)
             "sp": self.sp_degree,

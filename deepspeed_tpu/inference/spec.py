@@ -12,6 +12,12 @@ the target itself would have produced — the accepted prefix of the draft
 plus the target's correction token — so the output stream is token-exact
 with plain greedy decode at any acceptance rate.
 
+Two verifiers share the walker: :func:`greedy_accept` (token-exact with
+plain greedy decode) and :func:`rejection_accept` (distribution-exact
+with plain SAMPLED decode — the engine's rejection-sampling verify
+program computes the per-position accept verdicts and fallback draws on
+device; greedy is its ``temperature == 0`` degenerate case).
+
 This module is the host-side, device-free part: the n-gram proposer and
 the accept/rollback arithmetic.  Device wiring (the draft-model K-step
 program, the K+1 verify program, block accounting) lives in
@@ -69,6 +75,62 @@ def greedy_accept(window: Sequence[int], scored: Sequence[int],
             int(window[a + 1]) == int(scored[a]):
         a += 1
     candidate = [int(t) for t in window[1:a + 1]] + [int(scored[a])]
+    emitted: List[int] = []
+    finished = False
+    for tok in candidate:
+        emitted.append(tok)
+        if (eos_token_id is not None and tok == eos_token_id) or \
+                len(emitted) >= budget:
+            finished = True
+            break
+    return emitted, min(a, len(emitted)), finished
+
+
+def rejection_accept(window: Sequence[int], accept: Sequence[bool],
+                     fallback: Sequence[int], max_accept: int,
+                     eos_token_id: Optional[int],
+                     budget: int) -> Tuple[List[int], int, bool]:
+    """Distribution-exact draft verification for one sequence (the
+    delta-proposal form of Leviathan/Chen rejection sampling).
+
+    Same walker shape and emission semantics as :func:`greedy_accept`,
+    but the per-position equality test is replaced by the verify
+    program's device-computed verdicts:
+
+    accept: ``accept[i]`` is the rejection-sampler verdict for draft
+            ``d_{i+1}`` — ``u_i < p_target(d_{i+1})`` with ``u_i`` keyed
+            to the position's absolute emission index (the proposer is
+            treated as a point mass at its proposal, so this marginal is
+            exact for ANY proposer — draft model or n-gram — without
+            draft probabilities).
+    fallback: ``fallback[i]`` is the token to emit when the walk stops
+            at position ``i``: a residual-distribution draw when
+            ``accept[i]`` is False (the rejection resample), a plain
+            target-distribution draw when the walk stops for any other
+            reason — the ``max_accept`` cap, or the all-accepted bonus
+            position ``K`` (both stops are fresh draws, so the emitted
+            marginal is the target distribution either way).
+
+    ``temperature == 0`` rows are bit-identical to :func:`greedy_accept`:
+    the verify program's one-hot algebra makes ``accept[i]`` the argmax
+    equality test and ``fallback[i]`` the argmax itself.
+
+    Returns ``(emitted, accepted, finished)`` with identical semantics
+    (and identical eos/budget truncation) to :func:`greedy_accept`.
+    """
+    if budget < 1:
+        raise ValueError(f"budget must be >= 1, got {budget}")
+    k1 = len(window)
+    if len(fallback) != k1:
+        raise ValueError(f"fallback has {len(fallback)} entries for a "
+                         f"{k1}-token window")
+    if len(accept) != k1 - 1:
+        raise ValueError(f"accept has {len(accept)} verdicts for a "
+                         f"{k1}-token window (need K = {k1 - 1})")
+    a = 0
+    while a < max_accept and a + 1 < k1 and bool(accept[a]):
+        a += 1
+    candidate = [int(t) for t in window[1:a + 1]] + [int(fallback[a])]
     emitted: List[int] = []
     finished = False
     for tok in candidate:

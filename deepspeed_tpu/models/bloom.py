@@ -454,6 +454,9 @@ def build(cfg: Optional[BloomConfig] = None, **overrides) -> ModelSpec:
         # _alibi_cached_attention reads the pool only through paged_gather
         # (which dequantizes int8 records), so kv8 serving is supported
         "supports_kv_quant": True,
+        # raw next-token logits reach the serving engine's on-device
+        # sampler unchanged (per-slot temperature/top-k/top-p)
+        "supports_sampling": True,
     }
 
     pipeline_hooks = {

@@ -852,6 +852,9 @@ def build(cfg: Optional[GPT2Config] = None, **overrides) -> ModelSpec:
         # untouched (all KV reads/writes go through ops/paged_kv), so the
         # serving engine may quantize the pool (quantize="kv8")
         "supports_kv_quant": True,
+        # logits feed the on-device sampler unchanged (no fused head-side
+        # argmax / renorm), so per-slot temperature/top-k/top-p holds
+        "supports_sampling": True,
     }
 
     return ModelSpec(

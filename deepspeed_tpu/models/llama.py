@@ -436,6 +436,9 @@ def build(cfg: Optional[LlamaConfig] = None, **overrides) -> ModelSpec:
             # this family (rope applies before the cache write), so the
             # serving engine may quantize the pool (quantize="kv8")
             "supports_kv_quant": True,
+            # raw next-token logits reach the serving engine's on-device
+            # sampler unchanged (per-slot temperature/top-k/top-p)
+            "supports_sampling": True,
         },
         quant_aware=True,  # per-layer point-of-use dequant / w8a8 records
         name=f"llama-{cfg.num_layers}l-{cfg.hidden_size}d")

@@ -217,6 +217,9 @@ def build(cfg: Optional[MixtralConfig] = None, **overrides) -> ModelSpec:
         # the MoE path reads the pool only through the shared llama cached
         # attention (ops/paged_kv), so int8 records pass through untouched
         "supports_kv_quant": True,
+        # raw next-token logits reach the serving engine's on-device
+        # sampler unchanged (per-slot temperature/top-k/top-p)
+        "supports_sampling": True,
     }
 
     return ModelSpec(

@@ -533,6 +533,9 @@ def build(cfg: Optional[OPTConfig] = None, **overrides) -> ModelSpec:
         # int8 KV pool records flow through ops/paged_kv untouched
         # (quantize="kv8" in the serving engine)
         "supports_kv_quant": True,
+        # raw next-token logits reach the serving engine's on-device
+        # sampler unchanged (per-slot temperature/top-k/top-p)
+        "supports_sampling": True,
     }
 
     def _stream_embed(params, ids, pos):

@@ -1,0 +1,154 @@
+"""On-device sampling primitives for the serving engine (PR 20).
+
+Everything here is shaped so the scheduler can thread *per-slot* sampling
+state through the compiled decode/fused/verify programs as fixed-shape
+operands — ``[slots]`` knob vectors, ``[slots]`` counter vectors, and an
+optional ``[slots, vocab]`` logit mask — with **zero recompiles**: a slot
+changing temperature, or a mixed greedy+sampled batch, only changes
+operand *values*, never program shapes.
+
+Greedy is the ``temperature == 0`` row of the SAME program:
+:func:`filtered_logprobs` returns the exact one-hot (``0 / -inf``)
+distribution at the (masked) argmax for those rows, so every downstream
+draw — :func:`sample_tokens`, the rejection-sampler accept test, the
+residual fallback — degenerates bit-exactly to argmax without a single
+branch in the traced program.
+
+**Counter-based PRNG.** Each request owns one integer ``seed``; the key
+for any draw is ``fold_in(fold_in(PRNGKey(seed), salt), position)`` where
+``position`` is the absolute emitted-token index.  Keys are therefore a
+pure function of ``(seed, salt, position)`` — no mutable RNG state lives
+anywhere — which is what makes crash re-homing and preemption replay
+token-exact: the salvage path only needs to carry the request seed and
+the emitted count (``docs/inference.md`` "Sampled decoding").  The salts
+separate the independent sub-streams one emission position consumes:
+
+========  ===========================================================
+TOKEN     plain next-token draws (decode / fused decode / the prefill
+          first-token emit)
+ACCEPT    the rejection sampler's accept uniforms (verify program)
+RESIDUAL  residual + bonus categorical draws (verify fallback row)
+DRAFT     draft-model rollout draws (speculative propose program)
+========  ===========================================================
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "SALT_TOKEN", "SALT_ACCEPT", "SALT_RESIDUAL", "SALT_DRAFT",
+    "slot_keys", "grid_keys", "filtered_logprobs", "sample_tokens",
+    "accept_uniforms", "token_probs", "residual_logits",
+]
+
+SALT_TOKEN = 1
+SALT_ACCEPT = 2
+SALT_RESIDUAL = 3
+SALT_DRAFT = 4
+
+
+def slot_keys(seeds, counts, salt):
+    """``[rows]`` PRNG keys: ``fold_in(fold_in(PRNGKey(seed), salt),
+    count)`` per row — the whole counter-based scheme in one place."""
+    def one(seed, count):
+        key = jax.random.PRNGKey(seed)
+        return jax.random.fold_in(jax.random.fold_in(key, salt), count)
+
+    return jax.vmap(one)(jnp.asarray(seeds, jnp.uint32),
+                         jnp.asarray(counts, jnp.int32))
+
+
+def grid_keys(seeds, counts, salt, width):
+    """``[rows, width]`` keys for window draws: row ``s`` position ``i``
+    keys the emission index ``counts[s] + i`` (the verify/rollout window
+    grid — position ``i`` of the window IS absolute count ``c + i``)."""
+    offs = jnp.arange(int(width), dtype=jnp.int32)[None, :]
+    cnts = (jnp.asarray(counts, jnp.int32)[:, None] + offs).reshape(-1)
+    seeds2 = jnp.repeat(jnp.asarray(seeds, jnp.uint32), int(width))
+    flat = slot_keys(seeds2, cnts, salt)
+    return flat.reshape((-1, int(width)) + flat.shape[1:])
+
+
+def filtered_logprobs(logits, temps, top_k, top_p, masks=None):
+    """Per-row log-probs of the filtered sampling distribution.
+
+    ``[rows, vocab]`` logits + ``[rows]`` knobs -> ``(greedy [rows] i32,
+    logprobs [rows, vocab] f32)``.  The pipeline per row: apply the bool
+    logit mask (``-inf`` outside it; an all-False row is treated as
+    unmasked rather than poisoning the softmax), scale by ``1/temp``,
+    keep the top-k by kth-largest threshold (``top_k == 0`` = off; ties
+    at the threshold stay in), then nucleus-filter at ``top_p`` over the
+    renormalized top-k distribution (``top_p == 1`` = off; the token
+    that crosses the boundary stays in).  Rows with ``temps == 0``
+    return the exact one-hot (``0 / -inf``) at the masked argmax —
+    the greedy row of the same traced program."""
+    logits = logits.astype(jnp.float32)
+    vocab = logits.shape[-1]
+    if masks is not None:
+        ok = jnp.any(masks, axis=-1, keepdims=True)
+        logits = jnp.where(jnp.where(ok, masks, True), logits, -jnp.inf)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temps = jnp.asarray(temps, jnp.float32)[:, None]
+    scaled = logits / jnp.maximum(temps, 1e-6)
+    srt = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k = jnp.asarray(top_k, jnp.int32)
+    kidx = jnp.clip(jnp.where(k > 0, k, vocab) - 1, 0, vocab - 1)
+    kth = jnp.take_along_axis(srt, kidx[:, None], axis=-1)
+    keep = scaled >= kth
+    probs = jax.nn.softmax(jnp.where(keep, scaled, -jnp.inf), axis=-1)
+    psort = jnp.sort(probs, axis=-1)[:, ::-1]
+    before = jnp.cumsum(psort, axis=-1) - psort
+    p = jnp.asarray(top_p, jnp.float32)[:, None]
+    thr = jnp.min(jnp.where(before < p, psort, jnp.inf),
+                  axis=-1, keepdims=True)
+    keep = keep & (probs >= thr)
+    logprobs = jax.nn.log_softmax(jnp.where(keep, scaled, -jnp.inf),
+                                  axis=-1)
+    onehot = jnp.where(
+        jnp.arange(vocab)[None, :] == greedy[:, None], 0.0, -jnp.inf)
+    return greedy, jnp.where(temps > 0, logprobs, onehot)
+
+
+def sample_tokens(logprobs, keys):
+    """One categorical draw per row (``[rows, vocab]`` log-probs +
+    ``[rows]`` keys -> ``[rows]`` i32).  One-hot rows (greedy /
+    degenerate residual) come out deterministic — the single finite
+    entry wins every Gumbel race."""
+    return jax.vmap(jax.random.categorical)(keys, logprobs) \
+        .astype(jnp.int32)
+
+
+def accept_uniforms(keys):
+    """One ``U[0, 1)`` per key (any leading shape).  ``u < p(token)``
+    against a one-hot row is exact: ``p`` is exactly 1.0 or 0.0, so the
+    test never depends on ``u`` for greedy rows."""
+    flat = keys.reshape((-1,) + keys.shape[-1:])
+    u = jax.vmap(lambda k: jax.random.uniform(k))(flat)
+    return u.reshape(keys.shape[:-1])
+
+
+def token_probs(logprobs, tokens):
+    """``p(token)`` per row under the filtered distribution (``exp`` of
+    the gathered log-prob: exactly 1.0 / 0.0 on one-hot rows)."""
+    lp = jnp.take_along_axis(
+        logprobs, tokens.astype(jnp.int32)[:, None], axis=-1)[:, 0]
+    return jnp.exp(lp)
+
+
+def residual_logits(logprobs, tokens):
+    """Rejection-sampler residual per row: the filtered distribution
+    with the rejected ``token`` removed (categorical renormalizes, so
+    raw ``-inf``-masked log-probs suffice).  A row with nothing left
+    (a temp=0 row whose draft WAS the argmax — only reachable when the
+    accept test already passed) falls back to the one-hot argmax so the
+    unused lane stays NaN-free."""
+    vocab = logprobs.shape[-1]
+    idx = jnp.arange(vocab)[None, :]
+    resid = jnp.where(idx == tokens[:, None].astype(jnp.int32),
+                      -jnp.inf, logprobs)
+    dead = ~jnp.any(jnp.isfinite(resid), axis=-1, keepdims=True)
+    onehot = jnp.where(idx == jnp.argmax(logprobs, axis=-1)[:, None],
+                       0.0, -jnp.inf)
+    return jnp.where(dead, onehot, resid)

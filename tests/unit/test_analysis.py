@@ -500,6 +500,58 @@ def close(path):
     assert [f.code for f in kept] == ["GL013"]
 
 
+def test_gl014_global_rng_fires_scoped_exempts_and_pragma():
+    """GL014: process-global RNG draws (``random.*`` / ``np.random.*``
+    module singletons) in fleet-path code are interleaving-order
+    dependent — a replayed/re-homed request cannot reproduce them.
+    Seeded instance constructors through the same modules are the fix
+    spelling and must stay CLEAN."""
+    in_scope = "deepspeed_tpu/serving/router.py"
+    fires = """
+import random
+import numpy as np
+
+def jitter(base):
+    d = random.uniform(0.0, base)
+    k = np.random.randint(0, 4)
+    np.random.seed(0)
+    return d + k
+"""
+    codes = [f.code for f in lint.check_source(fires, path=in_scope)]
+    assert codes == ["GL014"] * 3, codes
+    # out of fleet scope (tests, models, analysis tools): silent
+    assert lint.check_source(fires, path="deepspeed_tpu/models/gpt2.py") \
+        == []
+    assert lint.check_source(fires) == []
+    # inference/serving.py shares GL013's file-level scope rule
+    assert [f.code for f in lint.check_source(
+        fires, path="deepspeed_tpu/inference/serving.py")] == ["GL014"] * 3
+
+    near_misses = """
+import random
+import numpy as np
+
+def jitter(base, rng, entry):
+    g = np.random.default_rng([7, 11])     # seeded instance ctor
+    r = random.Random(42)                  # seeded instance ctor
+    ss = np.random.SeedSequence(3)
+    d = rng.uniform(0.0, base)             # instance method, not module
+    k = entry.random.choice([1, 2])        # attribute chain, not np.random
+    return g.integers(0, 4) + r.random() + d + k, ss
+"""
+    assert lint.check_source(near_misses, path=in_scope) == []
+
+    pragma = """
+import random
+
+def backoff(base):
+    return random.uniform(0.0, base)  # graft: noqa(GL014) jitter, non-replayed path
+"""
+    assert lint.check_source(pragma, path=in_scope) == []
+    kept = lint.check_source(pragma, path=in_scope, keep_suppressed=True)
+    assert [f.code for f in kept] == ["GL014"]
+
+
 def test_noqa_pragma_suppresses_named_rule_only():
     src = """
 import jax

@@ -1,0 +1,210 @@
+"""On-device sampling primitives (``ops/sampling.py``) and the
+distribution-exact rejection verifier's accept walker (``spec.
+rejection_accept``) — the PR 20 unit layer under the serving tests in
+``test_sampled_serving.py``.
+
+Covers: the temperature=0 exact-one-hot contract (greedy is the zero row
+of the SAME filtered-logprobs program), top-k/top-p filtering on known
+distributions (ties-in kth threshold, nucleus boundary), logit-mask
+application, the counter-based PRNG key schedule (pure function of
+(seed, emission position, salt) — the crash re-homing determinism
+contract), empirical total-variation checks of the categorical draws,
+and the delta-form rejection identity: accept the proposed token with
+probability ``p_target(d)``, else draw from the renormalized residual —
+marginal EXACTLY ``p_target`` for ANY proposer, no draft probabilities
+needed.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.spec import rejection_accept
+from deepspeed_tpu.ops import sampling as S
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+# ------------------------------------------------------ filtered_logprobs
+def test_temp0_rows_are_exact_onehot():
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(5, 17)).astype(np.float32))
+    temps = jnp.zeros(5, jnp.float32)
+    greedy, lp = S.filtered_logprobs(logits, temps,
+                                     jnp.zeros(5, jnp.int32),
+                                     jnp.ones(5, jnp.float32))
+    np.testing.assert_array_equal(_np(greedy), _np(logits).argmax(-1))
+    lp = _np(lp)
+    for i, g in enumerate(_np(greedy)):
+        assert lp[i, g] == 0.0                       # exact, not approx
+        row = np.delete(lp[i], g)
+        assert np.all(np.isneginf(row))
+
+
+def test_topk_threshold_keeps_ties():
+    logits = jnp.asarray([[4.0, 3.0, 3.0, 1.0, 0.0]])
+    temps = jnp.ones(1, jnp.float32)
+    _, lp = S.filtered_logprobs(logits, temps, jnp.asarray([2]),
+                                jnp.ones(1, jnp.float32))
+    lp = _np(lp)[0]
+    # kth-largest (k=2) is 3.0; BOTH ties at the threshold stay in
+    assert np.isfinite(lp[[0, 1, 2]]).all()
+    assert np.isneginf(lp[[3, 4]]).all()
+    # kept mass renormalizes to 1
+    assert np.isclose(np.exp(lp[np.isfinite(lp)]).sum(), 1.0, atol=1e-6)
+
+
+def test_topp_nucleus_boundary():
+    probs = np.array([0.5, 0.3, 0.15, 0.05], np.float32)
+    logits = jnp.asarray(np.log(probs)[None, :])
+    temps = jnp.ones(1, jnp.float32)
+    for p, want in ((0.7, [0, 1]), (0.85, [0, 1, 2]), (1.0, [0, 1, 2, 3])):
+        _, lp = S.filtered_logprobs(logits, temps, jnp.zeros(1, jnp.int32),
+                                    jnp.asarray([p], jnp.float32))
+        kept = np.flatnonzero(np.isfinite(_np(lp)[0]))
+        assert kept.tolist() == want, (p, kept)
+
+
+def test_mask_applies_before_filtering_and_empty_row_is_inert():
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(2, 9)).astype(np.float32))
+    masks = np.zeros((2, 9), bool)
+    masks[0, [2, 5]] = True                 # row 0: constrained to {2, 5}
+    # row 1 all-False = the unconstrained-slot sentinel: treated unmasked
+    temps = jnp.zeros(2, jnp.float32)
+    greedy, lp = S.filtered_logprobs(logits, temps,
+                                     jnp.zeros(2, jnp.int32),
+                                     jnp.ones(2, jnp.float32),
+                                     jnp.asarray(masks))
+    assert int(greedy[0]) in (2, 5)
+    assert int(greedy[0]) == (2 if logits[0, 2] >= logits[0, 5] else 5)
+    assert int(greedy[1]) == int(_np(logits)[1].argmax())
+    lp0 = _np(lp)[0]
+    assert np.isneginf(np.delete(lp0, [int(greedy[0])])).all()
+
+
+# -------------------------------------------------------- key schedule
+def test_keys_are_pure_functions_of_seed_count_salt():
+    seeds = jnp.asarray([7, 7, 9], jnp.uint32)
+    counts = jnp.asarray([0, 3, 3], jnp.int32)
+    a = _np(S.slot_keys(seeds, counts, S.SALT_TOKEN))
+    b = _np(S.slot_keys(seeds, counts, S.SALT_TOKEN))
+    np.testing.assert_array_equal(a, b)             # pure
+    assert not np.array_equal(a[0], a[1])           # count matters
+    assert not np.array_equal(a[1], a[2])           # seed matters
+    c = _np(S.slot_keys(seeds, counts, S.SALT_RESIDUAL))
+    assert not np.array_equal(a, c)                 # salt streams disjoint
+    # grid keys ARE slot keys at offset emission counts — the fused
+    # while_loop and a step-at-a-time replay draw identical streams
+    g = _np(S.grid_keys(seeds, counts, S.SALT_TOKEN, 4))
+    for i in range(4):
+        np.testing.assert_array_equal(
+            g[:, i], _np(S.slot_keys(seeds, counts + i, S.SALT_TOKEN)))
+
+
+def _tv(counts, probs):
+    freq = counts / counts.sum()
+    return 0.5 * np.abs(freq - probs).sum()
+
+
+def test_categorical_draws_match_distribution():
+    probs = np.array([0.45, 0.25, 0.15, 0.1, 0.05], np.float32)
+    n = 4000
+    lp = jnp.asarray(np.tile(np.log(probs), (n, 1)))
+    keys = S.slot_keys(jnp.full(n, 7, jnp.uint32),
+                       jnp.arange(n, dtype=jnp.int32), S.SALT_TOKEN)
+    draws = _np(S.sample_tokens(lp, keys))
+    counts = np.bincount(draws, minlength=5).astype(float)
+    assert _tv(counts, probs) < 0.05, counts
+
+
+def test_delta_rejection_marginal_is_target_distribution():
+    """The verifier identity, adversarial case: a proposer that ALWAYS
+    proposes the same token.  accept w.p. p_target(d); reject -> draw
+    from the d-zeroed renormalized residual.  The marginal must still be
+    exactly p_target (here: empirically, TV < 0.05 at n=4000)."""
+    probs = np.array([0.4, 0.3, 0.2, 0.1], np.float32)
+    d = 3                                   # propose the LEAST likely token
+    n = 4000
+    lp = jnp.asarray(np.tile(np.log(probs), (n, 1)))
+    drafts = jnp.full((n,), d, jnp.int32)
+    seeds = jnp.full(n, 11, jnp.uint32)
+    counts = jnp.arange(n, dtype=jnp.int32)
+    u = _np(S.accept_uniforms(S.slot_keys(seeds, counts, S.SALT_ACCEPT)))
+    p_d = _np(S.token_probs(lp, drafts))
+    accept = u < p_d
+    resid_lp = S.residual_logits(lp, drafts)
+    rkeys = S.slot_keys(seeds, counts, S.SALT_RESIDUAL)
+    resid_draw = _np(S.sample_tokens(resid_lp, rkeys))
+    final = np.where(accept, d, resid_draw)
+    # rejected rows never re-emit the proposed token
+    assert not np.any(resid_draw[~accept] == d)
+    counts_f = np.bincount(final, minlength=4).astype(float)
+    assert _tv(counts_f, probs) < 0.05, counts_f
+    # acceptance rate ~ p_target(d)
+    assert abs(accept.mean() - probs[d]) < 0.03
+
+
+def test_residual_logits_masks_draft_and_dead_row_falls_back():
+    # normal row: the rejected draft goes to -inf, survivors untouched
+    lp = jnp.asarray(np.log(np.array([[0.5, 0.3, 0.2]], np.float32)))
+    out = _np(S.residual_logits(lp, jnp.asarray([1])))
+    assert np.isneginf(out[0, 1])
+    np.testing.assert_allclose(out[0, [0, 2]], _np(lp)[0, [0, 2]])
+    # one-hot row whose only token IS the draft: nothing survives, so
+    # the helper emits the argmax one-hot instead of an all--inf row
+    # (the lane is unreachable — the accept prob was exactly 1 — but it
+    # must stay NaN-free inside the traced program)
+    onehot = jnp.asarray([[0.0, -np.inf, -np.inf]], jnp.float32)
+    out = _np(S.residual_logits(onehot, jnp.asarray([0])))
+    assert out[0, 0] == 0.0 and np.isneginf(out[0, 1:]).all()
+
+
+# ------------------------------------------------------ rejection_accept
+def test_rejection_accept_walker_prefix_and_fallback():
+    # window [pending, d1..d3]; drafts 1..2 accepted, d3 rejected
+    window = [10, 11, 12, 13]
+    accept = [True, True, False]
+    fallback = [21, 22, 23, 24]
+    emitted, accepted, finished = rejection_accept(
+        window, accept, fallback, 3, None, 100)
+    # 2 accepted drafts + the residual draw AT the rejection position
+    assert emitted == [11, 12, 23] and accepted == 2 and not finished
+
+
+def test_rejection_accept_all_accepted_gets_bonus_and_cap():
+    window = [1, 2, 3, 4]
+    emitted, accepted, _ = rejection_accept(
+        window, [True, True, True], [9, 9, 9, 77], 3, None, 100)
+    assert emitted == [2, 3, 4, 77] and accepted == 3   # bonus draw
+    # draft-model cap K-1: position K's plain draw replaces the K-th
+    # draft (its KV was never written in the draft cache)
+    emitted, accepted, _ = rejection_accept(
+        window, [True, True, True], [9, 9, 55, 77], 2, None, 100)
+    assert emitted == [2, 3, 55] and accepted == 2
+
+
+def test_rejection_accept_eos_and_budget_truncate():
+    window = [1, 7, 8, 9]
+    accept = [True, True, True]
+    fb = [0, 0, 0, 5]
+    emitted, accepted, finished = rejection_accept(
+        window, accept, fb, 3, 8, 100)
+    assert emitted == [7, 8] and finished           # truncated AT eos
+    emitted, accepted, finished = rejection_accept(
+        window, accept, fb, 3, None, 2)
+    assert emitted == [7, 8] and finished           # budget
+    with pytest.raises(ValueError):
+        rejection_accept(window, accept, fb, 3, None, 0)
+    with pytest.raises(ValueError):
+        rejection_accept(window, accept, fb[:-1], 3, None, 4)
+    with pytest.raises(ValueError):
+        rejection_accept(window, accept[:-1], fb, 3, None, 4)
+
+
+def test_rejection_accept_immediate_reject_still_progresses():
+    emitted, accepted, finished = rejection_accept(
+        [5, 1, 2], [False, False], [40, 41, 42], 2, None, 100)
+    assert emitted == [40] and accepted == 0 and not finished

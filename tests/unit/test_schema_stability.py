@@ -58,6 +58,8 @@ ENGINE_STATS_KEYS = frozenset({
     "prefix_hit_tokens", "prompt_tokens", "quantize", "queue_depth",
     "requests_finished", "resume_recompute_tokens", "retraces_observed",
     "role",
+    "sampling", "spec_verifier", "logit_masks", "sampled_requests",
+    "spec_draft_rejected",
     "sp", "resident_window_blocks", "context_window_slides",
     "sp_alltoall_bytes",
     "spec_rounds", "spec_tokens", "speculative", "swap_bytes", "swap_in",
@@ -75,8 +77,9 @@ CONFIG_KEYS = frozenset({
     "max_seq_len", "ngram_max", "ngram_min", "num_blocks",
     "nvme_blocks", "nvme_high_watermark", "nvme_path", "peak_flops",
     "prefill_batch", "prefill_chunk", "prefix_caching", "prompt_buckets",
-    "quantize", "resident_window_blocks", "role", "shard_kv",
-    "slo_targets", "slots", "sp", "spec_tokens",
+    "quantize", "resident_window_blocks", "role", "sampling", "shard_kv",
+    "slo_targets", "slots", "sp", "spec_tokens", "spec_verifier",
+    "logit_masks",
     "swap_batch", "topology", "trace_capacity",
 })
 

@@ -144,6 +144,46 @@ def test_trace_determinism_slice_and_roundtrip(tmp_path):
     assert t2.working_set_tokens() == t.working_set_tokens()
 
 
+def test_trace_v2_sampling_roundtrip_and_v1_load(tmp_path):
+    from deepspeed_tpu.autotuning.trace import TRACE_VERSION, TraceEntry
+
+    # v2: sampled traces carry per-request params + deterministic seeds
+    t = sessions_trace(8, vocab=128, seed=5, temperature=0.8, top_k=20,
+                       top_p=0.9)
+    assert all(e.temperature == 0.8 and e.top_k == 20 and e.top_p == 0.9
+               and e.seed > 0 for e in t.entries)
+    # seeds are deterministic functions of the trace seed
+    t_again = sessions_trace(8, vocab=128, seed=5, temperature=0.8,
+                             top_k=20, top_p=0.9)
+    assert [e.seed for e in t.entries] == [e.seed for e in t_again.entries]
+    d = t.to_dict()
+    assert d["version"] == TRACE_VERSION == 2
+    t2 = ServingTrace.from_dict(json.loads(json.dumps(d)))
+    for e, e2 in zip(t.entries, t2.entries):
+        assert (e.temperature, e.top_k, e.top_p, e.seed) == \
+            (e2.temperature, e2.top_k, e2.top_p, e2.seed)
+    req = t2.requests()[0][0]
+    assert req.temperature == 0.8 and req.top_k == 20 \
+        and req.top_p == 0.9 and req.seed == t.entries[0].seed
+
+    # greedy traces serialize WITHOUT the sampling keys — a committed
+    # v1 BENCH trace and its v2 re-save are entry-for-entry identical
+    g = sessions_trace(4, vocab=128, seed=5)
+    for e in g.to_dict()["entries"]:
+        assert not ({"temperature", "top_k", "top_p", "seed"} & set(e))
+
+    # old-format (v1) files load and replay as greedy
+    v1 = {"version": 1, "vocab": 128, "seed": 5, "prefix_len": 0,
+          "meta": {}, "entries": [{"uid": 0, "max_new_tokens": 4,
+                                   "prompt_len": 8}]}
+    path = str(tmp_path / "v1.json")
+    json.dump(v1, open(path, "w"))
+    old = ServingTrace.load(path)
+    e = old.entries[0]
+    assert (e.temperature, e.top_k, e.top_p, e.seed) == (0.0, 0, 1.0, 0)
+    assert not old.requests()[0][0].sampled
+
+
 def test_trace_record_then_replay_same_tokens(tiny_engine):
     engine, cfg = tiny_engine
     trace = sessions_trace(6, vocab=cfg.vocab_size, seed=7, sessions=2,
@@ -304,6 +344,18 @@ def test_every_constraint_has_a_loud_ctor_twin(tiny_engine):
         ("pool_min_blocks",
          {**base, "resident_window_blocks": 4, "host_blocks": 8,
           "swap_batch": 4, "num_blocks": 5}, "resident"),
+        # PR 20: on-device sampling stack + constrained decoding
+        ("spec_sampling_needs_rejection",
+         {**base, "spec_tokens": 2, "spec_verifier": "greedy"},
+         "rejection verifier"),
+        ("spec_sampling_needs_rejection",
+         {**base, "spec_verifier": "argmax"}, "spec_verifier"),
+        ("logit_masks_excludes_dp_tp",
+         {**base, "logit_masks": True, "sampling": False},
+         "sampling"),
+        ("logit_masks_excludes_dp_tp",
+         {**base, "logit_masks": True, "engine_mode": "dp_tp",
+          "prefix_caching": False}, "logit_masks"),
     ]
     for name, kwargs, fragment in cases:
         with pytest.raises(ValueError, match=fragment):

@@ -404,6 +404,76 @@ def test_crash_rehoming_salvages_survivor_kv(tiny):
     assert survivor.prefix_hit_tokens > 0
 
 
+def _sampled_reqs(cfg, n=8, max_new=12, seed=2, temperature=0.8):
+    """Session trace with every odd request sampled (temperature/top-k/
+    top-p + its own seed) and every even one greedy — the mixed stream
+    the chaos gate must replay token-exactly."""
+    rng = np.random.default_rng([seed, 1009])
+    _, base = _session_trace(cfg, n=n, max_new=max_new, seed=seed)
+    return [Request(uid=r.uid, prompt=r.prompt,
+                    max_new_tokens=r.max_new_tokens,
+                    temperature=temperature if i % 2 else 0.0,
+                    top_k=20 if i % 2 else 0,
+                    top_p=0.95 if i % 2 else 1.0,
+                    seed=int(rng.integers(1, 2 ** 31 - 1)) if i % 2 else 0)
+            for i, r in enumerate(base)]
+
+
+def test_crash_rehoming_token_exact_under_sampling(tiny):
+    """PR 20 chaos gate: a replica dies mid-decode while serving SAMPLED
+    requests; the re-homed resumes reproduce the exact sampled streams
+    of a fault-free twin fleet.  Works because the sampler's PRNG is
+    counter-based — the key at every emission position is a pure
+    function of (request seed, tokens emitted), never of which replica
+    or scheduling interleave drew it."""
+    spec, cfg, engine = tiny
+    reqs = _sampled_reqs(cfg)
+    assert any(r.sampled for r in reqs) and any(not r.sampled for r in reqs)
+
+    free = _chaos_fleet(spec, engine.params)
+    outs_free = free.serve(reqs)
+
+    router = _chaos_fleet(spec, engine.params)
+    inj = router.arm_faults(
+        FaultPlan(seed=0, crashes=[{"replica": 1, "at_step": 4}]))
+    handles = [router.submit(r) for r in reqs]
+    while router.step():
+        pass
+    assert inj.report()["crashes_fired"] == [{"replica": 1, "step": 4}]
+    for r, h in zip(reqs, handles):
+        assert h.status == "finished", (r.uid, h.status)
+        np.testing.assert_array_equal(h.result(timeout=0),
+                                      outs_free[r.uid],
+                                      err_msg=f"uid {r.uid}")
+    st = router.stats()
+    assert st["requests_rehomed"] >= 1 and st["requests_failed"] == 0
+    audit_router(router)
+
+
+def test_crash_rehoming_token_exact_sampled_spec(tiny):
+    """Sampled speculative lane under crash: the n-gram proposer plus
+    rejection verifier re-homes token-exactly too (the resume backs up
+    to re-emit through the verify program's RESIDUAL-salt draws)."""
+    spec, cfg, engine = tiny
+    reqs = _sampled_reqs(cfg, n=6, max_new=10, seed=5, temperature=0.6)
+    mk = lambda: _mk_srv(spec, engine.params, spec_tokens=2)  # noqa: E731
+    free = ReplicaRouter([mk() for _ in range(2)], debug_checks=True)
+    outs_free = free.serve(reqs)
+
+    router = ReplicaRouter([mk() for _ in range(2)], debug_checks=True)
+    router.arm_faults(
+        FaultPlan(seed=0, crashes=[{"replica": 0, "at_step": 4}]))
+    handles = [router.submit(r) for r in reqs]
+    while router.step():
+        pass
+    for r, h in zip(reqs, handles):
+        assert h.status == "finished", (r.uid, h.status)
+        np.testing.assert_array_equal(h.result(timeout=0),
+                                      outs_free[r.uid],
+                                      err_msg=f"uid {r.uid}")
+    assert router.stats()["replica_failures"] == 1
+
+
 # ------------------------------------------------------ transport faults
 def test_transient_pull_faults_retry_with_parity(tiny):
     spec, cfg, engine = tiny
