@@ -379,6 +379,13 @@ class Request:
         if not 0 < self.top_p <= 1:
             raise ValueError(f"request {self.uid!r}: top_p must be in "
                              f"(0, 1] (1 = off), got {self.top_p}")
+        # the PRNG root is materialized as np.uint32 at admission —
+        # validate here so a bad seed is refused at submit() rather than
+        # exploding the scheduler loop mid-trace (NumPy >= 2 raises
+        # OverflowError on out-of-range uint32 casts)
+        if not 0 <= self.seed < 2 ** 32:
+            raise ValueError(f"request {self.uid!r}: seed must be in "
+                             f"[0, 2**32), got {self.seed}")
 
     @property
     def sampled(self) -> bool:
@@ -2105,12 +2112,16 @@ class ServingEngine:
                 int32 [slots] committed lengths; valid int32 [slots] real
                 window tokens (0 for non-decode rows — all writes land in
                 scratch).  Greedy engines return ``(scored, cache)``;
-                sampling engines return ``(scored, accept, fallback,
+                sampling engines return ``(scored, accept, plain, resid,
                 cache)`` where ``accept[s, i]`` is the rejection verdict
-                for draft ``d_{i+1}`` and ``fallback[s, i]`` is the token
-                to emit if the host walker stops at window position ``i``
-                (residual draw on reject, plain draw at the accept cap /
-                bonus position — both exact, see docs/inference.md)."""
+                for draft ``d_{i+1}`` and the two tail lanes are the
+                draws the host walker picks between BY STOP REASON:
+                ``resid[s, i]`` (residual draw) when the walk stopped
+                because ``accept[s, i]`` is False, ``plain[s, i]``
+                (unconditional target draw) when it stopped at the
+                accept cap or the all-accepted bonus position — a cap
+                stop never consumed the verdict, so blending on it
+                would bias the emission (see docs/inference.md)."""
                 logits, cache = fwd(prepare(params), ids, cache, base,
                                     lengths=valid, block_tables=block_tables,
                                     all_positions=True)
@@ -2135,11 +2146,17 @@ class ServingEngine:
                 u = sampling_ops.accept_uniforms(sampling_ops.grid_keys(
                     seeds, counts, sampling_ops.SALT_ACCEPT, k))
                 accept = u < p_d
-                # fallback lane: plain draw (accept-cap / bonus stop) vs
-                # residual draw (rejection stop) share the RESIDUAL-salt
-                # key at their emission index — only one is ever consumed
-                # per position, and the accept uniforms live on their own
-                # salt, so the consumed stream stays i.i.d.
+                # tail lanes: the plain draw (accept-cap / bonus stop)
+                # and the residual draw (rejection stop) share the
+                # RESIDUAL-salt key at their emission index — the host
+                # walker consumes exactly ONE of them per round (at the
+                # single stop position), and the accept uniforms live on
+                # their own salt, so the consumed stream stays i.i.d.
+                # They are returned SEPARATELY: only the walker knows the
+                # stop reason, and a cap stop (draft-model K-1 cap,
+                # constrained cap 0) leaves accept[a] unconsumed — a
+                # device-side where(accept, plain, resid) blend there
+                # would emit marginal p(x)(1 + q) / q^2 instead of p.
                 fkeys = sampling_ops.grid_keys(
                     seeds, counts, sampling_ops.SALT_RESIDUAL, width)
                 fkeys = fkeys.reshape((-1,) + fkeys.shape[2:])
@@ -2152,13 +2169,12 @@ class ServingEngine:
                     sampling_ops.residual_logits(pos, drafts),
                     rkeys.reshape((-1,) + rkeys.shape[2:])) \
                     .reshape(slots, k)
-                fallback = jnp.concatenate(
-                    [jnp.where(accept, plain[:, :k], resid),
-                     plain[:, k:]], axis=1)
                 # temp == 0 rows: bit-exact greedy (already implied by the
                 # one-hot algebra; the select makes it unconditional)
-                fallback = jnp.where(temps[:, None] > 0, fallback, scored)
-                return scored, accept, fallback, cache
+                plain = jnp.where(temps[:, None] > 0, plain, scored)
+                resid = jnp.where(temps[:, None] > 0, resid,
+                                  scored[:, :k])
+                return scored, accept, plain, resid, cache
 
             self._program_bodies["verify"] = verify
             self._verify_fn = jax.jit(self.sentry.wrap(verify, "verify"),
@@ -3934,9 +3950,10 @@ class ServingEngine:
                     params, self._cache, jnp.asarray(ids), jnp.asarray(bt),
                     jnp.asarray(self._lengths), jnp.asarray(valid), *samp)
             if self.sampling:
-                scored, accept, fallback, self._cache = out
+                scored, accept, plain, resid, self._cache = out
                 accept = np.asarray(accept)
-                fallback = np.asarray(fallback)
+                plain = np.asarray(plain)
+                resid = np.asarray(resid)
             else:
                 scored, self._cache = out
             scored = np.asarray(scored)
@@ -3952,23 +3969,40 @@ class ServingEngine:
             if self._masks is not None and st.req.mask_builder is not None:
                 # constrained slots accept 0 drafts per round: the mask
                 # row is host-built per emitted token, so only the first
-                # window position's (masked) distribution is valid.
-                # fallback[0] is exact there — the plain/residual blend
-                # marginalizes to the masked target distribution
+                # window position's (masked) distribution is valid.  The
+                # cap-0 stop emits plain[0] — an unconditional draw from
+                # the masked target distribution, exact by construction
+                # (the unconsumed accept verdict never enters)
                 cap = 0
+            budget = st.req.max_new_tokens - st.gen_count
             if self.sampling:
                 emitted, accepted, finished = rejection_accept(
                     ids[slot].tolist(), accept[slot].tolist(),
-                    fallback[slot].tolist(), cap, st.eos,
-                    st.req.max_new_tokens - st.gen_count)
+                    plain[slot].tolist(), resid[slot].tolist(), cap,
+                    st.eos, budget)
+                verdict = lambda i: bool(accept[slot][i])  # noqa: E731
             else:
                 emitted, accepted, finished = greedy_accept(
                     ids[slot].tolist(), scored[slot].tolist(), cap,
-                    st.eos, st.req.max_new_tokens - st.gen_count)
-            self._c_drafted.inc(k)
-            self._c_accepted.inc(accepted)
-            self._c_spec_rejected.inc(k - accepted)
-            self._h_accept_ratio.observe(accepted / k)
+                    st.eos, budget)
+                verdict = lambda i: int(ids[slot][i + 1]) == \
+                    int(scored[slot][i])                   # noqa: E731
+            # acceptance telemetry counts PRE-truncation verdicts over
+            # the drafts whose verdicts are real: cap-ineligible drafts
+            # (the draft-model K-th, the whole window on constrained
+            # slots) and positions past the completion budget (scratch-
+            # routed, garbage logits) are excluded rather than counted
+            # as rejections — eos/budget-truncated rounds would
+            # otherwise read artificially rejection-heavy
+            eligible = min(k, cap, budget)
+            raw = 0
+            while raw < eligible and verdict(raw):
+                raw += 1
+            self._c_drafted.inc(eligible)
+            self._c_accepted.inc(raw)
+            self._c_spec_rejected.inc(eligible - raw)
+            if eligible:
+                self._h_accept_ratio.observe(raw / eligible)
             accept_lens.append(accepted)
             st.out.extend(emitted)
             self._emit_tokens(st, emitted)

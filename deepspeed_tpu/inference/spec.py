@@ -15,8 +15,10 @@ with plain greedy decode at any acceptance rate.
 Two verifiers share the walker: :func:`greedy_accept` (token-exact with
 plain greedy decode) and :func:`rejection_accept` (distribution-exact
 with plain SAMPLED decode — the engine's rejection-sampling verify
-program computes the per-position accept verdicts and fallback draws on
-device; greedy is its ``temperature == 0`` degenerate case).
+program computes the per-position accept verdicts plus two tail-draw
+lanes (plain target draw / residual draw) on device and the walker picks
+between them by stop reason; greedy is its ``temperature == 0``
+degenerate case).
 
 This module is the host-side, device-free part: the n-gram proposer and
 the accept/rollback arithmetic.  Device wiring (the draft-model K-step
@@ -53,9 +55,9 @@ def greedy_accept(window: Sequence[int], scored: Sequence[int],
 
     Returns ``(emitted, accepted, finished)``: the tokens to append to the
     request's output this round, the number of accepted draft tokens
-    actually EMITTED — eos/budget truncation caps it, so the
-    drafted/accepted stats never count draft matches past the stopping
-    point (where ``scored`` may even be scratch-routed garbage: positions
+    actually EMITTED — eos/budget truncation caps it, so the cache
+    commit never advances over draft matches past the stopping point
+    (where ``scored`` may even be scratch-routed garbage: positions
     past the request's block budget never allocate) — (cache-commit
     advance is ``accepted + 1``: the pending token plus the accepted
     drafts; when not finished, ``emitted[-1]`` is the new pending token —
@@ -87,15 +89,16 @@ def greedy_accept(window: Sequence[int], scored: Sequence[int],
 
 
 def rejection_accept(window: Sequence[int], accept: Sequence[bool],
-                     fallback: Sequence[int], max_accept: int,
-                     eos_token_id: Optional[int],
+                     plain: Sequence[int], resid: Sequence[int],
+                     max_accept: int, eos_token_id: Optional[int],
                      budget: int) -> Tuple[List[int], int, bool]:
     """Distribution-exact draft verification for one sequence (the
     delta-proposal form of Leviathan/Chen rejection sampling).
 
     Same walker shape and emission semantics as :func:`greedy_accept`,
     but the per-position equality test is replaced by the verify
-    program's device-computed verdicts:
+    program's device-computed verdicts, and the correction token is
+    picked from one of two device-drawn lanes BY STOP REASON:
 
     accept: ``accept[i]`` is the rejection-sampler verdict for draft
             ``d_{i+1}`` — ``u_i < p_target(d_{i+1})`` with ``u_i`` keyed
@@ -103,17 +106,26 @@ def rejection_accept(window: Sequence[int], accept: Sequence[bool],
             treated as a point mass at its proposal, so this marginal is
             exact for ANY proposer — draft model or n-gram — without
             draft probabilities).
-    fallback: ``fallback[i]`` is the token to emit when the walk stops
-            at position ``i``: a residual-distribution draw when
-            ``accept[i]`` is False (the rejection resample), a plain
-            target-distribution draw when the walk stops for any other
-            reason — the ``max_accept`` cap, or the all-accepted bonus
-            position ``K`` (both stops are fresh draws, so the emitted
-            marginal is the target distribution either way).
+    plain:  ``plain[i]`` (``K + 1`` entries) is an unconditional draw
+            from the (filtered) target distribution at position ``i`` —
+            emitted when the walk stops WITHOUT consuming a rejection:
+            the ``max_accept`` cap, or the all-accepted bonus position
+            ``K``.
+    resid:  ``resid[i]`` (``K`` entries) is a draw from the
+            ``d_{i+1}``-zeroed renormalized residual — emitted only when
+            the walk stopped because ``accept[i]`` is False.
+
+    The stop reason matters: at a cap stop ``accept[a]`` was never
+    consumed, so conditioning the emission on it (e.g. a
+    ``where(accept, plain, resid)`` blend) would skew the marginal to
+    ``p(x)(1 + q)`` for non-draft tokens and ``q^2`` for the draft
+    (``q = p_target(d)``) instead of the target ``p`` — picking the lane
+    by stop reason is what keeps every emission exactly
+    target-distributed.
 
     ``temperature == 0`` rows are bit-identical to :func:`greedy_accept`:
     the verify program's one-hot algebra makes ``accept[i]`` the argmax
-    equality test and ``fallback[i]`` the argmax itself.
+    equality test and both lanes the argmax itself.
 
     Returns ``(emitted, accepted, finished)`` with identical semantics
     (and identical eos/budget truncation) to :func:`greedy_accept`.
@@ -121,16 +133,24 @@ def rejection_accept(window: Sequence[int], accept: Sequence[bool],
     if budget < 1:
         raise ValueError(f"budget must be >= 1, got {budget}")
     k1 = len(window)
-    if len(fallback) != k1:
-        raise ValueError(f"fallback has {len(fallback)} entries for a "
+    if len(plain) != k1:
+        raise ValueError(f"plain has {len(plain)} entries for a "
                          f"{k1}-token window")
+    if len(resid) != k1 - 1:
+        raise ValueError(f"resid has {len(resid)} entries for a "
+                         f"{k1}-token window (need K = {k1 - 1})")
     if len(accept) != k1 - 1:
         raise ValueError(f"accept has {len(accept)} verdicts for a "
                          f"{k1}-token window (need K = {k1 - 1})")
     a = 0
     while a < max_accept and a + 1 < k1 and bool(accept[a]):
         a += 1
-    candidate = [int(t) for t in window[1:a + 1]] + [int(fallback[a])]
+    # stopped by a rejection (verdict consumed) -> residual resample;
+    # stopped by the cap / bonus position (verdict NOT consumed) -> an
+    # unconditional fresh draw from the target
+    rejected = a < max_accept and a + 1 < k1
+    tail = int(resid[a]) if rejected else int(plain[a])
+    candidate = [int(t) for t in window[1:a + 1]] + [tail]
     emitted: List[int] = []
     finished = False
     for tok in candidate:

@@ -316,6 +316,13 @@ def test_request_and_engine_validation(tiny_engine):
         Request(uid=0, prompt=prompt, max_new_tokens=4, top_p=0.0)
     with pytest.raises(ValueError, match="top_p"):
         Request(uid=0, prompt=prompt, max_new_tokens=4, top_p=1.5)
+    # seed lands in a np.uint32 slot array at admission: out-of-range
+    # values must be refused at construction, not crash step() later
+    with pytest.raises(ValueError, match="seed"):
+        Request(uid=0, prompt=prompt, max_new_tokens=4, seed=-1)
+    with pytest.raises(ValueError, match="seed"):
+        Request(uid=0, prompt=prompt, max_new_tokens=4, seed=2 ** 32)
+    Request(uid=0, prompt=prompt, max_new_tokens=4, seed=2 ** 32 - 1)
 
     with pytest.raises(ValueError, match="sampling"):
         ServingEngine(engine, logit_masks=True, sampling=False, **_KW)

@@ -163,48 +163,75 @@ def test_residual_logits_masks_draft_and_dead_row_falls_back():
 
 
 # ------------------------------------------------------ rejection_accept
-def test_rejection_accept_walker_prefix_and_fallback():
+def test_rejection_accept_walker_prefix_and_rejection_stop():
     # window [pending, d1..d3]; drafts 1..2 accepted, d3 rejected
     window = [10, 11, 12, 13]
     accept = [True, True, False]
-    fallback = [21, 22, 23, 24]
+    plain = [21, 22, 23, 24]
+    resid = [31, 32, 33]
     emitted, accepted, finished = rejection_accept(
-        window, accept, fallback, 3, None, 100)
-    # 2 accepted drafts + the residual draw AT the rejection position
-    assert emitted == [11, 12, 23] and accepted == 2 and not finished
+        window, accept, plain, resid, 3, None, 100)
+    # 2 accepted drafts + the RESIDUAL draw at the rejection position
+    assert emitted == [11, 12, 33] and accepted == 2 and not finished
 
 
 def test_rejection_accept_all_accepted_gets_bonus_and_cap():
     window = [1, 2, 3, 4]
+    plain = [9, 9, 55, 77]
+    resid = [41, 42, 43]
     emitted, accepted, _ = rejection_accept(
-        window, [True, True, True], [9, 9, 9, 77], 3, None, 100)
-    assert emitted == [2, 3, 4, 77] and accepted == 3   # bonus draw
+        window, [True, True, True], plain, resid, 3, None, 100)
+    assert emitted == [2, 3, 4, 77] and accepted == 3   # bonus plain draw
     # draft-model cap K-1: position K's plain draw replaces the K-th
     # draft (its KV was never written in the draft cache)
     emitted, accepted, _ = rejection_accept(
-        window, [True, True, True], [9, 9, 55, 77], 2, None, 100)
+        window, [True, True, True], plain, resid, 2, None, 100)
     assert emitted == [2, 3, 55] and accepted == 2
+
+
+def test_rejection_accept_cap_stop_ignores_unconsumed_verdict():
+    """REGRESSION: a walk stopped by the accept cap (draft-model K-1,
+    constrained 0) must emit the unconditional PLAIN target draw even
+    when the verdict at the stop position happens to be False — that
+    verdict was never consumed, and conditioning on it (the old
+    device-side ``where(accept, plain, resid)`` blend) yields marginal
+    ``p(x)(1 + q)`` / ``q^2`` instead of the target distribution."""
+    window = [1, 2, 3, 4]
+    plain = [50, 51, 52, 53]
+    resid = [60, 61, 62]
+    # draft-model cap 2: accept[2] is False but the walk stopped at the
+    # cap, not on the verdict -> plain[2], never resid[2]
+    emitted, accepted, _ = rejection_accept(
+        window, [True, True, False], plain, resid, 2, None, 100)
+    assert emitted == [2, 3, 52] and accepted == 2
+    # constrained cap 0: every round is a cap stop at position 0
+    emitted, accepted, _ = rejection_accept(
+        window, [False, False, False], plain, resid, 0, None, 100)
+    assert emitted == [50] and accepted == 0
 
 
 def test_rejection_accept_eos_and_budget_truncate():
     window = [1, 7, 8, 9]
     accept = [True, True, True]
-    fb = [0, 0, 0, 5]
+    plain = [0, 0, 0, 5]
+    resid = [1, 1, 1]
     emitted, accepted, finished = rejection_accept(
-        window, accept, fb, 3, 8, 100)
+        window, accept, plain, resid, 3, 8, 100)
     assert emitted == [7, 8] and finished           # truncated AT eos
     emitted, accepted, finished = rejection_accept(
-        window, accept, fb, 3, None, 2)
+        window, accept, plain, resid, 3, None, 2)
     assert emitted == [7, 8] and finished           # budget
     with pytest.raises(ValueError):
-        rejection_accept(window, accept, fb, 3, None, 0)
+        rejection_accept(window, accept, plain, resid, 3, None, 0)
     with pytest.raises(ValueError):
-        rejection_accept(window, accept, fb[:-1], 3, None, 4)
+        rejection_accept(window, accept, plain[:-1], resid, 3, None, 4)
     with pytest.raises(ValueError):
-        rejection_accept(window, accept[:-1], fb, 3, None, 4)
+        rejection_accept(window, accept, plain, resid[:-1], 3, None, 4)
+    with pytest.raises(ValueError):
+        rejection_accept(window, accept[:-1], plain, resid, 3, None, 4)
 
 
 def test_rejection_accept_immediate_reject_still_progresses():
     emitted, accepted, finished = rejection_accept(
-        [5, 1, 2], [False, False], [40, 41, 42], 2, None, 100)
-    assert emitted == [40] and accepted == 0 and not finished
+        [5, 1, 2], [False, False], [40, 41, 42], [45, 46], 2, None, 100)
+    assert emitted == [45] and accepted == 0 and not finished
